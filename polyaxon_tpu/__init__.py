@@ -1,0 +1,28 @@
+"""polyaxon_tpu — a TPU-native ML orchestration framework.
+
+A ground-up rebuild of the capabilities of the reference ``okoye/polyaxon``
+(a Kubernetes MLOps orchestrator; see SURVEY.md for the layer map) designed
+TPU-first on JAX/XLA/pjit/Pallas:
+
+- Polyaxonfile-compatible specs (``polyflow`` IR + ``polyaxonfile`` reader)
+  compile to TPU slice launch plans instead of GPU pod specs.
+- A first-class **JAXJob** distributed runtime (``runtime``) replaces
+  TFJob/PyTorchJob/MPIJob delegation: XLA collectives over ICI inside
+  compiled step functions, ``jax.distributed`` bootstrap over DCN.
+- ``parallel`` owns meshes and sharding rules (dp/fsdp/tp/pp/sp/cp/ep).
+- ``models`` + ``ops`` own the math the reference never shipped (Llama,
+  ViT, ResNet, BERT, MNIST; Pallas flash/ring attention).
+- ``tracking``/``streams``/``sidecar`` reimplement traceml's event
+  contract with libtpu system metrics.
+- ``tune`` reimplements Polytune (grid/random/Hyperband/Bayesian opt).
+- ``controlplane``/``scheduler``/``agent`` collapse haupt + agent +
+  operator into an embedded service over a pluggable slice provider.
+
+Reference parity note: the reference mount was empty in every session so
+far (SURVEY.md §0); parity targets come from BASELINE.json's north star
+and knowledge of public upstream Polyaxon, per-claim tagged in SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+DIST = "polyaxon_tpu"

@@ -1,0 +1,1 @@
+from polyaxon_tpu.schemas.base import BaseSchema, to_camel  # noqa: F401

@@ -1,0 +1,37 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The axon PJRT plugin auto-registers via sitecustomize and pins
+``jax_platforms="axon,cpu"``; flipping the env var alone is not enough
+once ``register()`` has run, so we also update the config before any
+backend initializes. Multi-chip sharding tests then run on 8 virtual CPU
+devices exactly the way the driver's ``dryrun_multichip`` harness does.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices
+
+
+@pytest.fixture()
+def tmp_store(tmp_path):
+    """A throwaway artifacts-store root."""
+    root = tmp_path / "store"
+    root.mkdir()
+    return str(root)

@@ -1,0 +1,143 @@
+"""Polyaxonfile reader tests: loading, kind detection, presets/patching,
+interpolation — the [B] acceptance bar ("run unchanged after swapping the
+environment preset from gpu to tpu") is asserted directly here."""
+
+import os
+
+import pytest
+
+from polyaxon_tpu.polyaxonfile import (
+    PolyaxonfileError,
+    apply_presets,
+    check_polyaxonfile,
+    patch_dict,
+    render_value,
+    resolve_operation_context,
+    spec_kind,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+class TestLoading:
+    def test_all_baseline_configs_parse(self):
+        """The five BASELINE.json configs must parse and round-trip."""
+        for name in (
+            "mnist.yaml",
+            "resnet_tfjob.yaml",
+            "bert_pytorchjob.yaml",
+            "llama3_8b.yaml",
+            "hyperband_vit.yaml",
+        ):
+            op = check_polyaxonfile(fixture(name))
+            assert op.component is not None
+            round_tripped = check_polyaxonfile(op.to_dict())
+            assert round_tripped.to_dict() == op.to_dict()
+
+    def test_kind_detection(self):
+        assert spec_kind({"kind": "component", "run": {}}) == "component"
+        assert spec_kind({"run": {}}) == "component"
+        assert spec_kind({"hubRef": "x"}) == "operation"
+        with pytest.raises(PolyaxonfileError):
+            spec_kind({"foo": 1})
+
+    def test_component_becomes_operation(self):
+        op = check_polyaxonfile(fixture("mnist.yaml"))
+        assert op.kind == "operation"
+        assert op.component.name == "mnist-quickstart"
+        assert op.component.run_kind == "jaxjob"
+
+    def test_cli_params_override(self):
+        op = check_polyaxonfile(fixture("mnist.yaml"), params={"lr": 0.01})
+        assert op.params["lr"].value == 0.01
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(ValueError):
+            check_polyaxonfile(fixture("mnist.yaml"), params={"nope": 1})
+
+
+class TestPatch:
+    def test_post_merge(self):
+        base = {"a": {"x": 1, "y": 2}, "keep": True, "lst": [1, 2]}
+        patch = {"a": {"y": 3, "z": 4}, "lst": [9]}
+        out = patch_dict(base, patch, "post_merge")
+        assert out == {"a": {"x": 1, "y": 3, "z": 4}, "keep": True, "lst": [9]}
+
+    def test_pre_merge(self):
+        out = patch_dict({"a": {"y": 2}}, {"a": {"y": 3, "z": 4}}, "pre_merge")
+        assert out == {"a": {"y": 2, "z": 4}}
+
+    def test_isnull(self):
+        out = patch_dict({"a": None, "b": 1}, {"a": 5, "b": 9}, "isnull")
+        assert out == {"a": 5, "b": 1}
+
+    def test_replace(self):
+        out = patch_dict({"a": {"deep": 1}}, {"a": {"flat": 2}}, "replace")
+        assert out == {"a": {"flat": 2}}
+
+
+class TestPresets:
+    def test_gpu_to_tpu_preset_swap(self):
+        """[B] acceptance: same Polyaxonfile, swap preset gpu→tpu."""
+        op_gpu = check_polyaxonfile(fixture("mnist.yaml"), presets=[fixture("presets/gpu.yaml")])
+        env = op_gpu.run_patch["environment"]
+        assert "gke-accelerator" in str(env.get("nodeSelector", {}))
+
+        op_tpu = check_polyaxonfile(fixture("mnist.yaml"), presets=[fixture("presets/tpu.yaml")])
+        env = op_tpu.run_patch["environment"]
+        assert env["tpu"]["accelerator"] == "v5e"
+        assert env["tpu"]["topology"] == "2x4"
+        # The underlying component spec is untouched — only the patch differs.
+        assert op_tpu.component.to_dict() == op_gpu.component.to_dict()
+
+    def test_presets_apply_in_order(self):
+        op = check_polyaxonfile(
+            fixture("mnist.yaml"),
+            presets=[fixture("presets/gpu.yaml"), fixture("presets/tpu.yaml")],
+        )
+        env = op.run_patch["environment"]
+        assert env["tpu"]["accelerator"] == "v5e"
+
+
+class TestInterpolation:
+    def test_render_preserves_types(self):
+        ctx = {"params": {"lr": 0.1, "steps": 10, "name": "x"}}
+        assert render_value("{{ params.lr }}", ctx) == 0.1
+        assert render_value("{{ params.steps }}", ctx) == 10
+        assert render_value("lr={{ params.lr }}", ctx) == "lr=0.1"
+        assert render_value(["--lr", "{{ params.lr }}"], ctx) == ["--lr", 0.1]
+
+    def test_resolve_operation(self):
+        op = check_polyaxonfile(fixture("llama3_8b.yaml"))
+        resolved = resolve_operation_context(
+            op, run_uuid="abc", project_name="llm", artifacts_root="/tmp/store"
+        )
+        runtime = resolved.component.run.runtime
+        assert runtime["learning_rate"] == 0.0003
+        assert runtime["seq_len"] == 8192
+
+    def test_globals_paths(self):
+        op = check_polyaxonfile(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "job",
+                    "container": {
+                        "image": "busybox",
+                        "command": ["echo", "{{ globals.run_outputs_path }}"],
+                    },
+                },
+            }
+        )
+        resolved = resolve_operation_context(op, run_uuid="u1", artifacts_root="/store")
+        assert resolved.component.run.container.command[1] == "/store/u1/outputs"
+
+    def test_strict_undefined_raises(self):
+        from polyaxon_tpu.polyaxonfile import ContextError
+
+        with pytest.raises(ContextError):
+            render_value("{{ params.missing }}", {"params": {}})

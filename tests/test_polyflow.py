@@ -1,0 +1,271 @@
+"""Spec/IR unit tests (SURVEY.md §4: the bulk of the reference's suite is
+pure spec tests over schemas, parsing, matrix math, lifecycle)."""
+
+import math
+
+import pytest
+
+from polyaxon_tpu import lifecycle
+from polyaxon_tpu.lifecycle import StatusTracker, V1Statuses
+from polyaxon_tpu.polyflow import (
+    V1Bayes,
+    V1Component,
+    V1GridSearch,
+    V1Hyperband,
+    V1HpChoice,
+    V1IO,
+    V1JAXJob,
+    V1MeshSpec,
+    V1Operation,
+    V1Param,
+    V1RandomSearch,
+    V1TpuTopology,
+    validate_params_against_io,
+)
+from polyaxon_tpu.polyflow.io import parse_value
+from polyaxon_tpu.polyflow.matrix import V1HpLinSpace, V1HpLogUniform, V1HpRange
+
+
+class TestIO:
+    def test_parse_scalars(self):
+        assert parse_value("3", "int") == 3
+        assert parse_value(3.0, "int") == 3
+        assert parse_value("0.5", "float") == 0.5
+        assert parse_value("true", "bool") is True
+        assert parse_value("off", "bool") is False
+        assert parse_value(5, "str") == "5"
+        with pytest.raises(ValueError):
+            parse_value("3.5", "int")
+        with pytest.raises(ValueError):
+            parse_value({"a": 1}, "str")
+        with pytest.raises(ValueError):
+            parse_value("maybe", "bool")
+
+    def test_required_and_defaults(self):
+        io = V1IO(name="lr", type="float", value=0.1, is_optional=True)
+        assert io.validate_value(None) == 0.1
+        assert io.validate_value("0.2") == 0.2
+        required = V1IO(name="steps", type="int")
+        with pytest.raises(ValueError):
+            required.validate_value(None)
+
+    def test_options_and_lists(self):
+        io = V1IO(name="opt", type="str", options=["adam", "sgd"], is_optional=True, value="adam")
+        assert io.validate_value("sgd") == "sgd"
+        with pytest.raises(ValueError):
+            io.validate_value("lamb")
+        lst = V1IO(name="dims", type="int", is_list=True)
+        assert lst.validate_value(["1", 2]) == [1, 2]
+
+    def test_params_against_io(self):
+        inputs = [
+            V1IO(name="lr", type="float"),
+            V1IO(name="steps", type="int", value=10, is_optional=True),
+        ]
+        resolved = validate_params_against_io({"lr": V1Param(value="0.3")}, inputs)
+        assert resolved == {"lr": 0.3, "steps": 10}
+        with pytest.raises(ValueError):
+            validate_params_against_io({"bogus": V1Param(value=1)}, inputs)
+        with pytest.raises(ValueError):
+            validate_params_against_io({}, [V1IO(name="lr", type="float")])
+
+    def test_ref_params(self):
+        p = V1Param(ref="runs.abc123.outputs.accuracy")
+        assert p.is_runs_ref
+        assert p.get_ref_parts() == ("runs", "abc123", "outputs.accuracy")
+
+
+class TestMatrix:
+    def test_grid_enumeration(self):
+        grid = V1GridSearch(
+            params={
+                "lr": V1HpChoice(kind="choice", value=[0.1, 0.01]),
+                "bs": V1HpRange(kind="range", value=[32, 97, 32]),
+            }
+        )
+        assert grid.params["lr"].to_grid() == [0.1, 0.01]
+        assert grid.params["bs"].to_grid() == [32, 64, 96]
+
+    def test_linspace(self):
+        hp = V1HpLinSpace(kind="linspace", value=[0, 1, 5])
+        assert hp.to_grid() == [0, 0.25, 0.5, 0.75, 1.0]
+
+    def test_random_sampling_deterministic(self):
+        import random
+
+        hp = V1HpLogUniform(kind="loguniform", value={"low": math.log(1e-4), "high": math.log(1e-1)})
+        rng = random.Random(7)
+        samples = [hp.sample(rng) for _ in range(50)]
+        assert all(1e-4 <= s <= 1e-1 for s in samples)
+        rng2 = random.Random(7)
+        assert samples == [hp.sample(rng2) for _ in range(50)]
+
+    def test_hyperband_bracket_math(self):
+        hb = V1Hyperband.from_dict(
+            {
+                "kind": "hyperband",
+                "maxIterations": 81,
+                "eta": 3,
+                "resource": {"name": "epochs", "type": "int"},
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "params": {"lr": {"kind": "choice", "value": [0.1]}},
+            }
+        )
+        assert hb.s_max == 4
+        assert hb.B == 5 * 81
+        # Hyperband paper (Li et al., JMLR 18) Table: R=81, eta=3 →
+        # n = ceil((s_max+1) * eta^s / (s+1)), r = R * eta^-s.
+        assert hb.bracket(4) == (81, 1)
+        assert hb.bracket(3) == (34, 3)
+        assert hb.bracket(2) == (15, 9)
+        assert hb.bracket(1) == (8, 27)
+        assert hb.bracket(0) == (5, 81)
+
+    def test_bayes_spec(self):
+        bayes = V1Bayes.from_dict(
+            {
+                "kind": "bayes",
+                "numInitialRuns": 5,
+                "maxIterations": 20,
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "utilityFunction": {"acquisitionFunction": "ei"},
+                "params": {"lr": {"kind": "uniform", "value": {"low": 0.0, "high": 1.0}}},
+            }
+        )
+        assert bayes.metric.is_better(0.1, 0.5)
+        assert bayes.utility_function.acquisition_function == "ei"
+
+    def test_pchoice_probabilities(self):
+        from polyaxon_tpu.polyflow import V1HpPChoice
+
+        with pytest.raises(ValueError):
+            V1HpPChoice(kind="pchoice", value=[("a", 0.5), ("b", 0.2)])
+
+
+class TestRunKinds:
+    def test_jaxjob_mesh_validation(self):
+        job = V1JAXJob.from_dict(
+            {
+                "kind": "jaxjob",
+                "runtime": {"model": "llama3_8b"},
+                "topology": {"accelerator": "v5e", "topology": "8x8"},
+                "mesh": {"axes": {"dp": 1, "fsdp": 64}},
+            }
+        )
+        assert job.get_topology().total_chips() == 64
+        assert job.mesh.resolved_axes(64) == {"dp": 1, "fsdp": 64}
+
+    def test_mesh_fill_axis(self):
+        mesh = V1MeshSpec(axes={"dp": 2, "fsdp": -1})
+        assert mesh.resolved_axes(8) == {"dp": 2, "fsdp": 4}
+        with pytest.raises(ValueError):
+            mesh.resolved_axes(9)
+        with pytest.raises(ValueError):
+            V1MeshSpec(axes={"dp": -1, "fsdp": -1})
+
+    def test_topology_math(self):
+        topo = V1TpuTopology(accelerator="v5e", topology="4x8", slices=2)
+        assert topo.chips_per_slice() == 32
+        assert topo.total_chips() == 64
+        assert topo.hosts_per_slice() == 8
+        with pytest.raises(ValueError):
+            V1TpuTopology(accelerator="v5e", topology="4xx")
+
+    def test_jaxjob_requires_payload(self):
+        with pytest.raises(ValueError):
+            V1JAXJob.from_dict({"kind": "jaxjob"})
+
+    def test_dcn_axes_must_divide_slices(self):
+        with pytest.raises(ValueError):
+            V1JAXJob.from_dict(
+                {
+                    "kind": "jaxjob",
+                    "runtime": {"model": "x"},
+                    "topology": {"accelerator": "v5e", "topology": "2x4", "slices": 1},
+                    "mesh": {"axes": {"dp": 2, "fsdp": 4}, "dcnAxes": ["dp"]},
+                }
+            )
+
+    def test_kubeflow_kinds(self):
+        comp = V1Component.from_dict(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "tfjob",
+                    "worker": {"replicas": 4, "container": {"image": "x"}},
+                },
+            }
+        )
+        assert comp.run_kind == "tfjob"
+        assert comp.run.replica_map()["worker"].replicas == 4
+        assert not comp.is_native_kind()
+
+
+class TestOperation:
+    def test_requires_component_source(self):
+        with pytest.raises(ValueError):
+            V1Operation.from_dict({"kind": "operation", "name": "x"})
+
+    def test_single_source(self):
+        with pytest.raises(ValueError):
+            V1Operation.from_dict(
+                {
+                    "kind": "operation",
+                    "hubRef": "a",
+                    "component": {"run": {"kind": "job", "container": {"image": "i"}}},
+                }
+            )
+
+    def test_camel_round_trip(self):
+        op = V1Operation.from_dict(
+            {
+                "kind": "operation",
+                "hubRef": "tensorboard",
+                "runPatch": {"container": {"image": "z"}},
+                "skipOnUpstreamSkip": True,
+            }
+        )
+        data = op.to_dict()
+        assert data["hubRef"] == "tensorboard"
+        assert data["skipOnUpstreamSkip"] is True
+        assert "skip_on_upstream_skip" not in data
+
+
+class TestLifecycle:
+    def test_happy_path(self):
+        tracker = StatusTracker()
+        for status in (
+            V1Statuses.COMPILED,
+            V1Statuses.QUEUED,
+            V1Statuses.SCHEDULED,
+            V1Statuses.STARTING,
+            V1Statuses.RUNNING,
+            V1Statuses.SUCCEEDED,
+        ):
+            tracker.transition(status)
+        assert tracker.is_done
+        assert len(tracker.conditions) == 7
+
+    def test_illegal_transitions(self):
+        tracker = StatusTracker()
+        with pytest.raises(lifecycle.LifecycleError):
+            tracker.transition(V1Statuses.RUNNING)
+        tracker.transition(V1Statuses.COMPILED)
+        tracker.transition(V1Statuses.QUEUED)
+        tracker.transition(V1Statuses.STOPPED)  # universal edge
+        with pytest.raises(lifecycle.LifecycleError):
+            tracker.transition(V1Statuses.RUNNING)
+
+    def test_preemption_cycle(self):
+        tracker = StatusTracker()
+        for status in (
+            V1Statuses.COMPILED,
+            V1Statuses.QUEUED,
+            V1Statuses.SCHEDULED,
+            V1Statuses.RUNNING,
+            V1Statuses.PREEMPTED,
+            V1Statuses.RETRYING,
+            V1Statuses.QUEUED,
+        ):
+            tracker.transition(status)
+        assert tracker.status == V1Statuses.QUEUED
