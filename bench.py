@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Headline benchmark: JAXJob LM training throughput, tokens/sec/chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md: ``published == {}``), so
+``vs_baseline`` is the ratio against the recorded target in
+``bench_baseline.json`` (written on first successful run; 1.0 until a
+prior round exists to compare with).
+
+Runs on whatever the default JAX backend is — the axon TPU v5e emulator
+in this environment, a real chip under the driver. Model is a ~200M-param
+Llama proxy (8B does not fit one v5e chip with optimizer state); metric
+is normalized per chip.
+
+Usage: python bench.py [--smoke] [--model llama_200m] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true", help="tiny fast run (CI)")
+    parser.add_argument("--model", default="llama_200m")
+    parser.add_argument("--steps", type=int, default=None)
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--seq", type=int, default=None)
+    args = parser.parse_args()
+
+    import jax
+
+    from polyaxon_tpu.polyflow import V1JAXJob
+    from polyaxon_tpu.runtime import run_jaxjob
+
+    if args.smoke:
+        model, steps, batch, seq = "llama_tiny", 8, 2, 64
+    else:
+        model = args.model
+        steps = args.steps or 30
+        batch = args.batch or 8
+        seq = args.seq or 2048
+
+    n_chips = jax.device_count()
+    job = V1JAXJob.from_dict(
+        {
+            "kind": "jaxjob",
+            "mesh": {"axes": {"dp": 1, "fsdp": -1}} if n_chips > 1 else {"axes": {"dp": 1}},
+            "runtime": {
+                "model": model,
+                "dataset": "lm_synthetic",
+                "steps": steps,
+                "optimizer": "adamw",
+                "learning_rate": 3e-4,
+                "global_batch_size": batch * n_chips,
+                "seq_len": seq,
+                "log_every": 10**9,
+                "remat": "none" if args.smoke else "dots",
+            },
+        }
+    )
+    result = run_jaxjob(job)
+    tokens_per_sec_per_chip = result.throughput / max(n_chips, 1)
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    vs_baseline = 1.0
+    record = {
+        "model": model, "steps": result.steps, "seq": seq,
+        "tokens_per_sec_per_chip": tokens_per_sec_per_chip,
+        "params": result.param_count, "n_chips": n_chips,
+        "backend": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+    }
+    try:
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as fh:
+                prior = json.load(fh)
+            prior_tps = prior.get("tokens_per_sec_per_chip")
+            if prior_tps and prior.get("model") == model and prior.get("seq") == seq:
+                vs_baseline = tokens_per_sec_per_chip / prior_tps
+        elif not args.smoke:
+            with open(baseline_path, "w") as fh:
+                json.dump(record, fh, indent=2)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": f"jaxjob_train_tokens_per_sec_per_chip[{model},seq{seq}]",
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

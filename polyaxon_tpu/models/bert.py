@@ -1,0 +1,123 @@
+"""BERT masked-LM pretraining model (BASELINE config 3's capability,
+rebuilt JAX-native instead of delegating to torch-xla in a container)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import encoder
+from polyaxon_tpu.models.common import (
+    Batch,
+    ModelDef,
+    Variables,
+    cross_entropy_loss,
+    layer_norm,
+    scaled_init,
+    truncated_normal_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    dim: int = 1024          # bert-large
+    n_layers: int = 24
+    n_heads: int = 16
+    ffn_dim: int = 4096
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"
+
+    def encoder_config(self) -> encoder.EncoderConfig:
+        return encoder.EncoderConfig(
+            dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
+            ffn_dim=self.ffn_dim, dtype=self.dtype, remat=self.remat,
+        )
+
+
+CONFIGS: dict[str, BertConfig] = {
+    "bert_large": BertConfig(),
+    "bert_base": BertConfig(dim=768, n_layers=12, n_heads=12, ffn_dim=3072),
+    "bert_tiny": BertConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                            ffn_dim=128, max_seq_len=64),
+}
+
+
+def init(cfg: BertConfig, rng: jax.Array) -> Variables:
+    keys = jax.random.split(rng, 6)
+    params = {
+        "tok_embed": truncated_normal_init(keys[0], (cfg.vocab_size, cfg.dim)),
+        "pos_embed": truncated_normal_init(keys[1], (cfg.max_seq_len, cfg.dim)),
+        "type_embed": truncated_normal_init(keys[2], (cfg.type_vocab_size, cfg.dim)),
+        "embed_ln_scale": jnp.ones((cfg.dim,)),
+        "embed_ln_bias": jnp.zeros((cfg.dim,)),
+        "layers": encoder.init_layers(cfg.encoder_config(), keys[3]),
+        "mlm_dense": scaled_init(keys[4], (cfg.dim, cfg.dim), fan_in=cfg.dim),
+        "mlm_bias": jnp.zeros((cfg.dim,)),
+        "mlm_ln_scale": jnp.ones((cfg.dim,)),
+        "mlm_ln_bias": jnp.zeros((cfg.dim,)),
+        "mlm_out_bias": jnp.zeros((cfg.vocab_size,)),
+    }
+    return {"params": params, "state": {}}
+
+
+def logical_axes(cfg: BertConfig) -> Variables:
+    return {
+        "params": {
+            "tok_embed": ("vocab", "embed"),
+            "pos_embed": ("seq", "embed"),
+            "type_embed": (None, "embed"),
+            "embed_ln_scale": ("embed",),
+            "embed_ln_bias": ("embed",),
+            "layers": encoder.layers_logical_axes(),
+            "mlm_dense": ("embed", "embed"),
+            "mlm_bias": ("embed",),
+            "mlm_ln_scale": ("embed",),
+            "mlm_ln_bias": ("embed",),
+            "mlm_out_bias": ("vocab",),
+        },
+        "state": {},
+    }
+
+
+def forward(cfg: BertConfig, params: dict, tokens: jax.Array,
+            type_ids: Optional[jax.Array] = None) -> jax.Array:
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["tok_embed"].astype(dt)[tokens]
+    x = x + params["pos_embed"].astype(dt)[None, :S]
+    if type_ids is not None:
+        x = x + params["type_embed"].astype(dt)[type_ids]
+    x = layer_norm(x, params["embed_ln_scale"], params["embed_ln_bias"])
+    x = encoder.encode(cfg.encoder_config(), params["layers"], x)
+    # MLM head: dense + gelu + LN, tied output embedding.
+    h = jax.nn.gelu(x @ params["mlm_dense"].astype(dt) + params["mlm_bias"].astype(dt))
+    h = layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"])
+    logits = h @ params["tok_embed"].astype(dt).T + params["mlm_out_bias"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def apply(cfg: BertConfig, variables: Variables, batch: Batch, train: bool = True,
+          rng: Optional[jax.Array] = None):
+    """``batch``: tokens [B,S] (with [MASK] ids already substituted),
+    labels [B,S] (-1 at unmasked positions), optional type_ids."""
+    logits = forward(cfg, variables["params"], batch["tokens"], batch.get("type_ids"))
+    loss, acc = cross_entropy_loss(logits, batch["labels"])
+    return loss, {"loss": loss, "accuracy": acc}, variables["state"]
+
+
+def model_def(name: str, **overrides) -> ModelDef:
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ModelDef(
+        name=name,
+        init=functools.partial(init, cfg),
+        apply=functools.partial(apply, cfg),
+        logical_axes=functools.partial(logical_axes, cfg),
+        unit="tokens",
+    )

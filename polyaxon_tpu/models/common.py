@@ -1,0 +1,98 @@
+"""Model convention for the built-in zoo.
+
+The reference ships no model math at all (SURVEY.md §2b: delegated to
+user containers); this zoo is net-new surface that makes the BASELINE
+configs runnable end-to-end. Every model is a pure-JAX pytree module:
+
+- ``init(rng) -> Variables``            params + (optional) mutable state
+- ``apply(variables, batch, train, rng) -> (loss, metrics, new_state)``
+- ``logical_axes() -> Variables``-shaped pytree of logical-axis tuples
+  consumed by ``parallel.sharding`` rule tables.
+
+Design choices are TPU-first: weights in fp32 master copies, compute in
+bfloat16 (MXU-native), losses/softmax in fp32; transformer layers are
+*stacked* along a leading ``layers`` dim and executed with ``lax.scan``
+(one compiled layer body instead of L unrolled copies — small HLO, fast
+compile, remat-friendly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Variables = dict[str, Any]  # {"params": pytree, "state": pytree}
+Batch = dict[str, jax.Array]
+Metrics = dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDef:
+    name: str
+    init: Callable[[jax.Array], Variables]
+    apply: Callable[..., tuple[jax.Array, Metrics, Any]]
+    logical_axes: Callable[[], Variables]
+    # tokens (LM) or samples (vision) consumed per batch element; used by
+    # the runtime for throughput accounting.
+    unit: str = "examples"
+
+
+def truncated_normal_init(rng, shape, dtype=jnp.float32, stddev=0.02):
+    return stddev * jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype)
+
+
+def scaled_init(rng, shape, dtype=jnp.float32, *, fan_in: Optional[int] = None):
+    """LeCun-style scaling by fan-in (default: product of all but last axis)."""
+    import math
+
+    if fan_in is None:
+        fan_in = shape[0] if len(shape) <= 2 else math.prod(shape[:-1])
+    stddev = 1.0 / math.sqrt(max(int(fan_in), 1))
+    return truncated_normal_init(rng, shape, dtype, stddev=stddev)
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [..., vocab] any float dtype; upcast internally
+    labels: jax.Array,  # [...] int32
+    mask: Optional[jax.Array] = None,  # [...] 0/1
+) -> tuple[jax.Array, jax.Array]:
+    """Mean CE over unmasked positions (fp32), plus accuracy."""
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    labels_clipped = jnp.maximum(labels, 0)
+    nll = -jnp.take_along_axis(log_probs, labels_clipped[..., None], axis=-1)[..., 0]
+    correct = (jnp.argmax(logits, axis=-1) == labels_clipped).astype(jnp.float32)
+    if mask is None:
+        mask = (labels >= 0).astype(jnp.float32)
+    else:
+        mask = mask.astype(jnp.float32) * (labels >= 0).astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = (correct * mask).sum() / denom
+    return loss, acc
+
+
+def shift_right(tokens: jax.Array, bos_id: int = 0) -> jax.Array:
+    """Next-token LM inputs: tokens shifted right with BOS at position 0."""
+    return jnp.concatenate(
+        [jnp.full_like(tokens[:, :1], bos_id), tokens[:, :-1]], axis=1
+    )

@@ -1,0 +1,103 @@
+"""Bidirectional transformer encoder block shared by ViT and BERT.
+
+Same TPU-first construction as the Llama decoder (stacked params +
+``lax.scan``, bf16 compute, fp32 norms/softmax), with LayerNorm + GELU
+and learned position embeddings, no causal mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.common import layer_norm, scaled_init
+from polyaxon_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    dim: int
+    n_layers: int
+    n_heads: int
+    ffn_dim: int
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def init_layers(cfg: EncoderConfig, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, 6)
+    L, D, F, H = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_heads
+    return {
+        "ln1_scale": jnp.ones((L, D)),
+        "ln1_bias": jnp.zeros((L, D)),
+        "wqkv": scaled_init(keys[0], (L, D, 3 * D), fan_in=D),
+        "wo": scaled_init(keys[1], (L, D, D), fan_in=D),
+        "ln2_scale": jnp.ones((L, D)),
+        "ln2_bias": jnp.zeros((L, D)),
+        "w_up": scaled_init(keys[2], (L, D, F), fan_in=D),
+        "b_up": jnp.zeros((L, F)),
+        "w_down": scaled_init(keys[3], (L, F, D), fan_in=F),
+        "b_down": jnp.zeros((L, D)),
+    }
+
+
+def layers_logical_axes() -> dict:
+    return {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "wqkv": ("layers", "embed", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"),
+        "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "b_down": ("layers", "embed"),
+    }
+
+
+def _layer(cfg: EncoderConfig, x: jax.Array, layer: dict) -> jax.Array:
+    B, S, D = x.shape
+    H, Hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], cfg.norm_eps)
+    qkv = h @ layer["wqkv"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, Hd)
+    k = k.reshape(B, S, H, Hd)
+    v = v.reshape(B, S, H, Hd)
+    attn = dot_product_attention(q, k, v, causal=False, impl=cfg.attention_impl)
+    x = x + attn.reshape(B, S, D) @ layer["wo"].astype(dt)
+
+    h = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], cfg.norm_eps)
+    h = jax.nn.gelu(h @ layer["w_up"].astype(dt) + layer["b_up"].astype(dt))
+    x = x + (h @ layer["w_down"].astype(dt) + layer["b_down"].astype(dt))
+    return x
+
+
+def encode(cfg: EncoderConfig, layers: dict, x: jax.Array) -> jax.Array:
+    """[B, S, D] → [B, S, D] through the stacked encoder."""
+    body = functools.partial(_layer, cfg)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        )
+
+    def scan_body(carry, layer_params):
+        return body(carry, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, layers)
+    return x

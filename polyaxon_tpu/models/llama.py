@@ -1,0 +1,210 @@
+"""Llama-3-style decoder-only transformer (the flagship JAXJob model).
+
+Target of the BASELINE north star [B]: "Llama-3-8B, FSDP over ICI on
+v5e-64". TPU-first construction:
+
+- stacked layer params + ``lax.scan`` body → one compiled block,
+  remat-able per layer (``jax.checkpoint`` policies map to the spec's
+  ``remat`` knob);
+- GQA attention (RoPE, fp32 softmax) through ``ops.attention`` so the
+  impl can swap xla ↔ Pallas flash ↔ ring (context parallel);
+- bf16 activations/compute, fp32 master weights, fp32 loss;
+- logical axes on every param so FSDP/TP/CP rule tables place them
+  (``parallel.sharding``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.common import (
+    Batch,
+    ModelDef,
+    Variables,
+    cross_entropy_loss,
+    rms_norm,
+    scaled_init,
+    shift_right,
+    truncated_normal_init,
+)
+from polyaxon_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"  # none | full | dots (checkpoint policy per layer)
+    attention_impl: str = "xla"  # xla | flash | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Named configs. llama3_8b matches the Llama-3-8B architecture; the
+# smaller ones are proxies for single-chip benchmarking and tests.
+CONFIGS: dict[str, LlamaConfig] = {
+    "llama3_8b": LlamaConfig(),
+    "llama3_1b": LlamaConfig(
+        vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        ffn_dim=8192, max_seq_len=8192,
+    ),
+    "llama_200m": LlamaConfig(
+        vocab_size=32_000, dim=1024, n_layers=12, n_heads=16, n_kv_heads=8,
+        ffn_dim=2816, max_seq_len=2048, rope_theta=10_000.0,
+    ),
+    "llama_tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,
+    ),
+}
+
+
+def init(cfg: LlamaConfig, rng: jax.Array) -> Variables:
+    keys = jax.random.split(rng, 10)
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    params = {
+        "embed": truncated_normal_init(keys[0], (cfg.vocab_size, D)),
+        "layers": {
+            "attn_norm": jnp.ones((L, D)),
+            "wq": scaled_init(keys[1], (L, D, H * Hd), fan_in=D),
+            "wk": scaled_init(keys[2], (L, D, KV * Hd), fan_in=D),
+            "wv": scaled_init(keys[3], (L, D, KV * Hd), fan_in=D),
+            "wo": scaled_init(keys[4], (L, H * Hd, D), fan_in=H * Hd),
+            "mlp_norm": jnp.ones((L, D)),
+            "w_gate": scaled_init(keys[5], (L, D, F), fan_in=D),
+            "w_up": scaled_init(keys[6], (L, D, F), fan_in=D),
+            "w_down": scaled_init(keys[7], (L, F, D), fan_in=F),
+        },
+        "final_norm": jnp.ones((D,)),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = truncated_normal_init(keys[8], (D, cfg.vocab_size))
+    return {"params": params, "state": {}}
+
+
+def logical_axes(cfg: LlamaConfig) -> Variables:
+    params = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = ("embed", "vocab")
+    return {"params": params, "state": {}}
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings on [B, S, H, D] with fp32 trig."""
+    d_half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, d_half, dtype=jnp.float32) / d_half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, d_half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) -> jax.Array:
+    B, S, D = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, Hd)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, Hd)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+    up = h @ layer["w_up"].astype(dt)
+    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    return x
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,  # [B, S] int32 input ids
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token ids → logits [B, S, vocab]."""
+    dt = cfg.dtype
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    x = params["embed"].astype(dt)[tokens]
+
+    body = functools.partial(_layer, cfg)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body, static_argnums=())
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        )
+
+    def scan_body(carry, layer_params):
+        return body(carry, layer_params, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    # fp32 logits: the MXU matmul stays bf16; accumulate/softmax in fp32.
+    return (x @ head.astype(dt)).astype(jnp.float32)
+
+
+def apply(
+    cfg: LlamaConfig,
+    variables: Variables,
+    batch: Batch,
+    train: bool = True,
+    rng: Optional[jax.Array] = None,
+):
+    tokens = batch["tokens"]
+    inputs = shift_right(tokens)
+    logits = forward(cfg, variables["params"], inputs)
+    mask = batch.get("mask")
+    loss, acc = cross_entropy_loss(logits, tokens, mask)
+    return loss, {"loss": loss, "accuracy": acc}, variables["state"]
+
+
+def model_def(name: str, **overrides) -> ModelDef:
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ModelDef(
+        name=name,
+        init=functools.partial(init, cfg),
+        apply=functools.partial(apply, cfg),
+        logical_axes=functools.partial(logical_axes, cfg),
+        unit="tokens",
+    )

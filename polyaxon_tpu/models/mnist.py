@@ -1,0 +1,98 @@
+"""MNIST CNN — the quick-start model (BASELINE config 1)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.common import (
+    Batch,
+    ModelDef,
+    Variables,
+    cross_entropy_loss,
+    scaled_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistConfig:
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+
+CONFIGS = {"mnist_cnn": MnistConfig()}
+
+
+def _conv(x, w, b):
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + b
+
+
+def init(cfg: MnistConfig, rng: jax.Array) -> Variables:
+    keys = jax.random.split(rng, 4)
+    params = {
+        "conv1": scaled_init(keys[0], (3, 3, 1, 32), fan_in=9),
+        "conv1_b": jnp.zeros((32,)),
+        "conv2": scaled_init(keys[1], (3, 3, 32, 64), fan_in=288),
+        "conv2_b": jnp.zeros((64,)),
+        "dense1": scaled_init(keys[2], (7 * 7 * 64, 256), fan_in=7 * 7 * 64),
+        "dense1_b": jnp.zeros((256,)),
+        "dense2": scaled_init(keys[3], (256, cfg.num_classes), fan_in=256),
+        "dense2_b": jnp.zeros((cfg.num_classes,)),
+    }
+    return {"params": params, "state": {}}
+
+
+def logical_axes(cfg: MnistConfig) -> Variables:
+    return {
+        "params": {
+            "conv1": (None, None, "conv_in", "conv_out"),
+            "conv1_b": ("conv_out",),
+            "conv2": (None, None, "conv_in", "conv_out"),
+            "conv2_b": ("conv_out",),
+            "dense1": (None, "mlp"),
+            "dense1_b": ("mlp",),
+            "dense2": ("mlp", "classes"),
+            "dense2_b": ("classes",),
+        },
+        "state": {},
+    }
+
+
+def forward(cfg: MnistConfig, params: dict, images: jax.Array) -> jax.Array:
+    dt = cfg.dtype
+    x = images.astype(dt)
+    if x.ndim == 3:
+        x = x[..., None]
+    x = jax.nn.relu(_conv(x, params["conv1"].astype(dt), params["conv1_b"].astype(dt)))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    x = jax.nn.relu(_conv(x, params["conv2"].astype(dt), params["conv2_b"].astype(dt)))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense1"].astype(dt) + params["dense1_b"].astype(dt))
+    return (x @ params["dense2"].astype(dt) + params["dense2_b"].astype(dt)).astype(jnp.float32)
+
+
+def apply(cfg: MnistConfig, variables: Variables, batch: Batch, train: bool = True,
+          rng: Optional[jax.Array] = None):
+    logits = forward(cfg, variables["params"], batch["image"])
+    loss, acc = cross_entropy_loss(logits, batch["label"])
+    return loss, {"loss": loss, "accuracy": acc}, variables["state"]
+
+
+def model_def(name: str = "mnist_cnn", **overrides) -> ModelDef:
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ModelDef(
+        name=name,
+        init=functools.partial(init, cfg),
+        apply=functools.partial(apply, cfg),
+        logical_axes=functools.partial(logical_axes, cfg),
+        unit="examples",
+    )

@@ -1,0 +1,187 @@
+"""ResNet-50 with BatchNorm running statistics (BASELINE config 2's
+capability, rebuilt JAX-native instead of a TFJob container).
+
+BatchNorm is the one stateful layer in the zoo: running mean/var live in
+``variables["state"]`` and the train step threads the updated state
+through (``apply`` returns it), matching the Variables convention in
+``models.common``. Cross-replica batch stats come for free under pjit:
+the batch mean/var are computed over the *global* (sharded) batch axis
+because XLA inserts the reduction collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models.common import (
+    Batch,
+    ModelDef,
+    Variables,
+    cross_entropy_loss,
+    scaled_init,
+)
+
+BN_MOMENTUM = 0.9
+BN_EPS = 1e-5
+
+# (blocks per stage, channels) for ResNet-50.
+STAGES = ((3, 256), (4, 512), (6, 1024), (3, 2048))
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    width: int = 64
+    stages: tuple = STAGES
+    dtype: Any = jnp.bfloat16
+
+
+CONFIGS = {
+    "resnet50": ResNetConfig(),
+    "resnet_tiny": ResNetConfig(num_classes=10, width=8,
+                                stages=((1, 32), (1, 64))),
+}
+
+
+def _conv_init(rng, kh, kw, cin, cout):
+    return scaled_init(rng, (kh, kw, cin, cout), fan_in=kh * kw * cin)
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+
+def init(cfg: ResNetConfig, rng: jax.Array) -> Variables:
+    rngs = iter(jax.random.split(rng, 256))
+    params: dict = {
+        "stem_conv": _conv_init(next(rngs), 7, 7, 3, cfg.width),
+        "stem_bn": _bn_init(cfg.width),
+    }
+    state: dict = {"stem_bn": _bn_state(cfg.width)}
+    cin = cfg.width
+    for si, (n_blocks, cout) in enumerate(cfg.stages):
+        mid = cout // 4
+        for bi in range(n_blocks):
+            name = f"s{si}b{bi}"
+            block = {
+                "conv1": _conv_init(next(rngs), 1, 1, cin, mid), "bn1": _bn_init(mid),
+                "conv2": _conv_init(next(rngs), 3, 3, mid, mid), "bn2": _bn_init(mid),
+                "conv3": _conv_init(next(rngs), 1, 1, mid, cout), "bn3": _bn_init(cout),
+            }
+            bstate = {"bn1": _bn_state(mid), "bn2": _bn_state(mid), "bn3": _bn_state(cout)}
+            if bi == 0 and cin != cout:
+                block["proj"] = _conv_init(next(rngs), 1, 1, cin, cout)
+                block["proj_bn"] = _bn_init(cout)
+                bstate["proj_bn"] = _bn_state(cout)
+            params[name] = block
+            state[name] = bstate
+            cin = cout
+    params["head"] = scaled_init(next(rngs), (cin, cfg.num_classes), fan_in=cin)
+    params["head_bias"] = jnp.zeros((cfg.num_classes,))
+    return {"params": params, "state": state}
+
+
+def logical_axes(cfg: ResNetConfig) -> Variables:
+    def conv_axes(_):
+        return (None, None, "conv_in", "conv_out")
+
+    variables = init(cfg, jax.random.key(0))
+
+    def map_leaf(path, leaf):
+        names = [p.key for p in path]
+        if "head" in names and "head_bias" not in names:
+            return ("embed", "classes")
+        if names[-1] == "head_bias":
+            return ("classes",)
+        if leaf.ndim == 4:
+            return (None, None, "conv_in", "conv_out")
+        return ("conv_out",) if leaf.ndim == 1 else tuple(None for _ in leaf.shape)
+
+    return jax.tree_util.tree_map_with_path(map_leaf, variables)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, p, s, train: bool):
+    """Returns (normalized, new_state)."""
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        new_state = {
+            "mean": BN_MOMENTUM * s["mean"] + (1 - BN_MOMENTUM) * mean,
+            "var": BN_MOMENTUM * s["var"] + (1 - BN_MOMENTUM) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_state = s
+    y = (x32 - mean) * jax.lax.rsqrt(var + BN_EPS) * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_state
+
+
+def _block(x, p, s, stride: int, train: bool):
+    new_s = {}
+    h, new_s["bn1"] = _bn(_conv(x, p["conv1"].astype(x.dtype)), p["bn1"], s["bn1"], train)
+    h = jax.nn.relu(h)
+    h, new_s["bn2"] = _bn(_conv(h, p["conv2"].astype(x.dtype), stride), p["bn2"], s["bn2"], train)
+    h = jax.nn.relu(h)
+    h, new_s["bn3"] = _bn(_conv(h, p["conv3"].astype(x.dtype)), p["bn3"], s["bn3"], train)
+    if "proj" in p:
+        x, new_s["proj_bn"] = _bn(
+            _conv(x, p["proj"].astype(x.dtype), stride), p["proj_bn"], s["proj_bn"], train
+        )
+    elif stride != 1:
+        x = x[:, ::stride, ::stride]
+    return jax.nn.relu(x + h), new_s
+
+
+def forward(cfg: ResNetConfig, params: dict, state: dict, images: jax.Array,
+            train: bool) -> tuple[jax.Array, dict]:
+    dt = cfg.dtype
+    x = images.astype(dt)
+    new_state: dict = {}
+    x = _conv(x, params["stem_conv"].astype(dt), stride=2)
+    x, new_state["stem_bn"] = _bn(x, params["stem_bn"], state["stem_bn"], train)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for si, (n_blocks, _) in enumerate(cfg.stages):
+        for bi in range(n_blocks):
+            name = f"s{si}b{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            x, new_state[name] = _block(x, params[name], state[name], stride, train)
+    x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+    logits = x @ params["head"].astype(jnp.float32) + params["head_bias"]
+    return logits, new_state
+
+
+def apply(cfg: ResNetConfig, variables: Variables, batch: Batch, train: bool = True,
+          rng: Optional[jax.Array] = None):
+    logits, new_state = forward(cfg, variables["params"], variables["state"],
+                                batch["image"], train)
+    loss, acc = cross_entropy_loss(logits, batch["label"])
+    return loss, {"loss": loss, "accuracy": acc}, new_state
+
+
+def model_def(name: str = "resnet50", **overrides) -> ModelDef:
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ModelDef(
+        name=name,
+        init=functools.partial(init, cfg),
+        apply=functools.partial(apply, cfg),
+        logical_axes=functools.partial(logical_axes, cfg),
+        unit="examples",
+    )

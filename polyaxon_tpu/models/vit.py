@@ -1,0 +1,125 @@
+"""Vision Transformer (ViT-B/16 is BASELINE config 5's sweep target)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import encoder
+from polyaxon_tpu.models.common import (
+    Batch,
+    ModelDef,
+    Variables,
+    cross_entropy_loss,
+    layer_norm,
+    scaled_init,
+    truncated_normal_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def encoder_config(self) -> encoder.EncoderConfig:
+        return encoder.EncoderConfig(
+            dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
+            ffn_dim=self.ffn_dim, dtype=self.dtype, remat=self.remat,
+        )
+
+
+CONFIGS: dict[str, ViTConfig] = {
+    "vit_b16": ViTConfig(),
+    "vit_s16": ViTConfig(dim=384, n_layers=12, n_heads=6, ffn_dim=1536),
+    "vit_tiny": ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                          dim=64, n_layers=2, n_heads=4, ffn_dim=128),
+}
+
+
+def init(cfg: ViTConfig, rng: jax.Array) -> Variables:
+    keys = jax.random.split(rng, 5)
+    patch_dim = 3 * cfg.patch_size * cfg.patch_size
+    params = {
+        "patch_embed": scaled_init(keys[0], (patch_dim, cfg.dim), fan_in=patch_dim),
+        "patch_bias": jnp.zeros((cfg.dim,)),
+        "cls_token": truncated_normal_init(keys[1], (1, 1, cfg.dim)),
+        "pos_embed": truncated_normal_init(keys[2], (1, cfg.n_patches + 1, cfg.dim)),
+        "layers": encoder.init_layers(cfg.encoder_config(), keys[3]),
+        "final_ln_scale": jnp.ones((cfg.dim,)),
+        "final_ln_bias": jnp.zeros((cfg.dim,)),
+        # Zero-init classifier head: init loss is exactly ln(num_classes).
+        "head": jnp.zeros((cfg.dim, cfg.num_classes)),
+        "head_bias": jnp.zeros((cfg.num_classes,)),
+    }
+    return {"params": params, "state": {}}
+
+
+def logical_axes(cfg: ViTConfig) -> Variables:
+    return {
+        "params": {
+            "patch_embed": (None, "embed"),
+            "patch_bias": ("embed",),
+            "cls_token": (None, None, "embed"),
+            "pos_embed": (None, "seq", "embed"),
+            "layers": encoder.layers_logical_axes(),
+            "final_ln_scale": ("embed",),
+            "final_ln_bias": ("embed",),
+            "head": ("embed", "classes"),
+            "head_bias": ("classes",),
+        },
+        "state": {},
+    }
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, 3] → [B, (H/p)*(W/p), 3*p*p]."""
+    B, H, W, C = images.shape
+    x = images.reshape(B, H // patch, patch, W // patch, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, (H // patch) * (W // patch), patch * patch * C)
+
+
+def forward(cfg: ViTConfig, params: dict, images: jax.Array) -> jax.Array:
+    dt = cfg.dtype
+    x = patchify(images.astype(dt), cfg.patch_size)
+    x = x @ params["patch_embed"].astype(dt) + params["patch_bias"].astype(dt)
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"].astype(dt), (B, 1, cfg.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"].astype(dt)
+    x = encoder.encode(cfg.encoder_config(), params["layers"], x)
+    x = layer_norm(x[:, 0], params["final_ln_scale"], params["final_ln_bias"])
+    return (x @ params["head"].astype(dt) + params["head_bias"].astype(dt)).astype(jnp.float32)
+
+
+def apply(cfg: ViTConfig, variables: Variables, batch: Batch, train: bool = True,
+          rng: Optional[jax.Array] = None):
+    logits = forward(cfg, variables["params"], batch["image"])
+    loss, acc = cross_entropy_loss(logits, batch["label"])
+    return loss, {"loss": loss, "accuracy": acc}, variables["state"]
+
+
+def model_def(name: str, **overrides) -> ModelDef:
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ModelDef(
+        name=name,
+        init=functools.partial(init, cfg),
+        apply=functools.partial(apply, cfg),
+        logical_axes=functools.partial(logical_axes, cfg),
+        unit="examples",
+    )

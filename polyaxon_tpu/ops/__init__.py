@@ -1,0 +1,3 @@
+from polyaxon_tpu.ops.attention import dot_product_attention, xla_attention
+
+__all__ = ["dot_product_attention", "xla_attention"]

@@ -1,0 +1,79 @@
+"""Attention ops with selectable implementations.
+
+``impl``:
+- ``"xla"``   einsum attention with fp32 softmax — the always-correct
+  reference; XLA fuses it well on TPU for moderate sequence lengths.
+- ``"flash"`` Pallas blocked flash attention (TPU): O(S) memory, MXU
+  tiled; falls back to xla off-TPU (ops/flash.py).
+- ``"ring"``  context-parallel ring attention over the cp mesh axis:
+  KV blocks rotate around the ICI ring via ppermute inside shard_map
+  while queries stay resident (ops/ring.py). Net-new vs the reference
+  (SURVEY.md §5.7: long-context is absent upstream).
+
+All impls take [B, S, H, D] and GQA (n_kv_heads <= n_heads) layouts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def xla_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        # Offset supports decode/extension where Sq < Sk.
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg_mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    impl: str = "xla",
+    segment_ids: Optional[jax.Array] = None,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if impl == "flash":
+        from polyaxon_tpu.ops.flash import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        from polyaxon_tpu.ops.ring import ring_attention
+
+        return ring_attention(q, k, v, causal=causal, axis_name=axis_name or "cp")
+    raise ValueError(f"Unknown attention impl `{impl}`")

@@ -1,0 +1,33 @@
+from polyaxon_tpu.parallel.bootstrap import ProcessGroup, initialize, read_env_contract
+from polyaxon_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    build_mesh,
+    mesh_summary,
+    single_device_mesh,
+)
+from polyaxon_tpu.parallel.sharding import (
+    STRATEGY_RULES,
+    batch_spec,
+    logical_to_spec,
+    merge_rules,
+    param_bytes,
+    rules_for_mesh,
+    tree_shardings,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "ProcessGroup",
+    "STRATEGY_RULES",
+    "batch_spec",
+    "build_mesh",
+    "initialize",
+    "logical_to_spec",
+    "merge_rules",
+    "mesh_summary",
+    "param_bytes",
+    "read_env_contract",
+    "rules_for_mesh",
+    "single_device_mesh",
+    "tree_shardings",
+]

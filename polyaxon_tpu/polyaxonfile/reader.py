@@ -31,8 +31,12 @@ def _load_one(source: Union[str, dict]) -> list[dict]:
     if isinstance(source, dict):
         return [copy.deepcopy(source)]
     text = source
-    if isinstance(source, str) and (os.sep in source or source.endswith((".yaml", ".yml", ".json"))) \
-            and os.path.exists(source):
+    looks_like_path = isinstance(source, str) and "\n" not in source and (
+        os.sep in source or source.endswith((".yaml", ".yml", ".json"))
+    )
+    if looks_like_path:
+        if not os.path.exists(source):
+            raise PolyaxonfileError(f"Polyaxonfile not found: {source}")
         with open(source) as handle:
             text = handle.read()
     try:
@@ -91,7 +95,6 @@ def get_operation(data: dict) -> V1Operation:
 def check_polyaxonfile(
     polyaxonfile: Union[str, dict, Sequence[Union[str, dict]], None] = None,
     *,
-    python_module: Optional[str] = None,
     url: Optional[str] = None,
     hub: Optional[str] = None,
     params: Optional[dict[str, Any]] = None,
@@ -191,6 +194,7 @@ def apply_presets(
 def resolve_operation_context(
     op: V1Operation,
     *,
+    params: Optional[dict[str, Any]] = None,
     run_uuid: str = "",
     run_name: str = "",
     project_name: str = "",
@@ -205,8 +209,17 @@ def resolve_operation_context(
     """
     if op.component is None:
         raise PolyaxonfileError("Cannot resolve an operation without an inline component")
+    bound = dict(op.params or {})
+    for name, value in (params or {}).items():
+        bound[name] = value if isinstance(value, V1Param) else V1Param(value=value)
+    unbound = matrix_param_names(op) - set(bound)
+    if unbound:
+        raise PolyaxonfileError(
+            f"Matrix-bound params {sorted(unbound)} must be bound per-trial before "
+            "resolution (pass them via `params=`)"
+        )
     param_values = validate_params_against_io(
-        op.params, op.component.inputs, op.component.outputs
+        bound, op.component.inputs, op.component.outputs
     )
     context = {
         "params": param_values,

@@ -143,15 +143,7 @@ class V1IO(BaseSchema):
         return value
 
 
-class RefMixin:
-    """Shared helpers for entities referencing other runs/ops (``ref``)."""
-
-    @staticmethod
-    def is_literal_ref(ref: Optional[str]) -> bool:
-        return bool(ref) and (ref.startswith("runs.") or ref.startswith("ops.") or ref in ("dag", "dag.uuid"))
-
-
-class V1Param(BaseSchema, RefMixin):
+class V1Param(BaseSchema):
     value: Optional[Any] = None
     ref: Optional[str] = None
     connection: Optional[str] = None
@@ -181,10 +173,6 @@ class V1Param(BaseSchema, RefMixin):
         return parts[0], parts[1], parts[2]
 
 
-def params_as_values(params: Optional[dict[str, V1Param]]) -> dict[str, Any]:
-    return {k: p.value for k, p in (params or {}).items() if not p.is_ref}
-
-
 def validate_params_against_io(
     params: Optional[dict[str, V1Param]],
     inputs: Optional[list[V1IO]],
@@ -199,7 +187,8 @@ def validate_params_against_io(
     context will expose as ``params.*``.
     """
     params = dict(params or {})
-    declared = {io.name: io for io in (inputs or [])}
+    declared_inputs = {io.name: io for io in (inputs or [])}
+    declared = dict(declared_inputs)
     declared.update({io.name: io for io in (outputs or []) if io.name not in declared})
     resolved: dict[str, Any] = {}
     for name, param in params.items():
@@ -217,7 +206,8 @@ def validate_params_against_io(
             # exists; type checking is deferred to resolution time.
             continue
         resolved[name] = declared[name].validate_value(param.value)
-    for name, io in declared.items():
+    # Only *inputs* can be required: outputs are produced by the run.
+    for name, io in declared_inputs.items():
         if name in resolved:
             continue
         param = params.get(name)

@@ -89,8 +89,6 @@ class V1MeshSpec(BaseSchema):
     dcn_axes: Optional[list[str]] = None
     allow_split_physical_axes: Optional[bool] = None
 
-    _KNOWN = ("dp", "fsdp", "tp", "pp", "sp", "cp", "ep", "expert", "seq", "data", "model")
-
     @field_validator("axes")
     @classmethod
     def _check_axes(cls, v: dict[str, int]):

@@ -1,0 +1,4 @@
+from polyaxon_tpu.runtime.config import RuntimeConfig
+from polyaxon_tpu.runtime.loop import TrainResult, run_jaxjob
+
+__all__ = ["RuntimeConfig", "TrainResult", "run_jaxjob"]

@@ -1,0 +1,77 @@
+"""Sharded checkpoint/resume for the JAXJob runtime (orbax-backed).
+
+The reference provides only the outputs-path contract + run-level
+restart (SURVEY.md §5.4 [K]); the TPU build owns both halves. Each
+process writes its own shards (orbax OCDBT), saves are async by default
+so the step loop never blocks on IO, and restore re-lays tensors onto
+the current mesh from the saved shardings — preemption-safe resume is
+``latest_step() → restore(state_like)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        directory: str,
+        spec: Optional[V1JaxCheckpointing] = None,
+    ):
+        self.spec = spec or V1JaxCheckpointing()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=self.spec.max_to_keep,
+            enable_async_checkpointing=bool(self.spec.async_save),
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.spec.enabled)
+
+    def interval(self) -> Optional[int]:
+        return self.spec.interval_steps
+
+    def should_save(self, step: int) -> bool:
+        if not self.enabled:
+            return False
+        interval = self.spec.interval_steps
+        return bool(interval) and step > 0 and step % interval == 0
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> None:
+        if not self.enabled and not force:
+            return
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the sharding/layout of ``state_like`` (an existing
+        state pytree or eval_shape'd abstract tree with shardings)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint under {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        logger.info("Restored checkpoint step=%s from %s", step, self.directory)
+        return restored
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,143 @@
+"""Per-host data pipelines.
+
+The reference's data story is "whatever the user container does"; here
+the runtime owns it (SURVEY.md §2b: "per-host data loading" is the DP
+obligation). Two tiers:
+
+- synthetic datasets for every model family — deterministic, generated
+  on-host with numpy, no network (this environment has none [E]);
+- a file-backed token dataset (memory-mapped ``.npy``) for real LM
+  corpora via the artifacts/init contract.
+
+Batches are yielded as *global* jax.Arrays laid out on the mesh with
+``jax.make_array_from_process_local_data``, so each host materializes
+only its shard (multi-host correct, single-host trivial).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from polyaxon_tpu.parallel.sharding import Rules, batch_spec
+
+Batch = dict[str, jax.Array]
+
+
+@dataclasses.dataclass
+class DatasetSpec:
+    name: str
+    make: Callable[..., Iterator[dict[str, np.ndarray]]]
+    batch_keys: tuple[str, ...]
+
+
+def _rng(seed: int) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+def lm_synthetic(batch_size: int, seq_len: int = 2048, vocab_size: int = 32_000,
+                 seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+    """Zipf-ish token stream — exercises the LM path with a realistic
+    skewed distribution (uniform tokens make CE flat)."""
+    rng = _rng(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    probs = 1.0 / ranks
+    probs /= probs.sum()
+    while True:
+        yield {"tokens": rng.choice(vocab_size, size=(batch_size, seq_len), p=probs).astype(np.int32)}
+
+
+def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
+            **_) -> Iterator[dict[str, np.ndarray]]:
+    """Memory-mapped token file: flat int32/int16 .npy of token ids."""
+    if not path:
+        raise ValueError("lm_file dataset requires `path`")
+    tokens = np.load(path, mmap_mode="r")
+    n = tokens.shape[0] - seq_len - 1
+    rng = _rng(seed)
+    while True:
+        starts = rng.integers(0, n, size=(batch_size,))
+        yield {"tokens": np.stack([tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
+
+
+def mlm_synthetic(batch_size: int, seq_len: int = 128, vocab_size: int = 30_522,
+                  mask_rate: float = 0.15, mask_id: int = 103, seed: int = 0,
+                  **_) -> Iterator[dict[str, np.ndarray]]:
+    rng = _rng(seed)
+    while True:
+        tokens = rng.integers(5, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        mask = rng.random((batch_size, seq_len)) < mask_rate
+        labels = np.where(mask, tokens, -1).astype(np.int32)
+        masked = np.where(mask, mask_id, tokens).astype(np.int32)
+        yield {"tokens": masked, "labels": labels}
+
+
+def image_synthetic(batch_size: int, image_size: int = 224, num_classes: int = 1000,
+                    seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+    rng = _rng(seed)
+    while True:
+        yield {
+            "image": rng.standard_normal((batch_size, image_size, image_size, 3)).astype(np.float32),
+            "label": rng.integers(0, num_classes, size=(batch_size,)).astype(np.int32),
+        }
+
+
+def mnist_synthetic(batch_size: int, seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+    """Class-conditional blobs: learnable, so the quick-start converges."""
+    rng = _rng(seed)
+    protos = rng.standard_normal((10, 28, 28)).astype(np.float32)
+    while True:
+        labels = rng.integers(0, 10, size=(batch_size,)).astype(np.int32)
+        images = protos[labels] + 0.3 * rng.standard_normal((batch_size, 28, 28)).astype(np.float32)
+        yield {"image": images[..., None], "label": labels}
+
+
+DATASETS: dict[str, Callable[..., Iterator[dict[str, np.ndarray]]]] = {
+    "lm_synthetic": lm_synthetic,
+    "lm_file": lm_file,
+    "mlm_synthetic": mlm_synthetic,
+    "imagenet_synthetic": image_synthetic,
+    "image_synthetic": image_synthetic,
+    "mnist_synthetic": mnist_synthetic,
+}
+
+
+def get_dataset(name: str, **kwargs) -> Iterator[dict[str, np.ndarray]]:
+    if name not in DATASETS:
+        raise ValueError(f"Unknown dataset `{name}`. Available: {sorted(DATASETS)}")
+    return DATASETS[name](**kwargs)
+
+
+def shard_batches(
+    it: Iterator[dict[str, np.ndarray]],
+    mesh: Mesh,
+    rules: Rules,
+) -> Iterator[Batch]:
+    """Host-local numpy batches → global mesh-laid-out jax.Arrays.
+
+    The iterator yields this process's shard (batch_size = per-host);
+    ``make_array_from_process_local_data`` assembles the logical global
+    array across hosts without any host gathering the whole batch.
+    """
+    for local in it:
+        global_batch = {}
+        for key, value in local.items():
+            sharding = NamedSharding(mesh, batch_spec(mesh, rules, ndim=value.ndim))
+            global_batch[key] = jax.make_array_from_process_local_data(sharding, value)
+        yield global_batch
+
+
+def dataset_for_model(model_name: str) -> str:
+    if model_name.startswith(("llama",)):
+        return "lm_synthetic"
+    if model_name.startswith("bert"):
+        return "mlm_synthetic"
+    if model_name.startswith(("vit", "resnet")):
+        return "imagenet_synthetic"
+    if model_name.startswith("mnist"):
+        return "mnist_synthetic"
+    return "lm_synthetic"

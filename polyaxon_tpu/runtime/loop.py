@@ -1,0 +1,198 @@
+"""The JAXJob training loop: mesh → data → compiled step → metrics/
+checkpoints. Single code path from the 1-chip emulator to multi-host
+slices (only the mesh and the env contract change — SURVEY.md §7 step 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from polyaxon_tpu.models import get_model
+from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
+from polyaxon_tpu.parallel.sharding import param_bytes
+from polyaxon_tpu.polyflow.runs import V1JAXJob, V1JaxCheckpointing
+from polyaxon_tpu.runtime import data as data_lib
+from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+from polyaxon_tpu.runtime.config import RuntimeConfig
+from polyaxon_tpu.runtime.optim import build_optimizer
+from polyaxon_tpu.runtime.step import build_eval_step, build_init, build_train_step
+
+logger = logging.getLogger(__name__)
+
+MetricsCallback = Callable[[int, dict[str, float]], None]
+
+
+@dataclasses.dataclass
+class TrainResult:
+    steps: int
+    final_metrics: dict[str, float]
+    throughput: float  # units/sec (tokens or examples)
+    unit: str
+    units_per_step: int
+    wall_time: float
+    param_count: int
+    restored_from_step: Optional[int] = None
+
+
+def _model_config_cls(model_name: str):
+    from polyaxon_tpu.models import bert, llama, mnist, resnet, vit
+
+    for mod in (llama, vit, bert, resnet, mnist):
+        if model_name in mod.CONFIGS:
+            return type(mod.CONFIGS[model_name])
+    raise ValueError(f"Unknown model `{model_name}`")
+
+
+def _dataset_kwargs(cfg: RuntimeConfig, model_cfg, per_host_batch: int) -> dict:
+    kwargs: dict[str, Any] = {"batch_size": per_host_batch, "seed": cfg.seed}
+    extras = dict(cfg.__pydantic_extra__ or {})
+    for key in ("path", "image_size", "num_classes", "mask_rate"):
+        if key in extras:
+            kwargs[key] = extras[key]
+    if cfg.seq_len:
+        kwargs["seq_len"] = cfg.seq_len
+    elif hasattr(model_cfg, "max_seq_len"):
+        kwargs["seq_len"] = min(model_cfg.max_seq_len, 2048)
+    if hasattr(model_cfg, "vocab_size"):
+        kwargs["vocab_size"] = model_cfg.vocab_size
+    if hasattr(model_cfg, "image_size") and "image_size" not in kwargs:
+        kwargs["image_size"] = model_cfg.image_size
+    if hasattr(model_cfg, "num_classes") and "num_classes" not in kwargs:
+        kwargs["num_classes"] = model_cfg.num_classes
+    return kwargs
+
+
+def run_jaxjob(
+    job: V1JAXJob,
+    *,
+    artifacts_dir: Optional[str] = None,
+    on_metrics: Optional[MetricsCallback] = None,
+    devices: Optional[list] = None,
+) -> TrainResult:
+    """Execute a builtin-runtime JAXJob in-process."""
+    if not job.runtime:
+        raise ValueError("run_jaxjob requires a jaxjob with a `runtime` section")
+    cfg = RuntimeConfig.model_validate(job.runtime)
+
+    mesh = build_mesh(job.mesh, job.get_topology(), devices=devices)
+    rules = rules_for_mesh(mesh)
+    logger.info("mesh axes=%s devices=%d", dict(zip(mesh.axis_names, mesh.devices.shape)),
+                mesh.devices.size)
+
+    config_cls = _model_config_cls(cfg.model)
+    overrides = cfg.model_overrides(config_cls)
+    model_def = get_model(cfg.model, **overrides)
+    model_cfg = dataclasses.replace(_get_cfg(cfg.model), **overrides)
+
+    n_devices = mesh.devices.size
+    if cfg.global_batch_size:
+        global_batch = cfg.global_batch_size
+    else:
+        global_batch = (cfg.batch_size or 8) * n_devices
+    if global_batch % jax.process_count():
+        raise ValueError(
+            f"global batch {global_batch} must divide process count {jax.process_count()}"
+        )
+    per_host_batch = global_batch // jax.process_count()
+
+    dataset_name = cfg.dataset or data_lib.dataset_for_model(cfg.model)
+    ds_kwargs = _dataset_kwargs(cfg, model_cfg, per_host_batch)
+    host_iter = data_lib.get_dataset(dataset_name, **ds_kwargs)
+    batches = data_lib.shard_batches(host_iter, mesh, rules)
+
+    optimizer = build_optimizer(cfg)
+
+    with mesh:
+        init_fn = build_init(model_def, optimizer, mesh, rules)
+        train_step = build_train_step(model_def, optimizer, mesh, rules)
+
+        rng = jax.random.key(cfg.seed)
+        state = init_fn(rng)
+        n_params = sum(x.size for x in jax.tree.leaves(state["params"]))
+        logger.info("model=%s params=%.2fM bytes=%.1fMB", cfg.model, n_params / 1e6,
+                    param_bytes(state["params"]) / 1e6)
+
+        ckpt: Optional[CheckpointManager] = None
+        restored_from = None
+        ckpt_spec = job.checkpointing or V1JaxCheckpointing(enabled=False)
+        if artifacts_dir and ckpt_spec.enabled:
+            ckpt = CheckpointManager(f"{artifacts_dir}/checkpoints", ckpt_spec)
+            if ckpt_spec.restore_on_start and ckpt.latest_step() is not None:
+                state = ckpt.restore(state)
+                restored_from = int(state["step"])
+
+        seq = ds_kwargs.get("seq_len", 1)
+        units_per_step = global_batch * (seq if model_def.unit == "tokens" else 1)
+
+        start_step = int(state["step"])
+        if start_step >= cfg.steps:
+            if ckpt:
+                ckpt.close()
+            return TrainResult(
+                steps=start_step,
+                final_metrics={},
+                throughput=0.0,
+                unit=model_def.unit,
+                units_per_step=0,
+                wall_time=0.0,
+                param_count=int(n_params),
+                restored_from_step=restored_from,
+            )
+        final_metrics: dict[str, float] = {}
+        step_rng = jax.random.key(cfg.seed + 17)
+        # Warm up compile outside the timed window.
+        first_batch = next(batches)
+        state, metrics = train_step(state, first_batch, step_rng)
+        jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        timed_steps = 0
+        for step in range(start_step + 1, cfg.steps):
+            profiling = cfg.profile_steps and step in cfg.profile_steps and artifacts_dir
+            if profiling:
+                jax.profiler.start_trace(f"{artifacts_dir}/profile")
+            batch = next(batches)
+            state, metrics = train_step(state, batch, step_rng)
+            timed_steps += 1
+            if profiling:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+            if on_metrics and (step % cfg.log_every == 0 or step == cfg.steps - 1):
+                vals = {k: float(v) for k, v in metrics.items()}
+                on_metrics(step, vals)
+            if ckpt and ckpt.should_save(step):
+                ckpt.save(step, state)
+        jax.block_until_ready(state["params"])
+        wall = time.perf_counter() - t0
+        final_metrics = {k: float(v) for k, v in metrics.items()}
+        final_step = int(state["step"])
+
+        if ckpt:
+            ckpt.save(final_step, state, force=True)
+            ckpt.close()
+
+    throughput = units_per_step * timed_steps / wall if wall > 0 and timed_steps else 0.0
+    return TrainResult(
+        steps=final_step,
+        final_metrics=final_metrics,
+        throughput=throughput,
+        unit=model_def.unit,
+        units_per_step=units_per_step,
+        wall_time=wall,
+        param_count=int(n_params),
+        restored_from_step=restored_from,
+    )
+
+
+def _get_cfg(model_name: str):
+    from polyaxon_tpu.models import bert, llama, mnist, resnet, vit
+
+    for mod in (llama, vit, bert, resnet, mnist):
+        if model_name in mod.CONFIGS:
+            return mod.CONFIGS[model_name]
+    raise ValueError(f"Unknown model `{model_name}`")

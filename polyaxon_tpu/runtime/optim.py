@@ -1,0 +1,47 @@
+"""Optimizer + LR-schedule builders (optax)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def build_schedule(cfg) -> optax.Schedule:
+    base = cfg.learning_rate
+    if cfg.lr_schedule == "constant":
+        sched = optax.constant_schedule(base)
+    elif cfg.lr_schedule == "cosine":
+        decay_steps = max(cfg.steps - cfg.warmup_steps, 1)
+        sched = optax.cosine_decay_schedule(base, decay_steps)
+    elif cfg.lr_schedule == "linear":
+        decay_steps = max(cfg.steps - cfg.warmup_steps, 1)
+        sched = optax.linear_schedule(base, 0.0, decay_steps)
+    else:
+        raise ValueError(f"Unknown lr_schedule `{cfg.lr_schedule}`")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, base, cfg.warmup_steps)
+        sched = optax.join_schedules([warmup, sched], [cfg.warmup_steps])
+    return sched
+
+
+def build_optimizer(cfg) -> optax.GradientTransformation:
+    sched = build_schedule(cfg)
+    name = cfg.optimizer.lower()
+    if name == "adamw":
+        opt = optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=cfg.weight_decay)
+    elif name == "adam":
+        opt = optax.adam(sched)
+    elif name == "sgd":
+        opt = optax.sgd(sched, momentum=0.9)
+    elif name == "lion":
+        opt = optax.lion(sched, weight_decay=cfg.weight_decay)
+    elif name == "adafactor":
+        opt = optax.adafactor(sched)
+    else:
+        raise ValueError(f"Unknown optimizer `{cfg.optimizer}`")
+    chain = []
+    if cfg.grad_clip_norm:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    chain.append(opt)
+    return optax.chain(*chain)

@@ -1,0 +1,115 @@
+"""Mesh + sharding-rule tests on the 8-device virtual CPU mesh —
+SURVEY.md §4: real collective execution is testable in-process here,
+which the reference never had for NCCL."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from polyaxon_tpu.parallel import (
+    build_mesh,
+    logical_to_spec,
+    mesh_summary,
+    merge_rules,
+    rules_for_mesh,
+    tree_shardings,
+)
+from polyaxon_tpu.parallel.bootstrap import read_env_contract
+from polyaxon_tpu.parallel.sharding import FSDP_RULES, TP_RULES
+from polyaxon_tpu.polyflow import V1MeshSpec, V1TpuTopology
+
+
+class TestMesh:
+    def test_build_from_spec(self, cpu_devices):
+        mesh = build_mesh(V1MeshSpec(axes={"dp": 2, "fsdp": 4}))
+        assert mesh.axis_names == ("dp", "fsdp")
+        assert mesh.devices.shape == (2, 4)
+
+    def test_fill_axis(self, cpu_devices):
+        mesh = build_mesh(V1MeshSpec(axes={"dp": 2, "fsdp": -1}))
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "fsdp": 4}
+
+    def test_axis_aliases_and_order(self, cpu_devices):
+        mesh = build_mesh(axes={"model": 2, "data": 4})
+        # canonical order: dp before tp regardless of spec order
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh.devices.shape == (4, 2)
+
+    def test_size_mismatch(self, cpu_devices):
+        with pytest.raises(ValueError):
+            build_mesh(axes={"dp": 3})
+
+    def test_hybrid_multislice_mesh(self, cpu_devices):
+        """2 slices of 4 chips: dp over DCN, fsdp over ICI."""
+        topo = V1TpuTopology(accelerator="v5e", topology="2x2", slices=2)
+        spec = V1MeshSpec(axes={"dp": 2, "fsdp": 4}, dcn_axes=["dp"])
+        mesh = build_mesh(spec, topo)
+        assert mesh.devices.shape == (2, 4)
+        summary = mesh_summary(mesh)
+        assert summary["n_devices"] == 8
+
+    def test_collective_on_mesh(self, cpu_devices):
+        mesh = build_mesh(axes={"dp": 8})
+        x = jax.device_put(
+            jnp.arange(16.0).reshape(8, 2), NamedSharding(mesh, P("dp"))
+        )
+        total = jax.jit(lambda a: a.sum())(x)
+        assert float(total) == sum(range(16))
+
+
+class TestRules:
+    def test_fsdp_spec_mapping(self):
+        spec = logical_to_spec(("embed", "heads"), FSDP_RULES)
+        assert spec == P("fsdp")
+        spec = logical_to_spec(("batch", None), FSDP_RULES)
+        assert spec == P(("dp", "fsdp"))
+
+    def test_axis_used_once(self):
+        # embed->fsdp twice in one tensor: second occurrence replicates.
+        spec = logical_to_spec(("embed", "embed"), FSDP_RULES)
+        assert spec == P("fsdp")
+
+    def test_mesh_filtering(self, cpu_devices):
+        mesh = build_mesh(axes={"dp": 8})  # no fsdp axis in mesh
+        spec = logical_to_spec(("embed", "mlp"), FSDP_RULES, mesh=mesh)
+        assert spec == P()
+
+    def test_merge_rules_later_wins(self):
+        rules = merge_rules(FSDP_RULES, TP_RULES)
+        table = dict(rules)
+        assert table["mlp"] == "tp"
+        assert table["batch"] == ("dp", "fsdp")
+
+    def test_rules_for_mesh_composition(self, cpu_devices):
+        mesh = build_mesh(axes={"dp": 2, "fsdp": 2, "tp": 2})
+        table = dict(rules_for_mesh(mesh))
+        assert table["mlp"] == "tp"
+        assert table["embed"] == "fsdp"
+
+    def test_tree_shardings(self, cpu_devices):
+        mesh = build_mesh(V1MeshSpec(axes={"dp": 2, "fsdp": 4}))
+        tree = {"w": ("embed", "mlp"), "b": ("mlp",)}
+        sh = tree_shardings(tree, mesh, rules_for_mesh(mesh))
+        assert sh["w"].spec == P("fsdp")
+        assert sh["b"].spec == P()
+
+
+class TestBootstrap:
+    def test_env_contract(self):
+        group = read_env_contract(
+            {
+                "POLYAXON_TPU_COORDINATOR": "10.0.0.1:8476",
+                "POLYAXON_TPU_NUM_PROCESSES": "16",
+                "POLYAXON_TPU_PROCESS_ID": "3",
+            }
+        )
+        assert group.coordinator == "10.0.0.1:8476"
+        assert group.num_processes == 16
+        assert group.process_id == 3
+        assert group.is_multiprocess
+
+    def test_single_process_default(self):
+        group = read_env_contract({})
+        assert not group.is_multiprocess

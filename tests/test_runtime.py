@@ -1,0 +1,119 @@
+"""JAXJob runtime end-to-end tests on the virtual mesh: train, learn,
+checkpoint, resume — the §7 'minimum end-to-end slice' compute half."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from polyaxon_tpu.polyflow import V1JAXJob
+from polyaxon_tpu.runtime import RuntimeConfig, run_jaxjob
+from polyaxon_tpu.runtime import data as data_lib
+
+
+def tiny_job(steps=10, **runtime_extra):
+    runtime = {
+        "model": "llama_tiny",
+        "dataset": "lm_synthetic",
+        "steps": steps,
+        "optimizer": "adamw",
+        "learning_rate": 1e-3,
+        "batch_size": 2,
+        "seq_len": 32,
+        "log_every": 2,
+        **runtime_extra,
+    }
+    return V1JAXJob.from_dict(
+        {"kind": "jaxjob", "mesh": {"axes": {"dp": 2, "fsdp": 4}}, "runtime": runtime}
+    )
+
+
+class TestData:
+    def test_synthetic_datasets_shapes(self):
+        it = data_lib.get_dataset("lm_synthetic", batch_size=4, seq_len=16, vocab_size=100)
+        batch = next(it)
+        assert batch["tokens"].shape == (4, 16)
+        it = data_lib.get_dataset("mnist_synthetic", batch_size=4)
+        batch = next(it)
+        assert batch["image"].shape == (4, 28, 28, 1)
+        it = data_lib.get_dataset("mlm_synthetic", batch_size=2, seq_len=16)
+        batch = next(it)
+        assert (batch["labels"] >= 0).sum() > 0
+
+    def test_deterministic_by_seed(self):
+        a = next(data_lib.get_dataset("lm_synthetic", batch_size=2, seq_len=8, seed=3))
+        b = next(data_lib.get_dataset("lm_synthetic", batch_size=2, seq_len=8, seed=3))
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+    def test_unknown_dataset(self):
+        with pytest.raises(ValueError):
+            data_lib.get_dataset("nope", batch_size=1)
+
+
+class TestRuntimeConfig:
+    def test_model_overrides_filtering(self):
+        from polyaxon_tpu.models.llama import LlamaConfig
+
+        cfg = RuntimeConfig.model_validate(
+            {"model": "llama_tiny", "seq_len": 64, "remat": "full", "bogus_knob": 1}
+        )
+        overrides = cfg.model_overrides(LlamaConfig)
+        assert overrides["max_seq_len"] == 64
+        assert overrides["remat"] == "full"
+        assert "bogus_knob" not in overrides
+
+
+class TestTrainLoop:
+    def test_loss_decreases(self, cpu_devices):
+        result = run_jaxjob(tiny_job(steps=30, dataset="mnist_synthetic", model="mnist_cnn",
+                                     batch_size=16, learning_rate=3e-3))
+        assert result.steps == 30
+        assert result.final_metrics["loss"] < 2.0  # from ~2.3 at init
+        assert result.throughput > 0
+
+    def test_metrics_callback(self, cpu_devices):
+        seen = []
+        run_jaxjob(tiny_job(steps=6), on_metrics=lambda s, m: seen.append((s, m)))
+        assert seen and all("loss" in m for _, m in seen)
+
+    def test_checkpoint_and_resume(self, cpu_devices, tmp_path):
+        art = str(tmp_path / "run")
+        job = V1JAXJob.from_dict(
+            {
+                "kind": "jaxjob",
+                "mesh": {"axes": {"dp": 2, "fsdp": 4}},
+                "checkpointing": {"enabled": True, "intervalSteps": 4, "asyncSave": False},
+                "runtime": {"model": "llama_tiny", "steps": 8, "batch_size": 2,
+                            "seq_len": 16, "learning_rate": 1e-3},
+            }
+        )
+        r1 = run_jaxjob(job, artifacts_dir=art)
+        assert r1.steps == 8
+        assert os.path.isdir(os.path.join(art, "checkpoints"))
+        # Bump steps and resume: must restore from 8, not restart.
+        job2 = job.clone()
+        job2.runtime = {**job.runtime, "steps": 12}
+        r2 = run_jaxjob(job2, artifacts_dir=art)
+        assert r2.restored_from_step == 8
+        assert r2.steps == 12
+
+    def test_resume_of_complete_run_is_noop(self, cpu_devices, tmp_path):
+        art = str(tmp_path / "run")
+        job = V1JAXJob.from_dict(
+            {
+                "kind": "jaxjob",
+                "mesh": {"axes": {"dp": -1}},
+                "checkpointing": {"enabled": True, "intervalSteps": 4, "asyncSave": False},
+                "runtime": {"model": "llama_tiny", "steps": 6, "batch_size": 1, "seq_len": 16},
+            }
+        )
+        run_jaxjob(job, artifacts_dir=art)
+        r2 = run_jaxjob(job, artifacts_dir=art)
+        assert r2.steps == 6
+        assert r2.restored_from_step == 6
+        assert r2.wall_time == 0.0
+
+    def test_global_batch_size(self, cpu_devices):
+        result = run_jaxjob(tiny_job(steps=4, global_batch_size=16))
+        assert result.units_per_step == 16 * 32
