@@ -1,0 +1,4 @@
+from polyaxon_tpu.agent.agent import Agent
+from polyaxon_tpu.agent.executor import LocalExecutor
+
+__all__ = ["Agent", "LocalExecutor"]

@@ -1,0 +1,89 @@
+"""The agent reconcile loop (SURVEY.md §2 "Agent", §3.2 spine 🔥).
+
+Upstream: long-poll the control plane, apply Operation CRs, sync
+statuses back. Here the control plane is embedded, the "cluster" is a
+slice provider (LocalExecutor today; the C++ slice daemon fronts real
+topologies), and one loop drives scheduler ticks + executor reconcile:
+
+    queued runs   → executor.start (capacity permitting)
+    running gangs → executor.poll  (reap → terminal statuses)
+    pipelines     → scheduler.tick (DAG/tuner advancement)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from polyaxon_tpu.controlplane.scheduler import Scheduler
+from polyaxon_tpu.controlplane.service import ControlPlane
+from polyaxon_tpu.agent.executor import LocalExecutor
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.polyflow.runs import V1RunKind
+
+_PIPELINE_KINDS = {"matrix", V1RunKind.DAG}
+
+
+class Agent:
+    def __init__(
+        self,
+        plane: ControlPlane,
+        *,
+        executor: Optional[LocalExecutor] = None,
+        max_concurrent: int = 4,
+        in_process: bool = False,
+    ):
+        self.plane = plane
+        self.scheduler = Scheduler(plane)
+        self.executor = executor or LocalExecutor(plane, in_process=in_process)
+        self.max_concurrent = max_concurrent
+
+    def reconcile_once(self) -> int:
+        actions = self.scheduler.tick()
+        actions += self.executor.poll()
+        queued = [
+            r for r in self.plane.list_runs(statuses=[V1Statuses.QUEUED])
+            if r.kind not in _PIPELINE_KINDS
+        ]
+        capacity = self.max_concurrent - len(self.executor.active_runs)
+        for record in queued[: max(capacity, 0)]:
+            self.executor.start(record.uuid)
+            actions += 1
+        # Stop requests for gangs we own.
+        for record in self.plane.list_runs(statuses=[V1Statuses.STOPPING]):
+            if record.uuid in self.executor.active_runs:
+                self.executor.stop(record.uuid)
+            elif record.kind in _PIPELINE_KINDS:
+                children = self.plane.list_runs(pipeline_uuid=record.uuid)
+                if all(c.is_done for c in children):
+                    self.plane.store.transition(record.uuid, V1Statuses.STOPPED)
+                    actions += 1
+            else:
+                self.plane.store.transition(record.uuid, V1Statuses.STOPPED)
+                actions += 1
+        return actions
+
+    def run_until_done(
+        self,
+        run_uuid: str,
+        *,
+        timeout: float = 600.0,
+        poll_seconds: float = 0.2,
+    ) -> V1Statuses:
+        """Drive reconcile until ``run_uuid`` (and, for pipelines, all
+        descendants) reaches a terminal state."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.reconcile_once()
+            record = self.plane.get_run(run_uuid)
+            if record.is_done:
+                children = self.plane.list_runs(pipeline_uuid=run_uuid)
+                if all(c.is_done for c in children):
+                    return record.status
+            time.sleep(poll_seconds)
+        raise TimeoutError(f"Run `{run_uuid}` did not finish within {timeout}s")
+
+    def serve_forever(self, poll_seconds: float = 1.0) -> None:
+        while True:
+            did = self.reconcile_once()
+            time.sleep(poll_seconds if not did else 0.05)

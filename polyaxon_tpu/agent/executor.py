@@ -1,0 +1,258 @@
+"""Local slice executor: materializes launch plans as processes.
+
+This is the local provider behind the agent (SURVEY.md §2 "Agent", §7
+step 5): the reconcile target that upstream delegates to k8s+operator.
+It owns gang semantics in miniature — all processes of a plan start
+together, the gang fails/stops together, and preemption (real eviction
+on TPU-VMs, injected in tests) kills the gang and reports PREEMPTED so
+the scheduler can requeue without consuming retries.
+
+Modes per process:
+- runnable command (python/binaries on PATH) → subprocess, stdout/err →
+  ``logs/main-<i>.log`` in the run dir;
+- ``in_process=True`` (tests/CLI fast path, single-process jaxjob
+  gangs) → execute the builtin runtime in a thread, skipping the
+  ~20s+ JAX re-import/compile of a fresh interpreter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from polyaxon_tpu.compiler import COORDINATOR_PLACEHOLDER, ENV_JAXJOB_SPEC
+from polyaxon_tpu.compiler.plan import V1LaunchPlan
+from polyaxon_tpu.controlplane.service import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+
+
+@dataclass
+class _Gang:
+    run_uuid: str
+    plan: V1LaunchPlan
+    procs: list[subprocess.Popen] = field(default_factory=list)
+    thread: Optional[threading.Thread] = None
+    thread_error: Optional[str] = None
+    thread_done: bool = False
+    preempted: bool = False
+
+
+class LocalExecutor:
+    def __init__(self, plane: ControlPlane, *, in_process: bool = False):
+        self.plane = plane
+        self.store = plane.store
+        self.in_process = in_process
+        self._gangs: dict[str, _Gang] = {}
+
+    # ------------------------------------------------------------------ init
+    def _run_init_phases(self, plan: V1LaunchPlan) -> None:
+        """Local init phases (SURVEY §3.3): auth context stub, artifact
+        copies, tpu metadata discovery (local → loopback coordinator)."""
+        os.makedirs(plan.artifacts_dir, exist_ok=True)
+        os.makedirs(plan.outputs_dir, exist_ok=True)
+        os.makedirs(os.path.join(plan.artifacts_dir, "logs"), exist_ok=True)
+        for phase in plan.init:
+            if phase.kind == "auth":
+                with open(os.path.join(plan.artifacts_dir, ".auth"), "w") as fh:
+                    json.dump({"run_uuid": plan.run_uuid, "mode": "local"}, fh)
+            elif phase.kind == "artifacts":
+                src = phase.config.get("path") or phase.path
+                if src and os.path.exists(src):
+                    dest = os.path.join(plan.artifacts_dir, "inputs",
+                                        os.path.basename(src))
+                    os.makedirs(os.path.dirname(dest), exist_ok=True)
+                    if os.path.isdir(src):
+                        shutil.copytree(src, dest, dirs_exist_ok=True)
+                    else:
+                        shutil.copy2(src, dest)
+            elif phase.kind == "file":
+                content = phase.config.get("content", "")
+                name = phase.config.get("filename", "file")
+                path = os.path.join(plan.artifacts_dir, "inputs", name)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as fh:
+                    fh.write(content)
+            elif phase.kind == "tpu_metadata":
+                with open(os.path.join(plan.artifacts_dir, "tpu-metadata.json"), "w") as fh:
+                    json.dump({"coordinator": "127.0.0.1", "topology": "local"}, fh)
+            # git/dockerfile need network/docker: recorded, skipped locally.
+
+    # ----------------------------------------------------------------- start
+    def start(self, run_uuid: str) -> bool:
+        """queued → scheduled → starting → running; spawns the gang."""
+        record = self.store.get_run(run_uuid)
+        plan_dict = record.launch_plan
+        if not plan_dict:
+            self.store.transition(run_uuid, V1Statuses.FAILED, reason="NoLaunchPlan")
+            return False
+        plan = V1LaunchPlan.from_dict(plan_dict)
+        self.store.transition(run_uuid, V1Statuses.SCHEDULED)
+        self.store.transition(run_uuid, V1Statuses.STARTING)
+
+        gang = _Gang(run_uuid=run_uuid, plan=plan)
+        try:
+            self._run_init_phases(plan)
+            if self.in_process and self._can_run_in_process(plan):
+                gang.thread = threading.Thread(
+                    target=self._run_in_process, args=(gang,), daemon=True
+                )
+                gang.thread.start()
+            else:
+                for proc_spec in plan.processes:
+                    env = dict(os.environ)
+                    env.update(proc_spec.env)
+                    for key, value in list(env.items()):
+                        if isinstance(value, str) and COORDINATOR_PLACEHOLDER in value:
+                            env[key] = value.replace(COORDINATOR_PLACEHOLDER, "127.0.0.1")
+                    cmd = list(proc_spec.command) + list(proc_spec.args)
+                    if not cmd:
+                        raise RuntimeError("Process has no command")
+                    if shutil.which(cmd[0]) is None and not os.path.exists(cmd[0]):
+                        raise RuntimeError(
+                            f"Command `{cmd[0]}` is not executable on this host "
+                            f"(image `{proc_spec.image}` delegation needs a cluster provider)"
+                        )
+                    log_path = os.path.join(plan.artifacts_dir, "logs",
+                                            f"main-{proc_spec.index}.log")
+                    log_handle = open(log_path, "ab")
+                    proc = subprocess.Popen(
+                        cmd, env=env, stdout=log_handle, stderr=subprocess.STDOUT,
+                        cwd=proc_spec.working_dir or None, start_new_session=True,
+                    )
+                    proc._plx_log_handle = log_handle  # closed in poll()
+                    gang.procs.append(proc)
+        except Exception as exc:
+            # Kill any half-started gang members — a partial gang must not
+            # keep running unowned (gang semantics: start together or not
+            # at all).
+            for proc in gang.procs:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+                handle = getattr(proc, "_plx_log_handle", None)
+                if handle and not handle.closed:
+                    handle.close()
+            self.store.transition(run_uuid, V1Statuses.FAILED,
+                                  reason="StartError", message=str(exc)[:500])
+            return False
+        self._gangs[run_uuid] = gang
+        self.store.transition(run_uuid, V1Statuses.RUNNING)
+        return True
+
+    def _can_run_in_process(self, plan: V1LaunchPlan) -> bool:
+        return (
+            plan.run_kind == "jaxjob"
+            and plan.num_processes == 1
+            and ENV_JAXJOB_SPEC in plan.processes[0].env
+        )
+
+    def _run_in_process(self, gang: _Gang) -> None:
+        from polyaxon_tpu.polyflow.runs import V1JAXJob
+        from polyaxon_tpu.runtime.loop import run_jaxjob
+        from polyaxon_tpu.tracking.run import Run
+
+        plan = gang.plan
+        spec = json.loads(plan.processes[0].env[ENV_JAXJOB_SPEC])
+        job = V1JAXJob.from_dict(spec)
+        tracking = Run(plan.run_uuid, plan.artifacts_dir)
+        try:
+            tracking.log_status(V1Statuses.RUNNING)
+            result = run_jaxjob(job, artifacts_dir=plan.artifacts_dir,
+                                on_metrics=tracking.log_metrics_cb())
+            tracking.log_outputs(
+                steps=result.steps, throughput=result.throughput,
+                wall_time=result.wall_time, param_count=result.param_count,
+                **{f"final_{k}": v for k, v in result.final_metrics.items()},
+            )
+            tracking.log_succeeded()
+        except Exception as exc:
+            gang.thread_error = f"{type(exc).__name__}: {exc}"
+            with open(os.path.join(plan.artifacts_dir, "logs", "main-0.log"), "a") as fh:
+                fh.write(traceback.format_exc())
+            tracking.log_failed(reason=type(exc).__name__, message=str(exc)[:2000])
+        finally:
+            tracking.close()
+            gang.thread_done = True
+
+    # ------------------------------------------------------------------ poll
+    def poll(self) -> int:
+        """Reap finished gangs → terminal statuses. Returns actions."""
+        actions = 0
+        for run_uuid, gang in list(self._gangs.items()):
+            status = self._gang_status(gang)
+            if status is None:
+                continue
+            del self._gangs[run_uuid]
+            record = self.store.get_run(run_uuid)
+            if record.status == V1Statuses.STOPPING:
+                self.store.transition(run_uuid, V1Statuses.STOPPED)
+            elif gang.preempted:
+                self.store.transition(run_uuid, V1Statuses.PREEMPTED,
+                                      reason="SlicePreempted", force=True)
+            else:
+                target = V1Statuses.SUCCEEDED if status == 0 else V1Statuses.FAILED
+                self.store.transition(
+                    run_uuid, target,
+                    reason="Completed" if status == 0 else "ProcessFailed",
+                    message=gang.thread_error or (None if status == 0
+                                                  else f"exit code {status}"),
+                )
+            actions += 1
+        return actions
+
+    def _gang_status(self, gang: _Gang) -> Optional[int]:
+        """None while running; else max exit code of the gang."""
+        if gang.thread is not None:
+            if not gang.thread_done and gang.thread.is_alive():
+                return None
+            return 1 if gang.thread_error else 0
+        codes = []
+        for proc in gang.procs:
+            code = proc.poll()
+            if code is None:
+                return None
+            codes.append(code)
+        for proc in gang.procs:
+            handle = getattr(proc, "_plx_log_handle", None)
+            if handle and not handle.closed:
+                handle.close()
+        if not codes:
+            return 1
+        # Any nonzero (incl. negative signal codes) fails the gang.
+        return next((c for c in codes if c != 0), 0)
+
+    # ------------------------------------------------------------- stop/kill
+    def stop(self, run_uuid: str) -> None:
+        gang = self._gangs.get(run_uuid)
+        if gang is None:
+            return
+        for proc in gang.procs:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+
+    def preempt(self, run_uuid: str) -> bool:
+        """Simulate slice preemption (fault-injection hook — SURVEY §5.3:
+        test-only in the fake provider; real eviction signals map here)."""
+        gang = self._gangs.get(run_uuid)
+        if gang is None:
+            return False
+        gang.preempted = True
+        for proc in gang.procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        return True
+
+    @property
+    def active_runs(self) -> list[str]:
+        return list(self._gangs)

@@ -1,0 +1,12 @@
+"""``plx`` — the CLI (SURVEY.md §2 "CLI" [K]).
+
+Mirrors the reference's command surface (run / ops / projects / config /
+check / models) against the embedded control plane. State lives under
+``$POLYAXON_TPU_HOME`` (default ``~/.polyaxon_tpu``).
+
+Usage: ``python -m polyaxon_tpu.cli <command> ...``
+"""
+
+from polyaxon_tpu.cli.main import cli
+
+__all__ = ["cli"]

@@ -1,0 +1,3 @@
+from polyaxon_tpu.cli.main import cli
+
+cli()

@@ -1,0 +1,309 @@
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+import click
+
+DEFAULT_HOME = os.path.join(os.path.expanduser("~"), ".polyaxon_tpu")
+
+
+def get_home() -> str:
+    return os.environ.get("POLYAXON_TPU_HOME", DEFAULT_HOME)
+
+
+def get_plane():
+    from polyaxon_tpu.controlplane import ControlPlane
+
+    return ControlPlane(get_home())
+
+
+def get_run_or_fail(plane, uid):
+    try:
+        return plane.get_run(uid)
+    except KeyError as exc:
+        raise click.ClickException(str(exc.args[0])) from exc
+
+
+def _parse_params(params: tuple[str, ...]) -> dict:
+    out = {}
+    for item in params:
+        if "=" not in item:
+            raise click.BadParameter(f"-P expects name=value, got `{item}`")
+        name, raw = item.split("=", 1)
+        try:
+            out[name] = json.loads(raw)
+        except json.JSONDecodeError:
+            out[name] = raw
+    return out
+
+
+def _echo_run(record, verbose: bool = False) -> None:
+    status = record.status.value if hasattr(record.status, "value") else record.status
+    click.echo(f"{record.uuid}  {status:12s}  {record.kind or '-':10s}  "
+               f"{record.project}/{record.name or '-'}")
+    if verbose and record.meta:
+        click.echo(f"  meta: {json.dumps(record.meta)[:200]}")
+
+
+@click.group()
+def cli():
+    """polyaxon_tpu: TPU-native ML orchestration."""
+
+
+# ---------------------------------------------------------------------- run
+@cli.command()
+@click.option("-f", "--polyaxonfile", "files", multiple=True, type=click.Path(),
+              help="Polyaxonfile path(s); later files patch earlier ones.")
+@click.option("-P", "--param", "params", multiple=True, help="name=value override")
+@click.option("--preset", "presets", multiple=True, help="preset file/name to apply")
+@click.option("-p", "--project", default="default")
+@click.option("--name", default=None)
+@click.option("--hub", default=None, help="hub component ref")
+@click.option("-w", "--watch", is_flag=True, help="execute locally and stream status")
+@click.option("--eager", is_flag=True, help="alias for --watch")
+@click.option("-u", "--upload", is_flag=True, hidden=True)
+def run(files, params, presets, project, name, hub, watch, eager, upload):
+    """Submit an operation (optionally executing it to completion)."""
+    from polyaxon_tpu.polyaxonfile import PolyaxonfileError
+
+    plane = get_plane()
+    try:
+        record = plane.submit(
+            list(files) if files else None,
+            project=project,
+            params=_parse_params(params),
+            presets=list(presets) or None,
+            name=name,
+        )
+    except (PolyaxonfileError, ValueError) as exc:
+        raise click.ClickException(str(exc)) from exc
+    click.echo(f"Run created: {record.uuid} (project={project})")
+    if watch or eager:
+        from polyaxon_tpu.agent import Agent
+
+        agent = Agent(plane, in_process=True)
+        click.echo("Executing locally...")
+        last = None
+        deadline = time.monotonic() + 24 * 3600
+        while time.monotonic() < deadline:
+            agent.reconcile_once()
+            current = plane.get_run(record.uuid)
+            if current.status != last:
+                click.echo(f"  status: {current.status.value}")
+                last = current.status
+            if current.is_done:
+                children = plane.list_runs(pipeline_uuid=record.uuid)
+                if all(c.is_done for c in children):
+                    break
+            time.sleep(0.3)
+        outputs = plane.streams.get_outputs(record.uuid)
+        if outputs:
+            click.echo("outputs: " + json.dumps(outputs, indent=2, default=str))
+        sys.exit(0 if plane.get_run(record.uuid).status.value == "succeeded" else 1)
+
+
+# ---------------------------------------------------------------------- ops
+@cli.group()
+def ops():
+    """Inspect and manage runs."""
+
+
+@ops.command("ls")
+@click.option("-p", "--project", default=None)
+@click.option("--status", default=None)
+@click.option("--limit", default=50)
+def ops_ls(project, status, limit):
+    from polyaxon_tpu.lifecycle import V1Statuses
+
+    plane = get_plane()
+    statuses = [V1Statuses(status)] if status else None
+    for record in plane.list_runs(project=project, statuses=statuses, limit=limit):
+        _echo_run(record)
+
+
+@ops.command("get")
+@click.option("-uid", "--uid", required=True)
+def ops_get(uid):
+    plane = get_plane()
+    record = get_run_or_fail(plane, uid)
+    data = {
+        "uuid": record.uuid, "project": record.project, "name": record.name,
+        "kind": record.kind, "status": record.status.value,
+        "created_at": record.created_at, "finished_at": record.finished_at,
+        "meta": record.meta, "params": record.params,
+    }
+    click.echo(json.dumps(data, indent=2, default=str))
+
+
+@ops.command("statuses")
+@click.option("-uid", "--uid", required=True)
+def ops_statuses(uid):
+    plane = get_plane()
+    for cond in plane.get_statuses(uid):
+        click.echo(f"{cond['created_at']}  {cond['type']:16s} "
+                   f"{cond.get('reason') or ''} {cond.get('message') or ''}")
+
+
+@ops.command("logs")
+@click.option("-uid", "--uid", required=True)
+@click.option("--follow", is_flag=True)
+def ops_logs(uid, follow):
+    plane = get_plane()
+    names = plane.streams.log_files(uid)
+    if not names:
+        click.echo("(no logs)")
+        return
+    for name in names:
+        chunk, _ = plane.streams.read_logs(uid, name)
+        if chunk:
+            click.echo(chunk, nl=False)
+    if follow:
+        record = get_run_or_fail(plane, uid)
+
+        def done():
+            return plane.get_run(uid).is_done
+
+        if not record.is_done:
+            for chunk in plane.streams.follow_logs(uid, names[0], should_stop=done):
+                click.echo(chunk, nl=False)
+
+
+@ops.command("outputs")
+@click.option("-uid", "--uid", required=True)
+def ops_outputs(uid):
+    plane = get_plane()
+    click.echo(json.dumps(plane.streams.get_outputs(uid), indent=2, default=str))
+
+
+@ops.command("artifacts")
+@click.option("-uid", "--uid", required=True)
+def ops_artifacts(uid):
+    plane = get_plane()
+    for rel in plane.streams.list_artifacts(uid):
+        click.echo(rel)
+
+
+@ops.command("metrics")
+@click.option("-uid", "--uid", required=True)
+@click.option("--name", "names", multiple=True)
+def ops_metrics(uid, names):
+    plane = get_plane()
+    metrics = plane.streams.get_metrics(uid, list(names) or None)
+    click.echo(json.dumps(metrics, indent=2, default=str))
+
+
+@ops.command("stop")
+@click.option("-uid", "--uid", required=True)
+def ops_stop(uid):
+    plane = get_plane()
+    plane.stop(uid)
+    click.echo(f"Stop requested for {uid}")
+
+
+@ops.command("restart")
+@click.option("-uid", "--uid", required=True)
+@click.option("--copy", is_flag=True)
+def ops_restart(uid, copy):
+    plane = get_plane()
+    record = plane.restart(uid, copy=copy)
+    click.echo(f"Restarted as {record.uuid}")
+
+
+@ops.command("resume")
+@click.option("-uid", "--uid", required=True)
+def ops_resume(uid):
+    plane = get_plane()
+    try:
+        record = plane.resume(uid)
+    except ValueError as exc:
+        raise click.ClickException(str(exc)) from exc
+    click.echo(f"Resumed {record.uuid}")
+
+
+# ------------------------------------------------------------------ project
+@cli.group()
+def projects():
+    """Manage projects."""
+
+
+@projects.command("create")
+@click.option("--name", required=True)
+@click.option("--description", default="")
+def projects_create(name, description):
+    plane = get_plane()
+    plane.store.create_project(name, description)
+    click.echo(f"Project `{name}` created")
+
+
+@projects.command("ls")
+def projects_ls():
+    plane = get_plane()
+    for proj in plane.store.list_projects():
+        click.echo(f"{proj['name']}  {proj.get('description') or ''}")
+
+
+# -------------------------------------------------------------------- check
+@cli.command()
+@click.option("-f", "--polyaxonfile", "files", multiple=True, required=True,
+              type=click.Path())
+@click.option("-P", "--param", "params", multiple=True)
+def check(files, params):
+    """Validate a Polyaxonfile and print the resolved operation."""
+    from polyaxon_tpu.polyaxonfile import PolyaxonfileError, check_polyaxonfile
+
+    try:
+        op = check_polyaxonfile(list(files), params=_parse_params(params))
+    except (PolyaxonfileError, ValueError) as exc:
+        raise click.ClickException(str(exc)) from exc
+    click.echo(json.dumps(op.to_dict(), indent=2, default=str))
+
+
+# -------------------------------------------------------------------- agent
+@cli.command("agent")
+@click.option("--poll", default=1.0)
+@click.option("--max-concurrent", default=4)
+def agent_cmd(poll, max_concurrent):
+    """Run the agent reconcile loop in the foreground."""
+    from polyaxon_tpu.agent import Agent
+
+    plane = get_plane()
+    agent = Agent(plane, max_concurrent=max_concurrent)
+    click.echo(f"Agent serving (home={get_home()})")
+    agent.serve_forever(poll_seconds=poll)
+
+
+# ------------------------------------------------------------------- models
+@cli.command("models")
+def models_cmd():
+    """List builtin model zoo entries."""
+    from polyaxon_tpu.models import available_models
+
+    for name in available_models():
+        click.echo(name)
+
+
+@cli.command("config")
+@click.option("--set", "sets", multiple=True, help="key=value")
+def config_cmd(sets):
+    """Show or set client config (home dir based)."""
+    path = os.path.join(get_home(), "config.json")
+    cfg = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            cfg = json.load(fh)
+    for item in sets:
+        key, _, value = item.partition("=")
+        cfg[key] = value
+    if sets:
+        os.makedirs(get_home(), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(cfg, fh, indent=2)
+    click.echo(json.dumps({"home": get_home(), **cfg}, indent=2))
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,21 @@
+from polyaxon_tpu.compiler.compile import CompilerError, ENV_JAXJOB_SPEC, compile_operation
+from polyaxon_tpu.compiler.plan import (
+    COORDINATOR_PLACEHOLDER,
+    V1InitPhase,
+    V1LaunchPlan,
+    V1ProcessSpec,
+    V1ResourceRequest,
+    V1SidecarSpec,
+)
+
+__all__ = [
+    "COORDINATOR_PLACEHOLDER",
+    "CompilerError",
+    "ENV_JAXJOB_SPEC",
+    "V1InitPhase",
+    "V1LaunchPlan",
+    "V1ProcessSpec",
+    "V1ResourceRequest",
+    "V1SidecarSpec",
+    "compile_operation",
+]

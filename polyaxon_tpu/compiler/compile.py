@@ -1,0 +1,331 @@
+"""Compile a resolved operation into a launch plan.
+
+One converter per run kind, mirroring the reference's
+``converter/converters/{job,service,kubeflow}.py`` split (SURVEY.md §2
+[K]) with a native jaxjob converter replacing the Kubeflow delegation:
+
+- jaxjob → one SPMD process per slice host; env contract carries the
+  ``jax.distributed`` bootstrap (coordinator/process id/count over DCN)
+  and the tracking paths; resources request ``google.com/tpu`` with
+  topology [B].
+- tfjob/pytorchjob/mpijob → per-replica processes with the frameworks'
+  rendezvous env (TF_CONFIG / MASTER_ADDR+RANK / OMPI vars) so existing
+  Polyaxonfiles compile unchanged; execution of those frameworks is
+  delegated, as upstream does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from polyaxon_tpu.compiler.plan import (
+    COORDINATOR_PLACEHOLDER,
+    COORDINATOR_PORT,
+    V1InitPhase,
+    V1LaunchPlan,
+    V1ProcessSpec,
+    V1ResourceRequest,
+    V1SidecarSpec,
+    builtin_runtime_command,
+    sidecar_sync_command,
+)
+from polyaxon_tpu.parallel.bootstrap import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+from polyaxon_tpu.polyflow.component import V1Component
+from polyaxon_tpu.polyflow.environment import TPU_RESOURCE
+from polyaxon_tpu.polyflow.operation import V1Operation
+from polyaxon_tpu.polyflow.runs import V1JAXJob, V1RunKind
+from polyaxon_tpu.tracking.run import (
+    ENV_ARTIFACTS_PATH,
+    ENV_OUTPUTS_PATH,
+    ENV_PROJECT,
+    ENV_RUN_NAME,
+    ENV_RUN_UUID,
+)
+
+ENV_JAXJOB_SPEC = "POLYAXON_JAXJOB_SPEC"
+
+
+class CompilerError(ValueError):
+    pass
+
+
+def _base_env(plan_args: dict[str, Any]) -> dict[str, str]:
+    env = {
+        ENV_RUN_UUID: plan_args["run_uuid"],
+        ENV_RUN_NAME: plan_args.get("run_name") or "",
+        ENV_PROJECT: plan_args.get("project") or "",
+        ENV_ARTIFACTS_PATH: plan_args["artifacts_dir"],
+        ENV_OUTPUTS_PATH: plan_args["outputs_dir"],
+    }
+    return env
+
+
+def _io_env(op: V1Operation) -> dict[str, str]:
+    """Params/IO routed to env via ``toEnv`` (SURVEY §2 IO contract)."""
+    env: dict[str, str] = {}
+    component = op.component
+    if component is None:
+        return env
+    params = op.params or {}
+    for io in (component.inputs or []) + (component.outputs or []):
+        if not io.to_env:
+            continue
+        param = params.get(io.name)
+        value = param.value if param is not None else io.value
+        if value is not None:
+            env[io.to_env] = value if isinstance(value, str) else json.dumps(value)
+    return env
+
+
+def _container_cmd(container) -> tuple[list[str], list[str]]:
+    command = container.command_list() if container else []
+    args = container.args_list() if container else []
+    return command, [str(a) for a in args]
+
+
+def _init_phases(run, plugins) -> list[V1InitPhase]:
+    phases: list[V1InitPhase] = []
+    if plugins is None or plugins.auth is not False:
+        phases.append(V1InitPhase(kind="auth", config={}))
+    for init in getattr(run, "init", None) or []:
+        if init.git is not None:
+            phases.append(V1InitPhase(kind="git", config=init.git,
+                                      connection=init.connection, path=init.path))
+        elif init.artifacts is not None:
+            phases.append(V1InitPhase(kind="artifacts", config=init.artifacts,
+                                      connection=init.connection, path=init.path))
+        elif init.dockerfile is not None:
+            phases.append(V1InitPhase(kind="dockerfile", config=init.dockerfile))
+        elif init.file is not None:
+            phases.append(V1InitPhase(kind="file", config=init.file, path=init.path))
+        elif init.tpu_metadata:
+            phases.append(V1InitPhase(kind="tpu_metadata", config={}))
+        elif init.container is not None:
+            phases.append(V1InitPhase(kind="container",
+                                      config=init.container.to_dict()))
+    return phases
+
+
+def _sidecars(run, plugins, artifacts_dir: str, store_dir: Optional[str]) -> list[V1SidecarSpec]:
+    sidecars: list[V1SidecarSpec] = []
+    collect = plugins is None or plugins.collect_logs is not False or bool(
+        plugins and plugins.collect_artifacts
+    )
+    if collect and store_dir:
+        sidecars.append(
+            V1SidecarSpec(
+                kind="sync",
+                command=sidecar_sync_command(artifacts_dir, store_dir),
+                config={"store_dir": store_dir},
+            )
+        )
+    for sc in getattr(run, "sidecars", None) or []:
+        cmd, args = _container_cmd(sc)
+        sidecars.append(V1SidecarSpec(kind="container", command=cmd + args,
+                                      config=sc.to_dict()))
+    return sidecars
+
+
+# ---------------------------------------------------------------------------
+# Converters per kind
+# ---------------------------------------------------------------------------
+
+def _compile_jaxjob(job: V1JAXJob, plan_args, env_base) -> tuple[V1ResourceRequest, list[V1ProcessSpec]]:
+    topo = job.get_topology()
+    n_proc = job.num_processes or topo.total_hosts()
+    resources = V1ResourceRequest(
+        resources={TPU_RESOURCE: topo.chips_per_slice() // max(topo.hosts_per_slice(), 1)},
+        accelerator=topo.accelerator,
+        topology=topo.topology,
+        slices=topo.slices,
+        chips=topo.total_chips(),
+        hosts=n_proc,
+        preemptible=bool(topo.preemptible),
+        node_selector=(job.environment.node_selector if job.environment else None),
+    )
+    if job.runtime is not None:
+        command, args = builtin_runtime_command(), []
+        extra_env = {ENV_JAXJOB_SPEC: json.dumps(job.to_dict())}
+    else:
+        command, args = _container_cmd(job.container)
+        extra_env = {}
+
+    processes = []
+    for idx in range(n_proc):
+        env = dict(env_base)
+        env.update(extra_env)
+        env.update({
+            ENV_NUM_PROCESSES: str(n_proc),
+            ENV_PROCESS_ID: str(idx),
+            ENV_COORDINATOR: f"{COORDINATOR_PLACEHOLDER}:{COORDINATOR_PORT}",
+        })
+        if job.container and job.container.env:
+            env.update({e.name: str(e.value) for e in job.container.env if e.value is not None})
+        processes.append(
+            V1ProcessSpec(
+                index=idx, host_index=idx, command=command, args=args, env=env,
+                image=(job.container.image if job.container else None),
+                working_dir=(job.container.working_dir if job.container else None),
+            )
+        )
+    return resources, processes
+
+
+def _kf_env(kind: str, replica: str, idx: int, global_idx: int, topology: dict) -> dict[str, str]:
+    """Framework rendezvous env for delegated kinds (SURVEY §2c)."""
+    if kind == V1RunKind.TFJOB:
+        cluster = {
+            name: [f"{name}-{i}.gang:2222" for i in range(count)]
+            for name, count in topology.items()
+        }
+        return {"TF_CONFIG": json.dumps(
+            {"cluster": cluster, "task": {"type": replica, "index": idx}}
+        )}
+    if kind == V1RunKind.PYTORCHJOB:
+        world = sum(topology.values())
+        return {
+            "MASTER_ADDR": "master-0.gang" if "master" in topology else "worker-0.gang",
+            "MASTER_PORT": "23456",
+            "WORLD_SIZE": str(world),
+            "RANK": str(global_idx),
+        }
+    if kind == V1RunKind.MPIJOB:
+        return {
+            "OMPI_MCA_orte_keep_fqdn_hostnames": "true",
+            "OMPI_COMM_WORLD_SIZE": str(sum(topology.values())),
+            "OMPI_COMM_WORLD_RANK": str(global_idx),
+        }
+    return {}
+
+
+def _compile_kubeflow(run, kind: str, plan_args, env_base):
+    replica_map = run.replica_map()
+    if not replica_map:
+        raise CompilerError(f"{kind} requires at least one replica spec")
+    topology = {name: (rep.replicas or 1) for name, rep in replica_map.items()}
+    processes = []
+    chips = 0
+    accelerator = None
+    global_idx = 0
+    for name, rep in replica_map.items():
+        cmd, args = _container_cmd(rep.container)
+        for i in range(rep.replicas or 1):
+            env = dict(env_base)
+            env.update(_kf_env(kind, name, i, global_idx, topology))
+            if rep.container and rep.container.env:
+                env.update({e.name: str(e.value) for e in rep.container.env
+                            if e.value is not None})
+            processes.append(
+                V1ProcessSpec(
+                    index=global_idx, host_index=global_idx, replica_name=name,
+                    command=cmd, args=args, env=env,
+                    image=(rep.container.image if rep.container else None),
+                )
+            )
+            global_idx += 1
+        if rep.container and rep.container.resources:
+            chips += rep.container.resources.tpu_chips() * (rep.replicas or 1)
+        if rep.environment and rep.environment.tpu:
+            accelerator = rep.environment.tpu.accelerator
+    resources = V1ResourceRequest(
+        resources={TPU_RESOURCE: chips} if chips else {},
+        accelerator=accelerator, chips=chips, hosts=len(processes),
+    )
+    return resources, processes
+
+
+def _compile_job(run, plan_args, env_base, *, service: bool = False):
+    cmd, args = _container_cmd(run.container)
+    env = dict(env_base)
+    if run.container and run.container.env:
+        env.update({e.name: str(e.value) for e in run.container.env if e.value is not None})
+    tpu = run.environment.tpu if run.environment else None
+    resources = V1ResourceRequest(
+        resources=(run.container.resources.to_dict()
+                   if run.container and run.container.resources else {}),
+        accelerator=(tpu.accelerator if tpu else None),
+        topology=(tpu.topology if tpu else None),
+        preemptible=bool(tpu.preemptible) if tpu else False,
+        chips=(tpu.total_chips() if tpu else 0),
+        node_selector=(run.environment.node_selector if run.environment else None),
+    )
+    n = (run.replicas or 1) if service else 1
+    processes = []
+    for i in range(n):
+        penv = dict(env)
+        spec = V1ProcessSpec(
+            index=i, command=cmd, args=args, env=penv,
+            image=(run.container.image if run.container else None),
+            working_dir=(run.container.working_dir if run.container else None),
+            ports=(run.ports if service else None),
+        )
+        processes.append(spec)
+    return resources, processes
+
+
+def compile_operation(
+    op: V1Operation,
+    *,
+    run_uuid: str,
+    artifacts_root: str,
+    project: str = "default",
+    store_dir: Optional[str] = None,
+) -> V1LaunchPlan:
+    """Resolved operation (literal params — run through
+    ``resolve_operation_context`` first) → launch plan."""
+    if op.component is None:
+        raise CompilerError("Cannot compile an operation without a resolved component")
+    component: V1Component = op.component
+    run = component.run
+    kind = component.run_kind
+
+    artifacts_dir = os.path.join(artifacts_root, run_uuid)
+    outputs_dir = os.path.join(artifacts_dir, "outputs")
+    plan_args = {
+        "run_uuid": run_uuid,
+        "run_name": op.name or component.name,
+        "project": project,
+        "artifacts_dir": artifacts_dir,
+        "outputs_dir": outputs_dir,
+    }
+    env_base = _base_env(plan_args)
+    env_base.update(_io_env(op))
+
+    if kind == V1RunKind.JAXJOB:
+        resources, processes = _compile_jaxjob(run, plan_args, env_base)
+    elif kind in (V1RunKind.TFJOB, V1RunKind.PYTORCHJOB, V1RunKind.MPIJOB):
+        resources, processes = _compile_kubeflow(run, kind, plan_args, env_base)
+    elif kind == V1RunKind.JOB or kind == V1RunKind.NOTIFIER or kind == V1RunKind.CLEANER:
+        resources, processes = _compile_job(run, plan_args, env_base)
+    elif kind == V1RunKind.SERVICE:
+        resources, processes = _compile_job(run, plan_args, env_base, service=True)
+    else:
+        raise CompilerError(f"Run kind `{kind}` is not compilable to a launch plan")
+
+    plugins = op.plugins or component.plugins
+    termination = None
+    if op.termination or component.termination:
+        termination = (op.termination or component.termination).to_dict()
+
+    return V1LaunchPlan(
+        run_uuid=run_uuid,
+        run_name=plan_args["run_name"],
+        project=project,
+        run_kind=kind,
+        artifacts_dir=artifacts_dir,
+        outputs_dir=outputs_dir,
+        resources=resources,
+        num_processes=len(processes),
+        processes=processes,
+        init=_init_phases(run, plugins),
+        sidecars=_sidecars(run, plugins, artifacts_dir, store_dir),
+        termination=termination,
+        queue=op.queue or component.queue,
+        labels=(run.environment.labels if getattr(run, "environment", None) else None),
+    )

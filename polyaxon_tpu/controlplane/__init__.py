@@ -1,0 +1,5 @@
+from polyaxon_tpu.controlplane.scheduler import Scheduler
+from polyaxon_tpu.controlplane.service import ControlPlane
+from polyaxon_tpu.controlplane.store import RunRecord, Store
+
+__all__ = ["ControlPlane", "RunRecord", "Scheduler", "Store"]

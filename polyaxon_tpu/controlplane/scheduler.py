@@ -1,0 +1,409 @@
+"""Background scheduler: compiles created runs, expands and advances
+pipelines (DAG + matrix/tuner iterations).
+
+haupt's orchestration/scheduler equivalent (SURVEY.md §2 "Scheduler",
+§3.2, §3.4 [K]). Everything is driven by idempotent ``tick()`` passes
+over the store — no celery; the agent loop (or a test) calls tick.
+
+Matrix state machines live in the pipeline run's ``meta["tuner"]``:
+  grid/random/mapping → one-shot fan-out with a concurrency window;
+  hyperband           → per-(bracket, rung) advancement with
+                         preemption-requeue (SURVEY §7 hard-part 4);
+  bayes               → initial batch, then GP-suggested singles.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from polyaxon_tpu.controlplane.service import ControlPlane
+from polyaxon_tpu.controlplane.store import RunRecord
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.polyaxonfile import get_operation
+from polyaxon_tpu.polyflow.matrix import (
+    V1Bayes,
+    V1GridSearch,
+    V1Hyperband,
+    V1Mapping,
+    V1RandomSearch,
+)
+from polyaxon_tpu.polyflow.operation import V1Operation, V1TriggerPolicy
+from polyaxon_tpu.polyflow.runs import V1RunKind
+from polyaxon_tpu.tune import (
+    BayesManager,
+    GridSearchManager,
+    HyperbandManager,
+    MappingManager,
+    Observation,
+    RandomSearchManager,
+)
+
+logger = logging.getLogger(__name__)
+
+_DONE = V1Statuses.terminal_values()
+
+
+def _trigger_satisfied(policy: str, statuses: list[V1Statuses]) -> Optional[bool]:
+    """True → start, False → won't ever start, None → keep waiting."""
+    done = [s for s in statuses if s in _DONE]
+    succeeded = [s for s in done if s == V1Statuses.SUCCEEDED]
+    # Anything done-but-not-succeeded (incl. SKIPPED) blocks ALL_SUCCEEDED —
+    # a skipped upstream must resolve the trigger, never stall it.
+    failed = [s for s in done if s != V1Statuses.SUCCEEDED]
+    policy = policy or V1TriggerPolicy.ALL_SUCCEEDED
+    n = len(statuses)
+    if policy == V1TriggerPolicy.ALL_SUCCEEDED:
+        if failed:
+            return False
+        return True if len(succeeded) == n else None
+    if policy == V1TriggerPolicy.ALL_FAILED:
+        if succeeded:
+            return False
+        return True if len(failed) == n else None
+    if policy == V1TriggerPolicy.ALL_DONE:
+        return True if len(done) == n else None
+    if policy == V1TriggerPolicy.ONE_SUCCEEDED:
+        if succeeded:
+            return True
+        return False if len(done) == n else None
+    if policy == V1TriggerPolicy.ONE_FAILED:
+        if failed:
+            return True
+        return False if len(done) == n else None
+    if policy == V1TriggerPolicy.ONE_DONE:
+        return True if done else None
+    return None
+
+
+class Scheduler:
+    def __init__(self, plane: ControlPlane):
+        self.plane = plane
+        self.store = plane.store
+
+    # ------------------------------------------------------------------ tick
+    def tick(self) -> int:
+        """One idempotent scheduling pass; returns number of actions."""
+        actions = 0
+        for record in self.store.list_runs(statuses=[V1Statuses.CREATED]):
+            if record.kind == V1RunKind.DAG and record.pipeline_uuid:
+                pass  # nested dags compile like any pipeline
+            self.plane.compile_run(record.uuid)
+            actions += 1
+        for record in self.store.list_runs(statuses=[V1Statuses.QUEUED, V1Statuses.RUNNING]):
+            if record.kind == "matrix":
+                actions += self._tick_matrix(record)
+            elif record.kind == V1RunKind.DAG:
+                actions += self._tick_dag(record)
+        for record in self.store.list_runs(statuses=[V1Statuses.PREEMPTED]):
+            actions += self._tick_preempted(record)
+        return actions
+
+    # ------------------------------------------------------------ preemption
+    def _tick_preempted(self, record: RunRecord) -> int:
+        """Requeue preempted runs per termination policy (preemption does
+        not consume a retry unless the spec says so — TPU-native rule)."""
+        op = get_operation(record.spec)
+        term = op.termination or (op.component.termination if op.component else None)
+        counts = bool(term and term.preemption_counts_as_retry)
+        max_retries = term.max_retries if term and term.max_retries is not None else 3
+        if counts:
+            if record.retries + 1 > max_retries:
+                self.store.transition(record.uuid, V1Statuses.FAILED,
+                                      reason="RetriesExhausted")
+                return 1
+            self.store.update_run(record.uuid, retries=record.retries + 1)
+        self.store.transition(record.uuid, V1Statuses.RETRYING, reason="Preempted")
+        self.store.transition(record.uuid, V1Statuses.QUEUED)
+        return 1
+
+    # ------------------------------------------------------------------- dag
+    def _tick_dag(self, record: RunRecord) -> int:
+        op = get_operation(record.spec)
+        dag = op.component.run
+        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        by_name = {c.name: c for c in children}
+        actions = 0
+
+        if record.status == V1Statuses.QUEUED:
+            self.store.transition(record.uuid, V1Statuses.SCHEDULED)
+            self.store.transition(record.uuid, V1Statuses.RUNNING,
+                                  reason="PipelineRunning", force=True)
+            actions += 1
+
+        for op_data in dag.operations:
+            child_op = op_data if isinstance(op_data, V1Operation) else get_operation(dict(op_data))
+            cname = child_op.name
+            if cname in by_name:
+                continue
+            deps = child_op.dependencies or []
+            dep_statuses = [by_name[d].status for d in deps if d in by_name]
+            if len(dep_statuses) < len(deps):
+                continue  # upstream not created yet
+            verdict = _trigger_satisfied(child_op.trigger, dep_statuses) if deps else True
+            if verdict is None:
+                continue
+            if verdict is False:
+                skip = bool(child_op.skip_on_upstream_skip) or any(
+                    s == V1Statuses.SKIPPED for s in dep_statuses
+                )
+                created = self.plane.submit(
+                    op=child_op, project=record.project, name=cname,
+                    pipeline_uuid=record.uuid, parent_uuid=record.uuid,
+                )
+                self.store.transition(
+                    created.uuid,
+                    V1Statuses.SKIPPED if skip else V1Statuses.UPSTREAM_FAILED,
+                    reason="UpstreamTrigger", force=True,
+                )
+                actions += 1
+                continue
+            self.plane.submit(
+                op=child_op, project=record.project, name=cname,
+                pipeline_uuid=record.uuid, parent_uuid=record.uuid,
+            )
+            actions += 1
+
+        # Pipeline completion: every declared op exists and is done.
+        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        declared = len(dag.operations)
+        if len(children) == declared and all(c.is_done for c in children):
+            failed = any(c.status in (V1Statuses.FAILED, V1Statuses.UPSTREAM_FAILED)
+                         for c in children)
+            self.store.transition(
+                record.uuid,
+                V1Statuses.FAILED if failed else V1Statuses.SUCCEEDED,
+                reason="PipelineDone",
+            )
+            actions += 1
+        return actions
+
+    # ---------------------------------------------------------------- matrix
+    def _observations(self, record: RunRecord, metric_name: str,
+                      children: list[RunRecord]) -> list[Observation]:
+        obs = []
+        for child in children:
+            params = (child.meta or {}).get("trial_params") or {}
+            if child.status == V1Statuses.SUCCEEDED:
+                value = self.plane.get_metric(child.uuid, metric_name)
+                obs.append(Observation(params=params, metric=value,
+                                       status="succeeded"))
+            elif child.status == V1Statuses.PREEMPTED:
+                obs.append(Observation(params=params, metric=None, status="preempted"))
+            elif child.is_done:
+                obs.append(Observation(params=params, metric=None, status="failed"))
+        return obs
+
+    def _spawn_trial(self, record: RunRecord, op: V1Operation, params: dict,
+                     index: int, iteration: Optional[int] = None,
+                     extra_meta: Optional[dict] = None) -> RunRecord:
+        child_spec = op.clone()
+        child_spec.matrix = None
+        child_spec.name = None
+        meta = {"trial_params": params, "trial_index": index}
+        if extra_meta:
+            meta.update(extra_meta)
+        return self.plane.submit(
+            op=child_spec,
+            project=record.project,
+            name=f"{record.name or 'matrix'}-{index}",
+            pipeline_uuid=record.uuid,
+            parent_uuid=record.uuid,
+            iteration=iteration,
+            meta=meta,
+        )
+
+    def _tick_matrix(self, record: RunRecord) -> int:
+        op = get_operation(record.spec)
+        matrix = op.matrix
+        meta = dict(record.meta or {})
+        tuner: dict[str, Any] = meta.get("tuner") or {}
+        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        actions = 0
+
+        if record.status == V1Statuses.QUEUED:
+            self.store.transition(record.uuid, V1Statuses.SCHEDULED)
+            self.store.transition(record.uuid, V1Statuses.RUNNING,
+                                  reason="TunerRunning", force=True)
+            actions += 1
+
+        if isinstance(matrix, (V1GridSearch, V1RandomSearch, V1Mapping)):
+            actions += self._tick_oneshot(record, op, matrix, tuner, meta, children)
+        elif isinstance(matrix, V1Hyperband):
+            actions += self._tick_hyperband(record, op, matrix, tuner, meta, children)
+        elif isinstance(matrix, V1Bayes):
+            actions += self._tick_bayes(record, op, matrix, tuner, meta, children)
+        else:
+            self.store.transition(record.uuid, V1Statuses.FAILED,
+                                  reason="UnsupportedMatrix",
+                                  message=f"{type(matrix).__name__}")
+            actions += 1
+        return actions
+
+    def _finish_if_done(self, record: RunRecord, children: list[RunRecord],
+                        expected: int) -> int:
+        if len(children) >= expected and all(c.is_done for c in children):
+            all_failed = children and all(
+                c.status in (V1Statuses.FAILED, V1Statuses.UPSTREAM_FAILED)
+                for c in children
+            )
+            self.store.transition(
+                record.uuid,
+                V1Statuses.FAILED if all_failed else V1Statuses.SUCCEEDED,
+                reason="TunerDone",
+            )
+            return 1
+        return 0
+
+    def _tick_oneshot(self, record, op, matrix, tuner, meta, children) -> int:
+        actions = 0
+        if not tuner.get("suggested"):
+            if isinstance(matrix, V1GridSearch):
+                suggestions = GridSearchManager(matrix).get_suggestions()
+            elif isinstance(matrix, V1RandomSearch):
+                suggestions = RandomSearchManager(matrix).get_suggestions()
+            else:
+                suggestions = MappingManager(matrix).get_suggestions()
+            tuner = {"suggested": True, "pending": suggestions, "spawned": 0,
+                     "total": len(suggestions)}
+        concurrency = matrix.concurrency or 0
+        pending = list(tuner.get("pending") or [])
+        active = len([c for c in children if not c.is_done])
+        while pending and (not concurrency or active < concurrency):
+            params = pending.pop(0)
+            self._spawn_trial(record, op, params, tuner["spawned"])
+            tuner["spawned"] += 1
+            active += 1
+            actions += 1
+        tuner["pending"] = pending
+        meta["tuner"] = tuner
+        self.store.update_run(record.uuid, meta=meta)
+        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        actions += self._finish_if_done(record, children, tuner.get("total", 0))
+        return actions
+
+    def _tick_hyperband(self, record, op, matrix: V1Hyperband, tuner, meta, children) -> int:
+        manager = HyperbandManager(matrix)
+        actions = 0
+        if not tuner:
+            bracket = manager.brackets()[0]
+            rung = manager.first_rung(bracket)
+            tuner = {"bracket": bracket, "rung": 0, "spawned": 0,
+                     "rung_uuids": [], "bracket_index": 0}
+            for params in rung.suggestions:
+                trial = dict(params)
+                trial[manager.resource_param()] = rung.resource
+                child = self._spawn_trial(
+                    record, op, trial, tuner["spawned"],
+                    iteration=0, extra_meta={"bracket": bracket, "rung": 0},
+                )
+                tuner["rung_uuids"].append(child.uuid)
+                tuner["spawned"] += 1
+                actions += 1
+            meta["tuner"] = tuner
+            self.store.update_run(record.uuid, meta=meta)
+            return actions
+
+        rung_children = [c for c in children if c.uuid in set(tuner["rung_uuids"])]
+        # Requeue preempted trials at the same rung with the same params.
+        for child in rung_children:
+            if child.status == V1Statuses.PREEMPTED:
+                return 0  # scheduler's preemption pass requeues it in place
+        if not all(c.is_done for c in rung_children):
+            return 0
+
+        obs = self._observations(record, matrix.metric.name, rung_children)
+        s, i = tuner["bracket"], tuner["rung"]
+        next_rung = manager.next_rung(s, i, obs)
+        if next_rung is not None:
+            tuner["rung"] = next_rung.rung
+            tuner["rung_uuids"] = []
+            for params in next_rung.suggestions:
+                trial = dict(params)
+                trial[manager.resource_param()] = next_rung.resource
+                child = self._spawn_trial(
+                    record, op, trial, tuner["spawned"],
+                    iteration=next_rung.rung,
+                    extra_meta={"bracket": s, "rung": next_rung.rung},
+                )
+                tuner["rung_uuids"].append(child.uuid)
+                tuner["spawned"] += 1
+                actions += 1
+            meta["tuner"] = tuner
+            self.store.update_run(record.uuid, meta=meta)
+            return actions
+
+        # Bracket exhausted → next bracket or done.
+        brackets = manager.brackets()
+        next_index = tuner["bracket_index"] + 1
+        if next_index < len(brackets):
+            bracket = brackets[next_index]
+            rung = manager.first_rung(bracket)
+            tuner.update({"bracket": bracket, "rung": 0, "rung_uuids": [],
+                          "bracket_index": next_index})
+            for params in rung.suggestions:
+                trial = dict(params)
+                trial[manager.resource_param()] = rung.resource
+                child = self._spawn_trial(
+                    record, op, trial, tuner["spawned"],
+                    iteration=0, extra_meta={"bracket": bracket, "rung": 0},
+                )
+                tuner["rung_uuids"].append(child.uuid)
+                tuner["spawned"] += 1
+                actions += 1
+            meta["tuner"] = tuner
+            self.store.update_run(record.uuid, meta=meta)
+            return actions
+
+        all_children = self.store.list_runs(pipeline_uuid=record.uuid)
+        any_ok = any(c.status == V1Statuses.SUCCEEDED for c in all_children)
+        self.store.transition(
+            record.uuid,
+            V1Statuses.SUCCEEDED if any_ok else V1Statuses.FAILED,
+            reason="HyperbandDone",
+            message=None if any_ok else "all trials failed",
+        )
+        return actions + 1
+
+    def _tick_bayes(self, record, op, matrix: V1Bayes, tuner, meta, children) -> int:
+        manager = BayesManager(matrix)
+        actions = 0
+        if not tuner:
+            tuner = {"spawned": 0, "phase": "initial"}
+            for params in manager.initial_suggestions():
+                self._spawn_trial(record, op, params, tuner["spawned"], iteration=0)
+                tuner["spawned"] += 1
+                actions += 1
+            meta["tuner"] = tuner
+            self.store.update_run(record.uuid, meta=meta)
+            return actions
+
+        active = [c for c in children if not c.is_done]
+        obs = self._observations(record, matrix.metric.name, children)
+        finished = [o for o in obs if o.status != "preempted"]
+        total_budget = matrix.num_initial_runs + matrix.max_iterations
+        if tuner["spawned"] >= total_budget:
+            if not active:
+                any_ok = any(c.status == V1Statuses.SUCCEEDED for c in children)
+                self.store.transition(
+                    record.uuid,
+                    V1Statuses.SUCCEEDED if any_ok else V1Statuses.FAILED,
+                    reason="BayesDone",
+                    message=None if any_ok else "all trials failed",
+                )
+                actions += 1
+            return actions
+        concurrency = matrix.concurrency or 1
+        if len(active) >= concurrency:
+            return 0
+        if len(finished) < matrix.num_initial_runs:
+            return 0  # wait for the initial batch before modeling
+        count = min(concurrency - len(active), total_budget - tuner["spawned"])
+        for params in manager.get_suggestions(obs, count=count):
+            self._spawn_trial(record, op, params, tuner["spawned"],
+                              iteration=tuner["spawned"] - matrix.num_initial_runs + 1)
+            tuner["spawned"] += 1
+            actions += 1
+        meta["tuner"] = tuner
+        self.store.update_run(record.uuid, meta=meta)
+        return actions

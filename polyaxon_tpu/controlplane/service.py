@@ -1,0 +1,174 @@
+"""The embedded control plane: run CRUD + lifecycle + compilation.
+
+haupt's API layer collapsed into an in-process service (SURVEY.md §2
+"API server", §7 step 4): same capability set — submit, compile, stop,
+approve, restart/resume, statuses, metrics — without Django or a
+network hop. An HTTP facade can wrap this class 1:1 later; the CLI and
+tuner consume it directly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Union
+
+from polyaxon_tpu.compiler import compile_operation
+from polyaxon_tpu.controlplane.store import RunRecord, Store
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.polyaxonfile import (
+    check_polyaxonfile,
+    get_operation,
+    resolve_operation_context,
+)
+from polyaxon_tpu.polyflow.operation import V1Operation
+from polyaxon_tpu.polyflow.runs import V1RunKind
+from polyaxon_tpu.streams import StreamsService
+
+
+class ControlPlane:
+    def __init__(self, home: str):
+        self.home = os.path.abspath(home)
+        os.makedirs(self.home, exist_ok=True)
+        self.store = Store(os.path.join(self.home, "plx.db"))
+        self.artifacts_root = os.path.join(self.home, "artifacts")
+        os.makedirs(self.artifacts_root, exist_ok=True)
+        self.streams = StreamsService(self.artifacts_root)
+
+    # -- submission --------------------------------------------------------
+    def submit(
+        self,
+        polyaxonfile: Union[str, dict, Sequence, None] = None,
+        *,
+        op: Optional[V1Operation] = None,
+        project: str = "default",
+        params: Optional[dict[str, Any]] = None,
+        presets: Optional[Sequence[Union[str, dict]]] = None,
+        name: Optional[str] = None,
+        tags: Optional[list[str]] = None,
+        meta: Optional[dict] = None,
+        parent_uuid: Optional[str] = None,
+        pipeline_uuid: Optional[str] = None,
+        iteration: Optional[int] = None,
+    ) -> RunRecord:
+        if op is None:
+            op = check_polyaxonfile(polyaxonfile, params=params, presets=presets)
+        elif params or presets:
+            op = check_polyaxonfile(op.to_dict(), params=params, presets=presets)
+        self.store.create_project(project)
+        is_pipeline = op.matrix is not None or (
+            op.component is not None and op.component.run_kind == V1RunKind.DAG
+        )
+        kind = "matrix" if op.matrix is not None else (
+            V1RunKind.DAG if is_pipeline else
+            (op.component.run_kind if op.component else "hub")
+        )
+        record = self.store.create_run(
+            project=project,
+            spec=op.to_dict(),
+            name=name or op.name or (op.component.name if op.component else None),
+            kind=kind,
+            params={k: p.to_dict() for k, p in (op.params or {}).items()} or None,
+            tags=tags or op.tags,
+            meta=meta,
+            parent_uuid=parent_uuid,
+            pipeline_uuid=pipeline_uuid,
+            iteration=iteration,
+        )
+        return record
+
+    # -- compilation -------------------------------------------------------
+    def compile_run(self, run_uuid: str) -> RunRecord:
+        """created → compiled → queued (SURVEY §3.1 lifecycle tail)."""
+        record = self.store.get_run(run_uuid)
+        op = get_operation(record.spec)
+        if record.kind in ("matrix", V1RunKind.DAG):
+            # Pipelines compile trivially: children are compiled per-trial.
+            self.store.transition(run_uuid, V1Statuses.COMPILED, reason="PipelineCompiled")
+            self.store.transition(run_uuid, V1Statuses.QUEUED)
+            return self.store.get_run(run_uuid)
+        trial_params = (record.meta or {}).get("trial_params") or {}
+        resolved = resolve_operation_context(
+            op,
+            params=trial_params,
+            run_uuid=record.uuid,
+            run_name=record.name or "",
+            project_name=record.project,
+            iteration=record.iteration,
+            artifacts_root=self.artifacts_root,
+        )
+        plan = compile_operation(
+            resolved,
+            run_uuid=record.uuid,
+            artifacts_root=self.artifacts_root,
+            project=record.project,
+        )
+        self.store.update_run(
+            run_uuid, resolved_spec=resolved.to_dict(), launch_plan=plan.to_dict()
+        )
+        self.store.transition(run_uuid, V1Statuses.COMPILED, reason="Compiled")
+        self.store.transition(run_uuid, V1Statuses.QUEUED)
+        return self.store.get_run(run_uuid)
+
+    # -- lifecycle ops -----------------------------------------------------
+    def stop(self, run_uuid: str, message: str = "") -> None:
+        record = self.store.get_run(run_uuid)
+        if record.is_done:
+            return
+        self.store.transition(run_uuid, V1Statuses.STOPPING, message=message)
+        for child in self.store.list_runs(pipeline_uuid=run_uuid):
+            if not child.is_done:
+                self.stop(child.uuid, message="pipeline stopped")
+
+    def restart(self, run_uuid: str, *, copy: bool = False) -> RunRecord:
+        record = self.store.get_run(run_uuid)
+        meta = dict(record.meta or {})
+        meta["restarted_from"] = record.uuid
+        if copy:
+            meta["copy_artifacts_from"] = record.uuid
+        return self.store.create_run(
+            project=record.project,
+            spec=record.spec,
+            name=record.name,
+            kind=record.kind,
+            params=record.params,
+            tags=record.tags,
+            meta=meta,
+            parent_uuid=record.parent_uuid,
+        )
+
+    def resume(self, run_uuid: str) -> RunRecord:
+        """Requeue a stopped/failed/preempted run in place, keeping its
+        artifacts dir so checkpoint restore continues from the last step
+        (SURVEY §5.4: the build owns both halves of resume)."""
+        record = self.store.get_run(run_uuid)
+        if not record.is_done and record.status != V1Statuses.PREEMPTED:
+            raise ValueError(f"Run `{run_uuid}` is not resumable from {record.status}")
+        self.store.transition(run_uuid, V1Statuses.RESUMING, force=True)
+        if record.launch_plan:
+            self.store.transition(run_uuid, V1Statuses.COMPILED)
+            self.store.transition(run_uuid, V1Statuses.QUEUED)
+            return self.store.get_run(run_uuid)
+        # Stopped before compilation: compile now (resolves + queues).
+        return self.compile_run(run_uuid)
+
+    # -- reads -------------------------------------------------------------
+    def get_run(self, run_uuid: str) -> RunRecord:
+        return self.store.get_run(run_uuid)
+
+    def list_runs(self, **kwargs) -> list[RunRecord]:
+        return self.store.list_runs(**kwargs)
+
+    def get_statuses(self, run_uuid: str) -> list[dict]:
+        return self.store.get_conditions(run_uuid)
+
+    def get_metric(self, run_uuid: str, name: str) -> Optional[float]:
+        value = self.streams.last_metric(run_uuid, name)
+        if value is None:
+            outputs = self.streams.get_outputs(run_uuid)
+            for key in (name, f"final_{name}"):
+                if key in outputs:
+                    return float(outputs[key])
+        return value
+
+    def run_artifacts_dir(self, run_uuid: str) -> str:
+        return os.path.join(self.artifacts_root, run_uuid)

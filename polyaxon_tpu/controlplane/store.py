@@ -1,0 +1,319 @@
+"""SQLite-backed run/project store — the reference's haupt DB collapsed
+to an embedded, dependency-free layer (SURVEY.md §2 "API server" [K],
+§7: "control plane + scheduler, single binary, SQLite").
+
+WAL mode so the scheduler/agent threads and CLI reads interleave safely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import os
+import sqlite3
+import threading
+import uuid as _uuid
+from typing import Any, Iterator, Optional
+
+from polyaxon_tpu.lifecycle import V1Statuses, can_transition, now
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS projects (
+    name TEXT PRIMARY KEY,
+    description TEXT,
+    created_at TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS runs (
+    uuid TEXT PRIMARY KEY,
+    project TEXT NOT NULL,
+    name TEXT,
+    description TEXT,
+    kind TEXT,
+    managed_by TEXT DEFAULT 'agent',
+    status TEXT NOT NULL,
+    spec TEXT,
+    resolved_spec TEXT,
+    launch_plan TEXT,
+    params TEXT,
+    tags TEXT,
+    meta TEXT,
+    parent_uuid TEXT,
+    pipeline_uuid TEXT,
+    iteration INTEGER,
+    retries INTEGER DEFAULT 0,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL,
+    started_at TEXT,
+    finished_at TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_runs_status ON runs(status);
+CREATE INDEX IF NOT EXISTS idx_runs_project ON runs(project);
+CREATE INDEX IF NOT EXISTS idx_runs_pipeline ON runs(pipeline_uuid);
+CREATE TABLE IF NOT EXISTS conditions (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_uuid TEXT NOT NULL,
+    type TEXT NOT NULL,
+    reason TEXT,
+    message TEXT,
+    created_at TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_conditions_run ON conditions(run_uuid);
+"""
+
+
+@dataclasses.dataclass
+class RunRecord:
+    uuid: str
+    project: str
+    name: Optional[str]
+    kind: Optional[str]
+    status: V1Statuses
+    spec: Optional[dict]
+    resolved_spec: Optional[dict]
+    launch_plan: Optional[dict]
+    params: Optional[dict]
+    tags: list[str]
+    meta: dict
+    parent_uuid: Optional[str]
+    pipeline_uuid: Optional[str]
+    iteration: Optional[int]
+    retries: int
+    created_at: str
+    updated_at: str
+    started_at: Optional[str]
+    finished_at: Optional[str]
+    description: Optional[str] = None
+    managed_by: str = "agent"
+
+    @property
+    def is_done(self) -> bool:
+        return self.status in V1Statuses.terminal_values()
+
+
+def _loads(text: Optional[str]):
+    return json.loads(text) if text else None
+
+
+class Store:
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._local = threading.local()
+        self._lock = threading.RLock()
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        # ':memory:' DBs are per-connection, so a thread-local connection
+        # would hand every thread an empty schema — share one connection
+        # (all access is serialized by self._lock anyway).
+        if self.path == ":memory:":
+            conn = getattr(self, "_memory_conn", None)
+            if conn is None:
+                conn = sqlite3.connect(self.path, timeout=30.0, check_same_thread=False)
+                conn.row_factory = sqlite3.Row
+                conn.execute("PRAGMA foreign_keys=ON")
+                self._memory_conn = conn
+            return conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0, check_same_thread=False)
+            conn.row_factory = sqlite3.Row
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA foreign_keys=ON")
+            self._local.conn = conn
+        return conn
+
+    # -- projects ---------------------------------------------------------
+    def create_project(self, name: str, description: str = "") -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                "INSERT OR IGNORE INTO projects(name, description, created_at) VALUES (?,?,?)",
+                (name, description, now().isoformat()),
+            )
+
+    def list_projects(self) -> list[dict]:
+        rows = self._conn().execute("SELECT * FROM projects ORDER BY name").fetchall()
+        return [dict(r) for r in rows]
+
+    def has_project(self, name: str) -> bool:
+        return self._conn().execute(
+            "SELECT 1 FROM projects WHERE name=?", (name,)
+        ).fetchone() is not None
+
+    # -- runs -------------------------------------------------------------
+    def create_run(
+        self,
+        *,
+        project: str,
+        spec: Optional[dict] = None,
+        name: Optional[str] = None,
+        description: Optional[str] = None,
+        kind: Optional[str] = None,
+        params: Optional[dict] = None,
+        tags: Optional[list[str]] = None,
+        meta: Optional[dict] = None,
+        parent_uuid: Optional[str] = None,
+        pipeline_uuid: Optional[str] = None,
+        iteration: Optional[int] = None,
+        run_uuid: Optional[str] = None,
+    ) -> RunRecord:
+        run_uuid = run_uuid or _uuid.uuid4().hex[:12]
+        ts = now().isoformat()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO runs(uuid, project, name, description, kind, status,
+                    spec, params, tags, meta, parent_uuid, pipeline_uuid, iteration,
+                    created_at, updated_at)
+                   VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                (
+                    run_uuid, project, name, description, kind,
+                    V1Statuses.CREATED.value,
+                    json.dumps(spec) if spec else None,
+                    json.dumps(params) if params else None,
+                    json.dumps(tags or []),
+                    json.dumps(meta or {}),
+                    parent_uuid, pipeline_uuid, iteration, ts, ts,
+                ),
+            )
+            conn.execute(
+                "INSERT INTO conditions(run_uuid, type, reason, message, created_at)"
+                " VALUES (?,?,?,?,?)",
+                (run_uuid, V1Statuses.CREATED.value, None, None, ts),
+            )
+        return self.get_run(run_uuid)
+
+    def _to_record(self, row: sqlite3.Row) -> RunRecord:
+        return RunRecord(
+            uuid=row["uuid"],
+            project=row["project"],
+            name=row["name"],
+            description=row["description"],
+            kind=row["kind"],
+            managed_by=row["managed_by"],
+            status=V1Statuses(row["status"]),
+            spec=_loads(row["spec"]),
+            resolved_spec=_loads(row["resolved_spec"]),
+            launch_plan=_loads(row["launch_plan"]),
+            params=_loads(row["params"]),
+            tags=_loads(row["tags"]) or [],
+            meta=_loads(row["meta"]) or {},
+            parent_uuid=row["parent_uuid"],
+            pipeline_uuid=row["pipeline_uuid"],
+            iteration=row["iteration"],
+            retries=row["retries"],
+            created_at=row["created_at"],
+            updated_at=row["updated_at"],
+            started_at=row["started_at"],
+            finished_at=row["finished_at"],
+        )
+
+    def get_run(self, run_uuid: str) -> RunRecord:
+        row = self._conn().execute("SELECT * FROM runs WHERE uuid=?", (run_uuid,)).fetchone()
+        if row is None:
+            raise KeyError(f"Run `{run_uuid}` not found")
+        return self._to_record(row)
+
+    def list_runs(
+        self,
+        *,
+        project: Optional[str] = None,
+        statuses: Optional[list[V1Statuses]] = None,
+        pipeline_uuid: Optional[str] = None,
+        parent_uuid: Optional[str] = None,
+        kind: Optional[str] = None,
+        limit: int = 1000,
+    ) -> list[RunRecord]:
+        clauses, args = [], []
+        if project:
+            clauses.append("project=?")
+            args.append(project)
+        if statuses:
+            clauses.append(f"status IN ({','.join('?' * len(statuses))})")
+            args.extend(s.value for s in statuses)
+        if pipeline_uuid:
+            clauses.append("pipeline_uuid=?")
+            args.append(pipeline_uuid)
+        if parent_uuid:
+            clauses.append("parent_uuid=?")
+            args.append(parent_uuid)
+        if kind:
+            clauses.append("kind=?")
+            args.append(kind)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        rows = self._conn().execute(
+            f"SELECT * FROM runs{where} ORDER BY created_at LIMIT ?", (*args, limit)
+        ).fetchall()
+        return [self._to_record(r) for r in rows]
+
+    def update_run(self, run_uuid: str, **fields: Any) -> None:
+        allowed = {"name", "description", "kind", "spec", "resolved_spec",
+                   "launch_plan", "params", "tags", "meta", "retries", "iteration"}
+        sets, args = ["updated_at=?"], [now().isoformat()]
+        for key, value in fields.items():
+            if key not in allowed:
+                raise ValueError(f"Cannot update field `{key}`")
+            if key in ("spec", "resolved_spec", "launch_plan", "params", "tags", "meta"):
+                value = json.dumps(value) if value is not None else None
+            sets.append(f"{key}=?")
+            args.append(value)
+        args.append(run_uuid)
+        with self._lock, self._conn() as conn:
+            conn.execute(f"UPDATE runs SET {', '.join(sets)} WHERE uuid=?", args)
+
+    # -- lifecycle --------------------------------------------------------
+    def transition(
+        self,
+        run_uuid: str,
+        status: V1Statuses,
+        *,
+        reason: Optional[str] = None,
+        message: Optional[str] = None,
+        force: bool = False,
+    ) -> bool:
+        """Atomically advance a run's status; returns False if illegal."""
+        ts = now().isoformat()
+        with self._lock, self._conn() as conn:
+            row = conn.execute("SELECT status FROM runs WHERE uuid=?", (run_uuid,)).fetchone()
+            if row is None:
+                raise KeyError(f"Run `{run_uuid}` not found")
+            current = V1Statuses(row["status"])
+            if not force and not can_transition(current, status):
+                return False
+            extra = ""
+            args: list[Any] = [status.value, ts]
+            if status == V1Statuses.RUNNING:
+                extra = ", started_at=COALESCE(started_at, ?)"
+                args.append(ts)
+            elif status in V1Statuses.terminal_values():
+                extra = ", finished_at=?"
+                args.append(ts)
+            args.append(run_uuid)
+            conn.execute(
+                f"UPDATE runs SET status=?, updated_at=?{extra} WHERE uuid=?", args
+            )
+            conn.execute(
+                "INSERT INTO conditions(run_uuid, type, reason, message, created_at)"
+                " VALUES (?,?,?,?,?)",
+                (run_uuid, status.value, reason, message, ts),
+            )
+        return True
+
+    def get_conditions(self, run_uuid: str) -> list[dict]:
+        rows = self._conn().execute(
+            "SELECT type, reason, message, created_at FROM conditions "
+            "WHERE run_uuid=? ORDER BY id", (run_uuid,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+        mem = getattr(self, "_memory_conn", None)
+        if mem is not None:
+            mem.close()
+            self._memory_conn = None

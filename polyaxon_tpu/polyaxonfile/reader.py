@@ -221,6 +221,10 @@ def resolve_operation_context(
     param_values = validate_params_against_io(
         bound, op.component.inputs, op.component.outputs
     )
+    # The rendered operation carries the fully-bound params so downstream
+    # consumers (compiler toEnv/toInit routing) see trial bindings too.
+    op = op.clone()
+    op.params = bound or None
     context = {
         "params": param_values,
         "globals": default_globals(

@@ -51,7 +51,9 @@ class V1Container(BaseSchema):
     name: Optional[str] = None
     image: Optional[str] = None
     command: Optional[Union[str, list[str]]] = None
-    args: Optional[Union[str, list[str]]] = None
+    # Args commonly carry interpolated param values ("{{ params.lr }}" →
+    # 0.1), so non-string items are allowed and coerced in args_list().
+    args: Optional[Union[str, list[Any]]] = None
     env: Optional[list[V1EnvVar]] = None
     resources: Optional[V1ResourceSpec] = None
     working_dir: Optional[str] = None
@@ -65,7 +67,9 @@ class V1Container(BaseSchema):
     def args_list(self) -> list[str]:
         if self.args is None:
             return []
-        return [self.args] if isinstance(self.args, str) else list(self.args)
+        if isinstance(self.args, str):
+            return [self.args]
+        return [a if isinstance(a, str) else str(a) for a in self.args]
 
 
 class V1TpuTopology(BaseSchema):

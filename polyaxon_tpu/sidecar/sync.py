@@ -1,0 +1,71 @@
+"""Incremental rsync-like tree sync (mtime+size) with a watch loop."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Optional
+
+
+def _should_copy(src: str, dest: str) -> bool:
+    if not os.path.exists(dest):
+        return True
+    s, d = os.stat(src), os.stat(dest)
+    return s.st_mtime > d.st_mtime or s.st_size != d.st_size
+
+
+def sync_tree(src_root: str, dest_root: str) -> int:
+    """Copy changed files; returns number synced. Append-heavy files
+    (jsonl/logs) are whole-file copied — sizes here are small relative to
+    checkpoints, which orbax already writes store-side."""
+    synced = 0
+    for dirpath, _, filenames in os.walk(src_root):
+        rel = os.path.relpath(dirpath, src_root)
+        dest_dir = os.path.join(dest_root, rel) if rel != "." else dest_root
+        for name in filenames:
+            if name.endswith((".tmp", ".lock")):
+                continue
+            src = os.path.join(dirpath, name)
+            dest = os.path.join(dest_dir, name)
+            if _should_copy(src, dest):
+                os.makedirs(dest_dir, exist_ok=True)
+                try:
+                    shutil.copy2(src, dest)
+                    synced += 1
+                except OSError:
+                    continue  # file vanished/rotating mid-walk
+    return synced
+
+
+class SidecarSync:
+    def __init__(self, run_dir: str, store_dir: str, interval_seconds: float = 5.0):
+        self.run_dir = run_dir
+        self.store_dir = store_dir
+        self.interval = interval_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sync_once(self) -> int:
+        return sync_tree(self.run_dir, self.store_dir)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync_once()
+            except Exception:
+                pass
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, name="plx-sidecar", daemon=True)
+            self._thread.start()
+
+    def stop(self, final_sync: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_sync:
+            self.sync_once()

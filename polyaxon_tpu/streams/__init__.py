@@ -1,0 +1,3 @@
+from polyaxon_tpu.streams.service import StreamsService
+
+__all__ = ["StreamsService"]

@@ -1,0 +1,118 @@
+"""Streams: serve logs/events/artifacts per run (SURVEY.md §2 "Streams",
+§3.5 read path [K]).
+
+The reference runs this as a FastAPI service multiplexing from fsspec
+stores; here it is an embedded service over the store tree that the CLI
+and tuner consume directly (the process boundary is optional — the same
+class would back an HTTP layer). Supports snapshot reads and follow-mode
+tailing with offsets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Iterator, Optional
+
+from polyaxon_tpu.tracking.events import list_event_names, read_events, tail_file
+
+
+class StreamsService:
+    def __init__(self, store_root: str):
+        self.store_root = store_root
+
+    def run_dir(self, run_uuid: str) -> str:
+        return os.path.join(self.store_root, run_uuid)
+
+    # -- metrics ----------------------------------------------------------
+    def metric_names(self, run_uuid: str) -> list[str]:
+        return list_event_names(self.run_dir(run_uuid), "metric")
+
+    def get_metrics(
+        self, run_uuid: str, names: Optional[list[str]] = None,
+        since_step: Optional[int] = None,
+    ) -> dict[str, list[dict[str, Any]]]:
+        rd = self.run_dir(run_uuid)
+        names = names or self.metric_names(run_uuid)
+        return {name: read_events(rd, "metric", name, since_step=since_step)
+                for name in names}
+
+    def last_metric(self, run_uuid: str, name: str) -> Optional[float]:
+        events = read_events(self.run_dir(run_uuid), "metric", name)
+        return events[-1]["value"] if events else None
+
+    def get_events(self, run_uuid: str, kind: str,
+                   names: Optional[list[str]] = None) -> dict[str, list[dict]]:
+        rd = self.run_dir(run_uuid)
+        names = names or list_event_names(rd, kind)
+        return {name: read_events(rd, kind, name) for name in names}
+
+    # -- logs -------------------------------------------------------------
+    def log_files(self, run_uuid: str) -> list[str]:
+        root = os.path.join(self.run_dir(run_uuid), "logs")
+        if not os.path.isdir(root):
+            return []
+        return sorted(os.listdir(root))
+
+    def read_logs(self, run_uuid: str, name: str = "main.log", offset: int = 0) -> tuple[str, int]:
+        return tail_file(os.path.join(self.run_dir(run_uuid), "logs", name), offset)
+
+    def follow_logs(
+        self, run_uuid: str, name: str = "main.log", *,
+        poll_seconds: float = 1.0, should_stop=None,
+    ) -> Iterator[str]:
+        """SSE-style tail loop (SURVEY §3.5 🔥): yields chunks until
+        ``should_stop()`` returns True and the file stops growing."""
+        offset = 0
+        while True:
+            chunk, offset = self.read_logs(run_uuid, name, offset)
+            if chunk:
+                yield chunk
+            elif should_stop is not None and should_stop():
+                final, offset = self.read_logs(run_uuid, name, offset)
+                if final:
+                    yield final
+                return
+            else:
+                time.sleep(poll_seconds)
+
+    # -- outputs / statuses / artifacts -----------------------------------
+    def get_outputs(self, run_uuid: str) -> dict[str, Any]:
+        path = os.path.join(self.run_dir(run_uuid), "outputs.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as fh:
+            return json.load(fh)
+
+    def get_statuses(self, run_uuid: str) -> list[dict[str, Any]]:
+        path = os.path.join(self.run_dir(run_uuid), "statuses.jsonl")
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path) as fh:
+            for line in fh:
+                if line.strip():
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        return out
+
+    def list_artifacts(self, run_uuid: str, prefix: str = "") -> list[str]:
+        root = os.path.join(self.run_dir(run_uuid), prefix)
+        if not os.path.isdir(root):
+            return []
+        out = []
+        for dirpath, _, filenames in os.walk(root):
+            for name in filenames:
+                out.append(os.path.relpath(os.path.join(dirpath, name),
+                                           self.run_dir(run_uuid)))
+        return sorted(out)
+
+    def artifact_path(self, run_uuid: str, rel: str) -> str:
+        root = os.path.abspath(self.run_dir(run_uuid))
+        path = os.path.abspath(os.path.join(root, rel))
+        if path != root and not path.startswith(root + os.sep):
+            raise ValueError(f"Artifact path escapes the run dir: {rel}")
+        return path

@@ -1,0 +1,37 @@
+from polyaxon_tpu.tracking.events import (
+    EventWriter,
+    V1EventKind,
+    list_event_names,
+    read_events,
+    tail_file,
+)
+from polyaxon_tpu.tracking.run import (
+    ENV_ARTIFACTS_PATH,
+    ENV_OUTPUTS_PATH,
+    ENV_PROJECT,
+    ENV_RUN_NAME,
+    ENV_RUN_UUID,
+    Run,
+    from_env,
+    get_or_create_run,
+)
+from polyaxon_tpu.tracking.systemmetrics import SystemMetricsMonitor, host_metrics, tpu_metrics
+
+__all__ = [
+    "ENV_ARTIFACTS_PATH",
+    "ENV_OUTPUTS_PATH",
+    "ENV_PROJECT",
+    "ENV_RUN_NAME",
+    "ENV_RUN_UUID",
+    "EventWriter",
+    "Run",
+    "SystemMetricsMonitor",
+    "V1EventKind",
+    "from_env",
+    "get_or_create_run",
+    "host_metrics",
+    "list_event_names",
+    "read_events",
+    "tail_file",
+    "tpu_metrics",
+]

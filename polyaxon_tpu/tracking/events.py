@@ -1,0 +1,115 @@
+"""Event-file contract: typed jsonl streams per run.
+
+Parity with traceml's event model (SURVEY.md §2 "Tracking", §5.5 [K]):
+each ``log_*`` call appends a typed jsonl line under the run's events
+dir; the sidecar ships the tree to the artifacts store; streams serve it
+back. Layout (under ``<artifacts>/<run_uuid>/``):
+
+    events/metric/<name>.jsonl     {"timestamp", "step", "value"}
+    events/<kind>/<name>.jsonl     other typed kinds
+    logs/<name>.log                plain text
+    statuses.jsonl                 condition stream
+    outputs.json                   declared outputs (merged)
+    lineage.jsonl                  artifact lineage records
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+from typing import Any, Iterator, Optional
+
+
+class V1EventKind:
+    METRIC = "metric"
+    IMAGE = "image"
+    HISTOGRAM = "histogram"
+    TEXT = "text"
+    HTML = "html"
+    AUDIO = "audio"
+    VIDEO = "video"
+    MODEL = "model"
+    DATAFRAME = "dataframe"
+    ARTIFACT = "artifact"
+    CURVE = "curve"
+    CONFUSION = "confusion"
+    SYSTEM = "system"
+
+    VALUES = {METRIC, IMAGE, HISTOGRAM, TEXT, HTML, AUDIO, VIDEO, MODEL,
+              DATAFRAME, ARTIFACT, CURVE, CONFUSION, SYSTEM}
+
+
+def _now_iso() -> str:
+    return _dt.datetime.now(_dt.timezone.utc).isoformat()
+
+
+class EventWriter:
+    """Append-only jsonl writer for one run directory. Buffered per file;
+    ``flush()`` is cheap and called by the tracking Run on every batch."""
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self._handles: dict[str, Any] = {}
+
+    def _handle(self, kind: str, name: str):
+        key = f"{kind}/{name}"
+        if key not in self._handles:
+            path = os.path.join(self.run_dir, "events", kind, f"{name}.jsonl")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._handles[key] = open(path, "a", buffering=1)
+        return self._handles[key]
+
+    def write(self, kind: str, name: str, record: dict[str, Any]) -> None:
+        record.setdefault("timestamp", _now_iso())
+        self._handle(kind, name).write(json.dumps(record) + "\n")
+
+    def metric(self, name: str, value: float, step: Optional[int] = None) -> None:
+        self.write(V1EventKind.METRIC, name, {"step": step, "value": float(value)})
+
+    def flush(self) -> None:
+        for handle in self._handles.values():
+            handle.flush()
+
+    def close(self) -> None:
+        for handle in self._handles.values():
+            handle.close()
+        self._handles.clear()
+
+
+def read_events(run_dir: str, kind: str, name: str,
+                since_step: Optional[int] = None) -> list[dict[str, Any]]:
+    path = os.path.join(run_dir, "events", kind, f"{name}.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write mid-sync
+            if since_step is not None and (rec.get("step") or 0) <= since_step:
+                continue
+            out.append(rec)
+    return out
+
+
+def list_event_names(run_dir: str, kind: str) -> list[str]:
+    root = os.path.join(run_dir, "events", kind)
+    if not os.path.isdir(root):
+        return []
+    return sorted(f[:-6] for f in os.listdir(root) if f.endswith(".jsonl"))
+
+
+def tail_file(path: str, offset: int = 0) -> tuple[str, int]:
+    """Read text from ``offset``; returns (chunk, new_offset)."""
+    if not os.path.exists(path):
+        return "", offset
+    with open(path) as fh:
+        fh.seek(offset)
+        chunk = fh.read()
+        return chunk, fh.tell()

@@ -1,0 +1,204 @@
+"""In-process tracking client — the ``traceml.tracking.Run`` equivalent
+(SURVEY.md §2 "Tracking" [K], §3.3 call stack).
+
+Works offline-first: writes the event/outputs/lineage contract straight
+into the run's artifacts dir (which the sidecar syncs to the store).
+``from_env()`` picks up the env contract injected by the compiler
+(POLYAXON_RUN_UUID / POLYAXON_RUN_ARTIFACTS_PATH), so user code does:
+
+    from polyaxon_tpu.tracking import get_or_create_run
+    run = get_or_create_run()
+    run.log_metrics(loss=..., step=10)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Optional
+
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.tracking.events import EventWriter, V1EventKind, _now_iso
+from polyaxon_tpu.tracking.systemmetrics import SystemMetricsMonitor
+
+ENV_RUN_UUID = "POLYAXON_RUN_UUID"
+ENV_RUN_NAME = "POLYAXON_RUN_NAME"
+ENV_ARTIFACTS_PATH = "POLYAXON_RUN_ARTIFACTS_PATH"
+ENV_OUTPUTS_PATH = "POLYAXON_RUN_OUTPUTS_PATH"
+ENV_PROJECT = "POLYAXON_PROJECT"
+
+_ACTIVE: Optional["Run"] = None
+
+
+class Run:
+    def __init__(
+        self,
+        run_uuid: str,
+        artifacts_dir: str,
+        *,
+        name: str = "",
+        project: str = "",
+        collect_system_metrics: bool = False,
+        system_metrics_interval: float = 10.0,
+    ):
+        self.run_uuid = run_uuid
+        self.name = name
+        self.project = project
+        self.artifacts_dir = artifacts_dir
+        os.makedirs(self.outputs_dir, exist_ok=True)
+        self._events = EventWriter(artifacts_dir)
+        self._monitor: Optional[SystemMetricsMonitor] = None
+        self._last_step: Optional[int] = None
+        if collect_system_metrics:
+            self._monitor = SystemMetricsMonitor(
+                self._emit_system_metrics, interval_seconds=system_metrics_interval
+            )
+            self._monitor.start()
+
+    # -- paths ------------------------------------------------------------
+    @property
+    def outputs_dir(self) -> str:
+        return os.path.join(self.artifacts_dir, "outputs")
+
+    @property
+    def outputs_file(self) -> str:
+        return os.path.join(self.artifacts_dir, "outputs.json")
+
+    # -- metrics/events ----------------------------------------------------
+    def log_metrics(self, step: Optional[int] = None, **metrics: float) -> None:
+        if step is None:
+            step = (self._last_step or 0) + 1
+        self._last_step = step
+        for name, value in metrics.items():
+            self._events.metric(name, value, step=step)
+        self._events.flush()
+
+    def log_metrics_cb(self):
+        """Adapter matching the runtime's ``on_metrics(step, dict)``."""
+        return lambda step, metrics: self.log_metrics(step=step, **metrics)
+
+    def _emit_system_metrics(self, metrics: dict[str, float]) -> None:
+        for name, value in metrics.items():
+            self._events.write(V1EventKind.SYSTEM, name, {"value": value})
+        self._events.flush()
+
+    def log_text(self, name: str, text: str, step: Optional[int] = None) -> None:
+        self._events.write(V1EventKind.TEXT, name, {"step": step, "text": text})
+
+    def log_curve(self, name: str, x: list, y: list, step: Optional[int] = None) -> None:
+        self._events.write(V1EventKind.CURVE, name, {"step": step, "x": list(x), "y": list(y)})
+
+    # -- outputs/lineage ---------------------------------------------------
+    def log_outputs(self, **outputs: Any) -> None:
+        current: dict[str, Any] = {}
+        if os.path.exists(self.outputs_file):
+            with open(self.outputs_file) as fh:
+                current = json.load(fh)
+        current.update(outputs)
+        tmp = self.outputs_file + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(current, fh, indent=2, default=str)
+        os.replace(tmp, self.outputs_file)
+
+    def get_outputs(self) -> dict[str, Any]:
+        if not os.path.exists(self.outputs_file):
+            return {}
+        with open(self.outputs_file) as fh:
+            return json.load(fh)
+
+    def log_artifact(
+        self,
+        path: str,
+        *,
+        name: Optional[str] = None,
+        kind: str = V1EventKind.ARTIFACT,
+        copy: bool = True,
+    ) -> str:
+        """Register (and by default copy) an artifact into the run tree,
+        appending a lineage record."""
+        name = name or os.path.basename(path)
+        dest = os.path.join(self.artifacts_dir, "assets", name)
+        if copy and os.path.abspath(path) != os.path.abspath(dest):
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            if os.path.isdir(path):
+                shutil.copytree(path, dest, dirs_exist_ok=True)
+            else:
+                shutil.copy2(path, dest)
+        record = {
+            "timestamp": _now_iso(),
+            "name": name,
+            "kind": kind,
+            "path": dest if copy else path,
+        }
+        with open(os.path.join(self.artifacts_dir, "lineage.jsonl"), "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+        return record["path"]
+
+    def log_model(self, path: str, *, name: str = "model", framework: str = "jax") -> str:
+        return self.log_artifact(path, name=name, kind=V1EventKind.MODEL)
+
+    # -- statuses ----------------------------------------------------------
+    def log_status(self, status: V1Statuses, reason: str = "", message: str = "") -> None:
+        record = {
+            "timestamp": _now_iso(),
+            "status": status.value if isinstance(status, V1Statuses) else status,
+            "reason": reason,
+            "message": message,
+        }
+        with open(os.path.join(self.artifacts_dir, "statuses.jsonl"), "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+
+    def log_succeeded(self) -> None:
+        self.log_status(V1Statuses.SUCCEEDED)
+
+    def log_failed(self, reason: str = "", message: str = "") -> None:
+        self.log_status(V1Statuses.FAILED, reason=reason, message=message)
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self) -> None:
+        self._events.flush()
+
+    def close(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            # Final sample so short runs still record system metrics.
+            try:
+                self._emit_system_metrics(self._monitor.sample())
+            except Exception:
+                pass
+        self._events.close()
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "Run":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def from_env(collect_system_metrics: bool = False) -> Run:
+    run_uuid = os.environ.get(ENV_RUN_UUID)
+    artifacts = os.environ.get(ENV_ARTIFACTS_PATH)
+    if not run_uuid or not artifacts:
+        raise RuntimeError(
+            f"Tracking env contract missing ({ENV_RUN_UUID}/{ENV_ARTIFACTS_PATH}); "
+            "running outside a compiled run? Use Run(...) directly."
+        )
+    return Run(
+        run_uuid,
+        artifacts,
+        name=os.environ.get(ENV_RUN_NAME, ""),
+        project=os.environ.get(ENV_PROJECT, ""),
+        collect_system_metrics=collect_system_metrics,
+    )
+
+
+def get_or_create_run(collect_system_metrics: bool = False) -> Run:
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = from_env(collect_system_metrics=collect_system_metrics)
+    return _ACTIVE

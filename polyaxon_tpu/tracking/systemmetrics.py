@@ -1,0 +1,103 @@
+"""System-metrics processors: host (psutil) + TPU (libtpu / device API).
+
+Parity: traceml's processors thread samples psutil + NVML every N s
+(SURVEY.md §5.1 [K]); the TPU build replaces NVML with libtpu-derived
+metrics [B]. On this stack the portable surface is
+``device.memory_stats()`` (PJRT) — duty-cycle/ICI counters land with the
+C++ libtpu shim (SURVEY §2a note 3) when real hardware is present.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import psutil
+
+
+def host_metrics() -> dict[str, float]:
+    vm = psutil.virtual_memory()
+    disk = psutil.disk_usage("/")
+    out = {
+        "cpu_percent": psutil.cpu_percent(interval=None),
+        "memory_used_gb": vm.used / 2**30,
+        "memory_percent": vm.percent,
+        "disk_used_percent": disk.percent,
+    }
+    try:
+        load1, _, _ = psutil.getloadavg()
+        out["load_1m"] = load1
+    except OSError:
+        pass
+    return out
+
+
+def tpu_metrics() -> dict[str, float]:
+    """Best-effort per-device metrics from the PJRT client; keys are
+    ``tpu<i>_*``. Empty off-TPU or when the plugin exposes no stats."""
+    out: dict[str, float] = {}
+    try:
+        import jax
+
+        for i, dev in enumerate(jax.local_devices()):
+            if dev.platform != "tpu":
+                continue
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                continue
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if in_use is not None:
+                out[f"tpu{i}_hbm_used_gb"] = in_use / 2**30
+            if in_use is not None and limit:
+                out[f"tpu{i}_hbm_percent"] = 100.0 * in_use / limit
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                out[f"tpu{i}_hbm_peak_gb"] = peak / 2**30
+    except Exception:
+        pass
+    return out
+
+
+class SystemMetricsMonitor:
+    """Background sampler thread; emits through a callback (the tracking
+    Run wires it to ``log_metrics(kind='system')``)."""
+
+    def __init__(
+        self,
+        emit: Callable[[dict[str, float]], None],
+        interval_seconds: float = 10.0,
+        include_tpu: bool = True,
+    ):
+        self.emit = emit
+        self.interval = interval_seconds
+        self.include_tpu = include_tpu
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> dict[str, float]:
+        metrics = host_metrics()
+        if self.include_tpu:
+            metrics.update(tpu_metrics())
+        return metrics
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.emit(self.sample())
+            except Exception:
+                pass  # sampling must never kill the training process
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, name="plx-sysmetrics", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,22 @@
+from polyaxon_tpu.tune.base import (
+    GridSearchManager,
+    MappingManager,
+    Observation,
+    RandomSearchManager,
+    top_k,
+)
+from polyaxon_tpu.tune.bayes import BayesManager, GaussianProcess, acquisition
+from polyaxon_tpu.tune.hyperband import HyperbandManager, Rung
+
+__all__ = [
+    "BayesManager",
+    "GaussianProcess",
+    "GridSearchManager",
+    "HyperbandManager",
+    "MappingManager",
+    "Observation",
+    "RandomSearchManager",
+    "Rung",
+    "acquisition",
+    "top_k",
+]

@@ -1,0 +1,80 @@
+"""Polytune search-manager interfaces (SURVEY.md §2 "Polytune" [K]).
+
+A manager consumes *observations* (completed trials: params + metric)
+and emits *suggestions* (param dicts to run next). Managers are pure
+state machines — the tuner loop in the scheduler owns IO, trial
+lifecycle, and preemption handling, mirroring upstream's
+search_managers/ split from the tuner service.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random
+from typing import Any, Optional
+
+from polyaxon_tpu.polyflow.matrix import (
+    V1GridSearch,
+    V1Mapping,
+    V1OptimizationMetric,
+    V1RandomSearch,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass
+class Observation:
+    params: Params
+    metric: Optional[float]
+    status: str = "succeeded"  # succeeded | failed | preempted
+
+    @property
+    def usable(self) -> bool:
+        return self.metric is not None and self.status == "succeeded"
+
+
+class GridSearchManager:
+    def __init__(self, config: V1GridSearch):
+        self.config = config
+
+    def get_suggestions(self) -> list[Params]:
+        names = list(self.config.params.keys())
+        grids = [self.config.params[n].to_grid() for n in names]
+        combos = [dict(zip(names, values)) for values in itertools.product(*grids)]
+        if self.config.num_runs:
+            combos = combos[: self.config.num_runs]
+        return combos
+
+
+class RandomSearchManager:
+    def __init__(self, config: V1RandomSearch):
+        self.config = config
+
+    def get_suggestions(self) -> list[Params]:
+        rng = random.Random(self.config.seed)
+        return [
+            {name: hp.sample(rng) for name, hp in self.config.params.items()}
+            for _ in range(self.config.num_runs)
+        ]
+
+
+class MappingManager:
+    def __init__(self, config: V1Mapping):
+        self.config = config
+
+    def get_suggestions(self) -> list[Params]:
+        return [dict(v) for v in self.config.values]
+
+
+def top_k(
+    observations: list[Observation],
+    metric: V1OptimizationMetric,
+    k: int,
+) -> list[Observation]:
+    """Best-k usable observations; failed trials rank as worst
+    (upstream semantics: failure = bad observation)."""
+    usable = [o for o in observations if o.usable]
+    usable.sort(key=lambda o: metric.sort_key(o.metric))
+    return usable[:k]

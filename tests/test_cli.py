@@ -1,0 +1,70 @@
+"""CLI surface tests via click's runner (fast paths only — the heavy
+execution paths are covered in test_controlplane)."""
+
+import json
+import os
+
+import pytest
+from click.testing import CliRunner
+
+from polyaxon_tpu.cli.main import cli
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+    return CliRunner()
+
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "mnist.yaml")
+
+
+class TestCheck:
+    def test_check_valid(self, runner):
+        result = runner.invoke(cli, ["check", "-f", FIXTURE, "-P", "lr=0.05"])
+        assert result.exit_code == 0, result.output
+        data = json.loads(result.output)
+        assert data["params"]["lr"]["value"] == 0.05
+
+    def test_check_missing_file(self, runner):
+        result = runner.invoke(cli, ["check", "-f", "nope.yaml"])
+        assert result.exit_code != 0
+        assert "not found" in result.output
+
+    def test_check_bad_param(self, runner):
+        result = runner.invoke(cli, ["check", "-f", FIXTURE, "-P", "bogus=1"])
+        assert result.exit_code != 0
+        assert "bogus" in result.output
+
+
+class TestRunAndOps:
+    def test_submit_and_inspect(self, runner):
+        result = runner.invoke(cli, ["run", "-f", FIXTURE, "-p", "demo"])
+        assert result.exit_code == 0, result.output
+        uid = result.output.split("Run created: ")[1].split()[0]
+
+        result = runner.invoke(cli, ["ops", "ls", "-p", "demo"])
+        assert uid in result.output
+
+        result = runner.invoke(cli, ["ops", "get", "-uid", uid])
+        data = json.loads(result.output)
+        assert data["status"] == "created"
+
+        result = runner.invoke(cli, ["ops", "statuses", "-uid", uid])
+        assert "created" in result.output
+
+    def test_projects(self, runner):
+        assert runner.invoke(cli, ["projects", "create", "--name", "p9"]).exit_code == 0
+        result = runner.invoke(cli, ["projects", "ls"])
+        assert "p9" in result.output
+
+    def test_models_listing(self, runner):
+        result = runner.invoke(cli, ["models"])
+        assert "llama3_8b" in result.output
+        assert "mnist_cnn" in result.output
+
+    def test_param_json_parsing(self, runner):
+        result = runner.invoke(
+            cli, ["run", "-f", FIXTURE, "-P", "lr=0.5", "-P", "epochs=3"]
+        )
+        assert result.exit_code == 0, result.output

@@ -1,0 +1,111 @@
+"""Compiler golden tests — compile-to-plan is pure and deterministic,
+so assert structurally (the reference's highest-value test pattern,
+SURVEY.md §4 "Compiler golden tests")."""
+
+import json
+import sys
+
+import pytest
+
+from polyaxon_tpu.compiler import COORDINATOR_PLACEHOLDER, compile_operation
+from polyaxon_tpu.compiler.compile import CompilerError, ENV_JAXJOB_SPEC
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile, resolve_operation_context
+
+
+def _compile(source, *, params=None, run_uuid="u1", store_dir=None):
+    op = check_polyaxonfile(source, params=params)
+    resolved = resolve_operation_context(
+        op, params=params or {}, run_uuid=run_uuid, project_name="proj",
+        artifacts_root="/store",
+    )
+    return compile_operation(
+        resolved, run_uuid=run_uuid, artifacts_root="/store", project="proj",
+        store_dir=store_dir,
+    )
+
+
+class TestJaxjobPlan:
+    def test_llama_fsdp_plan_golden(self):
+        plan = _compile("tests/fixtures/llama3_8b.yaml")
+        assert plan.run_kind == "jaxjob"
+        assert plan.resources.accelerator == "v5e"
+        assert plan.resources.topology == "8x8"
+        assert plan.resources.chips == 64
+        assert plan.resources.hosts == 16          # 64 chips / 4 per host
+        assert plan.resources.resources == {"google.com/tpu": 4}
+        assert plan.num_processes == 16
+        p0 = plan.processes[0]
+        env = p0.env
+        assert env["POLYAXON_RUN_UUID"] == "u1"
+        assert env["POLYAXON_RUN_ARTIFACTS_PATH"] == "/store/u1"
+        assert env["POLYAXON_RUN_OUTPUTS_PATH"] == "/store/u1/outputs"
+        assert env["POLYAXON_TPU_NUM_PROCESSES"] == "16"
+        assert env["POLYAXON_TPU_PROCESS_ID"] == "0"
+        assert COORDINATOR_PLACEHOLDER in env["POLYAXON_TPU_COORDINATOR"]
+        assert plan.processes[7].env["POLYAXON_TPU_PROCESS_ID"] == "7"
+        spec = json.loads(env[ENV_JAXJOB_SPEC])
+        assert spec["runtime"]["model"] == "llama3_8b"
+        assert spec["runtime"]["learning_rate"] == 0.0003
+        assert p0.command[0] == sys.executable
+
+    def test_plan_deterministic(self):
+        a = _compile("tests/fixtures/llama3_8b.yaml").to_dict()
+        b = _compile("tests/fixtures/llama3_8b.yaml").to_dict()
+        assert a == b
+
+    def test_sidecar_injected_with_store(self):
+        plan = _compile("tests/fixtures/mnist.yaml", store_dir="/remote/store")
+        kinds = [s.kind for s in plan.sidecars]
+        assert "sync" in kinds
+        sync = plan.sidecars[kinds.index("sync")]
+        assert "--store-dir" in sync.command
+
+    def test_auth_init_phase_default(self):
+        plan = _compile("tests/fixtures/mnist.yaml")
+        assert [p.kind for p in plan.init][:1] == ["auth"]
+
+
+class TestKubeflowPlans:
+    def test_tfjob_tf_config(self):
+        plan = _compile("tests/fixtures/resnet_tfjob.yaml")
+        assert plan.run_kind == "tfjob"
+        assert plan.num_processes == 4
+        tf_config = json.loads(plan.processes[2].env["TF_CONFIG"])
+        assert tf_config["task"] == {"type": "worker", "index": 2}
+        assert len(tf_config["cluster"]["worker"]) == 4
+        assert plan.resources.chips == 16  # 4 replicas x 4 chips
+
+    def test_pytorchjob_rendezvous(self):
+        plan = _compile("tests/fixtures/bert_pytorchjob.yaml")
+        assert plan.num_processes == 4  # 1 master + 3 workers
+        master = [p for p in plan.processes if p.replica_name == "master"][0]
+        worker = [p for p in plan.processes if p.replica_name == "worker"][-1]
+        assert master.env["RANK"] == "0"
+        assert worker.env["WORLD_SIZE"] == "4"
+        assert worker.env["MASTER_ADDR"].startswith("master-0")
+
+    def test_empty_replicas_rejected(self):
+        with pytest.raises(CompilerError):
+            _compile({"kind": "component", "run": {"kind": "tfjob"}})
+
+
+class TestIOEnv:
+    def test_to_env_params(self):
+        plan = _compile(
+            {
+                "kind": "component",
+                "inputs": [{"name": "lr", "type": "float", "toEnv": "TRAIN_LR"}],
+                "run": {"kind": "job", "container": {"image": "x", "command": ["run"]}},
+            },
+            params={"lr": 0.25},
+        )
+        assert plan.processes[0].env["TRAIN_LR"] == "0.25"
+
+    def test_dag_not_compilable(self):
+        with pytest.raises(CompilerError):
+            _compile(
+                {
+                    "kind": "component",
+                    "run": {"kind": "dag", "operations": []},
+                }
+            )

@@ -1,0 +1,287 @@
+"""Control plane + scheduler + agent tests: the §3.2 orchestration spine
+driven in-process against the local executor (no cluster — SURVEY.md §4
+"Control-plane tests" pattern)."""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+
+# A fast trial component: computes score=(lr-0.3)^2 "for `epochs` epochs"
+# and writes it through the tracking event contract — exercising
+# IO→env routing, the compiler, the executor, and streams end to end.
+TRIAL_SCRIPT = textwrap.dedent(
+    """
+    import json, os
+    d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+    os.makedirs(d + "/events/metric", exist_ok=True)
+    lr = float(os.environ["LR"])
+    epochs = int(os.environ.get("EPOCHS", "1"))
+    score = (lr - 0.3) ** 2 / epochs
+    with open(d + "/events/metric/score.jsonl", "a") as fh:
+        fh.write(json.dumps({"step": epochs, "value": score}) + "\\n")
+    """
+).strip()
+
+TRIAL_COMPONENT = {
+    "kind": "component",
+    "name": "trial",
+    "inputs": [
+        {"name": "lr", "type": "float", "toEnv": "LR"},
+        {"name": "epochs", "type": "int", "value": 1, "isOptional": True,
+         "toEnv": "EPOCHS"},
+    ],
+    "run": {
+        "kind": "job",
+        "container": {"command": ["python", "-c", TRIAL_SCRIPT]},
+    },
+}
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+@pytest.fixture()
+def agent(plane):
+    return Agent(plane, max_concurrent=8)
+
+
+class TestService:
+    def test_submit_compile_lifecycle(self, plane):
+        record = plane.submit({"kind": "component", **{k: v for k, v in TRIAL_COMPONENT.items() if k != "kind"}},
+                              params={"lr": 0.5}, project="p1")
+        assert record.status == V1Statuses.CREATED
+        compiled = plane.compile_run(record.uuid)
+        assert compiled.status == V1Statuses.QUEUED
+        assert compiled.launch_plan["runUuid"] == record.uuid
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert conditions == ["created", "compiled", "queued"]
+
+    def test_restart_links_origin(self, plane):
+        record = plane.submit(TRIAL_COMPONENT, params={"lr": 0.1})
+        restarted = plane.restart(record.uuid)
+        assert restarted.uuid != record.uuid
+        assert restarted.meta["restarted_from"] == record.uuid
+
+    def test_stop_cascades_to_children(self, plane):
+        pipeline = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {"kind": "mapping", "values": [{"lr": 0.1}, {"lr": 0.2}]},
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        # Spawn children without executing them.
+        from polyaxon_tpu.controlplane.scheduler import Scheduler
+
+        sched = Scheduler(plane)
+        sched.tick()  # compile
+        sched.tick()  # expand
+        children = plane.list_runs(pipeline_uuid=pipeline.uuid)
+        assert len(children) == 2
+        plane.stop(pipeline.uuid)
+        statuses = {c.status for c in plane.list_runs(pipeline_uuid=pipeline.uuid)}
+        assert statuses <= {V1Statuses.STOPPING, V1Statuses.STOPPED}
+
+
+class TestAgentExecution:
+    def test_job_end_to_end(self, plane, agent):
+        record = plane.submit(TRIAL_COMPONENT, params={"lr": 0.5})
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.SUCCEEDED
+        assert plane.get_metric(record.uuid, "score") == pytest.approx(0.04)
+        # Logs captured from the subprocess.
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert conditions[-1] == "succeeded"
+        assert "running" in conditions
+
+    def test_failing_command_marks_failed(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {"kind": "job",
+                        "container": {"command": ["python", "-c", "raise SystemExit(3)"]}},
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "exit code 3" in (last.get("message") or "")
+
+    def test_unrunnable_image_fails_cleanly(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {"kind": "job",
+                        "container": {"image": "gcr.io/x", "command": ["no-such-binary"]}},
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "not executable" in (last.get("message") or "")
+
+    def test_preemption_requeues_without_retry_cost(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {"kind": "job",
+                        "container": {"command": ["python", "-c",
+                                                  "import time; time.sleep(30)"]}},
+            }
+        )
+        agent.reconcile_once()
+        deadline = time.monotonic() + 20
+        while record.uuid not in agent.executor.active_runs:
+            assert time.monotonic() < deadline
+            agent.reconcile_once()
+            time.sleep(0.05)
+        assert agent.executor.preempt(record.uuid)
+        # Reap → PREEMPTED → scheduler requeues (retrying → queued → ...).
+        deadline = time.monotonic() + 20
+        while True:
+            agent.reconcile_once()
+            conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+            current = plane.get_run(record.uuid)
+            if "retrying" in conditions and current.status in (
+                V1Statuses.QUEUED, V1Statuses.RUNNING,
+                V1Statuses.STARTING, V1Statuses.SCHEDULED,
+            ):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert plane.get_run(record.uuid).retries == 0
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert "preempted" in conditions and "retrying" in conditions
+        plane.stop(record.uuid)
+        agent.reconcile_once()
+
+
+class TestDag:
+    def _dag_op(self, fail_a=False):
+        step = {
+            "kind": "job",
+            "container": {"command": ["python", "-c",
+                                      "raise SystemExit(1)" if fail_a else "print('ok')"]},
+        }
+        ok = {"kind": "job", "container": {"command": ["python", "-c", "print('ok')"]}}
+        return {
+            "kind": "component",
+            "name": "pipe",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {"name": "a", "component": {"run": step}},
+                    {"name": "b", "dependencies": ["a"], "component": {"run": ok}},
+                ],
+            },
+        }
+
+    def test_dag_ordering_and_success(self, plane, agent):
+        record = plane.submit(self._dag_op())
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.SUCCEEDED
+        children = {c.name: c for c in plane.list_runs(pipeline_uuid=record.uuid)}
+        assert set(children) == {"a", "b"}
+        assert children["a"].finished_at <= children["b"].created_at
+
+    def test_dag_upstream_failure(self, plane, agent):
+        record = plane.submit(self._dag_op(fail_a=True))
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.FAILED
+        children = {c.name: c for c in plane.list_runs(pipeline_uuid=record.uuid)}
+        assert children["a"].status == V1Statuses.FAILED
+        assert children["b"].status == V1Statuses.UPSTREAM_FAILED
+
+
+class TestTriggerPolicies:
+    def test_skipped_upstream_resolves_not_stalls(self):
+        from polyaxon_tpu.controlplane.scheduler import _trigger_satisfied
+
+        assert _trigger_satisfied("all_succeeded", [V1Statuses.SKIPPED]) is False
+        assert _trigger_satisfied("all_done", [V1Statuses.SKIPPED]) is True
+        assert _trigger_satisfied("all_succeeded", [V1Statuses.RUNNING]) is None
+        assert _trigger_satisfied("one_succeeded",
+                                  [V1Statuses.SKIPPED, V1Statuses.SUCCEEDED]) is True
+
+
+class TestMatrixPipelines:
+    def test_grid_sweep(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "grid",
+                    "params": {"lr": {"kind": "choice", "value": [0.1, 0.3, 0.5, 0.7]}},
+                },
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=120)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) == 4
+        scores = {c.meta["trial_params"]["lr"]: plane.get_metric(c.uuid, "score")
+                  for c in children}
+        assert scores[0.3] == pytest.approx(0.0)
+        assert scores[0.7] == pytest.approx(0.16)
+
+    def test_hyperband_promotes_best(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "hyperband",
+                    "maxIterations": 4,
+                    "eta": 2,
+                    "seed": 7,
+                    "resource": {"name": "epochs", "type": "int"},
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=180)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) >= 8  # several brackets' worth of trials
+        # Later rungs must re-run the best lr values with more epochs.
+        rung1 = [c for c in children if (c.meta or {}).get("rung", 0) >= 1]
+        assert rung1, "hyperband never promoted a rung"
+        for child in rung1:
+            assert child.meta["trial_params"]["epochs"] > 1
+
+    def test_bayes_converges_toward_optimum(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "bayes",
+                    "numInitialRuns": 4,
+                    "maxIterations": 4,
+                    "seed": 5,
+                    "concurrency": 2,
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "utilityFunction": {"acquisitionFunction": "ei"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=180)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) == 8
+        best = min(plane.get_metric(c.uuid, "score") for c in children)
+        assert best < 0.05  # found something near lr=0.3

@@ -1,0 +1,146 @@
+"""Tracking + streams + sidecar tests (event contract, SURVEY.md §3.3)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.streams import StreamsService
+from polyaxon_tpu.sidecar import SidecarSync, sync_tree
+from polyaxon_tpu.tracking import (
+    Run,
+    V1EventKind,
+    host_metrics,
+    list_event_names,
+    read_events,
+)
+from polyaxon_tpu.tracking import run as run_mod
+
+
+class TestRun:
+    def test_metrics_jsonl_contract(self, tmp_path):
+        rd = str(tmp_path / "r1")
+        with Run("r1", rd) as run:
+            run.log_metrics(step=1, loss=2.5, accuracy=0.1)
+            run.log_metrics(step=2, loss=2.1)
+        events = read_events(rd, "metric", "loss")
+        assert [e["value"] for e in events] == [2.5, 2.1]
+        assert [e["step"] for e in events] == [1, 2]
+        assert all("timestamp" in e for e in events)
+        assert set(list_event_names(rd, "metric")) == {"loss", "accuracy"}
+
+    def test_auto_step(self, tmp_path):
+        rd = str(tmp_path / "r2")
+        with Run("r2", rd) as run:
+            run.log_metrics(loss=1.0)
+            run.log_metrics(loss=0.9)
+        assert [e["step"] for e in read_events(rd, "metric", "loss")] == [1, 2]
+
+    def test_outputs_merge_atomic(self, tmp_path):
+        rd = str(tmp_path / "r3")
+        with Run("r3", rd) as run:
+            run.log_outputs(a=1)
+            run.log_outputs(b="two")
+        assert run.get_outputs() == {"a": 1, "b": "two"}
+
+    def test_artifact_lineage(self, tmp_path):
+        src = tmp_path / "model.bin"
+        src.write_bytes(b"weights")
+        rd = str(tmp_path / "r4")
+        with Run("r4", rd) as run:
+            dest = run.log_model(str(src))
+        assert os.path.exists(dest)
+        with open(os.path.join(rd, "lineage.jsonl")) as fh:
+            record = json.loads(fh.readline())
+        assert record["kind"] == V1EventKind.MODEL
+
+    def test_statuses(self, tmp_path):
+        rd = str(tmp_path / "r5")
+        with Run("r5", rd) as run:
+            run.log_succeeded()
+        svc = StreamsService(str(tmp_path))
+        statuses = svc.get_statuses("r5")
+        assert statuses[-1]["status"] == "succeeded"
+
+    def test_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(run_mod.ENV_RUN_UUID, "abc")
+        monkeypatch.setenv(run_mod.ENV_ARTIFACTS_PATH, str(tmp_path / "abc"))
+        run = run_mod.from_env()
+        run.log_metrics(step=0, x=1.0)
+        run.close()
+        assert read_events(str(tmp_path / "abc"), "metric", "x")
+
+    def test_from_env_missing_contract(self, monkeypatch):
+        monkeypatch.delenv(run_mod.ENV_RUN_UUID, raising=False)
+        monkeypatch.delenv(run_mod.ENV_ARTIFACTS_PATH, raising=False)
+        with pytest.raises(RuntimeError):
+            run_mod.from_env()
+
+
+class TestSystemMetrics:
+    def test_host_metrics_shape(self):
+        metrics = host_metrics()
+        assert "cpu_percent" in metrics and "memory_percent" in metrics
+
+    def test_monitor_emits_final_sample(self, tmp_path):
+        rd = str(tmp_path / "r6")
+        run = Run("r6", rd, collect_system_metrics=True, system_metrics_interval=60)
+        run.close()  # triggers the final sample
+        names = list_event_names(rd, "system")
+        assert "cpu_percent" in names
+
+
+class TestSidecarAndStreams:
+    def test_sync_tree_incremental(self, tmp_path):
+        src, dest = tmp_path / "src", tmp_path / "dest"
+        (src / "events" / "metric").mkdir(parents=True)
+        (src / "events" / "metric" / "loss.jsonl").write_text('{"value": 1}\n')
+        assert sync_tree(str(src), str(dest)) == 1
+        assert sync_tree(str(src), str(dest)) == 0  # unchanged
+        (src / "events" / "metric" / "loss.jsonl").write_text('{"value": 1}\n{"value": 2}\n')
+        assert sync_tree(str(src), str(dest)) == 1
+
+    def test_streams_over_synced_store(self, tmp_path):
+        run_dir, store = tmp_path / "live" / "r7", tmp_path / "store" / "r7"
+        with Run("r7", str(run_dir)) as run:
+            run.log_metrics(step=1, score=0.5)
+            run.log_outputs(done=True)
+        sync_tree(str(run_dir), str(store))
+        svc = StreamsService(str(tmp_path / "store"))
+        assert svc.last_metric("r7", "score") == 0.5
+        assert svc.get_outputs("r7") == {"done": True}
+
+    def test_follow_logs_until_done(self, tmp_path):
+        rd = tmp_path / "r8"
+        logs = rd / "logs"
+        logs.mkdir(parents=True)
+        path = logs / "main.log"
+        path.write_text("line1\n")
+        svc = StreamsService(str(tmp_path))
+        done = threading.Event()
+
+        def writer():
+            time.sleep(0.15)
+            with open(path, "a") as fh:
+                fh.write("line2\n")
+            done.set()
+
+        threading.Thread(target=writer).start()
+        chunks = list(svc.follow_logs("r8", "main.log", poll_seconds=0.05,
+                                      should_stop=done.is_set))
+        assert "".join(chunks) == "line1\nline2\n"
+
+    def test_artifact_path_escape_rejected(self, tmp_path):
+        svc = StreamsService(str(tmp_path))
+        with pytest.raises(ValueError):
+            svc.artifact_path("r9", "../../etc/passwd")
+
+    def test_torn_jsonl_line_skipped(self, tmp_path):
+        rd = tmp_path / "r10"
+        metric_dir = rd / "events" / "metric"
+        metric_dir.mkdir(parents=True)
+        (metric_dir / "loss.jsonl").write_text('{"value": 1.0}\n{"valu')
+        events = read_events(str(rd), "metric", "loss")
+        assert len(events) == 1

@@ -1,0 +1,196 @@
+"""Polytune manager unit tests: grid/random enumeration, Hyperband rung
+math + preemption accounting, Bayes GP/acquisition behavior."""
+
+import math
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.polyflow.matrix import (
+    V1Bayes,
+    V1GridSearch,
+    V1Hyperband,
+    V1Mapping,
+    V1RandomSearch,
+)
+from polyaxon_tpu.tune import (
+    BayesManager,
+    GaussianProcess,
+    GridSearchManager,
+    HyperbandManager,
+    MappingManager,
+    Observation,
+    RandomSearchManager,
+    acquisition,
+    top_k,
+)
+
+
+def _hb(max_iterations=81, eta=3) -> HyperbandManager:
+    return HyperbandManager(
+        V1Hyperband.from_dict(
+            {
+                "kind": "hyperband",
+                "maxIterations": max_iterations,
+                "eta": eta,
+                "resource": {"name": "epochs", "type": "int"},
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "params": {"lr": {"kind": "loguniform",
+                                  "value": {"low": math.log(1e-5), "high": math.log(1e-1)}}},
+                "seed": 11,
+            }
+        )
+    )
+
+
+class TestOneShotManagers:
+    def test_grid_product(self):
+        mgr = GridSearchManager(
+            V1GridSearch.from_dict(
+                {
+                    "kind": "grid",
+                    "params": {
+                        "a": {"kind": "choice", "value": [1, 2]},
+                        "b": {"kind": "choice", "value": ["x", "y", "z"]},
+                    },
+                }
+            )
+        )
+        suggestions = mgr.get_suggestions()
+        assert len(suggestions) == 6
+        assert {"a": 1, "b": "z"} in suggestions
+
+    def test_random_deterministic_seed(self):
+        config = V1RandomSearch.from_dict(
+            {
+                "kind": "random",
+                "numRuns": 5,
+                "seed": 3,
+                "params": {"lr": {"kind": "uniform", "value": {"low": 0, "high": 1}}},
+            }
+        )
+        assert RandomSearchManager(config).get_suggestions() == \
+               RandomSearchManager(config).get_suggestions()
+
+    def test_mapping(self):
+        mgr = MappingManager(V1Mapping.from_dict(
+            {"kind": "mapping", "values": [{"a": 1}, {"a": 2}]}))
+        assert mgr.get_suggestions() == [{"a": 1}, {"a": 2}]
+
+
+class TestHyperband:
+    def test_rung_shapes_paper_table(self):
+        mgr = _hb(81, 3)
+        assert mgr.brackets() == [4, 3, 2, 1, 0]
+        assert mgr.rung_shape(4, 0) == (81, 1)
+        assert mgr.rung_shape(4, 1) == (27, 3)
+        assert mgr.rung_shape(4, 2) == (9, 9)
+        assert mgr.rung_shape(4, 3) == (3, 27)
+        assert mgr.rung_shape(4, 4) == (1, 81)
+        assert mgr.rung_shape(0, 0) == (5, 81)
+
+    def test_first_rung_and_promotion(self):
+        mgr = _hb(9, 3)  # s_max=2
+        rung0 = mgr.first_rung(2)
+        assert rung0.n_configs == len(rung0.suggestions)
+        obs = [
+            Observation(params=p, metric=float(i), status="succeeded")
+            for i, p in enumerate(rung0.suggestions)
+        ]
+        rung1 = mgr.next_rung(2, 0, obs)
+        assert rung1 is not None
+        # minimize → the best (lowest metric) configs survive
+        surviving = rung1.suggestions
+        assert obs[0].params in surviving
+        assert len(surviving) == mgr.rung_shape(2, 1)[0]
+        assert rung1.resource > rung0.resource
+
+    def test_bracket_exhaustion(self):
+        mgr = _hb(9, 3)
+        obs = [Observation(params={"lr": 0.1}, metric=1.0)]
+        assert mgr.next_rung(2, 2, obs) is None
+
+    def test_failed_trials_rank_worst(self):
+        metric = _hb().config.metric
+        obs = [
+            Observation(params={"lr": 1}, metric=5.0),
+            Observation(params={"lr": 2}, metric=None, status="failed"),
+            Observation(params={"lr": 3}, metric=1.0),
+        ]
+        best = top_k(obs, metric, 2)
+        assert [o.params["lr"] for o in best] == [3, 1]
+
+    def test_preempted_reissued_not_scored(self):
+        mgr = _hb()
+        obs = [
+            Observation(params={"lr": 1}, metric=None, status="preempted"),
+            Observation(params={"lr": 2}, metric=2.0),
+        ]
+        assert mgr.reissue_preempted(obs) == [{"lr": 1}]
+        assert [o.params["lr"] for o in top_k(obs, mgr.config.metric, 2)] == [2]
+
+
+class TestBayes:
+    def _config(self, acq="ei"):
+        return V1Bayes.from_dict(
+            {
+                "kind": "bayes",
+                "numInitialRuns": 4,
+                "maxIterations": 10,
+                "seed": 5,
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "utilityFunction": {"acquisitionFunction": acq},
+                "params": {"x": {"kind": "uniform", "value": {"low": 0.0, "high": 1.0}}},
+            }
+        )
+
+    def test_gp_interpolates(self):
+        gp = GaussianProcess(kernel="matern", length_scale=0.3)
+        x = np.array([[0.0], [0.5], [1.0]])
+        y = np.array([0.0, 1.0, 0.0])
+        gp.fit(x, y)
+        mean, std = gp.predict(np.array([[0.5]]))
+        assert abs(mean[0] - 1.0) < 0.05
+        assert std[0] < 0.1
+        _, std_far = gp.predict(np.array([[0.25]]))
+        assert std_far[0] > std[0]
+
+    def test_acquisition_shapes(self):
+        mean = np.array([0.0, 1.0])
+        std = np.array([1.0, 0.01])
+        for kind in ("ucb", "ei", "poi"):
+            scores = acquisition(kind, mean, std, best=0.5)
+            assert scores.shape == (2,)
+        # EI prefers high-mean low-uncertainty point that beats best
+        ei = acquisition("ei", mean, std, best=0.5)
+        assert ei[1] > 0
+
+    def test_initial_then_model_based(self):
+        mgr = BayesManager(self._config())
+        initial = mgr.initial_suggestions()
+        assert len(initial) == 4
+        # Minimization objective: loss = (x - 0.3)^2
+        obs = [
+            Observation(params=p, metric=(p["x"] - 0.3) ** 2) for p in initial
+        ]
+        obs += [Observation(params={"x": 0.3}, metric=0.0),
+                Observation(params={"x": 0.9}, metric=0.36)]
+        suggestion = mgr.get_suggestions(obs, count=1)[0]
+        assert 0.0 <= suggestion["x"] <= 1.0
+        # The GP should focus near the optimum rather than the far edge.
+        assert abs(suggestion["x"] - 0.3) < abs(0.9 - 0.3)
+
+    def test_insufficient_observations_falls_back_to_random(self):
+        mgr = BayesManager(self._config())
+        out = mgr.get_suggestions([], count=3)
+        assert len(out) == 3
+
+    def test_done_accounting_ignores_preempted(self):
+        mgr = BayesManager(self._config())
+        obs = [Observation(params={"x": 0.1}, metric=1.0)] * 13
+        assert not mgr.is_done(obs)
+        obs += [Observation(params={"x": 0.2}, metric=1.0)]
+        assert mgr.is_done(obs)
+        preempted = obs[:13] + [Observation(params={"x": 0.3}, metric=None,
+                                            status="preempted")]
+        assert not mgr.is_done(preempted)
