@@ -1,3 +1,12 @@
 from polyaxon_tpu.ops.attention import dot_product_attention, xla_attention
+from polyaxon_tpu.ops.flash import flash_attention
+from polyaxon_tpu.ops.ring import ring_attention
+from polyaxon_tpu.ops.ulysses import ulysses_attention
 
-__all__ = ["dot_product_attention", "xla_attention"]
+__all__ = [
+    "dot_product_attention",
+    "flash_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "xla_attention",
+]

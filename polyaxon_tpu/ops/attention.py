@@ -68,6 +68,10 @@ def dot_product_attention(
 ) -> jax.Array:
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if segment_ids is not None:
+        raise ValueError(
+            f"segment_ids (packed sequences) only supported by impl='xla', got `{impl}`"
+        )
     if impl == "flash":
         from polyaxon_tpu.ops.flash import flash_attention
 
@@ -76,4 +80,8 @@ def dot_product_attention(
         from polyaxon_tpu.ops.ring import ring_attention
 
         return ring_attention(q, k, v, causal=causal, axis_name=axis_name or "cp")
+    if impl == "ulysses":
+        from polyaxon_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=causal, axis_name=axis_name or "cp")
     raise ValueError(f"Unknown attention impl `{impl}`")

@@ -1,0 +1,310 @@
+"""Blocked flash attention as a Pallas TPU kernel.
+
+TPU-first design (pallas_guide.md): the forward pass tiles Q into
+``block_q`` × head_dim VMEM blocks and streams K/V blocks through the
+innermost (sequential) grid dimension, keeping the online-softmax
+running max/denominator and the output accumulator in f32 VMEM scratch
+— O(S) memory instead of the O(S²) logits tensor, with every matmul on
+the MXU (``preferred_element_type=f32``). Causal blocks strictly above
+the diagonal are skipped with ``pl.when`` (no wasted MXU cycles), and
+GQA is handled in the K/V index maps (kv head = q head // n_rep) so
+grouped heads are never materialized ``n_rep`` times in HBM.
+
+The backward pass is a chunked XLA pass under ``jax.custom_vjp``: it
+recomputes attention probabilities one K/V block at a time from the
+saved logsumexp (the standard flash residual), so the bwd also never
+materializes S×S — while remaining a plain differentiable-free XLA
+program that runs identically on TPU and the CPU test mesh.
+
+The reference delegates attention entirely to user frameworks
+(SURVEY.md §2b: no model math in-repo); this kernel is owned surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports cleanly where libtpu/mosaic is present
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+LANES = 128  # TPU lane width: scratch vectors are kept lane-broadcast
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    """Largest power-of-two block <= preferred that divides seq."""
+    block = min(preferred, seq)
+    while block > 1 and seq % block:
+        block //= 2
+    return block
+
+
+def _fwd_kernel(
+    q_ref,  # [1, 1, block_q, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    o_ref,  # [1, 1, block_q, D]
+    lse_ref,  # [1, 1, block_q, 1]
+    acc_ref,  # VMEM [block_q, D] f32
+    m_ref,  # VMEM [block_q, LANES] f32
+    l_ref,  # VMEM [block_q, LANES] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    should_compute = True
+    if causal:
+        should_compute = qi * block_q + block_q > ki * block_k
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= scale  # [block_q, block_k]
+
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = rows >= cols
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log(l_safe)).astype(lse_ref.dtype)
+
+
+def _flash_fwd_pallas(
+    q: jax.Array,  # [B, H, Sq, D]
+    k: jax.Array,  # [B, KV, Sk, D]
+    v: jax.Array,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array]:
+    b, h, sq, d = q.shape
+    kv = k.shape[1]
+    sk = k.shape[2]
+    n_rep = h // kv
+    grid = (b, h, sq // block_q, sk // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+    )
+    compiler_params = None
+    if pltpu is not None and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        )
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+        pltpu.VMEM((block_q, LANES), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda b_, h_, qi, ki, n_rep=n_rep: (b_, h_ // n_rep, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda b_, h_, qi, ki, n_rep=n_rep: (b_, h_ // n_rep, ki, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+def _flash_bwd_xla(
+    causal: bool,
+    scale: float,
+    block_k: int,
+    res,
+    do: jax.Array,
+):
+    """Chunked recompute backward: O(Sq·block_k) live logits."""
+    q, k, v, o, lse = res  # q,o: [B,H,Sq,D]; k,v: [B,KV,Sk,D]; lse: [B,H,Sq]
+    b, h, sq, dh = q.shape
+    kv = k.shape[1]
+    sk = k.shape[2]
+    n_rep = h // kv
+    n_blocks = sk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    rows = jnp.arange(sq)
+
+    # [n_blocks, B, KV, block_k, D] views of K/V for the scan.
+    k_blocks = jnp.moveaxis(k.reshape(b, kv, n_blocks, block_k, dh), 2, 0)
+    v_blocks = jnp.moveaxis(v.reshape(b, kv, n_blocks, block_k, dh), 2, 0)
+
+    def body(dq_acc, inputs):
+        ki, kj, vj = inputs  # kj/vj: [B, KV, block_k, D]
+        # GQA: expand kv heads to q heads for this block only.
+        kj_h = jnp.repeat(kj, n_rep, axis=1) if n_rep > 1 else kj
+        vj_h = jnp.repeat(vj, n_rep, axis=1) if n_rep > 1 else vj
+        s = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk", q, kj_h, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            cols = ki * block_k + jnp.arange(block_k)
+            mask = rows[:, None] >= cols[None, :]
+            p = jnp.where(mask[None, None], jnp.exp(s - lse[..., None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[..., None])
+        dv_h = jnp.einsum(
+            "bhqk,bhqd->bhkd", p.astype(do.dtype), do,
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.einsum(
+            "bhqd,bhkd->bhqk", do, vj_h, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[..., None]) * scale  # [B,H,Sq,block_k] f32
+        dk_h = jnp.einsum(
+            "bhqk,bhqd->bhkd", ds.astype(q.dtype), q,
+            preferred_element_type=jnp.float32,
+        )
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds.astype(q.dtype), kj_h,
+            preferred_element_type=jnp.float32,
+        )
+        if n_rep > 1:  # fold grouped q-heads back onto their kv head
+            dk_h = dk_h.reshape(b, kv, n_rep, block_k, dh).sum(axis=2)
+            dv_h = dv_h.reshape(b, kv, n_rep, block_k, dh).sum(axis=2)
+        return dq_acc, (dk_h, dv_h)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        body, dq0, (jnp.arange(n_blocks), k_blocks, v_blocks)
+    )
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, kv, sk, dh)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, kv, sk, dh)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    del block_q, interpret
+    return _flash_bwd_xla(causal, scale, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, KV, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over [B, S, H, D] layouts with GQA support.
+
+    Falls back to the einsum reference (``ops.attention.xla_attention``)
+    when shapes don't tile (seq not divisible into >=128 blocks, or
+    head_dim not lane-aligned) — callers never need to special-case.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv = k.shape[2]
+    if h % kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {kv}")
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    if pltpu is None or bq < 128 or bk < 128 or (d % 128 and d != 64):
+        from polyaxon_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    if interpret is None:
+        interpret = _default_interpret()
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+
+    # Kernel layout: heads-major [B, H, S, D] so (seq, head_dim) is the
+    # trailing (sublane, lane) tile.
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    o = _flash(qT, kT, vT, causal, scale, bq, bk, interpret)
+    return o.transpose(0, 2, 1, 3)

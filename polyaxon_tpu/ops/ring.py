@@ -1,0 +1,169 @@
+"""Ring attention: context-parallel attention over the ``cp`` mesh axis.
+
+Net-new surface vs the reference (SURVEY.md §5.7: long-context is
+absent upstream — it ships no model math at all). Design:
+
+- Every device holds one contiguous sequence block of Q, K, V
+  (``seq → cp`` in the CP rule table). Queries stay resident; K/V
+  blocks rotate around the ICI ring via ``lax.ppermute`` — each step
+  overlaps the matmul for the current block with the DMA of the next.
+- Online-softmax accumulation (flash-style running max/denominator in
+  f32) combines the per-block partial attentions exactly, so the full
+  S×S score matrix never exists on any chip: memory is
+  O(S_local² · heads) per step and activations scale to sequence
+  lengths ∝ number of chips.
+- Causality is a pure position test (global query index ≥ global key
+  index), which uniformly covers the three block cases (fully visible /
+  diagonal / fully masked). Blocks ahead of the diagonal are masked
+  rather than skipped — balanced "zigzag" block placement is a later
+  optimization.
+- The loop is a ``lax.scan`` (not ``fori_loop``) so the whole ring is
+  reverse-differentiable: ppermute transposes to the inverse
+  permutation and the backward pass runs the ring the other way.
+
+``ring_attention`` can be called either inside an existing
+``shard_map`` (axis already bound) or under plain jit, where it wraps
+itself in ``jax.shard_map`` over the ambient mesh's ``cp`` axis with
+all other axes left to GSPMD (partial-manual sharding).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _axis_bound(axis_name: str) -> bool:
+    """True when ``axis_name`` is a bound manual-collective axis here."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def ambient_mesh():
+    """The mesh entered via ``with mesh:`` (as the runtime loop does)."""
+    try:
+        from jax.interpreters import pxla
+
+        mesh = pxla.thread_resources.env.physical_mesh
+        if mesh is not None and not mesh.empty:
+            return mesh
+    except Exception:
+        pass
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and not mesh.empty:
+            return mesh
+    except Exception:
+        pass
+    return None
+
+
+def _ring_attention_sharded(
+    q: jax.Array,  # [B, S_loc, H, D] local shard
+    k: jax.Array,  # [B, S_loc, KV, D]
+    v: jax.Array,
+    *,
+    causal: bool,
+    scale: float,
+    axis_name: str,
+) -> jax.Array:
+    from polyaxon_tpu.ops.attention import repeat_kv
+
+    cp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    n_rep = h // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    q_f = q.astype(jnp.float32)
+    q_pos = idx * s_loc + jnp.arange(s_loc)  # global query positions
+    local_pos = jnp.arange(s_loc)
+
+    # Send kv to the next device each step: after step s, device `idx`
+    # holds the block that started at device `(idx - s - 1) mod cp`.
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, s):
+        (k_cur, v_cur), (o, m, l) = carry
+        src = (idx - s) % cp  # which block this kv shard is
+        k_pos = src * s_loc + local_pos
+
+        logits = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q_f, k_cur.astype(jnp.float32),
+            )
+            * scale
+        )  # [B, H, Sq, Sk] f32
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))  # [B,H,Sq]
+        p = jnp.exp(logits - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return ((k_nxt, v_nxt), (o_new, m_new, l_new)), None
+
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    ((_, (o, _, l)), _) = jax.lax.scan(
+        step, ((k, v), (o0, m0, l0)), jnp.arange(cp)
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] (global, seq sharded over cp)
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    axis_name: str = "cp",
+    mesh=None,
+) -> jax.Array:
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    if _axis_bound(axis_name):
+        return _ring_attention_sharded(
+            q, k, v, causal=causal, scale=scale, axis_name=axis_name
+        )
+
+    mesh = mesh if mesh is not None else ambient_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"ring_attention needs mesh axis `{axis_name}`: call inside "
+            "shard_map, pass mesh=, or enter `with mesh:` (the runtime "
+            "loop does) with a cp axis in the mesh"
+        )
+    spec = P(None, axis_name, None, None)  # seq dim sharded over cp
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_sharded, causal=causal, scale=scale, axis_name=axis_name
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    return fn(q, k, v)
