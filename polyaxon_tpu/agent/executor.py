@@ -41,6 +41,8 @@ class _Gang:
     thread_error: Optional[str] = None
     thread_done: bool = False
     preempted: bool = False
+    stop_event: threading.Event = field(default_factory=threading.Event)
+    reaping: bool = False  # a member died; survivors were signalled
 
 
 class LocalExecutor:
@@ -121,10 +123,14 @@ class LocalExecutor:
                     log_path = os.path.join(plan.artifacts_dir, "logs",
                                             f"main-{proc_spec.index}.log")
                     log_handle = open(log_path, "ab")
-                    proc = subprocess.Popen(
-                        cmd, env=env, stdout=log_handle, stderr=subprocess.STDOUT,
-                        cwd=proc_spec.working_dir or None, start_new_session=True,
-                    )
+                    try:
+                        proc = subprocess.Popen(
+                            cmd, env=env, stdout=log_handle, stderr=subprocess.STDOUT,
+                            cwd=proc_spec.working_dir or None, start_new_session=True,
+                        )
+                    except Exception:
+                        log_handle.close()
+                        raise
                     proc._plx_log_handle = log_handle  # closed in poll()
                     gang.procs.append(proc)
         except Exception as exc:
@@ -165,13 +171,17 @@ class LocalExecutor:
         try:
             tracking.log_status(V1Statuses.RUNNING)
             result = run_jaxjob(job, artifacts_dir=plan.artifacts_dir,
-                                on_metrics=tracking.log_metrics_cb())
+                                on_metrics=tracking.log_metrics_cb(),
+                                should_stop=gang.stop_event.is_set)
             tracking.log_outputs(
                 steps=result.steps, throughput=result.throughput,
                 wall_time=result.wall_time, param_count=result.param_count,
                 **{f"final_{k}": v for k, v in result.final_metrics.items()},
             )
-            tracking.log_succeeded()
+            if gang.stop_event.is_set():
+                tracking.log_status(V1Statuses.STOPPED, reason="StopRequested")
+            else:
+                tracking.log_succeeded()
         except Exception as exc:
             gang.thread_error = f"{type(exc).__name__}: {exc}"
             with open(os.path.join(plan.artifacts_dir, "logs", "main-0.log"), "a") as fh:
@@ -208,17 +218,33 @@ class LocalExecutor:
         return actions
 
     def _gang_status(self, gang: _Gang) -> Optional[int]:
-        """None while running; else max exit code of the gang."""
+        """None while running; else first nonzero exit code of the gang.
+
+        Gang liveness: the moment any member exits nonzero, survivors are
+        terminated (they would otherwise block on the dead coordinator
+        forever) and the gang is reaped on a later poll once all exited.
+        """
         if gang.thread is not None:
             if not gang.thread_done and gang.thread.is_alive():
                 return None
             return 1 if gang.thread_error else 0
         codes = []
+        running = []
         for proc in gang.procs:
             code = proc.poll()
             if code is None:
-                return None
-            codes.append(code)
+                running.append(proc)
+            else:
+                codes.append(code)
+        if running:
+            if not gang.reaping and any(c != 0 for c in codes):
+                gang.reaping = True
+                for proc in running:
+                    try:
+                        proc.terminate()
+                    except OSError:
+                        pass
+            return None
         for proc in gang.procs:
             handle = getattr(proc, "_plx_log_handle", None)
             if handle and not handle.closed:
@@ -233,6 +259,7 @@ class LocalExecutor:
         gang = self._gangs.get(run_uuid)
         if gang is None:
             return
+        gang.stop_event.set()  # in-process runtime loop checks this per step
         for proc in gang.procs:
             try:
                 proc.terminate()

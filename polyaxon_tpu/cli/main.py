@@ -157,8 +157,9 @@ def ops_logs(uid, follow):
     if not names:
         click.echo("(no logs)")
         return
+    offsets = {}
     for name in names:
-        chunk, _ = plane.streams.read_logs(uid, name)
+        chunk, offsets[name] = plane.streams.read_logs(uid, name)
         if chunk:
             click.echo(chunk, nl=False)
     if follow:
@@ -168,7 +169,9 @@ def ops_logs(uid, follow):
             return plane.get_run(uid).is_done
 
         if not record.is_done:
-            for chunk in plane.streams.follow_logs(uid, names[0], should_stop=done):
+            for chunk in plane.streams.follow_logs(
+                uid, names[0], should_stop=done, offset=offsets[names[0]]
+            ):
                 click.echo(chunk, nl=False)
 
 

@@ -118,6 +118,40 @@ class Scheduler:
         return 1
 
     # ------------------------------------------------------------------- dag
+    @staticmethod
+    def _validate_dag(dag) -> Optional[str]:
+        """Unknown dependency names or cycles → error message, else None."""
+        ops = [
+            o if isinstance(o, V1Operation) else get_operation(dict(o))
+            for o in dag.operations
+        ]
+        names = [o.name for o in ops]
+        if len(set(names)) != len(names):
+            dupes = {n for n in names if names.count(n) > 1}
+            return f"duplicate operation names: {sorted(dupes)}"
+        deps = {o.name: list(o.dependencies or []) for o in ops}
+        known = set(names)
+        for name, dep_list in deps.items():
+            unknown = [d for d in dep_list if d not in known]
+            if unknown:
+                return f"operation `{name}` depends on unknown ops: {unknown}"
+        # Kahn's algorithm: leftover nodes ⇒ cycle.
+        indeg = {n: len(deps[n]) for n in names}
+        ready = [n for n, d in indeg.items() if d == 0]
+        seen = 0
+        while ready:
+            node = ready.pop()
+            seen += 1
+            for other, dep_list in deps.items():
+                if node in dep_list:
+                    indeg[other] -= 1
+                    if indeg[other] == 0:
+                        ready.append(other)
+        if seen != len(names):
+            cyclic = sorted(n for n, d in indeg.items() if d > 0)
+            return f"dependency cycle among: {cyclic}"
+        return None
+
     def _tick_dag(self, record: RunRecord) -> int:
         op = get_operation(record.spec)
         dag = op.component.run
@@ -126,6 +160,11 @@ class Scheduler:
         actions = 0
 
         if record.status == V1Statuses.QUEUED:
+            error = self._validate_dag(dag)
+            if error:
+                self.store.transition(record.uuid, V1Statuses.FAILED,
+                                      reason="InvalidDag", message=error)
+                return 1
             self.store.transition(record.uuid, V1Statuses.SCHEDULED)
             self.store.transition(record.uuid, V1Statuses.RUNNING,
                                   reason="PipelineRunning", force=True)
@@ -170,11 +209,14 @@ class Scheduler:
         if len(children) == declared and all(c.is_done for c in children):
             failed = any(c.status in (V1Statuses.FAILED, V1Statuses.UPSTREAM_FAILED)
                          for c in children)
-            self.store.transition(
-                record.uuid,
-                V1Statuses.FAILED if failed else V1Statuses.SUCCEEDED,
-                reason="PipelineDone",
-            )
+            stopped = any(c.status == V1Statuses.STOPPED for c in children)
+            if failed:
+                target = V1Statuses.FAILED
+            elif stopped:  # cancelled work is not success
+                target = V1Statuses.STOPPED
+            else:
+                target = V1Statuses.SUCCEEDED
+            self.store.transition(record.uuid, target, reason="PipelineDone")
             actions += 1
         return actions
 
@@ -243,15 +285,15 @@ class Scheduler:
     def _finish_if_done(self, record: RunRecord, children: list[RunRecord],
                         expected: int) -> int:
         if len(children) >= expected and all(c.is_done for c in children):
-            all_failed = children and all(
-                c.status in (V1Statuses.FAILED, V1Statuses.UPSTREAM_FAILED)
-                for c in children
-            )
-            self.store.transition(
-                record.uuid,
-                V1Statuses.FAILED if all_failed else V1Statuses.SUCCEEDED,
-                reason="TunerDone",
-            )
+            any_ok = any(c.status == V1Statuses.SUCCEEDED for c in children)
+            any_stopped = any(c.status == V1Statuses.STOPPED for c in children)
+            if any_ok:
+                target = V1Statuses.SUCCEEDED  # a sweep needs ≥1 usable trial
+            elif any_stopped:
+                target = V1Statuses.STOPPED
+            else:
+                target = V1Statuses.FAILED
+            self.store.transition(record.uuid, target, reason="TunerDone")
             return 1
         return 0
 
@@ -282,6 +324,24 @@ class Scheduler:
         actions += self._finish_if_done(record, children, tuner.get("total", 0))
         return actions
 
+    def _spawn_rung(self, record, op, manager: HyperbandManager, tuner, meta,
+                    bracket: int, rung) -> int:
+        """Spawn every trial of a rung, track uuids in tuner, persist meta."""
+        tuner["rung_uuids"] = []
+        for params in rung.suggestions:
+            trial = dict(params)
+            trial[manager.resource_param()] = rung.resource
+            child = self._spawn_trial(
+                record, op, trial, tuner["spawned"],
+                iteration=rung.rung,
+                extra_meta={"bracket": bracket, "rung": rung.rung},
+            )
+            tuner["rung_uuids"].append(child.uuid)
+            tuner["spawned"] += 1
+        meta["tuner"] = tuner
+        self.store.update_run(record.uuid, meta=meta)
+        return len(rung.suggestions)
+
     def _tick_hyperband(self, record, op, matrix: V1Hyperband, tuner, meta, children) -> int:
         manager = HyperbandManager(matrix)
         actions = 0
@@ -290,19 +350,7 @@ class Scheduler:
             rung = manager.first_rung(bracket)
             tuner = {"bracket": bracket, "rung": 0, "spawned": 0,
                      "rung_uuids": [], "bracket_index": 0}
-            for params in rung.suggestions:
-                trial = dict(params)
-                trial[manager.resource_param()] = rung.resource
-                child = self._spawn_trial(
-                    record, op, trial, tuner["spawned"],
-                    iteration=0, extra_meta={"bracket": bracket, "rung": 0},
-                )
-                tuner["rung_uuids"].append(child.uuid)
-                tuner["spawned"] += 1
-                actions += 1
-            meta["tuner"] = tuner
-            self.store.update_run(record.uuid, meta=meta)
-            return actions
+            return self._spawn_rung(record, op, manager, tuner, meta, bracket, rung)
 
         rung_children = [c for c in children if c.uuid in set(tuner["rung_uuids"])]
         # Requeue preempted trials at the same rung with the same params.
@@ -317,21 +365,7 @@ class Scheduler:
         next_rung = manager.next_rung(s, i, obs)
         if next_rung is not None:
             tuner["rung"] = next_rung.rung
-            tuner["rung_uuids"] = []
-            for params in next_rung.suggestions:
-                trial = dict(params)
-                trial[manager.resource_param()] = next_rung.resource
-                child = self._spawn_trial(
-                    record, op, trial, tuner["spawned"],
-                    iteration=next_rung.rung,
-                    extra_meta={"bracket": s, "rung": next_rung.rung},
-                )
-                tuner["rung_uuids"].append(child.uuid)
-                tuner["spawned"] += 1
-                actions += 1
-            meta["tuner"] = tuner
-            self.store.update_run(record.uuid, meta=meta)
-            return actions
+            return self._spawn_rung(record, op, manager, tuner, meta, s, next_rung)
 
         # Bracket exhausted → next bracket or done.
         brackets = manager.brackets()
@@ -339,21 +373,9 @@ class Scheduler:
         if next_index < len(brackets):
             bracket = brackets[next_index]
             rung = manager.first_rung(bracket)
-            tuner.update({"bracket": bracket, "rung": 0, "rung_uuids": [],
+            tuner.update({"bracket": bracket, "rung": 0,
                           "bracket_index": next_index})
-            for params in rung.suggestions:
-                trial = dict(params)
-                trial[manager.resource_param()] = rung.resource
-                child = self._spawn_trial(
-                    record, op, trial, tuner["spawned"],
-                    iteration=0, extra_meta={"bracket": bracket, "rung": 0},
-                )
-                tuner["rung_uuids"].append(child.uuid)
-                tuner["spawned"] += 1
-                actions += 1
-            meta["tuner"] = tuner
-            self.store.update_run(record.uuid, meta=meta)
-            return actions
+            return self._spawn_rung(record, op, manager, tuner, meta, bracket, rung)
 
         all_children = self.store.list_runs(pipeline_uuid=record.uuid)
         any_ok = any(c.status == V1Statuses.SUCCEEDED for c in all_children)

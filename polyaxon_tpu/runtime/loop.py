@@ -74,6 +74,7 @@ def run_jaxjob(
     artifacts_dir: Optional[str] = None,
     on_metrics: Optional[MetricsCallback] = None,
     devices: Optional[list] = None,
+    should_stop: Optional[Callable[[], bool]] = None,
 ) -> TrainResult:
     """Execute a builtin-runtime JAXJob in-process."""
     if not job.runtime:
@@ -153,6 +154,9 @@ def run_jaxjob(
         t0 = time.perf_counter()
         timed_steps = 0
         for step in range(start_step + 1, cfg.steps):
+            if should_stop is not None and should_stop():
+                logger.info("stop requested at step %d", step)
+                break
             profiling = cfg.profile_steps and step in cfg.profile_steps and artifacts_dir
             if profiling:
                 jax.profiler.start_trace(f"{artifacts_dir}/profile")

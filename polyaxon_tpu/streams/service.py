@@ -60,11 +60,11 @@ class StreamsService:
 
     def follow_logs(
         self, run_uuid: str, name: str = "main.log", *,
-        poll_seconds: float = 1.0, should_stop=None,
+        poll_seconds: float = 1.0, should_stop=None, offset: int = 0,
     ) -> Iterator[str]:
         """SSE-style tail loop (SURVEY §3.5 🔥): yields chunks until
-        ``should_stop()`` returns True and the file stops growing."""
-        offset = 0
+        ``should_stop()`` returns True and the file stops growing.
+        ``offset`` resumes after a snapshot read (avoids re-yielding it)."""
         while True:
             chunk, offset = self.read_logs(run_uuid, name, offset)
             if chunk:

@@ -285,3 +285,98 @@ class TestMatrixPipelines:
         assert len(children) == 8
         best = min(plane.get_metric(c.uuid, "score") for c in children)
         assert best < 0.05  # found something near lr=0.3
+
+
+class TestReviewHardening:
+    """Regression tests for the gang/DAG/stop-semantics review findings."""
+
+    def test_dag_unknown_dependency_fails(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"name": "a", "dependencies": ["typo"],
+                         "component": {"run": {"kind": "job", "container": {
+                             "command": ["python", "-c", "print('ok')"]}}}},
+                    ],
+                },
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "unknown ops" in (last.get("message") or "")
+
+    def test_dag_cycle_fails(self, plane, agent):
+        step = {"run": {"kind": "job",
+                        "container": {"command": ["python", "-c", "print('ok')"]}}}
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"name": "a", "dependencies": ["b"], "component": step},
+                        {"name": "b", "dependencies": ["a"], "component": step},
+                    ],
+                },
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "cycle" in (last.get("message") or "")
+
+    def test_gang_member_crash_kills_survivors(self, plane, agent):
+        """Rank 0 crashes; rank 1 (sleeping 60s) must be reaped fast."""
+        script = (
+            "import os, time, sys\n"
+            "if os.environ['POLYAXON_TPU_PROCESS_ID'] == '0':\n"
+            "    sys.exit(3)\n"
+            "time.sleep(60)\n"
+        )
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "jaxjob",
+                    "numProcesses": 2,
+                    "container": {"command": ["python", "-c", script]},
+                },
+            }
+        )
+        t0 = time.monotonic()
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
+        assert time.monotonic() - t0 < 25  # not the sleeper's 60s
+
+    def test_stopped_dag_child_stops_pipeline(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"name": "slow", "component": {"run": {
+                            "kind": "job",
+                            "container": {"command": [
+                                "python", "-c", "import time; time.sleep(30)"]},
+                        }}},
+                    ],
+                },
+            }
+        )
+        agent.reconcile_once()
+        deadline = time.monotonic() + 20
+        children = []
+        while not children:
+            assert time.monotonic() < deadline
+            agent.reconcile_once()
+            children = [c for c in plane.list_runs(pipeline_uuid=record.uuid)
+                        if c.status == V1Statuses.RUNNING]
+            time.sleep(0.05)
+        plane.stop(children[0].uuid)
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.STOPPED
