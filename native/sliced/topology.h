@@ -1,0 +1,47 @@
+// Torus topology model for TPU slices.
+//
+// The native layer of the framework (SURVEY.md §2a): the reference's only
+// substantive native component is its Go operator, which is topology-blind
+// (Kubeflow CRDs + node selectors). This daemon replaces it with
+// ICI-topology-aware placement: a slice is an N-d torus of chips
+// ("8x8", "4x4x4"); a gang request asks for a sub-torus and must get
+// chips that are ICI-contiguous (wraparound allowed), because XLA
+// collectives assume nearest-neighbour links.
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace sliced {
+
+constexpr int kMaxDims = 3;
+
+struct Topology {
+  std::array<int, kMaxDims> dims{1, 1, 1};
+  int ndims = 0;
+
+  int chips() const {
+    int n = 1;
+    for (int i = 0; i < ndims; ++i) n *= dims[i];
+    return n == 1 && ndims == 0 ? 0 : n;
+  }
+
+  std::string str() const {
+    std::string out;
+    for (int i = 0; i < ndims; ++i) {
+      if (i) out += 'x';
+      out += std::to_string(dims[i]);
+    }
+    return out;
+  }
+};
+
+// Parse "8", "8x8", "4x4x4". Returns false on malformed input.
+bool ParseTopology(const std::string& text, Topology* out);
+
+// Linearize torus coordinates (row-major over ndims of the slice).
+int CoordToIndex(const Topology& slice, const std::array<int, kMaxDims>& coord);
+
+}  // namespace sliced

@@ -1,4 +1,5 @@
 from polyaxon_tpu.agent.agent import Agent
 from polyaxon_tpu.agent.executor import LocalExecutor
+from polyaxon_tpu.agent.slices import SliceManager
 
-__all__ = ["Agent", "LocalExecutor"]
+__all__ = ["Agent", "LocalExecutor", "SliceManager"]

@@ -32,21 +32,62 @@ class Agent:
         executor: Optional[LocalExecutor] = None,
         max_concurrent: int = 4,
         in_process: bool = False,
+        slice_manager=None,  # agent.slices.SliceManager (native pool)
     ):
         self.plane = plane
         self.scheduler = Scheduler(plane)
         self.executor = executor or LocalExecutor(plane, in_process=in_process)
         self.max_concurrent = max_concurrent
+        self.slices = slice_manager
+
+    def _cleared_to_start(self, record) -> bool:
+        """Topology-gated placement through the native slice pool."""
+        if self.slices is None:
+            return True
+        plan = record.launch_plan or {}
+        resources = plan.get("resources") or {}
+        term = plan.get("termination") or {}
+        # Plans serialize by camelCase alias (schemas/base.py), so the
+        # stored key is maxRetries; accept both for robustness.
+        max_retries = term.get("maxRetries") or term.get("max_retries") or 0
+        state = self.slices.ensure_placed(
+            record.uuid,
+            resources.get("topology"),
+            max_restarts=max_retries,
+            preemptible=bool(resources.get("preemptible")),
+        )
+        if state == "unplaceable":
+            self.plane.store.transition(
+                record.uuid, V1Statuses.FAILED, reason="Unschedulable",
+                message=f"topology {resources.get('topology')!r} fits no slice",
+            )
+            return False
+        return state == "running"
 
     def reconcile_once(self) -> int:
         actions = self.scheduler.tick()
         actions += self.executor.poll()
+        if self.slices is not None:
+            # Heartbeat live gangs, advance the native pool, surface events.
+            for uuid in self.executor.active_runs:
+                self.slices.heartbeat(uuid)
+            for uuid, kinds in self.slices.tick().items():
+                if "PREEMPTED" in kinds and uuid in self.executor.active_runs:
+                    self.executor.preempt(uuid)
+                    actions += 1
+            # Release pool chips for runs the executor no longer owns.
+            active = set(self.executor.active_runs)
+            for uuid in self.slices.tracked_runs():
+                if uuid not in active and self.plane.get_run(uuid).is_done:
+                    self.slices.release(uuid)
         queued = [
             r for r in self.plane.list_runs(statuses=[V1Statuses.QUEUED])
             if r.kind not in _PIPELINE_KINDS
         ]
         capacity = self.max_concurrent - len(self.executor.active_runs)
         for record in queued[: max(capacity, 0)]:
+            if not self._cleared_to_start(record):
+                continue
             self.executor.start(record.uuid)
             actions += 1
         # Stop requests for gangs we own.

@@ -1,0 +1,113 @@
+"""SliceManager: bridges the agent reconcile loop and the native C++
+slice pool (polyaxon_tpu/native/sliced.py — SURVEY.md §2a).
+
+The agent asks it before starting any gang whose launch plan requests a
+TPU topology: placement either succeeds (gang pinned to ICI-contiguous
+chips of a registered slice), stays pending (no capacity — run stays
+QUEUED), or triggers priority eviction of lower-priority gangs on
+preemptible slices (victims transition PREEMPTED and the scheduler
+requeues them — SURVEY.md §5.3). Heartbeats come from the agent's own
+poll of live gang processes; a stale gang follows the native restart
+policy and surfaces RESTART/FAILED events back into run statuses.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from polyaxon_tpu.native import SlicePool, SlicedError
+
+logger = logging.getLogger(__name__)
+
+
+class SliceManager:
+    def __init__(
+        self,
+        slices: Optional[list[tuple[str, str, bool]]] = None,
+        *,
+        heartbeat_timeout: float = 60.0,
+    ):
+        self.pool = SlicePool()
+        self.heartbeat_timeout = heartbeat_timeout
+        self._gangs: dict[str, int] = {}  # run_uuid -> gang id
+        for name, topology, preemptible in slices or []:
+            self.pool.add_slice(name, topology, preemptible=preemptible)
+
+    def close(self) -> None:
+        self.pool.close()
+
+    # ------------------------------------------------------------ placement
+    def ensure_placed(self, run_uuid: str, topology: Optional[str], *,
+                      priority: int = 0, max_restarts: int = 0,
+                      preemptible: bool = False) -> str:
+        """Returns the gang state (``running`` means cleared to start).
+
+        Runs without a topology request bypass placement entirely.
+        """
+        if not topology:
+            return "running"
+        gang_id = self._gangs.get(run_uuid)
+        if gang_id is not None:
+            # A preempted/failed gang must be re-requested from scratch
+            # (the scheduler requeued the run; chips were already vacated).
+            try:
+                state = self.pool.gang(gang_id).state
+            except SlicedError:  # gang already erased pool-side
+                state = "released"
+            if state in ("preempted", "failed", "released"):
+                self.release(run_uuid)
+                gang_id = None
+        if gang_id is None:
+            try:
+                gang_id = self.pool.request_gang(
+                    run_uuid, topology,
+                    priority=priority if priority else (0 if preemptible else 1),
+                    max_restarts=max_restarts,
+                )
+            except SlicedError as exc:
+                logger.warning("placement rejected for %s: %s", run_uuid, exc)
+                return "unplaceable"
+            self._gangs[run_uuid] = gang_id
+        return self.pool.gang(gang_id).state
+
+    def placement(self, run_uuid: str):
+        gang_id = self._gangs.get(run_uuid)
+        return self.pool.gang(gang_id) if gang_id is not None else None
+
+    def tracked_runs(self) -> list[str]:
+        return list(self._gangs)
+
+    def release(self, run_uuid: str) -> None:
+        gang_id = self._gangs.pop(run_uuid, None)
+        if gang_id is not None:
+            try:
+                self.pool.release_gang(gang_id)
+            except SlicedError:
+                pass
+
+    # -------------------------------------------------------------- signals
+    def heartbeat(self, run_uuid: str, *, proc: int = 0,
+                  now: Optional[float] = None) -> None:
+        gang_id = self._gangs.get(run_uuid)
+        if gang_id is not None:
+            self.pool.heartbeat(gang_id, proc, time.time() if now is None else now)
+
+    def preempt_slice(self, name: str) -> int:
+        return self.pool.preempt_slice(name)
+
+    # ------------------------------------------------------------ reconcile
+    def tick(self, now: Optional[float] = None) -> dict[str, list[str]]:
+        """Advance the native pool; returns {run_uuid: [event kinds]}."""
+        events = self.pool.tick(
+            time.time() if now is None else now,
+            heartbeat_timeout=self.heartbeat_timeout,
+        )
+        by_gang = {gid: uuid for uuid, gid in self._gangs.items()}
+        out: dict[str, list[str]] = {}
+        for event in events:
+            uuid = by_gang.get(event.gang_id)
+            if uuid is not None:
+                out.setdefault(uuid, []).append(event.kind)
+        return out

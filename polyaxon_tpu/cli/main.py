@@ -269,13 +269,33 @@ def check(files, params):
 @cli.command("agent")
 @click.option("--poll", default=1.0)
 @click.option("--max-concurrent", default=4)
-def agent_cmd(poll, max_concurrent):
+@click.option("--slice", "slices", multiple=True,
+              help="Register a TPU slice: NAME:TOPOLOGY[:spot], e.g. "
+                   "pool0:8x8 or spot0:4x4:spot. Enables the native "
+                   "topology-aware gang scheduler.")
+def agent_cmd(poll, max_concurrent, slices):
     """Run the agent reconcile loop in the foreground."""
     from polyaxon_tpu.agent import Agent
 
+    manager = None
+    if slices:
+        from polyaxon_tpu.agent import SliceManager
+
+        parsed = []
+        for entry in slices:
+            parts = entry.split(":")
+            if len(parts) not in (2, 3):
+                raise click.ClickException(
+                    f"--slice must be NAME:TOPOLOGY[:spot], got {entry!r}")
+            if len(parts) == 3 and parts[2] != "spot":
+                raise click.ClickException(
+                    f"--slice third token must be `spot`, got {parts[2]!r}")
+            parsed.append((parts[0], parts[1], len(parts) == 3))
+        manager = SliceManager(parsed)
     plane = get_plane()
-    agent = Agent(plane, max_concurrent=max_concurrent)
-    click.echo(f"Agent serving (home={get_home()})")
+    agent = Agent(plane, max_concurrent=max_concurrent, slice_manager=manager)
+    click.echo(f"Agent serving (home={get_home()}"
+               + (f", slices={[s for s in slices]}" if slices else "") + ")")
     agent.serve_forever(poll_seconds=poll)
 
 

@@ -129,7 +129,8 @@ class Scheduler:
         if len(set(names)) != len(names):
             dupes = {n for n in names if names.count(n) > 1}
             return f"duplicate operation names: {sorted(dupes)}"
-        deps = {o.name: list(o.dependencies or []) for o in ops}
+        # Dedupe: a twice-listed dependency must not skew cycle detection.
+        deps = {o.name: sorted(set(o.dependencies or [])) for o in ops}
         known = set(names)
         for name, dep_list in deps.items():
             unknown = [d for d in dep_list if d not in known]
@@ -287,8 +288,8 @@ class Scheduler:
         if len(children) >= expected and all(c.is_done for c in children):
             any_ok = any(c.status == V1Statuses.SUCCEEDED for c in children)
             any_stopped = any(c.status == V1Statuses.STOPPED for c in children)
-            if any_ok:
-                target = V1Statuses.SUCCEEDED  # a sweep needs ≥1 usable trial
+            if any_ok or not children:  # degenerate empty sweep is not a failure
+                target = V1Statuses.SUCCEEDED
             elif any_stopped:
                 target = V1Statuses.STOPPED
             else:

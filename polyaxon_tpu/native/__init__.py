@@ -1,0 +1,3 @@
+from polyaxon_tpu.native.sliced import Gang, SlicePool, SlicedError, ensure_built
+
+__all__ = ["Gang", "SlicePool", "SlicedError", "ensure_built"]

@@ -380,3 +380,21 @@ class TestReviewHardening:
         plane.stop(children[0].uuid)
         status = agent.run_until_done(record.uuid, timeout=30)
         assert status == V1Statuses.STOPPED
+
+    def test_dag_duplicate_dependency_is_not_a_cycle(self, plane, agent):
+        step = {"run": {"kind": "job",
+                        "container": {"command": ["python", "-c", "print('ok')"]}}}
+        record = plane.submit(
+            {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"name": "a", "component": step},
+                        {"name": "b", "dependencies": ["a", "a"], "component": step},
+                    ],
+                },
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.SUCCEEDED

@@ -1,0 +1,289 @@
+"""Native slice daemon (C++ pool via ctypes): ICI-aware gang placement,
+liveness, preemption, restart policy — the operator-equivalent layer
+(SURVEY.md §2a; upstream tests its Go operator with envtest, here the
+pool is driven directly in-process)."""
+
+import subprocess
+
+import pytest
+
+from polyaxon_tpu.native import SlicePool, SlicedError, ensure_built
+
+
+@pytest.fixture(scope="module")
+def built():
+    return ensure_built()
+
+
+@pytest.fixture()
+def pool(built):
+    with SlicePool() as p:
+        yield p
+
+
+class TestPlacement:
+    def test_simple_placement(self, pool):
+        pool.add_slice("a", "4x4")
+        gid = pool.request_gang("run-1", "2x2")
+        gang = pool.gang(gid)
+        assert gang.state == "running"
+        assert gang.slice == "a"
+        assert len(gang.chips) == 4
+        assert pool.free_chips("a") == 12
+        events = pool.tick(0.0)
+        assert any(e.kind == "PLACED" and e.gang_id == gid for e in events)
+
+    def test_contiguous_sub_torus(self, pool):
+        """A 2x2 gang on a 4x4 torus must be a real sub-torus: chip rows
+        adjacent (wraparound allowed), not scattered."""
+        pool.add_slice("a", "4x4")
+        gid = pool.request_gang("r", "2x2")
+        chips = pool.gang(gid).chips
+        rows = sorted({c // 4 for c in chips})
+        cols = sorted({c % 4 for c in chips})
+        def contiguous(vals, dim):
+            span = {(v - vals[0]) % dim for v in vals}
+            return span == set(range(len(vals)))
+        assert contiguous(rows, 4) and contiguous(cols, 4)
+
+    def test_fills_to_capacity_then_queues(self, pool):
+        pool.add_slice("a", "4x4")
+        ids = [pool.request_gang(f"r{i}", "2x2") for i in range(4)]
+        assert all(pool.gang(g).state == "running" for g in ids)
+        assert pool.free_chips("a") == 0
+        extra = pool.request_gang("r-extra", "2x2")
+        assert pool.gang(extra).state == "pending"
+        # Releasing one frees a placement on the next tick.
+        pool.release_gang(ids[0])
+        assert pool.gang(extra).state == "running"
+
+    def test_dimension_permutation(self, pool):
+        """An 8x1 request fits a 4x... no — a 1x8 fits an 8x2 slice by
+        permuting request dims onto slice dims."""
+        pool.add_slice("a", "8x2")
+        gid = pool.request_gang("r", "8")
+        gang = pool.gang(gid)
+        assert gang.state == "running"
+        assert len(gang.chips) == 8
+
+    def test_never_fits_raises(self, pool):
+        pool.add_slice("a", "2x2")
+        with pytest.raises(SlicedError, match="never fit"):
+            pool.request_gang("r", "4x4")
+
+    def test_malformed_topology_raises(self, pool):
+        pool.add_slice("a", "2x2")
+        with pytest.raises(SlicedError, match="malformed"):
+            pool.request_gang("r", "2xx")
+
+    def test_tightest_fit_first(self, pool):
+        """Small gangs land on the smallest slice that fits, keeping the
+        big slice whole for big gangs."""
+        pool.add_slice("big", "8x8")
+        pool.add_slice("small", "2x2")
+        gid = pool.request_gang("r", "2x2")
+        assert pool.gang(gid).slice == "small"
+        big = pool.request_gang("r2", "8x8")
+        assert pool.gang(big).state == "running"
+
+
+class TestLiveness:
+    def test_heartbeat_timeout_restarts_then_fails(self, pool):
+        pool.add_slice("a", "2x2")
+        gid = pool.request_gang("r", "2x2", max_restarts=1)
+        pool.tick(0.0)  # drain PLACED
+        assert pool.heartbeat(gid, 0, 0.0)
+        events = pool.tick(100.0, heartbeat_timeout=30.0)
+        kinds = [e.kind for e in events if e.gang_id == gid]
+        assert kinds == ["LOST", "RESTART"]
+        assert pool.gang(gid).state == "restarting"
+        assert pool.free_chips("a") == 0  # chips stay reserved for restart
+
+        # Heartbeat after restart → running again.
+        assert pool.heartbeat(gid, 0, 110.0)
+        assert pool.gang(gid).state == "running"
+
+        events = pool.tick(200.0, heartbeat_timeout=30.0)
+        kinds = [e.kind for e in events if e.gang_id == gid]
+        assert kinds == ["LOST", "FAILED"]
+        assert pool.gang(gid).state == "failed"
+        assert pool.free_chips("a") == 4  # chips released on failure
+
+    def test_no_heartbeats_means_no_timeout(self, pool):
+        pool.add_slice("a", "2x2")
+        gid = pool.request_gang("r", "2x2")
+        pool.tick(0.0)
+        assert pool.tick(1e6) == []  # never heartbeated → not lost
+        assert pool.gang(gid).state == "running"
+
+
+class TestPreemption:
+    def test_slice_eviction(self, pool):
+        pool.add_slice("spot", "2x2", preemptible=True)
+        gid = pool.request_gang("r", "2x2")
+        pool.tick(0.0)
+        assert pool.preempt_slice("spot") == 1
+        assert pool.gang(gid).state == "preempted"
+        events = pool.tick(0.0)
+        assert any(e.kind == "PREEMPTED" and e.gang_id == gid for e in events)
+        assert pool.free_chips("spot") == 4
+
+    def test_priority_evicts_lower_on_preemptible(self, pool):
+        pool.add_slice("spot", "2x2", preemptible=True)
+        low = pool.request_gang("low", "2x2", priority=0)
+        pool.tick(0.0)
+        high = pool.request_gang("high", "2x2", priority=10)
+        assert pool.gang(low).state == "preempted"
+        assert pool.gang(high).state == "running"
+
+    def test_priority_never_evicts_on_reserved(self, pool):
+        pool.add_slice("reserved", "2x2", preemptible=False)
+        low = pool.request_gang("low", "2x2", priority=0)
+        high = pool.request_gang("high", "2x2", priority=10)
+        assert pool.gang(low).state == "running"
+        assert pool.gang(high).state == "pending"
+
+
+class TestDaemonBinary:
+    def test_line_protocol_end_to_end(self, built):
+        import os
+
+        binary = os.path.join(os.path.dirname(built), "sliced")
+        if not os.path.exists(binary):
+            subprocess.run(["make", "-C", os.path.dirname(os.path.dirname(built)),
+                            "build/sliced"], check=True, capture_output=True)
+        script = (
+            "ADD a 4x4 0\n"
+            "REQ run-1 2x2 0 0\n"
+            "INFO 1\n"
+            "TICK 0 30\n"
+            "REL 1\n"
+            "QUIT\n"
+        )
+        out = subprocess.run([binary], input=script, capture_output=True,
+                             text=True, timeout=30).stdout.splitlines()
+        assert out[0] == "ok"
+        assert out[1] == "1"
+        assert out[2].startswith("running a")
+        assert any("PLACED" in line for line in out)
+        assert "ok" in out[-1]
+
+
+class TestAgentIntegration:
+    """Agent + SliceManager: topology requests gate gang starts through
+    the native pool (the §3.2 spine with the operator-equivalent in the
+    loop)."""
+
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        return ControlPlane(str(tmp_path / "home"))
+
+    def _tpu_job(self, sleep=0.2, topology="2x2", preemptible=False):
+        return {
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "environment": {
+                    "tpu": {"accelerator": "v5e", "topology": topology,
+                            "preemptible": preemptible},
+                },
+                "container": {"command": [
+                    "python", "-c", f"import time; time.sleep({sleep})"]},
+            },
+        }
+
+    def test_topology_gates_capacity(self, plane):
+        from polyaxon_tpu.agent import Agent, SliceManager
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        manager = SliceManager([("a", "2x2", False)])
+        agent = Agent(plane, max_concurrent=8, slice_manager=manager)
+        first = plane.submit(self._tpu_job(sleep=1.0))
+        second = plane.submit(self._tpu_job(sleep=0.1))
+        agent.reconcile_once()
+        agent.reconcile_once()
+        # Only one 2x2 gang fits the single 2x2 slice.
+        assert plane.get_run(first.uuid).status in (
+            V1Statuses.RUNNING, V1Statuses.STARTING)
+        assert plane.get_run(second.uuid).status == V1Statuses.QUEUED
+        assert agent.run_until_done(second.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        assert plane.get_run(first.uuid).status == V1Statuses.SUCCEEDED
+        manager.close()
+
+    def test_unschedulable_topology_fails(self, plane):
+        from polyaxon_tpu.agent import Agent, SliceManager
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        manager = SliceManager([("a", "2x2", False)])
+        agent = Agent(plane, slice_manager=manager)
+        record = plane.submit(self._tpu_job(topology="8x8"))
+        agent.reconcile_once()
+        agent.reconcile_once()
+        assert plane.get_run(record.uuid).status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "Unschedulable" in (last.get("reason") or "")
+        manager.close()
+
+    def test_slice_preemption_requeues_run(self, plane):
+        import time as _time
+
+        from polyaxon_tpu.agent import Agent, SliceManager
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        manager = SliceManager([("spot", "2x2", True)])
+        agent = Agent(plane, slice_manager=manager)
+        record = plane.submit(self._tpu_job(sleep=30, preemptible=True))
+        deadline = _time.monotonic() + 20
+        while record.uuid not in agent.executor.active_runs:
+            assert _time.monotonic() < deadline
+            agent.reconcile_once()
+            _time.sleep(0.05)
+        manager.preempt_slice("spot")
+        deadline = _time.monotonic() + 20
+        while True:
+            agent.reconcile_once()
+            conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+            if "preempted" in conditions and "retrying" in conditions:
+                break
+            assert _time.monotonic() < deadline
+            _time.sleep(0.05)
+        plane.stop(record.uuid)
+        agent.reconcile_once()
+        manager.close()
+
+
+class TestReviewFixes:
+    """Regressions for the native-pool review findings."""
+
+    def test_higher_dim_request_rejected_not_underallocated(self, pool):
+        pool.add_slice("a", "8x8")
+        with pytest.raises(SlicedError, match="never fit"):
+            pool.request_gang("r", "2x2x2")  # 3D on 2D torus
+
+    def test_release_erases_gang(self, pool):
+        pool.add_slice("a", "2x2")
+        gid = pool.request_gang("r", "2x2")
+        pool.release_gang(gid)
+        with pytest.raises(SlicedError, match="unknown gang"):
+            pool.gang(gid)
+        assert pool.free_chips("a") == 4
+
+    def test_eviction_is_minimal(self, pool):
+        pool.add_slice("spot", "8x8", preemptible=True)
+        lows = [pool.request_gang(f"low{i}", "2x2", priority=0) for i in range(4)]
+        pool.tick(0.0)
+        # Free capacity exists: a high-priority request must not evict.
+        high = pool.request_gang("high", "2x2", priority=10)
+        assert pool.gang(high).state == "running"
+        assert all(pool.gang(g).state == "running" for g in lows)
+        # Fill the slice; the next high-priority gang evicts EXACTLY one.
+        more = [pool.request_gang(f"fill{i}", "2x2", priority=0)
+                for i in range(11)]
+        pool.tick(0.0)
+        high2 = pool.request_gang("high2", "2x2", priority=10)
+        assert pool.gang(high2).state == "running"
+        evicted = [g for g in lows + more
+                   if pool.gang(g).state == "preempted"]
+        assert len(evicted) == 1
