@@ -1,0 +1,3 @@
+from polyaxon_tpu.api.server import ApiServer
+
+__all__ = ["ApiServer"]

@@ -1,0 +1,292 @@
+"""REST API server over the embedded control plane.
+
+The reference's control plane is a Django/DRF service ("haupt",
+SURVEY.md §2) exposing ``/api/v1/{owner}/{project}/runs...`` plus a
+streams service for logs/events. Django is not available in this
+environment (SURVEY.md §7 [E]) and a TPU-cluster control plane doesn't
+need an ORM stack — this server maps the same REST surface onto the
+embedded ``ControlPlane`` with stdlib ``ThreadingHTTPServer``:
+
+    POST /api/v1/{owner}/{project}/runs              submit operation
+    GET  /api/v1/{owner}/{project}/runs              list (status=, pipeline=)
+    GET  /api/v1/{owner}/{project}/runs/{uuid}       run detail
+    POST /api/v1/{owner}/{project}/runs/{uuid}/stop|restart|resume
+    GET  .../statuses | metrics | outputs | artifacts[/{path}]
+    GET  /streams/v1/{owner}/{project}/runs/{uuid}/logs[?follow=true]  (SSE)
+    GET  /healthz | /api/v1/version | /api/v1/projects
+
+The ``owner`` segment is accepted for upstream URL compatibility; the
+embedded plane is single-tenant and ignores it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from polyaxon_tpu import __version__
+from polyaxon_tpu.controlplane.service import ControlPlane
+from polyaxon_tpu.controlplane.store import RunRecord
+
+
+def _record_json(record: RunRecord) -> dict[str, Any]:
+    return {
+        "uuid": record.uuid,
+        "name": record.name,
+        "project": record.project,
+        "kind": record.kind,
+        "status": record.status.value,
+        "created_at": record.created_at,
+        "finished_at": record.finished_at,
+        "params": record.params,
+        "tags": record.tags,
+        "meta": record.meta,
+        "pipeline_uuid": record.pipeline_uuid,
+        "parent_uuid": record.parent_uuid,
+        "retries": record.retries,
+    }
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Handler(BaseHTTPRequestHandler):
+    plane: ControlPlane  # injected by ApiServer via class attribute
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, *args):  # quiet; the agent log is the log
+        pass
+
+    def _json(self, payload: Any, status: int = 200) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length).decode())
+        except json.JSONDecodeError as exc:
+            raise ApiError(400, f"invalid JSON body: {exc}") from exc
+
+    # -- routing -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        self._route("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._route("POST")
+
+    def _route(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        parts = [urllib.parse.unquote(p) for p in parsed.path.split("/") if p]
+        query = urllib.parse.parse_qs(parsed.query)
+        try:
+            self._dispatch(method, parts, query)
+        except ApiError as exc:
+            self._json({"error": exc.message}, status=exc.status)
+        except (ValueError, KeyError) as exc:
+            self._json({"error": str(exc)}, status=400)
+        except BrokenPipeError:
+            pass
+        except Exception as exc:  # pragma: no cover - last resort
+            self._json({"error": f"{type(exc).__name__}: {exc}"}, status=500)
+
+    def _get_run(self, uuid: str) -> RunRecord:
+        try:
+            return self.plane.get_run(uuid)
+        except Exception as exc:
+            raise ApiError(404, f"run {uuid} not found") from exc
+
+    def _dispatch(self, method: str, parts: list[str], query: dict) -> None:
+        if parts == ["healthz"]:
+            return self._json({"status": "ok"})
+        if parts[:2] == ["api", "v1"]:
+            rest = parts[2:]
+            if rest == ["version"]:
+                return self._json({"version": __version__})
+            if rest == ["projects"]:
+                return self._json(self.plane.store.list_projects())
+            # /{owner}/{project}/runs...
+            if len(rest) >= 3 and rest[2] == "runs":
+                return self._runs(method, rest[1], rest[3:], query)
+        if parts[:2] == ["streams", "v1"]:
+            rest = parts[2:]
+            # /{owner}/{project}/runs/{uuid}/logs
+            if len(rest) >= 5 and rest[2] == "runs" and rest[4] == "logs":
+                return self._logs(rest[3], query)
+        raise ApiError(404, f"no route for {method} {'/'.join(parts)}")
+
+    # -- runs --------------------------------------------------------------
+    def _runs(self, method: str, project: str, rest: list[str], query: dict) -> None:
+        plane = self.plane
+        if not rest:
+            if method == "POST":
+                body = self._read_body()
+                try:
+                    record = plane.submit(
+                        body.get("content"),
+                        project=project,
+                        params=body.get("params"),
+                        presets=body.get("presets"),
+                        name=body.get("name"),
+                        tags=body.get("tags"),
+                    )
+                except Exception as exc:
+                    raise ApiError(400, f"submit failed: {exc}") from exc
+                return self._json(_record_json(record), status=201)
+            from polyaxon_tpu.lifecycle import V1Statuses
+
+            kwargs: dict[str, Any] = {"project": project}
+            if "status" in query:
+                try:
+                    kwargs["statuses"] = [V1Statuses(s) for s in query["status"]]
+                except ValueError as exc:
+                    raise ApiError(400, str(exc)) from exc
+            if "pipeline" in query:
+                kwargs["pipeline_uuid"] = query["pipeline"][0]
+            records = plane.list_runs(**kwargs)
+            return self._json({"count": len(records),
+                               "results": [_record_json(r) for r in records]})
+
+        uuid = rest[0]
+        record = self._get_run(uuid)
+        action = rest[1] if len(rest) > 1 else None
+        if action is None:
+            if method == "POST":
+                raise ApiError(405, "POST not allowed on run detail")
+            return self._json(_record_json(record))
+        if method == "POST":
+            if action == "stop":
+                plane.stop(uuid, message=(self._read_body().get("message") or ""))
+                return self._json({"status": "stopping"})
+            if action == "restart":
+                body = self._read_body()
+                new = plane.restart(uuid, copy=bool(body.get("copy")))
+                return self._json(_record_json(new), status=201)
+            if action == "resume":
+                return self._json(_record_json(plane.resume(uuid)), status=201)
+            raise ApiError(404, f"unknown action {action}")
+        if action == "statuses":
+            return self._json(plane.get_statuses(uuid))
+        if action == "metrics":
+            names = query.get("names")
+            return self._json(plane.streams.get_metrics(uuid, names))
+        if action == "outputs":
+            return self._json(plane.streams.get_outputs(uuid))
+        if action == "artifacts":
+            if len(rest) > 2:
+                return self._artifact(uuid, "/".join(rest[2:]))
+            return self._json(plane.streams.list_artifacts(uuid))
+        raise ApiError(404, f"unknown sub-resource {action}")
+
+    def _artifact(self, uuid: str, rel: str) -> None:
+        import os
+
+        path = self.plane.streams.artifact_path(uuid, rel)
+        if not os.path.isfile(path):
+            raise ApiError(404, f"artifact {rel} not found")
+        size = os.path.getsize(path)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(size))
+        self.end_headers()
+        with open(path, "rb") as fh:
+            while True:
+                chunk = fh.read(1 << 16)
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
+
+    # -- streams -----------------------------------------------------------
+    def _logs(self, uuid: str, query: dict) -> None:
+        import time
+
+        record = self._get_run(uuid)
+        follow = query.get("follow", ["false"])[0].lower() == "true"
+        streams = self.plane.streams
+        if not follow:
+            text = ""
+            for name in streams.log_files(uuid):
+                chunk, _ = streams.read_logs(uuid, name)
+                text += chunk
+            return self._json({"logs": text})
+
+        # SSE, ALWAYS (even when the run already finished — the client
+        # contract is `data:` events then `event: done`): tail every log
+        # file of the gang, interleaved as content appears.
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        offsets: dict[str, int] = {}
+
+        def emit_available() -> bool:
+            wrote = False
+            for name in streams.log_files(uuid):
+                chunk, offsets[name] = streams.read_logs(
+                    uuid, name, offsets.get(name, 0))
+                if chunk:
+                    payload = "".join(
+                        f"data: {line}\n" for line in chunk.splitlines())
+                    self.wfile.write((payload + "\n").encode())
+                    wrote = True
+            if wrote:
+                self.wfile.flush()
+            return wrote
+
+        try:
+            while True:
+                wrote = emit_available()
+                if self.plane.get_run(uuid).is_done:
+                    emit_available()  # final drain after terminal status
+                    break
+                if not wrote:
+                    time.sleep(0.2)
+            self.wfile.write(b"event: done\ndata: \n\n")
+        except BrokenPipeError:
+            pass
+
+
+class ApiServer:
+    """Owns the HTTP server thread; ``with ApiServer(plane) as s: s.port``."""
+
+    def __init__(self, plane: ControlPlane, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"plane": plane})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self) -> "ApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+

@@ -54,6 +54,62 @@ def cli():
     """polyaxon_tpu: TPU-native ML orchestration."""
 
 
+# ------------------------------------------------------------------- config
+@cli.group("config")
+def config_group():
+    """Client configuration (~/.polyaxon_tpu/config.json)."""
+
+
+def _read_json_or_empty(path: str) -> dict:
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return {}
+    return {}
+
+
+@config_group.command("set")
+@click.option("--host", default=None, help="API host, e.g. http://plx:8000")
+@click.argument("pairs", nargs=-1)
+def config_set(host, pairs):
+    """Set client host (--host) and/or home config key=value PAIRS."""
+    from polyaxon_tpu.client.client import CONFIG_DIR, CONFIG_FILE
+
+    out = {}
+    if host:
+        os.makedirs(CONFIG_DIR, exist_ok=True)
+        data = _read_json_or_empty(CONFIG_FILE)
+        data["host"] = host
+        with open(CONFIG_FILE, "w") as fh:
+            json.dump(data, fh, indent=2)
+        out["client"] = data
+    if pairs:
+        path = os.path.join(get_home(), "config.json")
+        cfg = _read_json_or_empty(path)
+        for item in pairs:
+            key, _, value = item.partition("=")
+            cfg[key] = value
+        os.makedirs(get_home(), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(cfg, fh, indent=2)
+        out["home"] = cfg
+    click.echo(json.dumps(out, indent=2))
+
+
+@config_group.command("show")
+def config_show():
+    from polyaxon_tpu.client.client import CONFIG_FILE, resolve_host
+
+    click.echo(json.dumps({
+        "client_file": CONFIG_FILE,
+        "client": _read_json_or_empty(CONFIG_FILE),
+        "home": _read_json_or_empty(os.path.join(get_home(), "config.json")),
+        "resolved_host": resolve_host(),
+    }, indent=2))
+
+
 # ---------------------------------------------------------------------- run
 @cli.command()
 @click.option("-f", "--polyaxonfile", "files", multiple=True, type=click.Path(),
@@ -265,6 +321,55 @@ def check(files, params):
     click.echo(json.dumps(op.to_dict(), indent=2, default=str))
 
 
+def _parse_slices(entries) -> list[tuple[str, str, bool]]:
+    """NAME:TOPOLOGY[:spot] → (name, topology, preemptible) triples."""
+    parsed = []
+    for entry in entries:
+        parts = entry.split(":")
+        if len(parts) not in (2, 3):
+            raise click.ClickException(
+                f"--slice must be NAME:TOPOLOGY[:spot], got {entry!r}")
+        if len(parts) == 3 and parts[2] != "spot":
+            raise click.ClickException(
+                f"--slice third token must be `spot`, got {parts[2]!r}")
+        parsed.append((parts[0], parts[1], len(parts) == 3))
+    return parsed
+
+
+# ------------------------------------------------------------------- server
+@cli.command("server")
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8000)
+@click.option("--with-agent", is_flag=True,
+              help="also run the agent reconcile loop in this process")
+@click.option("--slice", "slices", multiple=True,
+              help="(with --with-agent) register a TPU slice NAME:TOPOLOGY[:spot]")
+def server_cmd(host, port, with_agent, slices):
+    """Serve the REST API (control plane + streams) in the foreground."""
+    import threading
+
+    from polyaxon_tpu.api import ApiServer
+
+    plane = get_plane()
+    server = ApiServer(plane, host, port)
+    if with_agent:
+        from polyaxon_tpu.agent import Agent
+
+        manager = None
+        if slices:
+            from polyaxon_tpu.agent import SliceManager
+
+            manager = SliceManager(_parse_slices(slices))
+        agent = Agent(plane, slice_manager=manager)
+        threading.Thread(target=agent.serve_forever, daemon=True).start()
+    click.echo(f"API serving on {server.url} (home={get_home()})"
+               + (" with agent" if with_agent else ""))
+    try:
+        server.httpd.serve_forever()
+    finally:
+        server.stop()
+
+
 # -------------------------------------------------------------------- agent
 @cli.command("agent")
 @click.option("--poll", default=1.0)
@@ -281,17 +386,7 @@ def agent_cmd(poll, max_concurrent, slices):
     if slices:
         from polyaxon_tpu.agent import SliceManager
 
-        parsed = []
-        for entry in slices:
-            parts = entry.split(":")
-            if len(parts) not in (2, 3):
-                raise click.ClickException(
-                    f"--slice must be NAME:TOPOLOGY[:spot], got {entry!r}")
-            if len(parts) == 3 and parts[2] != "spot":
-                raise click.ClickException(
-                    f"--slice third token must be `spot`, got {parts[2]!r}")
-            parsed.append((parts[0], parts[1], len(parts) == 3))
-        manager = SliceManager(parsed)
+        manager = SliceManager(_parse_slices(slices))
     plane = get_plane()
     agent = Agent(plane, max_concurrent=max_concurrent, slice_manager=manager)
     click.echo(f"Agent serving (home={get_home()}"
@@ -307,25 +402,6 @@ def models_cmd():
 
     for name in available_models():
         click.echo(name)
-
-
-@cli.command("config")
-@click.option("--set", "sets", multiple=True, help="key=value")
-def config_cmd(sets):
-    """Show or set client config (home dir based)."""
-    path = os.path.join(get_home(), "config.json")
-    cfg = {}
-    if os.path.exists(path):
-        with open(path) as fh:
-            cfg = json.load(fh)
-    for item in sets:
-        key, _, value = item.partition("=")
-        cfg[key] = value
-    if sets:
-        os.makedirs(get_home(), exist_ok=True)
-        with open(path, "w") as fh:
-            json.dump(cfg, fh, indent=2)
-    click.echo(json.dumps({"home": get_home(), **cfg}, indent=2))
 
 
 if __name__ == "__main__":

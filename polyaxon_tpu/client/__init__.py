@@ -1,0 +1,3 @@
+from polyaxon_tpu.client.client import ApiClientError, PolyaxonClient, RunClient
+
+__all__ = ["ApiClientError", "PolyaxonClient", "RunClient"]

@@ -1,0 +1,170 @@
+"""REST API server + client SDK: the upstream client⇄API boundary
+(SURVEY.md §3.1/§3.5) exercised over real HTTP on an ephemeral port,
+with the agent reconciling in a background thread."""
+
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.api import ApiServer
+from polyaxon_tpu.client import ApiClientError, PolyaxonClient, RunClient
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+
+TRIAL = {
+    "kind": "component",
+    "name": "trial",
+    "inputs": [{"name": "lr", "type": "float", "toEnv": "LR"}],
+    "run": {
+        "kind": "job",
+        "container": {"command": [
+            "python", "-c",
+            "import json, os\n"
+            "d = os.environ['POLYAXON_RUN_ARTIFACTS_PATH']\n"
+            "os.makedirs(d + '/events/metric', exist_ok=True)\n"
+            "print('training with lr', os.environ['LR'])\n"
+            "score = (float(os.environ['LR']) - 0.3) ** 2\n"
+            "with open(d + '/events/metric/score.jsonl', 'a') as fh:\n"
+            "    fh.write(json.dumps({'step': 1, 'value': score}) + '\\n')\n",
+        ]},
+    },
+}
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """plane + HTTP server + background agent thread."""
+    plane = ControlPlane(str(tmp_path / "home"))
+    agent = Agent(plane, max_concurrent=4)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            agent.reconcile_once()
+            time.sleep(0.05)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    with ApiServer(plane) as server:
+        yield plane, server
+    stop.set()
+    thread.join(timeout=5)
+
+
+class TestApi:
+    def test_health_and_version(self, stack):
+        _, server = stack
+        client = PolyaxonClient(server.url)
+        assert client.healthy()
+        from polyaxon_tpu import __version__
+
+        assert client.version() == __version__
+
+    def test_run_end_to_end(self, stack, tmp_path):
+        _, server = stack
+        run = RunClient(host=server.url)
+        data = run.create(TRIAL, params={"lr": 0.5})
+        assert data["status"] == "created"
+        assert run.wait(timeout=60) == V1Statuses.SUCCEEDED
+
+        metrics = run.get_metrics(["score"])
+        assert metrics["score"][-1]["value"] == pytest.approx(0.04)
+        assert "training with lr 0.5" in run.get_logs()
+        statuses = [s["type"] for s in run.get_statuses()]
+        assert "running" in statuses and statuses[-1] == "succeeded"
+
+        arts = run.list_artifacts()
+        assert any("score" in a for a in arts)
+        rel = next(a for a in arts if "score" in a)
+        dest = run.download_artifact(rel, str(tmp_path / "score.jsonl"))
+        assert "0.04" in open(dest).read()
+
+    def test_list_runs_and_filters(self, stack):
+        _, server = stack
+        client = PolyaxonClient(server.url)
+        run = RunClient(host=server.url, client=client)
+        run.create(TRIAL, params={"lr": 0.1}, tags=["t1"])
+        run.wait(timeout=60)
+        runs = client.list_runs()
+        assert any(r["uuid"] == run.run_uuid for r in runs)
+        done = client.list_runs(status="succeeded")
+        assert any(r["uuid"] == run.run_uuid for r in done)
+        assert client.list_runs(status="failed") == []
+
+    def test_stop_and_restart(self, stack):
+        _, server = stack
+        slow = {
+            "kind": "component",
+            "run": {"kind": "job", "container": {"command": [
+                "python", "-c", "import time; time.sleep(30)"]}},
+        }
+        run = RunClient(host=server.url)
+        run.create(slow)
+        deadline = time.monotonic() + 20
+        while run.status != V1Statuses.RUNNING:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        run.stop()
+        assert run.wait(timeout=30) == V1Statuses.STOPPED
+
+        restarted = run.restart()
+        assert restarted.run_uuid != run.run_uuid
+        restarted.stop()
+
+    def test_watch_logs_sse(self, stack):
+        _, server = stack
+        chatty = {
+            "kind": "component",
+            "run": {"kind": "job", "container": {"command": [
+                "python", "-u", "-c",
+                "import time\n"
+                "for i in range(5):\n"
+                "    print('line', i, flush=True)\n"
+                "    time.sleep(0.2)\n",
+            ]}},
+        }
+        run = RunClient(host=server.url)
+        run.create(chatty)
+        deadline = time.monotonic() + 20
+        while run.status in (V1Statuses.CREATED, V1Statuses.COMPILED,
+                             V1Statuses.QUEUED, V1Statuses.SCHEDULED,
+                             V1Statuses.STARTING):
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        lines = list(run.watch_logs())
+        assert any("line 4" in line for line in lines)
+        assert run.wait(timeout=30) == V1Statuses.SUCCEEDED
+
+    def test_errors_are_typed(self, stack):
+        _, server = stack
+        client = PolyaxonClient(server.url)
+        with pytest.raises(ApiClientError) as err:
+            client.get("/api/v1/default/default/runs/nope-nope")
+        assert err.value.status == 404
+        with pytest.raises(ApiClientError) as err:
+            client.post("/api/v1/default/default/runs", body={"content": {"bad": 1}})
+        assert err.value.status == 400
+        bad_host = PolyaxonClient("http://127.0.0.1:1")
+        assert not bad_host.healthy()
+
+    def test_watch_logs_on_finished_run_still_yields(self, stack):
+        """SSE contract holds even when the run finished before follow."""
+        run = RunClient(host=stack[1].url)
+        run.create(TRIAL, params={"lr": 0.2})
+        assert run.wait(timeout=60) == V1Statuses.SUCCEEDED
+        lines = list(run.watch_logs())
+        assert any("training with lr 0.2" in line for line in lines)
+
+    def test_artifact_with_space_roundtrips(self, stack, tmp_path):
+        plane, server = stack
+        run = RunClient(host=server.url)
+        run.create(TRIAL, params={"lr": 0.3})
+        assert run.wait(timeout=60) == V1Statuses.SUCCEEDED
+        art_dir = plane.run_artifacts_dir(run.run_uuid)
+        with open(f"{art_dir}/my report.txt", "w") as fh:
+            fh.write("spaced")
+        assert "my report.txt" in run.list_artifacts()
+        dest = run.download_artifact("my report.txt", str(tmp_path / "r.txt"))
+        assert open(dest).read() == "spaced"
