@@ -39,6 +39,57 @@ class Agent:
         self.executor = executor or LocalExecutor(plane, in_process=in_process)
         self.max_concurrent = max_concurrent
         self.slices = slice_manager
+        self._notified: set[str] = set()
+        self._notify_service = None  # built lazily from the home catalog
+
+    def _notify_terminal_runs(self) -> int:
+        """Fan out spec'd notifications for newly-terminal runs.
+
+        Never raises: notification IO must not kill the reconcile loop
+        (notifiers/service.py contract). Scans the NEWEST terminal runs
+        so the set stays bounded no matter how much history accumulates;
+        anything older than the window was handled by a prior pass (or a
+        prior agent, per the persisted ``meta.notified`` flag).
+        """
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        sent = 0
+        try:
+            terminal = self.plane.list_runs(
+                statuses=list(V1Statuses.terminal_values()),
+                limit=500, newest_first=True)
+            for record in terminal:
+                if record.uuid in self._notified:
+                    continue
+                if (record.meta or {}).get("notified"):
+                    self._notified.add(record.uuid)
+                    continue  # sent by a previous agent incarnation
+                notifications = (record.spec or {}).get("notifications")
+                if not notifications:
+                    self._notified.add(record.uuid)
+                    continue
+                if self._notify_service is None:
+                    from polyaxon_tpu.notifiers import NotificationService
+
+                    self._notify_service = NotificationService(
+                        self.plane.connections)
+                run_info = {
+                    "uuid": record.uuid, "name": record.name,
+                    "project": record.project, "kind": record.kind,
+                    "finished_at": record.finished_at,
+                }
+                sent += self._notify_service.notify_terminal(
+                    run_info, record.status, notifications)
+                self._notified.add(record.uuid)
+                meta = dict(record.meta or {})
+                meta["notified"] = True
+                self.plane.store.update_run(record.uuid, meta=meta)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "notification pass failed", exc_info=True)
+        return sent
 
     def _cleared_to_start(self, record) -> bool:
         """Topology-gated placement through the native slice pool."""
@@ -67,6 +118,7 @@ class Agent:
     def reconcile_once(self) -> int:
         actions = self.scheduler.tick()
         actions += self.executor.poll()
+        self._notify_terminal_runs()
         if self.slices is not None:
             # Heartbeat live gangs, advance the native pool, surface events.
             for uuid in self.executor.active_runs:

@@ -269,6 +269,23 @@ def _compile_job(run, plan_args, env_base, *, service: bool = False):
     return resources, processes
 
 
+def _referenced_connections(op: V1Operation, run) -> tuple[list[str], list[str]]:
+    """(init connections — env injected into the gang,
+    notifier/hook connections — validated only: their schemas can carry
+    webhook URLs/secrets that must never reach user processes)."""
+    init_names = []
+    for init in getattr(run, "init", None) or []:
+        if init.connection:
+            init_names.append(init.connection)
+    notify_names = []
+    for notification in op.notifications or []:
+        notify_names.extend(notification.connections or [])
+    for hook in op.hooks or []:
+        if hook.connection:
+            notify_names.append(hook.connection)
+    return list(dict.fromkeys(init_names)), list(dict.fromkeys(notify_names))
+
+
 def compile_operation(
     op: V1Operation,
     *,
@@ -276,6 +293,7 @@ def compile_operation(
     artifacts_root: str,
     project: str = "default",
     store_dir: Optional[str] = None,
+    catalog=None,  # connections.ConnectionCatalog
 ) -> V1LaunchPlan:
     """Resolved operation (literal params — run through
     ``resolve_operation_context`` first) → launch plan."""
@@ -295,6 +313,30 @@ def compile_operation(
         "outputs_dir": outputs_dir,
     }
     env_base = _base_env(plan_args)
+    # Connection references resolve at compile time: a dangling name is a
+    # compile error (SURVEY §2 "Connections"). Init connections inject
+    # their env contract into the gang; notifier/hook connections are
+    # validated (exist + can notify) but their env stays agent-side.
+    init_conns, notify_conns = _referenced_connections(op, run)
+    if init_conns or notify_conns:
+        if catalog is None:
+            from polyaxon_tpu.connections import ConnectionCatalog
+
+            catalog = ConnectionCatalog()
+        from polyaxon_tpu.connections import V1ConnectionKind
+
+        try:
+            env_base.update(catalog.env_for(init_conns))
+            for name in notify_conns:
+                conn = catalog.get(name)
+                if not (conn.is_notifier or conn.kind == V1ConnectionKind.CUSTOM):
+                    raise CompilerError(
+                        f"connection `{name}` (kind={conn.kind}) cannot be "
+                        "used for notifications/hooks")
+        except CompilerError:
+            raise
+        except ValueError as exc:
+            raise CompilerError(str(exc)) from exc
     env_base.update(_io_env(op))
 
     if kind == V1RunKind.JAXJOB:

@@ -1,0 +1,17 @@
+from polyaxon_tpu.connections.catalog import (
+    ConnectionCatalog,
+    ConnectionResolutionError,
+)
+from polyaxon_tpu.connections.schemas import (
+    V1Connection,
+    V1ConnectionKind,
+    V1ConnectionResource,
+)
+
+__all__ = [
+    "ConnectionCatalog",
+    "ConnectionResolutionError",
+    "V1Connection",
+    "V1ConnectionKind",
+    "V1ConnectionResource",
+]

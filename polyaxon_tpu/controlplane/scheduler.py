@@ -88,7 +88,14 @@ class Scheduler:
         for record in self.store.list_runs(statuses=[V1Statuses.CREATED]):
             if record.kind == V1RunKind.DAG and record.pipeline_uuid:
                 pass  # nested dags compile like any pipeline
-            self.plane.compile_run(record.uuid)
+            try:
+                self.plane.compile_run(record.uuid)
+            except Exception as exc:
+                # A bad spec (dangling connection, invalid topology...)
+                # fails that run; it must not kill the scheduler loop.
+                self.store.transition(
+                    record.uuid, V1Statuses.FAILED,
+                    reason="CompilationError", message=str(exc)[:500])
             actions += 1
         for record in self.store.list_runs(statuses=[V1Statuses.QUEUED, V1Statuses.RUNNING]):
             if record.kind == "matrix":

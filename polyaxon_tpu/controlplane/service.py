@@ -33,6 +33,9 @@ class ControlPlane:
         self.artifacts_root = os.path.join(self.home, "artifacts")
         os.makedirs(self.artifacts_root, exist_ok=True)
         self.streams = StreamsService(self.artifacts_root)
+        from polyaxon_tpu.connections import ConnectionCatalog
+
+        self.connections = ConnectionCatalog(home=self.home)
 
     # -- submission --------------------------------------------------------
     def submit(
@@ -101,6 +104,7 @@ class ControlPlane:
             run_uuid=record.uuid,
             artifacts_root=self.artifacts_root,
             project=record.project,
+            catalog=self.connections,
         )
         self.store.update_run(
             run_uuid, resolved_spec=resolved.to_dict(), launch_plan=plan.to_dict()

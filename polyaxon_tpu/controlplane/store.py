@@ -225,6 +225,7 @@ class Store:
         parent_uuid: Optional[str] = None,
         kind: Optional[str] = None,
         limit: int = 1000,
+        newest_first: bool = False,
     ) -> list[RunRecord]:
         clauses, args = [], []
         if project:
@@ -243,8 +244,9 @@ class Store:
             clauses.append("kind=?")
             args.append(kind)
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        order = "created_at DESC" if newest_first else "created_at"
         rows = self._conn().execute(
-            f"SELECT * FROM runs{where} ORDER BY created_at LIMIT ?", (*args, limit)
+            f"SELECT * FROM runs{where} ORDER BY {order} LIMIT ?", (*args, limit)
         ).fetchall()
         return [self._to_record(r) for r in rows]
 

@@ -1,0 +1,17 @@
+from polyaxon_tpu.fs.store import (
+    LocalStore,
+    MemoryStore,
+    Store,
+    StoreError,
+    get_store,
+    register_store,
+)
+
+__all__ = [
+    "LocalStore",
+    "MemoryStore",
+    "Store",
+    "StoreError",
+    "get_store",
+    "register_store",
+]

@@ -1,0 +1,244 @@
+"""Artifact-store IO layer (upstream `polyaxon/fs`: async fsspec
+wrappers over S3/GCS/Azure/volumes — SURVEY.md §2 "fs").
+
+fsspec is not guaranteed in the TPU-VM image and the orchestration
+plane only needs a small surface, so this is a scheme-dispatched store
+abstraction with two native backends:
+
+- ``file://`` — host paths / mounted volumes (the TPU-VM default);
+- ``memory://`` — in-process, for tests and dry runs.
+
+``gs://``/``s3://``/``wasb://`` resolve through optional deps (gcsfs /
+s3fs via fsspec) when present and raise a typed, actionable error when
+not — the store *interface* (upload/download/sync semantics the sidecar
+and checkpoint manager rely on) is identical either way.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Callable, Iterator, Optional
+from urllib.parse import urlparse
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+class Store:
+    """Blob-store interface: paths are '/'-separated keys under a root."""
+
+    scheme = "abstract"
+
+    # -- required surface -------------------------------------------------
+    def read_bytes(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        """All keys under prefix (recursive), sorted."""
+        raise NotImplementedError
+
+    # -- derived ----------------------------------------------------------
+    def read_text(self, key: str) -> str:
+        return self.read_bytes(key).decode()
+
+    def write_text(self, key: str, text: str) -> None:
+        self.write_bytes(key, text.encode())
+
+    def upload_file(self, local_path: str, key: str) -> None:
+        with open(local_path, "rb") as fh:
+            self.write_bytes(key, fh.read())
+
+    def download_file(self, key: str, local_path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        with open(local_path, "wb") as fh:
+            fh.write(self.read_bytes(key))
+        return local_path
+
+    def upload_dir(self, local_dir: str, prefix: str = "") -> int:
+        """Recursive upload; returns number of files shipped."""
+        count = 0
+        for root, _, files in os.walk(local_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, local_dir)
+                key = f"{prefix}/{rel}".replace(os.sep, "/").lstrip("/")
+                self.upload_file(path, key)
+                count += 1
+        return count
+
+    def download_dir(self, prefix: str, local_dir: str) -> int:
+        count = 0
+        for key in self.list(prefix):
+            rel = key[len(prefix):].lstrip("/") if prefix else key
+            self.download_file(key, os.path.join(local_dir, rel))
+            count += 1
+        return count
+
+    def sync_dir(self, local_dir: str, prefix: str = "",
+                 state: Optional[dict[str, float]] = None) -> int:
+        """Incremental upload: only files whose mtime advanced since the
+        last call (the sidecar hot loop — SURVEY.md §3.3)."""
+        state = state if state is not None else {}
+        count = 0
+        for root, _, files in os.walk(local_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                try:
+                    mtime = os.path.getmtime(path)
+                except OSError:
+                    continue
+                if state.get(path) == mtime:
+                    continue
+                rel = os.path.relpath(path, local_dir)
+                key = f"{prefix}/{rel}".replace(os.sep, "/").lstrip("/")
+                self.upload_file(path, key)
+                state[path] = mtime
+                count += 1
+        return count
+
+
+class LocalStore(Store):
+    scheme = "file"
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        path = os.path.abspath(os.path.join(self.root, key.lstrip("/")))
+        if not path.startswith(self.root + os.sep) and path != self.root:
+            raise StoreError(f"key {key!r} escapes store root")
+        return path
+
+    def read_bytes(self, key: str) -> bytes:
+        try:
+            with open(self._path(key), "rb") as fh:
+                return fh.read()
+        except FileNotFoundError as exc:
+            raise StoreError(f"no such key {key!r}") from exc
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)  # atomic publish
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def delete(self, key: str) -> None:
+        path = self._path(key)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def list(self, prefix: str = "") -> list[str]:
+        base = self._path(prefix) if prefix else self.root
+        if not os.path.isdir(base):
+            return [prefix] if prefix and os.path.isfile(base) else []
+        out = []
+        for root, _, files in os.walk(base):
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(root, name), self.root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    # Local fast paths: copy instead of read+write round-trips.
+    def upload_file(self, local_path: str, key: str) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        shutil.copy2(local_path, path)
+
+    def download_file(self, key: str, local_path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        try:
+            shutil.copy2(self._path(key), local_path)
+        except FileNotFoundError as exc:
+            raise StoreError(f"no such key {key!r}") from exc
+        return local_path
+
+
+class MemoryStore(Store):
+    scheme = "memory"
+    _shared: dict[str, dict[str, bytes]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, namespace: str = "default"):
+        with MemoryStore._lock:
+            self._blobs = MemoryStore._shared.setdefault(namespace, {})
+
+    def read_bytes(self, key: str) -> bytes:
+        try:
+            return self._blobs[key.lstrip("/")]
+        except KeyError as exc:
+            raise StoreError(f"no such key {key!r}") from exc
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        self._blobs[key.lstrip("/")] = bytes(data)
+
+    def exists(self, key: str) -> bool:
+        key = key.lstrip("/")
+        return key in self._blobs or any(
+            k.startswith(key + "/") for k in self._blobs)
+
+    def delete(self, key: str) -> None:
+        key = key.lstrip("/")
+        for k in [k for k in self._blobs if k == key or k.startswith(key + "/")]:
+            del self._blobs[k]
+
+    def list(self, prefix: str = "") -> list[str]:
+        prefix = prefix.lstrip("/")
+        return sorted(
+            k for k in self._blobs
+            if not prefix or k == prefix or k.startswith(prefix.rstrip("/") + "/")
+        )
+
+
+_REGISTRY: dict[str, Callable[[str], Store]] = {}
+
+
+def register_store(scheme: str, factory: Callable[[str], Store]) -> None:
+    _REGISTRY[scheme] = factory
+
+
+def _fsspec_store(url: str) -> Store:
+    try:
+        import fsspec  # noqa: F401
+    except ImportError as exc:
+        raise StoreError(
+            f"store url {url!r} needs fsspec (+ gcsfs/s3fs/adlfs) which is "
+            "not installed in this image; use file:// volumes or register "
+            "a custom store via fs.register_store()") from exc
+    raise StoreError(f"no fsspec adapter wired for {url!r} yet")
+
+
+def get_store(url: str) -> Store:
+    """Dispatch a store URL: file:///path, memory://ns, gs://bucket, ..."""
+    parsed = urlparse(url)
+    scheme = parsed.scheme or "file"
+    if scheme in _REGISTRY:
+        return _REGISTRY[scheme](url)
+    if scheme == "file":
+        return LocalStore(parsed.path or url)
+    if scheme == "memory":
+        return MemoryStore(parsed.netloc or "default")
+    if scheme in ("gs", "s3", "wasb", "abfs"):
+        return _fsspec_store(url)
+    raise StoreError(f"unknown store scheme {scheme!r} in {url!r}")

@@ -1,0 +1,17 @@
+from polyaxon_tpu.notifiers.service import (
+    FileNotifier,
+    Notifier,
+    NotificationService,
+    PagerDutyNotifier,
+    SlackNotifier,
+    WebhookNotifier,
+)
+
+__all__ = [
+    "FileNotifier",
+    "NotificationService",
+    "Notifier",
+    "PagerDutyNotifier",
+    "SlackNotifier",
+    "WebhookNotifier",
+]

@@ -14,7 +14,13 @@ from typing import Annotated, Any, Optional, Union
 from pydantic import Field, field_validator, model_validator
 
 from polyaxon_tpu.polyflow.component import V1Component
-from polyaxon_tpu.polyflow.environment import V1Cache, V1Hook, V1Plugins, V1Termination
+from polyaxon_tpu.polyflow.environment import (
+    V1Cache,
+    V1Hook,
+    V1Notification,
+    V1Plugins,
+    V1Termination,
+)
 from polyaxon_tpu.polyflow.io import V1Param
 from polyaxon_tpu.polyflow.matrix import Matrix
 from polyaxon_tpu.polyflow.schedules import Schedule
@@ -81,6 +87,7 @@ class V1Operation(BaseSchema):
     plugins: Optional[V1Plugins] = None
     build: Optional[V1Build] = None
     hooks: Optional[list[V1Hook]] = None
+    notifications: Optional[list[V1Notification]] = None
     schedule: Optional[AnnotatedSchedule] = None
     events: Optional[list[V1EventTrigger]] = None
     joins: Optional[list[V1Join]] = None

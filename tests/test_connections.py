@@ -1,0 +1,300 @@
+"""Connections catalog + fs stores + notifiers (SURVEY.md §2
+"Connections"/"fs"/"Notifiers"): typed catalog resolution at compile
+time, store IO semantics, and terminal-status notification fan-out."""
+
+import json
+import os
+
+import pytest
+
+from polyaxon_tpu.connections import (
+    ConnectionCatalog,
+    ConnectionResolutionError,
+    V1Connection,
+    V1ConnectionKind,
+)
+from polyaxon_tpu.fs import LocalStore, MemoryStore, StoreError, get_store
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.notifiers import NotificationService, SlackNotifier
+
+
+class TestCatalog:
+    def _catalog(self):
+        return ConnectionCatalog([
+            {"name": "artifacts-store", "kind": "host_path",
+             "schema": {"hostPath": "/data/store"}},
+            {"name": "gcs-ckpts", "kind": "gcs",
+             "schema": {"bucket": "gs://my-ckpts"}},
+            {"name": "alerts", "kind": "slack",
+             "schema": {"url": "https://hooks.slack test"}},
+        ])
+
+    def test_resolution_and_kinds(self):
+        catalog = self._catalog()
+        assert len(catalog) == 3
+        store = catalog.get("artifacts-store")
+        assert store.is_artifact_store and not store.is_notifier
+        assert catalog.get("alerts").is_notifier
+
+    def test_store_urls(self):
+        catalog = self._catalog()
+        assert catalog.get("artifacts-store").store_url() == "file:///data/store"
+        assert catalog.get("gcs-ckpts").store_url() == "gs://my-ckpts"
+
+    def test_env_contract(self):
+        env = self._catalog().env_for(["gcs-ckpts"])
+        assert env["POLYAXON_CONNECTION_GCS_CKPTS_KIND"] == "gcs"
+        assert env["POLYAXON_CONNECTION_GCS_CKPTS_URL"] == "gs://my-ckpts"
+
+    def test_unknown_name_lists_known(self):
+        with pytest.raises(ConnectionResolutionError, match="gcs-ckpts"):
+            self._catalog().get("nope")
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(ConnectionResolutionError, match="duplicate"):
+            ConnectionCatalog([
+                {"name": "x", "kind": "host_path"},
+                {"name": "x", "kind": "gcs"},
+            ])
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            ConnectionCatalog([{"name": "x", "kind": "warp-drive"}])
+
+    def test_loads_from_home_yaml(self, tmp_path):
+        path = tmp_path / "connections.yaml"
+        path.write_text(
+            "connections:\n"
+            "  - name: store\n"
+            "    kind: host_path\n"
+            "    schema: {hostPath: /mnt/store}\n"
+        )
+        catalog = ConnectionCatalog(home=str(tmp_path))
+        assert "store" in catalog
+
+    def test_artifact_store_selection(self):
+        catalog = self._catalog()
+        with pytest.raises(ConnectionResolutionError, match="not an artifact store"):
+            catalog.artifact_store("alerts")
+        only = ConnectionCatalog([
+            {"name": "s", "kind": "host_path", "schema": {"hostPath": "/x"}}])
+        assert only.artifact_store().name == "s"
+
+
+class TestStores:
+    def test_local_roundtrip_and_list(self, tmp_path):
+        store = LocalStore(str(tmp_path / "root"))
+        store.write_text("a/b.txt", "hello")
+        assert store.read_text("a/b.txt") == "hello"
+        assert store.exists("a/b.txt") and not store.exists("a/c.txt")
+        store.write_text("a/c/d.txt", "x")
+        assert store.list("a") == ["a/b.txt", "a/c/d.txt"]
+        store.delete("a/c")
+        assert store.list() == ["a/b.txt"]
+
+    def test_local_traversal_guarded(self, tmp_path):
+        store = LocalStore(str(tmp_path / "root"))
+        with pytest.raises(StoreError, match="escapes"):
+            store.read_bytes("../../etc/passwd")
+
+    def test_sync_dir_is_incremental(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "x.log").write_text("1")
+        store = LocalStore(str(tmp_path / "root"))
+        state = {}
+        assert store.sync_dir(str(src), "runs/1", state) == 1
+        assert store.sync_dir(str(src), "runs/1", state) == 0  # unchanged
+        (src / "x.log").write_text("12")
+        os.utime(src / "x.log", (1e9, 1e9))
+        assert store.sync_dir(str(src), "runs/1", state) == 1
+
+    def test_memory_store_and_dispatch(self):
+        store = get_store("memory://t1")
+        store.write_text("k", "v")
+        assert get_store("memory://t1").read_text("k") == "v"
+        assert isinstance(get_store("file:///tmp/plx-store-test"), LocalStore)
+
+    def test_remote_schemes_raise_actionable(self):
+        with pytest.raises(StoreError, match="fsspec"):
+            get_store("gs://bucket")
+        with pytest.raises(StoreError, match="unknown store scheme"):
+            get_store("ftp://x")
+
+    def test_upload_download_dir(self, tmp_path):
+        src = tmp_path / "src"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.txt").write_text("A")
+        (src / "sub" / "b.txt").write_text("B")
+        store = MemoryStore("t2")
+        assert store.upload_dir(str(src), "out") == 2
+        dest = tmp_path / "dest"
+        assert store.download_dir("out", str(dest)) == 2
+        assert (dest / "sub" / "b.txt").read_text() == "B"
+
+
+class TestNotifiers:
+    def _catalog(self, tmp_path):
+        return ConnectionCatalog([
+            {"name": "sink", "kind": "custom",
+             "schema": {"path": str(tmp_path / "notify.jsonl")}},
+        ])
+
+    def test_trigger_filtering_and_delivery(self, tmp_path):
+        service = NotificationService(self._catalog(tmp_path))
+        run = {"uuid": "u1", "name": "r", "project": "p", "kind": "job"}
+        spec = [{"connections": ["sink"], "trigger": "failed"}]
+        assert service.notify_terminal(run, V1Statuses.SUCCEEDED, spec) == 0
+        assert service.notify_terminal(run, V1Statuses.FAILED, spec) == 1
+        lines = (tmp_path / "notify.jsonl").read_text().splitlines()
+        assert json.loads(lines[0])["status"] == "failed"
+
+    def test_failures_do_not_raise(self, tmp_path):
+        service = NotificationService(self._catalog(tmp_path))
+        run = {"uuid": "u1"}
+        spec = [{"connections": ["missing-conn"]}]
+        assert service.notify_terminal(run, V1Statuses.SUCCEEDED, spec) == 0
+
+    def test_slack_format(self):
+        conn = V1Connection(name="s", kind=V1ConnectionKind.SLACK,
+                            schema={"url": "http://x"})
+        body = SlackNotifier(conn).format(
+            {"uuid": "u", "name": "train", "project": "p"}, "succeeded")
+        assert ":white_check_mark:" in body["text"] and "train" in body["text"]
+
+
+class TestCompilerIntegration:
+    def test_dangling_connection_fails_compile(self, tmp_path):
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        plane = ControlPlane(str(tmp_path / "home"))
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"artifacts": {"files": ["x"]},
+                          "connection": "no-such-store"}],
+                "container": {"command": ["python", "-c", "print('hi')"]},
+            },
+        })
+        agent = Agent(plane)
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "no-such-store" in (last.get("message") or "")
+
+    def test_resolved_connection_injects_env(self, tmp_path):
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        home = tmp_path / "home"
+        home.mkdir()
+        (home / "connections.yaml").write_text(
+            "connections:\n"
+            "  - name: my-store\n"
+            "    kind: host_path\n"
+            "    schema: {hostPath: /mnt/data}\n"
+        )
+        plane = ControlPlane(str(home))
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"artifacts": {"files": ["x"]},
+                          "connection": "my-store"}],
+                "container": {"command": [
+                    "python", "-c",
+                    "import os; print(os.environ['POLYAXON_CONNECTION_MY_STORE_URL'])",
+                ]},
+            },
+        })
+        agent = Agent(plane)
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.SUCCEEDED
+        logs = plane.streams.read_logs(record.uuid, "main-0.log")[0]
+        assert "file:///mnt/data" in logs
+
+    def test_agent_notifies_on_terminal(self, tmp_path):
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        home = tmp_path / "home"
+        home.mkdir()
+        sink = tmp_path / "sink.jsonl"
+        (home / "connections.yaml").write_text(
+            "connections:\n"
+            f"  - name: sink\n    kind: custom\n    schema: {{path: {sink}}}\n"
+        )
+        plane = ControlPlane(str(home))
+        record = plane.submit({
+            "kind": "operation",
+            "notifications": [{"connections": ["sink"], "trigger": "done"}],
+            "component": {
+                "run": {"kind": "job",
+                        "container": {"command": ["python", "-c", "print(1)"]}},
+            },
+        })
+        agent = Agent(plane)
+        assert agent.run_until_done(record.uuid, timeout=30) == V1Statuses.SUCCEEDED
+        agent.reconcile_once()
+        lines = sink.read_text().splitlines()
+        assert json.loads(lines[0])["uuid"] == record.uuid
+        # Re-reconcile must not duplicate the notification.
+        agent.reconcile_once()
+        assert len(sink.read_text().splitlines()) == 1
+
+    def test_notification_kind_validated_at_compile(self, tmp_path):
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        home = tmp_path / "home"
+        home.mkdir()
+        (home / "connections.yaml").write_text(
+            "connections:\n"
+            "  - name: gcs-store\n"
+            "    kind: gcs\n"
+            "    schema: {bucket: gs://b}\n"
+        )
+        plane = ControlPlane(str(home))
+        record = plane.submit({
+            "kind": "operation",
+            "notifications": [{"connections": ["gcs-store"]}],
+            "component": {
+                "run": {"kind": "job",
+                        "container": {"command": ["python", "-c", "print(1)"]}},
+            },
+        })
+        agent = Agent(plane)
+        assert agent.run_until_done(record.uuid, timeout=30) == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "cannot be used for notifications" in (last.get("message") or "")
+
+    def test_notifier_env_not_injected_into_gang(self, tmp_path):
+        """Webhook URLs/secrets of notifier connections must stay
+        agent-side, never in user-process env."""
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        home = tmp_path / "home"
+        home.mkdir()
+        sink = tmp_path / "sink.jsonl"
+        (home / "connections.yaml").write_text(
+            "connections:\n"
+            f"  - name: sink\n    kind: custom\n    schema: {{path: {sink}}}\n"
+        )
+        plane = ControlPlane(str(home))
+        record = plane.submit({
+            "kind": "operation",
+            "notifications": [{"connections": ["sink"]}],
+            "component": {
+                "run": {"kind": "job", "container": {"command": [
+                    "python", "-c",
+                    "import os; print('leak' if any('SINK' in k for k in os.environ) else 'clean')",
+                ]}},
+            },
+        })
+        agent = Agent(plane)
+        assert agent.run_until_done(record.uuid, timeout=30) == V1Statuses.SUCCEEDED
+        logs = plane.streams.read_logs(record.uuid, "main-0.log")[0]
+        assert "clean" in logs
