@@ -9,13 +9,15 @@ from __future__ import annotations
 
 from typing import Callable
 
-from polyaxon_tpu.models import bert, llama, mnist, resnet, vit
+from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, vit
 from polyaxon_tpu.models.common import ModelDef
 
 _FACTORIES: dict[str, Callable[..., ModelDef]] = {}
 
 for _name in llama.CONFIGS:
     _FACTORIES[_name] = (lambda n: lambda **kw: llama.model_def(n, **kw))(_name)
+for _name in moe.CONFIGS:
+    _FACTORIES[_name] = (lambda n: lambda **kw: moe.model_def(n, **kw))(_name)
 for _name in vit.CONFIGS:
     _FACTORIES[_name] = (lambda n: lambda **kw: vit.model_def(n, **kw))(_name)
 for _name in bert.CONFIGS:

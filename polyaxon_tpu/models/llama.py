@@ -49,7 +49,11 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: str = "none"  # none | full | dots (checkpoint policy per layer)
-    attention_impl: str = "xla"  # xla | flash | ring
+    attention_impl: str = "xla"  # xla | flash | ring | ulysses
+    # Pipeline parallelism over the `pp` mesh axis (parallel/pipeline.py):
+    # >1 splits the layer stack into that many ppermute-chained stages.
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
 
     @property
     def head_dim(self) -> int:
@@ -153,6 +157,49 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) ->
     return x
 
 
+def _layer_body(cfg: LlamaConfig):
+    body = functools.partial(_layer, cfg)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body, static_argnums=())
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        )
+    return body
+
+
+def _pipelined_layers(cfg: LlamaConfig, body, layer_params, x: jax.Array) -> jax.Array:
+    """Run the layer stack as a `pp` pipeline (parallel/pipeline.py).
+
+    Assumes contiguous positions 0..S-1 (the pretraining case): each
+    microbatch rebuilds them locally instead of threading them through
+    the ppermute chain.
+    """
+    from polyaxon_tpu.ops.ring import ambient_mesh
+    from polyaxon_tpu.parallel.pipeline import pipeline_forward, stack_stages
+
+    mesh = ambient_mesh()
+    if mesh is None or "pp" not in mesh.axis_names:
+        raise ValueError(
+            f"pipeline_stages={cfg.pipeline_stages} needs a mesh with a "
+            "`pp` axis in context (`with mesh:`)")
+    stacked = stack_stages(layer_params, cfg.pipeline_stages)
+
+    def stage_fn(local_layers, x_mb):
+        mb, S, _ = x_mb.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (mb, S))
+
+        def scan_body(carry, layer):
+            return body(carry, layer, positions), None
+
+        out, _ = jax.lax.scan(scan_body, x_mb, local_layers)
+        return out
+
+    return pipeline_forward(
+        mesh, stage_fn, stacked, x,
+        n_microbatches=cfg.pipeline_microbatches)
+
+
 def forward(
     cfg: LlamaConfig,
     params: dict,
@@ -164,20 +211,22 @@ def forward(
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    elif cfg.pipeline_stages > 1:
+        raise ValueError(
+            "the pipelined path assumes contiguous positions 0..S-1 and "
+            "cannot honor explicit `positions` (packed sequences / decode "
+            "offsets); use pipeline_stages=1 for those")
     x = params["embed"].astype(dt)[tokens]
 
-    body = functools.partial(_layer, cfg)
-    if cfg.remat == "full":
-        body = jax.checkpoint(body, static_argnums=())
-    elif cfg.remat == "dots":
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-        )
+    body = _layer_body(cfg)
 
-    def scan_body(carry, layer_params):
-        return body(carry, layer_params, positions), None
+    if cfg.pipeline_stages > 1:
+        x = _pipelined_layers(cfg, body, params["layers"], x)
+    else:
+        def scan_body(carry, layer_params):
+            return body(carry, layer_params, positions), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     # fp32 logits: the MXU matmul stays bf16; accumulate/softmax in fp32.

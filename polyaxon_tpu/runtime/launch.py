@@ -29,6 +29,9 @@ def main() -> int:
         level=os.environ.get("POLYAXON_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    from polyaxon_tpu.utils import apply_jax_platforms_override
+
+    apply_jax_platforms_override()
     spec_json = os.environ.get(ENV_JAXJOB_SPEC)
     if not spec_json:
         print(f"{ENV_JAXJOB_SPEC} is not set", file=sys.stderr)

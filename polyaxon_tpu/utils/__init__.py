@@ -1,0 +1,3 @@
+from polyaxon_tpu.utils.env import apply_jax_platforms_override
+
+__all__ = ["apply_jax_platforms_override"]

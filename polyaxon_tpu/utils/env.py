@@ -1,0 +1,23 @@
+"""Small environment helpers shared by the CLI and runtime entrypoints."""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platforms_override() -> None:
+    """Honor ``JAX_PLATFORMS`` even where a sitecustomize hook (e.g. the
+    axon TPU-emulator plugin) pinned ``jax_platforms`` before our code
+    ran — required to target the virtual CPU mesh from the CLI:
+    ``JAX_PLATFORMS=cpu plx run ...``. No-op when unset or when jax is
+    unavailable/already initialized with the same value.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    except ImportError:
+        pass
