@@ -21,7 +21,7 @@ from polyaxon_tpu.agent.executor import LocalExecutor
 from polyaxon_tpu.lifecycle import V1Statuses
 from polyaxon_tpu.polyflow.runs import V1RunKind
 
-_PIPELINE_KINDS = {"matrix", V1RunKind.DAG}
+_PIPELINE_KINDS = {"matrix", V1RunKind.DAG, "schedule"}
 
 
 class Agent:
@@ -65,21 +65,25 @@ class Agent:
                     self._notified.add(record.uuid)
                     continue  # sent by a previous agent incarnation
                 notifications = (record.spec or {}).get("notifications")
-                if not notifications:
+                hooks = (record.spec or {}).get("hooks")
+                if not notifications and not hooks:
                     self._notified.add(record.uuid)
                     continue
-                if self._notify_service is None:
-                    from polyaxon_tpu.notifiers import NotificationService
+                if notifications:
+                    if self._notify_service is None:
+                        from polyaxon_tpu.notifiers import NotificationService
 
-                    self._notify_service = NotificationService(
-                        self.plane.connections)
-                run_info = {
-                    "uuid": record.uuid, "name": record.name,
-                    "project": record.project, "kind": record.kind,
-                    "finished_at": record.finished_at,
-                }
-                sent += self._notify_service.notify_terminal(
-                    run_info, record.status, notifications)
+                        self._notify_service = NotificationService(
+                            self.plane.connections)
+                    run_info = {
+                        "uuid": record.uuid, "name": record.name,
+                        "project": record.project, "kind": record.kind,
+                        "finished_at": record.finished_at,
+                    }
+                    sent += self._notify_service.notify_terminal(
+                        run_info, record.status, notifications)
+                if hooks:
+                    self._spawn_hooks(record, hooks)
                 self._notified.add(record.uuid)
                 meta = dict(record.meta or {})
                 meta["notified"] = True
@@ -90,6 +94,43 @@ class Agent:
             logging.getLogger(__name__).warning(
                 "notification pass failed", exc_info=True)
         return sent
+
+    def _spawn_hooks(self, record, hooks: list[dict]) -> int:
+        """Terminal-status hooks: spawn the referenced hub component as a
+        child run (upstream V1Hook semantics — SURVEY.md §2 lifecycle)."""
+        from polyaxon_tpu.lifecycle import V1Statuses as S
+        from polyaxon_tpu.polyflow.operation import V1Operation
+
+        matches = {
+            None: True, "done": True,
+            "succeeded": record.status == S.SUCCEEDED,
+            "failed": record.status in (S.FAILED, S.UPSTREAM_FAILED),
+            "stopped": record.status == S.STOPPED,
+        }
+        spawned = 0
+        for hook in hooks:
+            trigger = (hook.get("trigger") or "done").lower()
+            if not matches.get(trigger, False):
+                continue
+            hub_ref = hook.get("hubRef") or hook.get("hub_ref")
+            if not hub_ref:
+                continue  # connection-only hooks are notification aliases
+            try:
+                op = V1Operation(hub_ref=hub_ref, presets=hook.get("presets"))
+                self.plane.submit(
+                    op=op, project=record.project,
+                    params=hook.get("params"),
+                    name=f"{record.name or record.uuid}-hook",
+                    parent_uuid=record.uuid,
+                )
+                spawned += 1
+            except Exception as exc:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "hook %s for run %s failed to spawn: %s",
+                    hub_ref, record.uuid, exc)
+        return spawned
 
     def _cleared_to_start(self, record) -> bool:
         """Topology-gated placement through the native slice pool."""

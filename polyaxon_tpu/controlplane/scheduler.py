@@ -98,10 +98,20 @@ class Scheduler:
                     reason="CompilationError", message=str(exc)[:500])
             actions += 1
         for record in self.store.list_runs(statuses=[V1Statuses.QUEUED, V1Statuses.RUNNING]):
-            if record.kind == "matrix":
-                actions += self._tick_matrix(record)
-            elif record.kind == V1RunKind.DAG:
-                actions += self._tick_dag(record)
+            try:
+                if record.kind == "matrix":
+                    actions += self._tick_matrix(record)
+                elif record.kind == V1RunKind.DAG:
+                    actions += self._tick_dag(record)
+                elif record.kind == "schedule":
+                    actions += self._tick_schedule(record)
+            except Exception as exc:
+                # A bad spec (invalid cron, broken matrix...) fails that
+                # pipeline; it must never kill the reconcile loop.
+                self.store.transition(
+                    record.uuid, V1Statuses.FAILED,
+                    reason="PipelineError", message=str(exc)[:500])
+                actions += 1
         for record in self.store.list_runs(statuses=[V1Statuses.PREEMPTED]):
             actions += self._tick_preempted(record)
         return actions
@@ -227,6 +237,99 @@ class Scheduler:
             self.store.transition(record.uuid, target, reason="PipelineDone")
             actions += 1
         return actions
+
+    # -------------------------------------------------------------- schedule
+    def _tick_schedule(self, record: RunRecord, *, now=None) -> int:
+        """Recurring parent run: fire child runs per its V1*Schedule.
+
+        ``now`` is injectable for tests. ``last_fire`` advances to the
+        computed fire time (not wall clock) so cadence never drifts.
+        """
+        import datetime as dt
+
+        from polyaxon_tpu.controlplane.cron import next_fire
+        from polyaxon_tpu.polyflow.schedules import (
+            V1CronSchedule,
+            V1DateTimeSchedule,
+            V1IntervalSchedule,
+        )
+
+        def as_utc(value) -> dt.datetime:
+            if isinstance(value, str):
+                value = dt.datetime.fromisoformat(value)
+            if value.tzinfo is None:
+                return value.replace(tzinfo=dt.timezone.utc)
+            return value.astimezone(dt.timezone.utc)
+
+        op = get_operation(record.spec)
+        sched = op.schedule
+        meta = dict(record.meta or {})
+        state = dict(meta.get("schedule") or {})
+        fired = int(state.get("fired", 0))
+        now = as_utc(now) if now is not None else dt.datetime.now(dt.timezone.utc)
+        actions = 0
+
+        if record.status == V1Statuses.QUEUED:
+            self.store.transition(record.uuid, V1Statuses.SCHEDULED)
+            self.store.transition(record.uuid, V1Statuses.RUNNING,
+                                  reason="ScheduleActive", force=True)
+            actions += 1
+
+        created = as_utc(record.created_at)
+        last_fire = as_utc(state["last_fire"]) if state.get("last_fire") else None
+
+        # Next fire time per schedule kind (None ⇒ exhausted).
+        next_at: dt.datetime | None
+        if isinstance(sched, V1DateTimeSchedule):
+            next_at = None if fired else as_utc(sched.start_at)
+        elif isinstance(sched, V1IntervalSchedule):
+            start = as_utc(sched.start_at) if sched.start_at else created
+            next_at = start if (fired == 0 and sched.start_at) else (
+                (last_fire or start) + dt.timedelta(seconds=sched.frequency))
+        elif isinstance(sched, V1CronSchedule):
+            base = last_fire or (as_utc(sched.start_at) if sched.start_at else created)
+            next_at = next_fire(sched.cron, base)
+        else:
+            self.store.transition(record.uuid, V1Statuses.FAILED,
+                                  reason="UnsupportedSchedule",
+                                  message=type(sched).__name__)
+            return actions + 1
+
+        max_runs = getattr(sched, "max_runs", None)
+        end_at = getattr(sched, "end_at", None)
+        exhausted = (
+            next_at is None
+            or (max_runs is not None and fired >= max_runs)
+            or (end_at is not None and next_at > as_utc(end_at))
+        )
+        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        if exhausted:
+            if all(c.is_done for c in children):
+                self.store.transition(record.uuid, V1Statuses.SUCCEEDED,
+                                      reason="ScheduleDone",
+                                      message=f"fired {fired} runs")
+                actions += 1
+            return actions
+
+        if now < next_at:
+            return actions
+        if getattr(sched, "depends_on_past", None) and any(
+                not c.is_done for c in children):
+            return actions  # wait for the previous fire to finish
+
+        child_op = op.clone()
+        child_op.schedule = None
+        child_op.name = None
+        self.plane.submit(
+            op=child_op, project=record.project,
+            name=f"{record.name or 'scheduled'}-{fired}",
+            pipeline_uuid=record.uuid, parent_uuid=record.uuid,
+            iteration=fired,
+        )
+        state.update({"fired": fired + 1, "last_fire": next_at.isoformat()})
+        meta["schedule"] = state
+        self.store.update_run(record.uuid, meta=meta)
+        return actions + 1
 
     # ---------------------------------------------------------------- matrix
     def _observations(self, record: RunRecord, metric_name: str,

@@ -61,10 +61,19 @@ class ControlPlane:
         is_pipeline = op.matrix is not None or (
             op.component is not None and op.component.run_kind == V1RunKind.DAG
         )
-        kind = "matrix" if op.matrix is not None else (
-            V1RunKind.DAG if is_pipeline else
-            (op.component.run_kind if op.component else "hub")
-        )
+        if op.schedule is not None:
+            kind = "schedule"  # a recurring parent that spawns child runs
+            cron_expr = getattr(op.schedule, "cron", None)
+            if cron_expr:
+                from polyaxon_tpu.controlplane.cron import Cron
+
+                Cron(cron_expr)  # fail fast at submit, not in the agent
+        elif op.matrix is not None:
+            kind = "matrix"
+        elif is_pipeline:
+            kind = V1RunKind.DAG
+        else:
+            kind = op.component.run_kind if op.component else "hub"
         record = self.store.create_run(
             project=project,
             spec=op.to_dict(),
@@ -80,11 +89,47 @@ class ControlPlane:
         return record
 
     # -- compilation -------------------------------------------------------
+    def resolve_hub_ref(self, ref: str):
+        """Load a component from the local hub (<home>/hub/<name>.yaml).
+
+        Upstream resolves hub refs against the component registry; the
+        embedded plane's registry is a directory of component files.
+        Version tags (``name:tag``) select ``<name>-<tag>.yaml`` first,
+        then fall back to ``<name>.yaml``.
+        """
+        from polyaxon_tpu.polyaxonfile import get_component, load_specs
+
+        name, _, tag = ref.partition(":")
+        hub_dir = os.path.join(self.home, "hub")
+        candidates = [f"{name}-{tag}" if tag else None, name]
+        for candidate in candidates:
+            if not candidate:
+                continue
+            for ext in (".yaml", ".yml", ".json"):
+                path = os.path.join(hub_dir, candidate + ext)
+                if os.path.exists(path):
+                    return get_component(load_specs(path))
+        raise ValueError(
+            f"hub component `{ref}` not found under {hub_dir}")
+
     def compile_run(self, run_uuid: str) -> RunRecord:
         """created → compiled → queued (SURVEY §3.1 lifecycle tail)."""
         record = self.store.get_run(run_uuid)
         op = get_operation(record.spec)
-        if record.kind in ("matrix", V1RunKind.DAG):
+        if op.component is None and op.hub_ref:
+            component = self.resolve_hub_ref(op.hub_ref)
+            # exactly-one-source validation: swap hubRef → component via
+            # a dict rebuild (validate_assignment rejects in-place edits).
+            op_dict = op.to_dict()
+            op_dict.pop("hubRef", None)
+            op_dict["component"] = component.to_dict()
+            op = get_operation(op_dict)
+            # The resolved component may be a pipeline: recompute kind so
+            # a hub DAG takes the pipeline path, not the job compiler.
+            kind = component.run_kind or record.kind
+            self.store.update_run(run_uuid, spec=op.to_dict(), kind=kind)
+            record = self.store.get_run(run_uuid)
+        if record.kind in ("matrix", V1RunKind.DAG, "schedule"):
             # Pipelines compile trivially: children are compiled per-trial.
             self.store.transition(run_uuid, V1Statuses.COMPILED, reason="PipelineCompiled")
             self.store.transition(run_uuid, V1Statuses.QUEUED)
@@ -109,9 +154,61 @@ class ControlPlane:
         self.store.update_run(
             run_uuid, resolved_spec=resolved.to_dict(), launch_plan=plan.to_dict()
         )
+
+        # Run memoization (upstream V1Cache lifecycle: created →
+        # awaiting_cache → succeeded on hit / compiled on miss): an
+        # identical resolved component+params that already succeeded
+        # short-circuits and reuses the hit's outputs.
+        cache = op.cache
+        if cache is not None and not cache.disable:
+            key = self._cache_key(resolved)
+            self.store.transition(run_uuid, V1Statuses.AWAITING_CACHE)
+            hit = self.store.find_cached(key, project=record.project, ttl=cache.ttl)
+            if hit is not None and hit.uuid != run_uuid:
+                self._adopt_outputs(hit, run_uuid)
+                meta = dict(record.meta or {})
+                meta["cache_hit_from"] = hit.uuid
+                self.store.update_run(run_uuid, meta=meta, cache_key=key)
+                self.store.transition(
+                    run_uuid, V1Statuses.SUCCEEDED, reason="CacheHit",
+                    message=f"reused outputs of {hit.uuid}")
+                return self.store.get_run(run_uuid)
+            self.store.update_run(run_uuid, cache_key=key)
+
         self.store.transition(run_uuid, V1Statuses.COMPILED, reason="Compiled")
         self.store.transition(run_uuid, V1Statuses.QUEUED)
         return self.store.get_run(run_uuid)
+
+    @staticmethod
+    def _cache_key(resolved: V1Operation) -> str:
+        """Content hash of what determines a run's outputs: the resolved
+        component spec + literal params."""
+        import hashlib
+        import json as _json
+
+        payload = {
+            "component": resolved.component.to_dict() if resolved.component else None,
+            "params": {k: p.to_dict() for k, p in (resolved.params or {}).items()},
+        }
+        return hashlib.sha256(
+            _json.dumps(payload, sort_keys=True, default=str).encode()
+        ).hexdigest()
+
+    def _adopt_outputs(self, hit: RunRecord, run_uuid: str) -> None:
+        """Copy the cache hit's outputs manifest into the new run's dir."""
+        import shutil
+
+        src = os.path.join(self.artifacts_root, hit.uuid)
+        dst = os.path.join(self.artifacts_root, run_uuid)
+        os.makedirs(dst, exist_ok=True)
+        for name in ("outputs.json",):
+            path = os.path.join(src, name)
+            if os.path.exists(path):
+                shutil.copy2(path, os.path.join(dst, name))
+        outputs_dir = os.path.join(src, "outputs")
+        if os.path.isdir(outputs_dir):
+            shutil.copytree(
+                outputs_dir, os.path.join(dst, "outputs"), dirs_exist_ok=True)
 
     # -- lifecycle ops -----------------------------------------------------
     def stop(self, run_uuid: str, message: str = "") -> None:

@@ -85,6 +85,7 @@ class RunRecord:
     finished_at: Optional[str]
     description: Optional[str] = None
     managed_by: str = "agent"
+    cache_key: Optional[str] = None
 
     @property
     def is_done(self) -> bool:
@@ -104,6 +105,14 @@ class Store:
         self._lock = threading.RLock()
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
+            # Migration: cache_key column for run memoization (upstream
+            # V1Cache semantics); older DBs lack it.
+            try:
+                conn.execute("ALTER TABLE runs ADD COLUMN cache_key TEXT")
+                conn.execute(
+                    "CREATE INDEX IF NOT EXISTS idx_runs_cache ON runs(cache_key)")
+            except sqlite3.OperationalError:
+                pass  # already migrated
 
     def _conn(self) -> sqlite3.Connection:
         # ':memory:' DBs are per-connection, so a thread-local connection
@@ -185,6 +194,27 @@ class Store:
             )
         return self.get_run(run_uuid)
 
+    def find_cached(self, cache_key: str, *, project: str,
+                    ttl: Optional[int] = None) -> Optional[RunRecord]:
+        """Newest SUCCEEDED run in ``project`` with this cache key
+        (within ttl seconds). Project-scoped: memoization must never
+        leak artifacts across project namespaces."""
+        rows = self._conn().execute(
+            "SELECT * FROM runs WHERE cache_key=? AND project=? AND status=? "
+            "ORDER BY created_at DESC LIMIT 5",
+            (cache_key, project, V1Statuses.SUCCEEDED.value),
+        ).fetchall()
+        for row in rows:
+            record = self._to_record(row)
+            if ttl and record.finished_at:
+                import datetime as _dt
+
+                finished = _dt.datetime.fromisoformat(record.finished_at)
+                if (now() - finished).total_seconds() > ttl:
+                    continue
+            return record
+        return None
+
     def _to_record(self, row: sqlite3.Row) -> RunRecord:
         return RunRecord(
             uuid=row["uuid"],
@@ -193,6 +223,7 @@ class Store:
             description=row["description"],
             kind=row["kind"],
             managed_by=row["managed_by"],
+            cache_key=row["cache_key"] if "cache_key" in row.keys() else None,
             status=V1Statuses(row["status"]),
             spec=_loads(row["spec"]),
             resolved_spec=_loads(row["resolved_spec"]),
@@ -252,7 +283,8 @@ class Store:
 
     def update_run(self, run_uuid: str, **fields: Any) -> None:
         allowed = {"name", "description", "kind", "spec", "resolved_spec",
-                   "launch_plan", "params", "tags", "meta", "retries", "iteration"}
+                   "launch_plan", "params", "tags", "meta", "retries",
+                   "iteration", "cache_key"}
         sets, args = ["updated_at=?"], [now().isoformat()]
         for key, value in fields.items():
             if key not in allowed:

@@ -1,0 +1,287 @@
+"""Schedules (cron/interval/datetime), run cache, hub refs, and hooks —
+the remaining Polyflow execution semantics (SURVEY.md §2 "Polyflow IR":
+schedules, cache; "Lifecycle": hooks; CLI `--hub`)."""
+
+import datetime as dt
+import time
+
+import pytest
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.controlplane.cron import Cron, CronError, next_fire
+from polyaxon_tpu.lifecycle import V1Statuses
+
+QUICK = {
+    "kind": "component",
+    "run": {"kind": "job",
+            "container": {"command": ["python", "-c", "print('tick')"]}},
+}
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+@pytest.fixture()
+def agent(plane):
+    return Agent(plane, max_concurrent=8)
+
+
+class TestCron:
+    def test_simple_fields(self):
+        t = dt.datetime(2026, 7, 29, 10, 30)
+        assert next_fire("*/15 * * * *", t) == dt.datetime(2026, 7, 29, 10, 45)
+        assert next_fire("0 0 * * *", t) == dt.datetime(2026, 7, 30, 0, 0)
+        assert next_fire("5 4 1 * *", t) == dt.datetime(2026, 8, 1, 4, 5)
+
+    def test_lists_and_ranges(self):
+        t = dt.datetime(2026, 7, 29, 10, 59)
+        assert next_fire("0,30 9-11 * * *", t) == dt.datetime(2026, 7, 29, 11, 0)
+
+    def test_dow_and_vixie_or(self):
+        # 2026-07-29 is a Wednesday. Next Monday = 2026-08-03.
+        t = dt.datetime(2026, 7, 29, 12, 0)
+        assert next_fire("0 9 * * 1", t) == dt.datetime(2026, 8, 3, 9, 0)
+        # dom=30 OR dow=Mon → the 30th comes first.
+        assert next_fire("0 9 30 * 1", t) == dt.datetime(2026, 7, 30, 9, 0)
+
+    def test_sunday_as_7(self):
+        assert 0 in Cron("* * * * 7").dow
+
+    def test_month_rollover(self):
+        t = dt.datetime(2026, 12, 31, 23, 59)
+        assert next_fire("0 0 1 1 *", t) == dt.datetime(2027, 1, 1, 0, 0)
+
+    def test_errors(self):
+        with pytest.raises(CronError):
+            Cron("* * * *")
+        with pytest.raises(CronError):
+            Cron("61 * * * *")
+        with pytest.raises(CronError):
+            Cron("*/0 * * * *")
+
+
+class TestSchedules:
+    def test_interval_fires_max_runs_then_succeeds(self, plane, agent):
+        record = plane.submit({
+            "kind": "operation",
+            "schedule": {"kind": "interval", "frequency": 1,
+                         "startAt": "2020-01-01T00:00:00+00:00",
+                         "maxRuns": 2},
+            "component": QUICK,
+        })
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) == 2
+        assert all(c.status == V1Statuses.SUCCEEDED for c in children)
+
+    def test_datetime_fires_once(self, plane, agent):
+        record = plane.submit({
+            "kind": "operation",
+            "schedule": {"kind": "datetime",
+                         "startAt": "2020-01-01T00:00:00+00:00"},
+            "component": QUICK,
+        })
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.SUCCEEDED
+        assert len(plane.list_runs(pipeline_uuid=record.uuid)) == 1
+
+    def test_future_datetime_does_not_fire(self, plane, agent):
+        record = plane.submit({
+            "kind": "operation",
+            "schedule": {"kind": "datetime",
+                         "startAt": "2099-01-01T00:00:00+00:00"},
+            "component": QUICK,
+        })
+        for _ in range(5):
+            agent.reconcile_once()
+        assert plane.list_runs(pipeline_uuid=record.uuid) == []
+        assert plane.get_run(record.uuid).status == V1Statuses.RUNNING
+        plane.stop(record.uuid)
+        agent.reconcile_once()
+
+
+class TestCache:
+    def _op(self, lr, ttl=None):
+        cache = {"disable": False}
+        if ttl:
+            cache["ttl"] = ttl
+        return {
+            "kind": "operation",
+            "cache": cache,
+            "params": {"lr": {"value": lr}},
+            "component": {
+                "inputs": [{"name": "lr", "type": "float", "toEnv": "LR"}],
+                "run": {"kind": "job", "container": {"command": [
+                    "python", "-c",
+                    "import os, json\n"
+                    "d = os.environ['POLYAXON_RUN_ARTIFACTS_PATH']\n"
+                    "json.dump({'lr': os.environ['LR']}, open(d+'/outputs.json','w'))\n",
+                ]}},
+            },
+        }
+
+    def test_identical_run_hits_cache(self, plane, agent):
+        first = plane.submit(self._op(0.1))
+        assert agent.run_until_done(first.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        second = plane.submit(self._op(0.1))
+        assert agent.run_until_done(second.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        rec = plane.get_run(second.uuid)
+        assert rec.meta.get("cache_hit_from") == first.uuid
+        conditions = [c["reason"] for c in plane.get_statuses(second.uuid)]
+        assert "CacheHit" in conditions
+        # Outputs adopted from the hit.
+        assert plane.streams.get_outputs(second.uuid) == {"lr": "0.1"}
+
+    def test_different_params_miss(self, plane, agent):
+        first = plane.submit(self._op(0.1))
+        agent.run_until_done(first.uuid, timeout=60)
+        second = plane.submit(self._op(0.2))
+        agent.run_until_done(second.uuid, timeout=60)
+        assert "cache_hit_from" not in plane.get_run(second.uuid).meta
+
+    def test_no_cache_section_never_memoizes(self, plane, agent):
+        op = self._op(0.1)
+        del op["cache"]
+        first = plane.submit(op)
+        agent.run_until_done(first.uuid, timeout=60)
+        second = plane.submit(op)
+        agent.run_until_done(second.uuid, timeout=60)
+        assert "cache_hit_from" not in plane.get_run(second.uuid).meta
+
+
+class TestHubAndHooks:
+    def _write_hub(self, plane, name="cleanup"):
+        import os
+
+        hub = os.path.join(plane.home, "hub")
+        os.makedirs(hub, exist_ok=True)
+        with open(os.path.join(hub, f"{name}.yaml"), "w") as fh:
+            fh.write(
+                "kind: component\n"
+                f"name: {name}\n"
+                "run:\n"
+                "  kind: job\n"
+                "  container:\n"
+                "    command: ['python', '-c', 'print(\"hook ran\")']\n"
+            )
+
+    def test_hub_ref_run(self, plane, agent):
+        self._write_hub(plane)
+        from polyaxon_tpu.polyflow.operation import V1Operation
+
+        record = plane.submit(op=V1Operation(hub_ref="cleanup"))
+        assert agent.run_until_done(record.uuid, timeout=60) == V1Statuses.SUCCEEDED
+
+    def test_missing_hub_ref_fails_compile(self, plane, agent):
+        from polyaxon_tpu.polyflow.operation import V1Operation
+
+        record = plane.submit(op=V1Operation(hub_ref="ghost"))
+        assert agent.run_until_done(record.uuid, timeout=30) == V1Statuses.FAILED
+
+    def test_hook_spawns_on_success(self, plane, agent):
+        self._write_hub(plane)
+        record = plane.submit({
+            "kind": "operation",
+            "hooks": [{"trigger": "succeeded", "hubRef": "cleanup"}],
+            "component": QUICK,
+        })
+        assert agent.run_until_done(record.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        deadline = time.monotonic() + 30
+        while True:
+            agent.reconcile_once()
+            hooks = plane.list_runs(parent_uuid=record.uuid)
+            if hooks and all(h.is_done for h in hooks):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert len(hooks) == 1
+        assert hooks[0].status == V1Statuses.SUCCEEDED
+        # Idempotent: another pass must not spawn a second hook run.
+        agent.reconcile_once()
+        assert len(plane.list_runs(parent_uuid=record.uuid)) == 1
+
+    def test_failed_trigger_does_not_fire_on_success(self, plane, agent):
+        self._write_hub(plane)
+        record = plane.submit({
+            "kind": "operation",
+            "hooks": [{"trigger": "failed", "hubRef": "cleanup"}],
+            "component": QUICK,
+        })
+        assert agent.run_until_done(record.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        for _ in range(3):
+            agent.reconcile_once()
+        assert plane.list_runs(parent_uuid=record.uuid) == []
+
+
+class TestReviewFixes:
+    def test_dow_ranges_with_seven(self):
+        assert Cron("0 9 * * 5-7").dow == {5, 6, 0}
+        assert Cron("0 9 * * 0-7").dow == {0, 1, 2, 3, 4, 5, 6}
+
+    def test_invalid_cron_rejected_at_submit(self, plane):
+        with pytest.raises(CronError):
+            plane.submit({
+                "kind": "operation",
+                "schedule": {"kind": "cron", "cron": "99 * * * *"},
+                "component": QUICK,
+            })
+
+    def test_pipeline_error_does_not_kill_loop(self, plane, agent):
+        """A schedule that breaks mid-tick fails alone; others proceed."""
+        bad = plane.submit({
+            "kind": "operation",
+            "schedule": {"kind": "interval", "frequency": 1, "maxRuns": 1},
+            "component": QUICK,
+        })
+        # Corrupt the stored spec AFTER submit-time validation.
+        spec = plane.get_run(bad.uuid).spec
+        spec["schedule"] = {"kind": "cron", "cron": "99 * * * *"}
+        plane.store.update_run(bad.uuid, spec=spec)
+        ok = plane.submit(QUICK)
+        assert agent.run_until_done(ok.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        assert plane.get_run(bad.uuid).status == V1Statuses.FAILED
+
+    def test_cache_is_project_scoped(self, plane, agent):
+        op = {
+            "kind": "operation",
+            "cache": {"disable": False},
+            "component": QUICK,
+        }
+        first = plane.submit(op, project="team-a")
+        assert agent.run_until_done(first.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        second = plane.submit(op, project="team-b")
+        assert agent.run_until_done(second.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        assert "cache_hit_from" not in plane.get_run(second.uuid).meta
+        third = plane.submit(op, project="team-a")
+        assert agent.run_until_done(third.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        assert plane.get_run(third.uuid).meta.get("cache_hit_from") == first.uuid
+
+    def test_hub_dag_takes_pipeline_path(self, plane, agent):
+        import os
+
+        hub = os.path.join(plane.home, "hub")
+        os.makedirs(hub, exist_ok=True)
+        with open(os.path.join(hub, "pipe.yaml"), "w") as fh:
+            fh.write(
+                "kind: component\n"
+                "name: pipe\n"
+                "run:\n"
+                "  kind: dag\n"
+                "  operations:\n"
+                "    - name: a\n"
+                "      component:\n"
+                "        run:\n"
+                "          kind: job\n"
+                "          container:\n"
+                "            command: ['python', '-c', 'print(1)']\n"
+            )
+        from polyaxon_tpu.polyflow.operation import V1Operation
+
+        record = plane.submit(op=V1Operation(hub_ref="pipe"))
+        assert agent.run_until_done(record.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) == 1 and children[0].name == "a"
