@@ -32,6 +32,10 @@ def main() -> int:
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--seq", type=int, default=None)
+    parser.add_argument("--attention", default="xla",
+                        choices=["xla", "flash"],
+                        help="attention impl; flash (Pallas) pays off at "
+                             "long seq on real chips, xla is the safe default")
     args = parser.parse_args()
 
     import jax
@@ -62,6 +66,7 @@ def main() -> int:
                 "seq_len": seq,
                 "log_every": 10**9,
                 "remat": "none" if args.smoke else "dots",
+                "attention_impl": args.attention,
             },
         }
     )
