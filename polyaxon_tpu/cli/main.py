@@ -339,15 +339,86 @@ def _parse_slices(entries) -> list[tuple[str, str, bool]]:
     return parsed
 
 
+# -------------------------------------------------------------------- admin
+@cli.group("admin")
+def admin_group():
+    """Deploy/manage the control-plane stack (upstream `admin deploy`)."""
+
+
+@admin_group.command("deploy")
+@click.option("-f", "--file", "config_file", required=True, type=click.Path(exists=True))
+@click.option("--dry-run", is_flag=True, help="validate and show the plan only")
+def admin_deploy(config_file, dry_run):
+    import yaml
+
+    from polyaxon_tpu.deploy import check_deployment, render_deployment
+
+    with open(config_file) as fh:
+        data = yaml.safe_load(fh)
+    try:
+        config = check_deployment(data or {})
+    except ValueError as exc:
+        raise click.ClickException(str(exc)) from exc
+    home = config.home or get_home()
+    if dry_run:
+        click.echo(json.dumps({"valid": True,
+                               "deploymentType": config.deployment_type,
+                               "home": home}, indent=2))
+        return
+    written = render_deployment(config, home)
+    click.echo(json.dumps(written, indent=2))
+
+
+@admin_group.command("teardown")
+@click.option("-f", "--file", "config_file", default=None,
+              type=click.Path(exists=True),
+              help="deploy values file (to locate a custom home:)")
+def admin_teardown(config_file):
+    import shutil
+
+    home = get_home()
+    if config_file:
+        import yaml
+
+        with open(config_file) as fh:
+            data = yaml.safe_load(fh) or {}
+        home = data.get("home") or home
+    deploy_dir = os.path.join(home, "deploy")
+    if not os.path.isdir(deploy_dir):
+        click.echo("nothing deployed")
+        return
+    # Remove every artifact deploy recorded — including ones rendered
+    # outside deploy/ (connections.yaml feeds the live catalog).
+    summary_path = os.path.join(deploy_dir, "deploy.json")
+    removed = []
+    if os.path.exists(summary_path):
+        try:
+            with open(summary_path) as fh:
+                artifacts = json.load(fh).get("artifacts") or {}
+            for path in artifacts.values():
+                if os.path.isfile(path) and not path.startswith(deploy_dir):
+                    os.remove(path)
+                    removed.append(path)
+        except (OSError, json.JSONDecodeError):
+            pass
+    shutil.rmtree(deploy_dir)
+    removed.append(deploy_dir)
+    click.echo(json.dumps({"removed": removed}))
+
+
 # ------------------------------------------------------------------- server
 @cli.command("server")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", default=8000)
 @click.option("--with-agent", is_flag=True,
               help="also run the agent reconcile loop in this process")
+@click.option("--max-concurrent", default=4,
+              help="(with --with-agent) max concurrent gangs")
+@click.option("--heartbeat-timeout", default=60.0,
+              help="(with --with-agent) slice-pool heartbeat timeout seconds")
 @click.option("--slice", "slices", multiple=True,
               help="(with --with-agent) register a TPU slice NAME:TOPOLOGY[:spot]")
-def server_cmd(host, port, with_agent, slices):
+def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices):
     """Serve the REST API (control plane + streams) in the foreground."""
     import threading
 
@@ -362,8 +433,10 @@ def server_cmd(host, port, with_agent, slices):
         if slices:
             from polyaxon_tpu.agent import SliceManager
 
-            manager = SliceManager(_parse_slices(slices))
-        agent = Agent(plane, slice_manager=manager)
+            manager = SliceManager(_parse_slices(slices),
+                                   heartbeat_timeout=heartbeat_timeout)
+        agent = Agent(plane, slice_manager=manager,
+                      max_concurrent=max_concurrent)
         threading.Thread(target=agent.serve_forever, daemon=True).start()
     click.echo(f"API serving on {server.url} (home={get_home()})"
                + (" with agent" if with_agent else ""))

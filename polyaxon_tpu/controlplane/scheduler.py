@@ -88,6 +88,16 @@ class Scheduler:
         for record in self.store.list_runs(statuses=[V1Statuses.CREATED]):
             if record.kind == V1RunKind.DAG and record.pipeline_uuid:
                 pass  # nested dags compile like any pipeline
+            verdict = self._events_satisfied(record)
+            if verdict is None:
+                continue  # still waiting on referenced run events
+            if verdict is False:
+                self.store.transition(
+                    record.uuid, V1Statuses.UPSTREAM_FAILED,
+                    reason="EventNeverFires",
+                    message="referenced run finished without the awaited event")
+                actions += 1
+                continue
             try:
                 self.plane.compile_run(record.uuid)
             except Exception as exc:
@@ -115,6 +125,46 @@ class Scheduler:
         for record in self.store.list_runs(statuses=[V1Statuses.PREEMPTED]):
             actions += self._tick_preempted(record)
         return actions
+
+    # -------------------------------------------------------------- events
+    def _events_satisfied(self, record: RunRecord) -> Optional[bool]:
+        """Gate compilation on V1EventTrigger refs.
+
+        True → proceed; None → keep waiting; False → can never fire
+        (referenced run is terminal without any awaited status).
+        Ref grammar: ``runs.<uuid>``; kinds are lifecycle status names
+        (the upstream event vocabulary subset the embedded plane emits).
+        """
+        events = (record.spec or {}).get("events")
+        if not events:
+            return True
+        for event in events:
+            ref = event.get("ref") or ""
+            if not ref.startswith("runs."):
+                self.store.transition(
+                    record.uuid, V1Statuses.FAILED, reason="InvalidEventRef",
+                    message=f"event ref {ref!r} must be `runs.<uuid>`")
+                return None
+            target_uuid = ref[len("runs."):]
+            try:
+                target = self.store.get_run(target_uuid)
+            except Exception:
+                self.store.transition(
+                    record.uuid, V1Statuses.FAILED, reason="InvalidEventRef",
+                    message=f"event ref {ref!r}: run not found")
+                return None
+            kinds = {str(k).split(".")[-1] for k in event.get("kinds") or []}
+            seen = {c["type"] for c in self.store.get_conditions(target_uuid)}
+            if not kinds:  # no kinds = "any terminal event"
+                if target.is_done:
+                    continue
+                return None
+            if kinds & seen:
+                continue
+            if target.is_done:
+                return False
+            return None
+        return True
 
     # ------------------------------------------------------------ preemption
     def _tick_preempted(self, record: RunRecord) -> int:

@@ -134,7 +134,14 @@ class ControlPlane:
             self.store.transition(run_uuid, V1Statuses.COMPILED, reason="PipelineCompiled")
             self.store.transition(run_uuid, V1Statuses.QUEUED)
             return self.store.get_run(run_uuid)
-        trial_params = (record.meta or {}).get("trial_params") or {}
+        trial_params = dict((record.meta or {}).get("trial_params") or {})
+        if op.joins:
+            from polyaxon_tpu.controlplane.joins import resolve_joins
+
+            joined = resolve_joins(
+                self.store, self.streams,
+                [j.to_dict() for j in op.joins], project=record.project)
+            trial_params.update(joined)
         resolved = resolve_operation_context(
             op,
             params=trial_params,

@@ -1,0 +1,4 @@
+from polyaxon_tpu.deploy.schemas import V1DeploymentConfig, check_deployment
+from polyaxon_tpu.deploy.render import render_deployment
+
+__all__ = ["V1DeploymentConfig", "check_deployment", "render_deployment"]

@@ -1,0 +1,3 @@
+from polyaxon_tpu.proxies.gateway import render_nginx_conf
+
+__all__ = ["render_nginx_conf"]

@@ -1,0 +1,131 @@
+"""Deploy schema + rendering + proxies (SURVEY.md §2 "Deploy",
+"Proxies/gateway")."""
+
+import json
+import os
+
+import pytest
+
+from polyaxon_tpu.deploy import check_deployment, render_deployment
+from polyaxon_tpu.proxies import render_nginx_conf
+
+VALUES = {
+    "deploymentType": "local",
+    "api": {"host": "127.0.0.1", "port": 9000},
+    "gateway": {"enabled": True, "port": 9443},
+    "agent": {"enabled": True,
+              "slices": [{"name": "pool0", "topology": "4x4"},
+                         {"name": "spot0", "topology": "2x2",
+                          "preemptible": True}]},
+    "artifactsStore": "store",
+    "connections": [
+        {"name": "store", "kind": "host_path", "schema": {"hostPath": "/mnt/s"}},
+    ],
+}
+
+
+class TestSchema:
+    def test_valid_config(self):
+        config = check_deployment(VALUES)
+        assert config.deployment_type == "local"
+        assert config.agent.slices[1].preemptible
+
+    def test_bad_type_rejected(self):
+        with pytest.raises(ValueError, match="deploymentType"):
+            check_deployment({"deploymentType": "warp"})
+
+    def test_unknown_artifacts_store_rejected(self):
+        bad = dict(VALUES, artifactsStore="ghost")
+        with pytest.raises(ValueError, match="ghost"):
+            check_deployment(bad)
+
+
+class TestRender:
+    def test_renders_all_artifacts(self, tmp_path):
+        config = check_deployment(VALUES)
+        written = render_deployment(config, str(tmp_path))
+        assert set(written) == {"connections", "gateway", "run", "summary"}
+        nginx = open(written["gateway"]).read()
+        assert "listen 9443" in nginx
+        assert "proxy_pass http://127.0.0.1:9000" in nginx
+        assert "proxy_buffering off" in nginx  # SSE location
+        run = open(written["run"]).read()
+        assert "--port 9000" in run
+        assert "--slice pool0:4x4" in run and "--slice spot0:2x2:spot" in run
+        assert os.access(written["run"], os.X_OK)
+        summary = json.load(open(written["summary"]))
+        assert summary["deploymentType"] == "local"
+        # connections.yaml lands where the control plane looks for it
+        assert written["connections"].endswith("connections.yaml")
+
+    def test_ssl_block(self):
+        conf = render_nginx_conf(ssl_cert="/etc/ssl/c.pem", ssl_key="/etc/ssl/k.pem")
+        assert "ssl_certificate /etc/ssl/c.pem" in conf
+        assert "listen 8080 ssl" in conf
+
+
+class TestCli:
+    def test_admin_deploy_dry_run_and_apply(self, tmp_path, monkeypatch):
+        import yaml
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        values_file = tmp_path / "deploy.yaml"
+        values_file.write_text(yaml.safe_dump(VALUES))
+        runner = CliRunner()
+        result = runner.invoke(cli, ["admin", "deploy", "-f", str(values_file),
+                                     "--dry-run"])
+        assert result.exit_code == 0, result.output
+        assert json.loads(result.output)["valid"] is True
+
+        result = runner.invoke(cli, ["admin", "deploy", "-f", str(values_file)])
+        assert result.exit_code == 0, result.output
+        written = json.loads(result.output)
+        assert os.path.exists(written["run"])
+
+        result = runner.invoke(cli, ["admin", "teardown"])
+        assert result.exit_code == 0
+        assert not os.path.exists(os.path.dirname(written["run"]))
+        # connections.yaml (outside deploy/) must be removed too
+        assert not os.path.exists(written["connections"])
+
+    def test_admin_deploy_invalid(self, tmp_path, monkeypatch):
+        import yaml
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        values_file = tmp_path / "deploy.yaml"
+        values_file.write_text(yaml.safe_dump({"deploymentType": "warp"}))
+        runner = CliRunner()
+        result = runner.invoke(cli, ["admin", "deploy", "-f", str(values_file)])
+        assert result.exit_code != 0
+        assert "deploymentType" in result.output
+
+
+    def test_ssl_partial_rejected(self):
+        bad = dict(VALUES)
+        bad["gateway"] = {"enabled": True, "ssl": {"cert": "/c.pem"}}
+        with pytest.raises(ValueError, match="BOTH cert and key"):
+            check_deployment(bad)
+
+    def test_agent_tuning_flags_rendered(self, tmp_path):
+        values = dict(VALUES)
+        values["agent"] = {"enabled": True, "maxConcurrent": 16,
+                           "heartbeatTimeout": 300}
+        config = check_deployment(values)
+        written = render_deployment(config, str(tmp_path))
+        run = open(written["run"]).read()
+        assert "--max-concurrent 16" in run
+        assert "--heartbeat-timeout 300" in run
+
+    def test_env_values_are_shell_quoted(self, tmp_path):
+        values = dict(VALUES)
+        values["environment"] = {"NASTY": "a b; echo pwned"}
+        config = check_deployment(values)
+        written = render_deployment(config, str(tmp_path))
+        run = open(written["run"]).read()
+        assert "export NASTY='a b; echo pwned'" in run

@@ -1,0 +1,144 @@
+"""Joins (query → collected params) + event triggers (run-event gated
+compilation) — SURVEY.md §2 Polyflow IR: joins, events/hooks."""
+
+import pytest
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.controlplane.joins import JoinError, parse_query, resolve_joins
+from polyaxon_tpu.lifecycle import V1Statuses
+
+QUICK = {
+    "kind": "component",
+    "run": {"kind": "job",
+            "container": {"command": ["python", "-c", "print('ok')"]}},
+}
+
+WRITER = {
+    "kind": "component",
+    "inputs": [{"name": "score", "type": "float", "toEnv": "SCORE"}],
+    "run": {"kind": "job", "container": {"command": [
+        "python", "-c",
+        "import os, json\n"
+        "d = os.environ['POLYAXON_RUN_ARTIFACTS_PATH']\n"
+        "json.dump({'score': float(os.environ['SCORE'])},"
+        " open(d+'/outputs.json','w'))\n",
+    ]}},
+}
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+@pytest.fixture()
+def agent(plane):
+    return Agent(plane, max_concurrent=8)
+
+
+class TestQueryParsing:
+    def test_fields(self):
+        assert parse_query("pipeline: abc, status: succeeded") == {
+            "pipeline": "abc", "status": "succeeded"}
+
+    def test_bad_clause(self):
+        with pytest.raises(JoinError, match="field: value"):
+            parse_query("pipeline")
+
+    def test_unknown_field(self):
+        with pytest.raises(JoinError, match="unknown join query field"):
+            parse_query("planet: mars")
+
+
+class TestJoins:
+    def test_collects_outputs_across_runs(self, plane, agent):
+        uuids = []
+        for score in (0.5, 0.25):
+            record = plane.submit(WRITER, params={"score": score}, tags=["trial"])
+            assert agent.run_until_done(record.uuid, timeout=60) == V1Statuses.SUCCEEDED
+            uuids.append(record.uuid)
+
+        joined = resolve_joins(
+            plane.store, plane.streams,
+            [{"query": "status: succeeded, tags: trial", "sort": "created_at",
+              "params": {"scores": {"value": "outputs.score"},
+                         "run_uuids": {"value": "uuid"}}}],
+            project="default")
+        assert joined["scores"] == [0.5, 0.25]
+        assert joined["run_uuids"] == uuids
+
+    def test_join_feeds_downstream_run(self, plane, agent):
+        for score in (1.0, 2.0):
+            record = plane.submit(WRITER, params={"score": score}, tags=["j2"])
+            agent.run_until_done(record.uuid, timeout=60)
+
+        consumer = {
+            "kind": "operation",
+            "joins": [{"query": "status: succeeded, tags: j2",
+                       "params": {"scores": {"value": "outputs.score"}}}],
+            "component": {
+                "inputs": [{"name": "scores", "type": "any", "toEnv": "SCORES"}],
+                "run": {"kind": "job", "container": {"command": [
+                    "python", "-c", "import os; print('got', os.environ['SCORES'])",
+                ]}},
+            },
+        }
+        record = plane.submit(consumer)
+        assert agent.run_until_done(record.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        logs = plane.streams.read_logs(record.uuid, "main-0.log")[0]
+        assert "1.0" in logs and "2.0" in logs
+
+    def test_limit_and_sort_desc(self, plane, agent):
+        for score in (1.0, 2.0, 3.0):
+            record = plane.submit(WRITER, params={"score": score}, tags=["j3"])
+            agent.run_until_done(record.uuid, timeout=60)
+        joined = resolve_joins(
+            plane.store, plane.streams,
+            [{"query": "status: succeeded, tags: j3", "sort": "-created_at",
+              "limit": 2, "params": {"scores": {"value": "outputs.score"}}}],
+            project="default")
+        assert joined["scores"] == [3.0, 2.0]
+
+
+class TestEvents:
+    def test_run_waits_for_event_then_fires(self, plane, agent):
+        slow = plane.submit({
+            "kind": "component",
+            "run": {"kind": "job", "container": {"command": [
+                "python", "-c", "import time; time.sleep(2)"]}},
+        })
+        follower = plane.submit({
+            "kind": "operation",
+            "events": [{"ref": f"runs.{slow.uuid}", "kinds": ["succeeded"]}],
+            "component": QUICK,
+        })
+        agent.reconcile_once()
+        # The follower must not compile while the event hasn't fired.
+        assert plane.get_run(follower.uuid).status == V1Statuses.CREATED
+        assert agent.run_until_done(slow.uuid, timeout=60) == V1Statuses.SUCCEEDED
+        assert agent.run_until_done(follower.uuid, timeout=60) == V1Statuses.SUCCEEDED
+
+    def test_event_that_cannot_fire_upstream_fails(self, plane, agent):
+        failing = plane.submit({
+            "kind": "component",
+            "run": {"kind": "job", "container": {"command": [
+                "python", "-c", "raise SystemExit(1)"]}},
+        })
+        follower = plane.submit({
+            "kind": "operation",
+            "events": [{"ref": f"runs.{failing.uuid}", "kinds": ["succeeded"]}],
+            "component": QUICK,
+        })
+        agent.run_until_done(failing.uuid, timeout=60)
+        status = agent.run_until_done(follower.uuid, timeout=30)
+        assert status == V1Statuses.UPSTREAM_FAILED
+
+    def test_invalid_ref_fails(self, plane, agent):
+        follower = plane.submit({
+            "kind": "operation",
+            "events": [{"ref": "runs.no-such-run", "kinds": ["succeeded"]}],
+            "component": QUICK,
+        })
+        status = agent.run_until_done(follower.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
