@@ -340,6 +340,32 @@ def compile_operation(
     env_base.update(_io_env(op))
 
     if kind == V1RunKind.JAXJOB:
+        # plugins.capture_profile → a jax.profiler trace artifact
+        # (SURVEY §5.1): inject profile steps into the builtin runtime.
+        capture = None
+        for plug in (op.plugins, component.plugins):
+            if plug is not None and plug.capture_profile is not None:
+                capture = plug.capture_profile
+                break
+        if capture is not None and capture is not False:
+            if run.runtime is None:
+                raise CompilerError(
+                    "captureProfile needs the builtin jaxjob runtime; a "
+                    "user container must call jax.profiler itself")
+            if not run.runtime.get("profile_steps"):
+                steps = capture.get("steps") if isinstance(capture, dict) else None
+                if steps is None:
+                    steps = [3]
+                elif isinstance(steps, int):
+                    steps = [steps]
+                elif not (isinstance(steps, list)
+                          and all(isinstance(s, int) for s in steps)):
+                    raise CompilerError(
+                        f"captureProfile.steps must be an int or list of "
+                        f"ints, got {steps!r}")
+                run = run.clone()
+                run.runtime = dict(run.runtime)
+                run.runtime["profile_steps"] = steps
         resources, processes = _compile_jaxjob(run, plan_args, env_base)
     elif kind in (V1RunKind.TFJOB, V1RunKind.PYTORCHJOB, V1RunKind.MPIJOB):
         resources, processes = _compile_kubeflow(run, kind, plan_args, env_base)

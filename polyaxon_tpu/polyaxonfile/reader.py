@@ -239,4 +239,12 @@ def resolve_operation_context(
     if extra_context:
         context.update(extra_context)
     rendered = render_value(op.to_dict(), context)
+    # Apply the operation's runPatch onto the component run — this is
+    # where preset fragments (e.g. the gpu→tpu environment swap, which
+    # apply_presets records as run_patch) take effect.
+    patch = rendered.pop("runPatch", None)
+    if patch:
+        strategy = rendered.get("patchStrategy")
+        run = rendered["component"].get("run") or {}
+        rendered["component"]["run"] = patch_dict(run, patch, strategy)
     return get_operation(rendered)

@@ -1,0 +1,132 @@
+"""North-star acceptance (BASELINE.md / SURVEY.md §6): a
+Polyaxonfile-driven Llama pretrain with the tpu preset swap, plus a
+Hyperband sweep whose trials are real JAXJobs — end-to-end through the
+control plane, scheduler, agent, tracking, and runtime, no GPU anywhere.
+Scaled to the test environment (tiny model, 8-device virtual CPU mesh)."""
+
+import textwrap
+
+import pytest
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+
+LLAMA_PRETRAIN = textwrap.dedent(
+    """
+    version: 1.1
+    kind: operation
+    name: llama-pretrain
+    params:
+      lr: {value: 0.001}
+    component:
+      name: llama
+      inputs:
+        - name: lr
+          type: float
+      run:
+        kind: jaxjob
+        numProcesses: 1
+        mesh:
+          axes: {dp: 2, fsdp: 4}
+        checkpointing:
+          enabled: true
+          intervalSteps: 2
+        runtime:
+          model: llama_tiny
+          dataset: lm_synthetic
+          steps: 4
+          seq_len: 128
+          global_batch_size: 8
+          learning_rate: "{{ params.lr }}"
+    """
+)
+
+HYPERBAND_SWEEP = {
+    "kind": "operation",
+    "name": "lr-sweep",
+    "matrix": {
+        "kind": "hyperband",
+        "maxIterations": 4,
+        "eta": 2,
+        "resource": {"name": "steps", "type": "int"},
+        "metric": {"name": "loss", "optimization": "minimize"},
+        "resume": False,
+        "seed": 7,
+        # loguniform takes natural-log bounds: lr in [exp(-9.2), exp(-2.3)]
+        # ≈ [1e-4, 1e-1].
+        "params": {"lr": {"kind": "loguniform", "value": {"low": -9.2, "high": -2.3}}},
+    },
+    "component": {
+        "inputs": [
+            {"name": "lr", "type": "float"},
+            {"name": "steps", "type": "int", "value": 2, "isOptional": True},
+        ],
+        "run": {
+            "kind": "jaxjob",
+            "mesh": {"axes": {"dp": 8}},
+            "runtime": {
+                "model": "llama_tiny",
+                "dataset": "lm_synthetic",
+                "steps": "{{ params.steps }}",
+                "seq_len": 64,
+                "global_batch_size": 8,
+                "learning_rate": "{{ params.lr }}",
+            },
+        },
+    },
+}
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+class TestNorthStar:
+    def test_llama_pretrain_with_tpu_preset(self, plane, tmp_path):
+        """The [B] bar: an existing Polyaxonfile runs unchanged after
+        swapping the environment preset from gpu to tpu."""
+        path = tmp_path / "llama.yaml"
+        path.write_text(LLAMA_PRETRAIN)
+        record = plane.submit(
+            str(path), presets=["tests/fixtures/presets/tpu.yaml"])
+        # The preset lands as a runPatch on the operation...
+        tpu = record.spec["runPatch"]["environment"]["tpu"]
+        assert tpu["accelerator"] == "v5e" and tpu["preemptible"] is True
+
+        agent = Agent(plane, in_process=True)
+        status = agent.run_until_done(record.uuid, timeout=300)
+        assert status == V1Statuses.SUCCEEDED
+
+        # ...and is applied onto the resolved run at compile time.
+        resolved = plane.get_run(record.uuid).resolved_spec
+        resolved_tpu = resolved["component"]["run"]["environment"]["tpu"]
+        assert resolved_tpu["accelerator"] == "v5e"
+
+        # Tracking contract: metrics flowed, checkpoint written.
+        metrics = plane.streams.get_metrics(record.uuid, ["loss"])
+        assert metrics["loss"], "no loss events tracked"
+        outputs = plane.streams.get_outputs(record.uuid)
+        assert outputs["steps"] == 4
+        arts = plane.streams.list_artifacts(record.uuid)
+        assert any("checkpoints" in a for a in arts)
+
+    def test_hyperband_sweep_of_jaxjobs(self, plane):
+        """Polytune Hyperband where every trial is a real JAXJob."""
+        record = plane.submit(HYPERBAND_SWEEP)
+        agent = Agent(plane, max_concurrent=2, in_process=True)
+        status = agent.run_until_done(record.uuid, timeout=600)
+        assert status == V1Statuses.SUCCEEDED
+        trials = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(trials) >= 3  # first rung + ≥1 promotion
+        assert any(t.status == V1Statuses.SUCCEEDED for t in trials)
+        # Promoted trials trained with more steps (the hyperband resource).
+        rungs = {(t.meta or {}).get("rung", 0) for t in trials}
+        assert max(rungs) >= 1
+        steps_by_rung = {}
+        for t in trials:
+            rung = (t.meta or {}).get("rung", 0)
+            steps_by_rung.setdefault(rung, set()).add(
+                t.meta["trial_params"]["steps"])
+        assert min(steps_by_rung[max(rungs)]) > min(steps_by_rung[0])

@@ -109,3 +109,58 @@ class TestIOEnv:
                     "run": {"kind": "dag", "operations": []},
                 }
             )
+
+
+class TestCaptureProfile:
+    """plugins.captureProfile → profile_steps in the jaxjob runtime."""
+
+    def _plan(self, capture, runtime={"model": "llama_tiny"}):
+        from polyaxon_tpu.compiler import compile_operation
+        from polyaxon_tpu.polyaxonfile import get_operation
+
+        run = {"kind": "jaxjob"}
+        if runtime is not None:
+            run["runtime"] = dict(runtime)
+        else:
+            run["container"] = {"command": ["python", "train.py"]}
+        op = get_operation({
+            "kind": "operation",
+            "plugins": {"captureProfile": capture},
+            "component": {"run": run},
+        })
+        return compile_operation(op, run_uuid="u1", artifacts_root="/tmp/x")
+
+    def _spec_steps(self, plan):
+        import json
+
+        from polyaxon_tpu.compiler.compile import ENV_JAXJOB_SPEC
+
+        spec = json.loads(plan.processes[0].env[ENV_JAXJOB_SPEC])
+        return spec["runtime"].get("profileSteps") or spec["runtime"].get(
+            "profile_steps")
+
+    def test_bool_and_empty_dict_enable_defaults(self):
+        assert self._spec_steps(self._plan(True)) == [3]
+        assert self._spec_steps(self._plan({})) == [3]
+
+    def test_scalar_step_normalized(self):
+        assert self._spec_steps(self._plan({"steps": 7})) == [7]
+
+    def test_bad_steps_fail_compile(self):
+        import pytest
+
+        from polyaxon_tpu.compiler import CompilerError
+
+        with pytest.raises(CompilerError, match="steps"):
+            self._plan({"steps": "everything"})
+
+    def test_container_jaxjob_rejected(self):
+        import pytest
+
+        from polyaxon_tpu.compiler import CompilerError
+
+        with pytest.raises(CompilerError, match="builtin jaxjob runtime"):
+            self._plan(True, runtime=None)
+
+    def test_false_disables(self):
+        assert self._spec_steps(self._plan(False)) is None
