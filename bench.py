@@ -25,6 +25,91 @@ import os
 import sys
 
 
+def tuner_bench(smoke: bool = False) -> int:
+    """Polytune trials/hour: a Hyperband LR sweep whose trials are real
+    JAXJobs driven by the embedded plane + agent (the BASELINE "trials/
+    hour on preemptible slices" metric, measured on this host's chip)."""
+    import tempfile
+    import time
+
+    from polyaxon_tpu.agent import Agent
+    from polyaxon_tpu.controlplane import ControlPlane
+    from polyaxon_tpu.lifecycle import V1Statuses
+
+    steps_base = 2 if smoke else 10
+    sweep = {
+        "kind": "operation",
+        "name": "bench-sweep",
+        "matrix": {
+            "kind": "hyperband",
+            "maxIterations": 4,
+            "eta": 2,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "resume": False,
+            "seed": 11,
+            "params": {"lr": {"kind": "loguniform",
+                               "value": {"low": -9.2, "high": -2.3}}},
+        },
+        "component": {
+            "inputs": [
+                {"name": "lr", "type": "float"},
+                {"name": "steps", "type": "int", "value": steps_base,
+                 "isOptional": True},
+            ],
+            "run": {
+                "kind": "jaxjob",
+                "runtime": {
+                    "model": "llama_tiny", "dataset": "lm_synthetic",
+                    "steps": "{{ params.steps }}",
+                    "seq_len": 64 if smoke else 512,
+                    "global_batch_size": 8,
+                    "learning_rate": "{{ params.lr }}",
+                    "log_every": 10**9,
+                },
+            },
+        },
+    }
+    with tempfile.TemporaryDirectory() as home:
+        plane = ControlPlane(home)
+        agent = Agent(plane, max_concurrent=1, in_process=True)
+        record = plane.submit(sweep)
+        t0 = time.perf_counter()
+        status = agent.run_until_done(record.uuid, timeout=3600)
+        wall = time.perf_counter() - t0
+        trials = plane.list_runs(pipeline_uuid=record.uuid)
+        done = [t for t in trials if t.status == V1Statuses.SUCCEEDED]
+    trials_per_hour = len(done) / wall * 3600 if wall > 0 else 0.0
+
+    # Regression tracking, same contract as the throughput metric:
+    # first non-smoke run records the baseline, later runs compare.
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    vs_baseline = 1.0
+    try:
+        prior = {}
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as fh:
+                prior = json.load(fh)
+        prior_rate = prior.get("tuner_trials_per_hour")
+        if prior_rate:
+            vs_baseline = trials_per_hour / prior_rate
+        elif not smoke:
+            prior["tuner_trials_per_hour"] = trials_per_hour
+            with open(baseline_path, "w") as fh:
+                json.dump(prior, fh, indent=2)
+    except (OSError, json.JSONDecodeError):
+        pass
+
+    print(json.dumps({
+        "metric": "polytune_hyperband_trials_per_hour[llama_tiny]",
+        "value": round(trials_per_hour, 1),
+        "unit": "trials/hour",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+    return 0 if status == V1Statuses.SUCCEEDED else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny fast run (CI)")
@@ -36,7 +121,14 @@ def main() -> int:
                         choices=["xla", "flash"],
                         help="attention impl; flash (Pallas) pays off at "
                              "long seq on real chips, xla is the safe default")
+    parser.add_argument("--tuner", action="store_true",
+                        help="measure Polytune throughput instead: a "
+                             "Hyperband LR sweep of JAXJob trials, "
+                             "reported as trials/hour (BASELINE metric 2)")
     args = parser.parse_args()
+
+    if args.tuner:
+        return tuner_bench(smoke=args.smoke)
 
     import jax
 

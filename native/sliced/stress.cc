@@ -1,0 +1,70 @@
+// Threaded stress over the C ABI — built under -fsanitize=thread in CI
+// (SURVEY.md §5.2: the reference's only race detection is `go test
+// -race` on its operator; this is the equivalent for the C++ daemon).
+// Several threads hammer one pool handle concurrently: placements,
+// heartbeats, ticks, preemptions, releases. Exit 0 = no crash; TSan
+// reports any data race on stderr (non-zero exit under halt_on_error).
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* sliced_new();
+void sliced_free(void*);
+int sliced_add_slice(void*, const char*, const char*, int);
+long long sliced_request_gang(void*, const char*, const char*, int, int);
+int sliced_release_gang(void*, long long);
+int sliced_heartbeat(void*, long long, int, double);
+int sliced_preempt_slice(void*, const char*);
+int sliced_tick(void*, double, double, char*, int);
+int sliced_gang_info(void*, long long, char*, int);
+}
+
+int main() {
+  void* pool = sliced_new();
+  sliced_add_slice(pool, "a", "8x8", 1);
+  sliced_add_slice(pool, "b", "4x4", 0);
+
+  std::atomic<bool> stop{false};
+  std::atomic<long long> last_gang{0};
+  std::vector<std::thread> threads;
+
+  // Requesters + releasers.
+  for (int t = 0; t < 3; ++t) {
+    threads.emplace_back([&, t] {
+      char name[32];
+      for (int i = 0; i < 500; ++i) {
+        std::snprintf(name, sizeof(name), "run-%d-%d", t, i);
+        long long id = sliced_request_gang(pool, name, "2x2", i % 3, 1);
+        if (id > 0) {
+          last_gang.store(id);
+          sliced_heartbeat(pool, id, 0, i * 1.0);
+          if (i % 2) sliced_release_gang(pool, id);
+        }
+      }
+    });
+  }
+  // Reconciler.
+  threads.emplace_back([&] {
+    char buf[1 << 16];
+    for (int i = 0; i < 2000 && !stop.load(); ++i)
+      sliced_tick(pool, i * 0.5, 30.0, buf, sizeof(buf));
+  });
+  // Preemptor + reader.
+  threads.emplace_back([&] {
+    char buf[4096];
+    for (int i = 0; i < 500; ++i) {
+      sliced_preempt_slice(pool, "a");
+      long long id = last_gang.load();
+      if (id > 0) sliced_gang_info(pool, id, buf, sizeof(buf));
+    }
+  });
+
+  for (auto& thread : threads) thread.join();
+  stop.store(true);
+  sliced_free(pool);
+  std::puts("stress ok");
+  return 0;
+}

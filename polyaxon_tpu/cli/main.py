@@ -471,6 +471,14 @@ def agent_cmd(poll, max_concurrent, slices):
 
 
 # ------------------------------------------------------------------- models
+@cli.command("version")
+def version_cmd():
+    """Print client/library version."""
+    from polyaxon_tpu import __version__
+
+    click.echo(json.dumps({"version": __version__}))
+
+
 @cli.command("models")
 def models_cmd():
     """List builtin model zoo entries."""

@@ -355,7 +355,11 @@ def compile_operation(
             if not run.runtime.get("profile_steps"):
                 steps = capture.get("steps") if isinstance(capture, dict) else None
                 if steps is None:
-                    steps = [3]
+                    # Default profile step, clamped into short jobs so a
+                    # 2-step run still produces a trace artifact.
+                    total = run.runtime.get("steps")
+                    steps = [min(3, total - 1) if isinstance(total, int)
+                             and total > 1 else 3]
                 elif isinstance(steps, int):
                     steps = [steps]
                 elif not (isinstance(steps, list)

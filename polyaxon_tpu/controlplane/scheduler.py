@@ -25,6 +25,7 @@ from polyaxon_tpu.polyflow.matrix import (
     V1Bayes,
     V1GridSearch,
     V1Hyperband,
+    V1Iterative,
     V1Mapping,
     V1RandomSearch,
 )
@@ -34,9 +35,11 @@ from polyaxon_tpu.tune import (
     BayesManager,
     GridSearchManager,
     HyperbandManager,
+    IterativeManager,
     MappingManager,
     Observation,
     RandomSearchManager,
+    check_early_stopping,
 )
 
 logger = logging.getLogger(__name__)
@@ -430,17 +433,86 @@ class Scheduler:
                                   reason="TunerRunning", force=True)
             actions += 1
 
+        early = self._tick_early_stop(record, matrix, meta, children)
+        if early is not None:
+            return actions + early
+
         if isinstance(matrix, (V1GridSearch, V1RandomSearch, V1Mapping)):
             actions += self._tick_oneshot(record, op, matrix, tuner, meta, children)
         elif isinstance(matrix, V1Hyperband):
             actions += self._tick_hyperband(record, op, matrix, tuner, meta, children)
         elif isinstance(matrix, V1Bayes):
             actions += self._tick_bayes(record, op, matrix, tuner, meta, children)
+        elif isinstance(matrix, V1Iterative):
+            actions += self._tick_iterative(record, op, matrix, tuner, meta, children)
         else:
             self.store.transition(record.uuid, V1Statuses.FAILED,
                                   reason="UnsupportedMatrix",
                                   message=f"{type(matrix).__name__}")
             actions += 1
+        return actions
+
+    def _tick_early_stop(self, record: RunRecord, matrix, meta: dict,
+                         children: list[RunRecord]) -> Optional[int]:
+        """Early-stopping policies: once triggered, stop in-flight trials
+        and finish the sweep when they drain. Returns None when the sweep
+        should keep ticking normally."""
+        state = meta.get("early_stopped")
+        if state is None:
+            action = check_early_stopping(
+                getattr(matrix, "early_stopping", None),
+                lambda name: self._observations(record, name, children),
+            )
+            if action is None:
+                return None
+            meta["early_stopped"] = state = action
+            self.store.update_run(record.uuid, meta=meta)
+            for child in children:
+                if not child.is_done:
+                    self.plane.stop(child.uuid)
+        # Drain phase: wait for every child, then finish.
+        if not all(c.is_done for c in children):
+            return 0
+        if state == "fail":
+            self.store.transition(record.uuid, V1Statuses.FAILED,
+                                  reason="FailureEarlyStopping")
+        else:
+            self.store.transition(record.uuid, V1Statuses.SUCCEEDED,
+                                  reason="MetricEarlyStopping",
+                                  message="target metric reached")
+        return 1
+
+    def _tick_iterative(self, record, op, matrix: V1Iterative, tuner, meta,
+                        children) -> int:
+        """Sequential suggest→run→observe loop (one trial per iteration,
+        up to `concurrency` in flight)."""
+        if matrix.tuner:
+            # Upstream runs custom tuners as services; the embedded plane
+            # only ships the builtin policy — fail loudly, never silently
+            # substitute random search for the user's strategy.
+            self.store.transition(
+                record.uuid, V1Statuses.FAILED, reason="UnsupportedTuner",
+                message="custom `tuner` services are not supported by the "
+                        "embedded plane; omit `tuner` for builtin iteration")
+            return 1
+        manager = IterativeManager(matrix)
+        tuner = tuner or {"spawned": 0}
+        active = [c for c in children if not c.is_done]
+        actions = 0
+        if tuner["spawned"] >= matrix.max_iterations:
+            return self._finish_if_done(record, children, matrix.max_iterations)
+        concurrency = matrix.concurrency or 1
+        while (tuner["spawned"] < matrix.max_iterations
+               and len(active) < concurrency):
+            params = manager.get_suggestion(tuner["spawned"])
+            child = self._spawn_trial(record, op, params, tuner["spawned"],
+                                      iteration=tuner["spawned"])
+            active.append(child)
+            tuner["spawned"] += 1
+            actions += 1
+        if actions:
+            meta["tuner"] = tuner
+            self.store.update_run(record.uuid, meta=meta)
         return actions
 
     def _finish_if_done(self, record: RunRecord, children: list[RunRecord],

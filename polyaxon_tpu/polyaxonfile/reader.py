@@ -244,6 +244,10 @@ def resolve_operation_context(
     # apply_presets records as run_patch) take effect.
     patch = rendered.pop("runPatch", None)
     if patch:
+        if not rendered.get("component"):
+            raise PolyaxonfileError(
+                "runPatch/presets need a resolved inline component "
+                "(pathRef/urlRef operations must be inlined first)")
         strategy = rendered.get("patchStrategy")
         run = rendered["component"].get("run") or {}
         rendered["component"]["run"] = patch_dict(run, patch, strategy)

@@ -1,8 +1,10 @@
 from polyaxon_tpu.tune.base import (
     GridSearchManager,
+    IterativeManager,
     MappingManager,
     Observation,
     RandomSearchManager,
+    check_early_stopping,
     top_k,
 )
 from polyaxon_tpu.tune.bayes import BayesManager, GaussianProcess, acquisition
@@ -13,10 +15,12 @@ __all__ = [
     "GaussianProcess",
     "GridSearchManager",
     "HyperbandManager",
+    "IterativeManager",
     "MappingManager",
     "Observation",
     "RandomSearchManager",
     "Rung",
     "acquisition",
+    "check_early_stopping",
     "top_k",
 ]

@@ -1,0 +1,16 @@
+#!/bin/sh
+# Full CI sweep: Python suites (8-device virtual CPU mesh), native
+# sanitizers, and the bench smoke contract.
+set -e
+cd "$(dirname "$0")/.."
+echo "== pytest"
+python -m pytest tests/ -q
+echo "== native ASan/UBSan"
+make -C native sanitize
+printf 'ADD a 4x4 0\nREQ r 2x2 0 0\nTICK 0 30\nQUIT\n' | ./native/build/sliced_san >/dev/null
+echo "== native TSan stress"
+make -C native tsan
+TSAN_OPTIONS=halt_on_error=1 ./native/build/sliced_tsan
+echo "== bench smoke"
+python bench.py --smoke
+echo "CI OK"

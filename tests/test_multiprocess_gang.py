@@ -1,0 +1,45 @@
+"""Real multi-process gang execution: the agent spawns N processes from
+the compiled launch plan, each bootstraps `jax.distributed` from the env
+contract (SURVEY.md §2c rendezvous), and they train one model together
+over the collective fabric (Gloo on CPU here, ICI/DCN on TPU fleets) —
+the path upstream never executes in its own tests (SURVEY.md §4
+"Multi-node without a cluster")."""
+
+import pytest
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+class TestMultiProcessGang:
+    def test_two_process_jaxjob_trains_together(self, plane, monkeypatch):
+        # Gang subprocesses must not inherit the 8-device host flag the
+        # test process uses: each rank contributes its own device(s).
+        monkeypatch.setenv("XLA_FLAGS", "")
+        record = plane.submit({
+            "kind": "component",
+            "name": "gang2",
+            "run": {
+                "kind": "jaxjob",
+                "numProcesses": 2,
+                "runtime": {"model": "llama_tiny", "dataset": "lm_synthetic",
+                            "steps": 3, "seq_len": 64,
+                            "global_batch_size": 4, "log_every": 1},
+            },
+        })
+        agent = Agent(plane)  # subprocess path (in_process only fits 1-proc)
+        status = agent.run_until_done(record.uuid, timeout=420)
+        assert status == V1Statuses.SUCCEEDED
+        # Both ranks produced logs; rank 0 owned tracking.
+        logs = plane.streams.log_files(record.uuid)
+        assert {"main-0.log", "main-1.log"} <= set(logs)
+        outputs = plane.streams.get_outputs(record.uuid)
+        assert outputs["steps"] == 3
+        metrics = plane.streams.get_metrics(record.uuid, ["loss"])
+        assert metrics["loss"]

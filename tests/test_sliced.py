@@ -287,3 +287,19 @@ class TestReviewFixes:
         evicted = [g for g in lows + more
                    if pool.gang(g).state == "preempted"]
         assert len(evicted) == 1
+
+
+class TestRaceDetection:
+    def test_tsan_stress_is_clean(self, built):
+        """SURVEY.md §5.2: the daemon's `go test -race` equivalent."""
+        import os
+
+        native_dir = os.path.dirname(os.path.dirname(built))
+        subprocess.run(["make", "-C", native_dir, "tsan"], check=True,
+                       capture_output=True)
+        result = subprocess.run(
+            [os.path.join(native_dir, "build", "sliced_tsan")],
+            env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"},
+            capture_output=True, text=True, timeout=120)
+        assert result.returncode == 0, result.stderr[-2000:]
+        assert "stress ok" in result.stdout

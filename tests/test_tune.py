@@ -194,3 +194,131 @@ class TestBayes:
         preempted = obs[:13] + [Observation(params={"x": 0.3}, metric=None,
                                             status="preempted")]
         assert not mgr.is_done(preempted)
+
+
+from tests.test_controlplane import TRIAL_COMPONENT  # noqa: E402
+
+
+class TestIterativeAndEarlyStopping:
+    """V1Iterative execution + early-stopping policies (scheduler-side)."""
+
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        return ControlPlane(str(tmp_path / "home"))
+
+    @pytest.fixture()
+    def agent(self, plane):
+        from polyaxon_tpu.agent import Agent
+
+        return Agent(plane, max_concurrent=8)
+
+    def test_iterative_runs_sequentially(self, plane, agent):
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        record = plane.submit({
+            "kind": "operation",
+            "matrix": {
+                "kind": "iterative",
+                "maxIterations": 3,
+                "seed": 3,
+                "params": {"lr": {"kind": "uniform",
+                                   "value": {"low": 0.0, "high": 1.0}}},
+            },
+            "component": TRIAL_COMPONENT,
+        })
+        status = agent.run_until_done(record.uuid, timeout=120)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) == 3
+        # Sequential: each child created only after the previous finished.
+        ordered = sorted(children, key=lambda c: c.created_at)
+        for first, second in zip(ordered, ordered[1:]):
+            assert first.finished_at <= second.created_at
+        lrs = {c.meta["trial_params"]["lr"] for c in children}
+        assert len(lrs) == 3  # per-iteration seeds differ
+
+    def test_metric_early_stopping_succeeds_sweep(self, plane, agent):
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        record = plane.submit({
+            "kind": "operation",
+            "matrix": {
+                "kind": "grid",
+                "concurrency": 1,
+                "earlyStopping": [{"kind": "metric_early_stopping",
+                                    "metric": "score", "value": 0.05}],
+                "params": {"lr": {"kind": "choice",
+                                   "value": [0.3, 0.9, 0.8, 0.7]}},
+            },
+            "component": TRIAL_COMPONENT,
+        })
+        status = agent.run_until_done(record.uuid, timeout=120)
+        assert status == V1Statuses.SUCCEEDED
+        conditions = [c["reason"] for c in plane.get_statuses(record.uuid)]
+        assert "MetricEarlyStopping" in conditions
+        # lr=0.3 hits score 0 on the FIRST trial: the rest never ran.
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) < 4
+
+    def test_failure_early_stopping_fails_sweep(self, plane, agent):
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        bad_component = {
+            "kind": "component",
+            "inputs": [{"name": "lr", "type": "float", "toEnv": "LR"}],
+            "run": {"kind": "job", "container": {
+                "command": ["python", "-c", "raise SystemExit(1)"]}},
+        }
+        record = plane.submit({
+            "kind": "operation",
+            "matrix": {
+                "kind": "grid",
+                "concurrency": 1,
+                "earlyStopping": [{"kind": "failure_early_stopping",
+                                    "percent": 50}],
+                "params": {"lr": {"kind": "choice",
+                                   "value": [0.1, 0.2, 0.3, 0.4]}},
+            },
+            "component": bad_component,
+        })
+        status = agent.run_until_done(record.uuid, timeout=120)
+        assert status == V1Statuses.FAILED
+        conditions = [c["reason"] for c in plane.get_statuses(record.uuid)]
+        assert "FailureEarlyStopping" in conditions
+        assert len(plane.list_runs(pipeline_uuid=record.uuid)) < 4
+
+    def test_custom_tuner_rejected(self, plane, agent):
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        record = plane.submit({
+            "kind": "operation",
+            "matrix": {
+                "kind": "iterative",
+                "maxIterations": 2,
+                "tuner": {"hubRef": "my-tuner"},
+                "params": {"lr": {"kind": "uniform",
+                                   "value": {"low": 0.0, "high": 1.0}}},
+            },
+            "component": TRIAL_COMPONENT,
+        })
+        status = agent.run_until_done(record.uuid, timeout=30)
+        assert status == V1Statuses.FAILED
+        conditions = [c["reason"] for c in plane.get_statuses(record.uuid)]
+        assert "UnsupportedTuner" in conditions
+
+    def test_unseeded_iterative_varies(self):
+        import dataclasses
+
+        from polyaxon_tpu.polyflow.matrix import V1Iterative
+        from polyaxon_tpu.tune import IterativeManager
+
+        matrix = V1Iterative.from_dict({
+            "kind": "iterative", "maxIterations": 2,
+            "params": {"lr": {"kind": "uniform",
+                               "value": {"low": 0.0, "high": 1.0}}},
+        })
+        a = IterativeManager(matrix).get_suggestion(0)
+        b = IterativeManager(matrix).get_suggestion(0)
+        assert a != b  # OS entropy, not a fixed seed-0 stream
