@@ -91,12 +91,13 @@ def tuner_bench(smoke: bool = False) -> int:
         if os.path.exists(baseline_path):
             with open(baseline_path) as fh:
                 prior = json.load(fh)
-        prior_rate = prior.get("tuner_trials_per_hour")
-        if prior_rate:
-            vs_baseline = trials_per_hour / prior_rate
-        elif not smoke:
-            prior["tuner_trials_per_hour"] = trials_per_hour
-            with open(baseline_path, "w") as fh:
+        record = prior.get("tuner")
+        # Compare only like-for-like configs (smoke ≠ full sweep).
+        if record and record.get("smoke") == smoke and record.get("rate"):
+            vs_baseline = trials_per_hour / record["rate"]
+        elif not smoke and not record:
+            prior["tuner"] = {"rate": trials_per_hour, "smoke": smoke}
+            with open(baseline_path, "w") as fh:  # merge, never clobber
                 json.dump(prior, fh, indent=2)
     except (OSError, json.JSONDecodeError):
         pass
@@ -175,16 +176,18 @@ def main() -> int:
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
     try:
+        prior = {}
         if os.path.exists(baseline_path):
             with open(baseline_path) as fh:
                 prior = json.load(fh)
-            prior_tps = prior.get("tokens_per_sec_per_chip")
-            if prior_tps and prior.get("model") == model and prior.get("seq") == seq:
-                vs_baseline = tokens_per_sec_per_chip / prior_tps
-        elif not args.smoke:
+        prior_tps = prior.get("tokens_per_sec_per_chip")
+        if prior_tps and prior.get("model") == model and prior.get("seq") == seq:
+            vs_baseline = tokens_per_sec_per_chip / prior_tps
+        elif not args.smoke and not prior_tps:
+            prior.update(record)  # merge: keep e.g. the tuner baseline
             with open(baseline_path, "w") as fh:
-                json.dump(record, fh, indent=2)
-    except OSError:
+                json.dump(prior, fh, indent=2)
+    except (OSError, json.JSONDecodeError):
         pass
 
     print(json.dumps({

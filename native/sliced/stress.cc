@@ -27,7 +27,6 @@ int main() {
   sliced_add_slice(pool, "a", "8x8", 1);
   sliced_add_slice(pool, "b", "4x4", 0);
 
-  std::atomic<bool> stop{false};
   std::atomic<long long> last_gang{0};
   std::vector<std::thread> threads;
 
@@ -49,7 +48,7 @@ int main() {
   // Reconciler.
   threads.emplace_back([&] {
     char buf[1 << 16];
-    for (int i = 0; i < 2000 && !stop.load(); ++i)
+    for (int i = 0; i < 2000; ++i)
       sliced_tick(pool, i * 0.5, 30.0, buf, sizeof(buf));
   });
   // Preemptor + reader.
@@ -63,7 +62,6 @@ int main() {
   });
 
   for (auto& thread : threads) thread.join();
-  stop.store(true);
   sliced_free(pool);
   std::puts("stress ok");
   return 0;

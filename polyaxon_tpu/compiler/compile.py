@@ -201,6 +201,13 @@ def _kf_env(kind: str, replica: str, idx: int, global_idx: int, topology: dict) 
             "OMPI_COMM_WORLD_SIZE": str(sum(topology.values())),
             "OMPI_COMM_WORLD_RANK": str(global_idx),
         }
+    if kind == V1RunKind.RAYJOB:
+        head = "head" if "head" in topology else next(iter(topology))
+        return {"RAY_ADDRESS": f"{head}-0.gang:6379",
+                "RAY_NODE_RANK": str(global_idx)}
+    if kind == V1RunKind.DASKJOB:
+        sched = "scheduler" if "scheduler" in topology else next(iter(topology))
+        return {"DASK_SCHEDULER_ADDRESS": f"tcp://{sched}-0.gang:8786"}
     return {}
 
 
@@ -371,7 +378,8 @@ def compile_operation(
                 run.runtime = dict(run.runtime)
                 run.runtime["profile_steps"] = steps
         resources, processes = _compile_jaxjob(run, plan_args, env_base)
-    elif kind in (V1RunKind.TFJOB, V1RunKind.PYTORCHJOB, V1RunKind.MPIJOB):
+    elif kind in (V1RunKind.TFJOB, V1RunKind.PYTORCHJOB, V1RunKind.MPIJOB,
+                  V1RunKind.RAYJOB, V1RunKind.DASKJOB):
         resources, processes = _compile_kubeflow(run, kind, plan_args, env_base)
     elif kind == V1RunKind.JOB or kind == V1RunKind.NOTIFIER or kind == V1RunKind.CLEANER:
         resources, processes = _compile_job(run, plan_args, env_base)

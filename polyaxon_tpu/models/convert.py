@@ -1,0 +1,105 @@
+"""Checkpoint interop: HuggingFace Llama ↔ the native param tree.
+
+Lets reference users bring their existing weights: an HF
+``LlamaForCausalLM`` state dict (torch CPU tensors or numpy arrays)
+maps onto the stacked-layer pytree ``models/llama.py`` trains, and
+back. Both sides use the rotate-half RoPE convention, so projections
+transfer by transpose alone — no head permutation.
+
+Layout mapping (HF → ours):
+    model.embed_tokens.weight        [V, D]   → embed            [V, D]
+    ...self_attn.{q,k,v}_proj.weight [O, D]   → w{q,k,v}         [D, O]
+    ...self_attn.o_proj.weight       [D, HHd] → wo               [HHd, D]
+    ...mlp.{gate,up}_proj.weight     [F, D]   → w_{gate,up}      [D, F]
+    ...mlp.down_proj.weight          [D, F]   → w_down           [F, D]
+    ...input_layernorm.weight        [D]      → attn_norm
+    ...post_attention_layernorm      [D]      → mlp_norm
+    model.norm.weight                [D]      → final_norm
+    lm_head.weight                   [V, D]   → lm_head          [D, V]
+
+Per-layer weights stack along a leading ``layers`` dim (the lax.scan
+layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from polyaxon_tpu.models.llama import LlamaConfig
+
+
+def _to_numpy(value: Any) -> np.ndarray:
+    if hasattr(value, "detach"):  # torch tensor
+        value = value.detach().cpu().numpy()
+    return np.asarray(value, dtype=np.float32)
+
+
+def from_hf_llama(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> dict:
+    """HF LlamaForCausalLM state dict → ``{"params": ..., "state": {}}``."""
+    sd = {k: _to_numpy(v) for k, v in state_dict.items()}
+    L = cfg.n_layers
+
+    def layer_stack(template: str, transpose: bool) -> jnp.ndarray:
+        mats = []
+        for i in range(L):
+            key = template.format(i=i)
+            if key not in sd:
+                raise KeyError(f"HF state dict missing `{key}`")
+            mat = sd[key]
+            mats.append(mat.T if transpose else mat)
+        return jnp.asarray(np.stack(mats))
+
+    params = {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "layers": {
+            "attn_norm": layer_stack(
+                "model.layers.{i}.input_layernorm.weight", False),
+            "wq": layer_stack("model.layers.{i}.self_attn.q_proj.weight", True),
+            "wk": layer_stack("model.layers.{i}.self_attn.k_proj.weight", True),
+            "wv": layer_stack("model.layers.{i}.self_attn.v_proj.weight", True),
+            "wo": layer_stack("model.layers.{i}.self_attn.o_proj.weight", True),
+            "mlp_norm": layer_stack(
+                "model.layers.{i}.post_attention_layernorm.weight", False),
+            "w_gate": layer_stack("model.layers.{i}.mlp.gate_proj.weight", True),
+            "w_up": layer_stack("model.layers.{i}.mlp.up_proj.weight", True),
+            "w_down": layer_stack("model.layers.{i}.mlp.down_proj.weight", True),
+        },
+        "final_norm": jnp.asarray(sd["model.norm.weight"]),
+    }
+    if cfg.tie_embeddings:
+        pass  # head is embed.T at apply time
+    elif "lm_head.weight" in sd:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T)
+    else:  # HF tie_word_embeddings checkpoints ship no lm_head
+        params["lm_head"] = jnp.asarray(sd["model.embed_tokens.weight"].T)
+    return {"params": params, "state": {}}
+
+
+def to_hf_llama(params: Mapping[str, Any], cfg: LlamaConfig) -> dict[str, np.ndarray]:
+    """Native param tree → HF LlamaForCausalLM state dict (numpy)."""
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    layers = params["layers"]
+    mapping = [
+        ("input_layernorm.weight", "attn_norm", False),
+        ("self_attn.q_proj.weight", "wq", True),
+        ("self_attn.k_proj.weight", "wk", True),
+        ("self_attn.v_proj.weight", "wv", True),
+        ("self_attn.o_proj.weight", "wo", True),
+        ("post_attention_layernorm.weight", "mlp_norm", False),
+        ("mlp.gate_proj.weight", "w_gate", True),
+        ("mlp.up_proj.weight", "w_up", True),
+        ("mlp.down_proj.weight", "w_down", True),
+    ]
+    for i in range(cfg.n_layers):
+        for hf_name, ours, transpose in mapping:
+            mat = np.asarray(layers[ours][i], np.float32)
+            out[f"model.layers.{i}.{hf_name}"] = mat.T if transpose else mat
+    if "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+    return out

@@ -233,6 +233,143 @@ def forward(
     return (x @ head.astype(dt)).astype(jnp.float32)
 
 
+# ---------------------------------------------------------------- decode
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    """KV cache [L, B, max_len, KV, Hd] per tensor, in compute dtype."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def decode_step(
+    cfg: LlamaConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B] int32 current-position token ids
+    pos: jax.Array,  # scalar int32 position being written
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step: returns (logits [B, V] fp32, new cache)."""
+    dt = cfg.dtype
+    B = tokens.shape[0]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = H // KV
+    max_len = cache["k"].shape[2]
+    positions = jnp.full((B, 1), pos, jnp.int32)
+    x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
+
+    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,S]
+
+    def layer_step(x, inputs):
+        layer, k_cache, v_cache = inputs  # caches [B, max_len, KV, Hd]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
+        k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+        from polyaxon_tpu.ops.attention import repeat_kv
+
+        keys = repeat_kv(k_cache, n_rep)
+        vals = repeat_kv(v_cache, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+        logits = logits * (Hd ** -0.5)
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+        x = x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt)
+
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, 0] @ head.astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def prefill(
+    cfg: LlamaConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, P] int32
+    max_len: int,
+) -> tuple[jax.Array, dict]:
+    """One batched causal pass over the prompt, filling the KV cache:
+    returns (last-position logits [B, V] fp32, cache). O(1) layer sweeps
+    instead of P sequential decode steps."""
+    dt = cfg.dtype
+    B, P = prompt.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None], (B, P))
+    x = params["embed"].astype(dt)[prompt]
+
+    def layer_step(x, layer):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(dt)).reshape(B, P, H, Hd)
+        k = (h @ layer["wk"].astype(dt)).reshape(B, P, KV, Hd)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, P, KV, Hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        attn = dot_product_attention(q, k, v, causal=True, impl="xla")
+        x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        return x, (k, v)
+
+    x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
+    pad = max_len - P
+    cache = {
+        "k": jnp.pad(k_all, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+        "v": jnp.pad(v_all, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
+    }
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x[:, -1] @ head.astype(dt)).astype(jnp.float32)
+    return logits, cache
+
+
+def generate(
+    cfg: LlamaConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, P] int32
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature 0) or sampled continuation: [B, max_new]."""
+    B, P = prompt.shape
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+
+    logits, cache = prefill(cfg, params, prompt, P + max_new_tokens)
+
+    def sample(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def decode_loop(carry, t):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        token = sample(logits, sub).astype(jnp.int32)
+        logits, cache = decode_step(cfg, params, cache, token, P + t)
+        return (cache, logits, key), token
+
+    (_, logits, _), tokens = jax.lax.scan(
+        decode_loop, (cache, logits, rng), jnp.arange(max_new_tokens))
+    return tokens.T  # [B, max_new]
+
+
 def apply(
     cfg: LlamaConfig,
     variables: Variables,

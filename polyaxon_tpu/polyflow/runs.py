@@ -255,12 +255,28 @@ class V1RayJob(_KubeflowRun):
     head: Optional[V1KFReplica] = None
     workers: Optional[dict[str, V1KFReplica]] = None
 
+    def replica_map(self) -> dict[str, V1KFReplica]:
+        out = {}
+        if self.head is not None:
+            out["head"] = self.head
+        for name, rep in (self.workers or {}).items():
+            out[f"worker-{name}"] = rep
+        return out
+
 
 class V1DaskJob(_KubeflowRun):
     kind: Literal["daskjob"] = "daskjob"
     job: Optional[V1KFReplica] = None
     worker: Optional[V1KFReplica] = None
     scheduler: Optional[V1KFReplica] = None
+
+    def replica_map(self) -> dict[str, V1KFReplica]:
+        out = {}
+        for name in ("job", "scheduler", "worker"):
+            rep = getattr(self, name)
+            if rep is not None:
+                out[name] = rep
+        return out
 
 
 # --------------------------------------------------------------------------
