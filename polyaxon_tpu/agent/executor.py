@@ -80,10 +80,40 @@ class LocalExecutor:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 with open(path, "w") as fh:
                     fh.write(content)
+            elif phase.kind == "git":
+                self._init_git(plan, phase)
             elif phase.kind == "tpu_metadata":
                 with open(os.path.join(plan.artifacts_dir, "tpu-metadata.json"), "w") as fh:
                     json.dump({"coordinator": "127.0.0.1", "topology": "local"}, fh)
-            # git/dockerfile need network/docker: recorded, skipped locally.
+            # dockerfile needs docker: recorded, skipped locally.
+
+    def _init_git(self, plan: V1LaunchPlan, phase) -> None:
+        """Git initializer (upstream init.git): clone url@revision into the
+        run context. Works against local paths and any remote git supports;
+        failures raise so the run fails with the real git error."""
+        url = phase.config.get("url")
+        if not url:
+            raise RuntimeError("git init phase has no `url`")
+        revision = phase.config.get("revision")
+        dest = os.path.join(plan.artifacts_dir, phase.path or "repo")
+        # Idempotent like every other init phase: a preemption-requeued
+        # run restarts against the same artifacts dir.
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        # `--` stops git from parsing a dash-prefixed url as an option.
+        clone = subprocess.run(
+            ["git", "clone", "--quiet", "--", url, dest],
+            capture_output=True, text=True, timeout=600)
+        if clone.returncode != 0:
+            raise RuntimeError(f"git clone {url} failed: {clone.stderr.strip()}")
+        if revision:
+            checkout = subprocess.run(
+                ["git", "-C", dest, "checkout", "--quiet", revision, "--"],
+                capture_output=True, text=True, timeout=120)
+            if checkout.returncode != 0:
+                raise RuntimeError(
+                    f"git checkout {revision} failed: {checkout.stderr.strip()}")
 
     # ----------------------------------------------------------------- start
     def start(self, run_uuid: str) -> bool:

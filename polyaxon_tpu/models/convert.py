@@ -32,8 +32,8 @@ from polyaxon_tpu.models.llama import LlamaConfig
 
 
 def _to_numpy(value: Any) -> np.ndarray:
-    if hasattr(value, "detach"):  # torch tensor
-        value = value.detach().cpu().numpy()
+    if hasattr(value, "detach"):  # torch tensor (bf16 can't .numpy() directly)
+        value = value.detach().float().cpu().numpy()
     return np.asarray(value, dtype=np.float32)
 
 
@@ -41,6 +41,11 @@ def from_hf_llama(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> dict:
     """HF LlamaForCausalLM state dict → ``{"params": ..., "state": {}}``."""
     sd = {k: _to_numpy(v) for k, v in state_dict.items()}
     L = cfg.n_layers
+    extra = f"model.layers.{L}.input_layernorm.weight"
+    if extra in sd:
+        raise ValueError(
+            f"checkpoint has more than {L} layers (found `{extra}`) — "
+            "cfg.n_layers does not match the state dict")
 
     def layer_stack(template: str, transpose: bool) -> jnp.ndarray:
         mats = []

@@ -316,7 +316,8 @@ def prefill(
         v = (h @ layer["wv"].astype(dt)).reshape(B, P, KV, Hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        attn = dot_product_attention(q, k, v, causal=True, impl="xla")
+        attn = dot_product_attention(q, k, v, causal=True,
+                                     impl=cfg.attention_impl)
         x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))

@@ -398,3 +398,97 @@ class TestReviewHardening:
         )
         status = agent.run_until_done(record.uuid, timeout=30)
         assert status == V1Statuses.SUCCEEDED
+
+
+class TestGitInit:
+    def test_git_init_clones_local_repo(self, plane, agent, tmp_path):
+        import subprocess as sp
+
+        src = tmp_path / "srcrepo"
+        src.mkdir()
+        sp.run(["git", "init", "-q", str(src)], check=True)
+        (src / "train.py").write_text("print('from repo')\n")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "HOME": str(tmp_path), "PATH": os.environ["PATH"]}
+        sp.run(["git", "-C", str(src), "add", "-A"], check=True, env=env)
+        sp.run(["git", "-C", str(src), "commit", "-qm", "init"], check=True,
+               env=env)
+
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"git": {"url": str(src)}, "path": "code"}],
+                "container": {"command": [
+                    "python", "-c",
+                    "import os\n"
+                    "d = os.environ['POLYAXON_RUN_ARTIFACTS_PATH']\n"
+                    "exec(open(d + '/code/train.py').read())\n",
+                ]},
+            },
+        })
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.SUCCEEDED
+        logs = plane.streams.read_logs(record.uuid, "main-0.log")[0]
+        assert "from repo" in logs
+
+    def test_git_init_bad_url_fails_run(self, plane, agent, tmp_path):
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"git": {"url": str(tmp_path / "nope")}}],
+                "container": {"command": ["python", "-c", "print(1)"]},
+            },
+        })
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "git clone" in (last.get("message") or "")
+
+    def test_git_init_is_idempotent_on_requeue(self, plane, agent, tmp_path):
+        """Preemption-requeued runs restart against the same artifacts
+        dir: the git phase must re-clone, not fail on the leftover."""
+        import subprocess as sp
+
+        src = tmp_path / "srcrepo2"
+        src.mkdir()
+        sp.run(["git", "init", "-q", str(src)], check=True)
+        (src / "f.txt").write_text("x")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "HOME": str(tmp_path), "PATH": os.environ["PATH"]}
+        sp.run(["git", "-C", str(src), "add", "-A"], check=True, env=env)
+        sp.run(["git", "-C", str(src), "commit", "-qm", "i"], check=True, env=env)
+
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"git": {"url": str(src)}, "path": "code"}],
+                "container": {"command": ["python", "-c",
+                                          "import time; time.sleep(20)"]},
+            },
+        })
+        agent.reconcile_once()
+        deadline = time.monotonic() + 20
+        while record.uuid not in agent.executor.active_runs:
+            assert time.monotonic() < deadline
+            agent.reconcile_once()
+            time.sleep(0.05)
+        agent.executor.preempt(record.uuid)
+        # Requeue → the second start() must survive the existing clone.
+        deadline = time.monotonic() + 30
+        while True:
+            agent.reconcile_once()
+            current = plane.get_run(record.uuid)
+            if current.status == V1Statuses.RUNNING and \
+                    record.uuid in agent.executor.active_runs:
+                break
+            assert current.status != V1Statuses.FAILED, \
+                plane.get_statuses(record.uuid)[-1]
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        plane.stop(record.uuid)
+        agent.reconcile_once()

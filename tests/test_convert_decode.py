@@ -104,3 +104,17 @@ class TestDecode:
                              rng=jax.random.key(5))
         assert gen.shape == (2, 3)
         assert bool((gen >= 0).all()) and bool((gen < cfg.vocab_size).all())
+
+
+class TestConvertGuards:
+    def test_bf16_torch_tensors_convert(self, tiny):
+        cfg, hf, torch = tiny
+        sd = {k: v.to(torch.bfloat16) for k, v in hf.state_dict().items()}
+        variables = from_hf_llama(sd, cfg)
+        assert variables["params"]["embed"].dtype == jnp.float32
+
+    def test_layer_count_mismatch_raises(self, tiny):
+        cfg, hf, _ = tiny
+        small = dataclasses.replace(cfg, n_layers=1)
+        with pytest.raises(ValueError, match="more than 1 layers"):
+            from_hf_llama(hf.state_dict(), small)
