@@ -118,10 +118,13 @@ def main() -> int:
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--seq", type=int, default=None)
-    parser.add_argument("--attention", default="xla",
-                        choices=["xla", "flash"],
-                        help="attention impl; flash (Pallas) pays off at "
-                             "long seq on real chips, xla is the safe default")
+    parser.add_argument("--attention", default="auto",
+                        choices=["auto", "xla", "flash"],
+                        help="attention impl; auto = Pallas flash on real "
+                             "TPU (self-falls-back), einsum elsewhere")
+    parser.add_argument("--remat", default=None,
+                        choices=["none", "dots", "full"],
+                        help="checkpoint policy (default: dots, none on --smoke)")
     parser.add_argument("--tuner", action="store_true",
                         help="measure Polytune throughput instead: a "
                              "Hyperband LR sweep of JAXJob trials, "
@@ -158,7 +161,7 @@ def main() -> int:
                 "global_batch_size": batch * n_chips,
                 "seq_len": seq,
                 "log_every": 10**9,
-                "remat": "none" if args.smoke else "dots",
+                "remat": args.remat or ("none" if args.smoke else "dots"),
                 "attention_impl": args.attention,
             },
         }

@@ -32,6 +32,17 @@ from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.lifecycle import V1Statuses
 
 
+def _safe_join(root: str, rel: str) -> str:
+    """Join a user-controlled relative path under ``root``, refusing
+    absolute paths and ``..`` escapes (and ``root`` itself)."""
+    joined = os.path.realpath(os.path.join(root, rel))
+    root_real = os.path.realpath(root)
+    if not joined.startswith(root_real + os.sep):
+        raise RuntimeError(
+            f"init path {rel!r} escapes the run's artifacts dir")
+    return joined
+
+
 @dataclass
 class _Gang:
     run_uuid: str
@@ -76,7 +87,7 @@ class LocalExecutor:
             elif phase.kind == "file":
                 content = phase.config.get("content", "")
                 name = phase.config.get("filename", "file")
-                path = os.path.join(plan.artifacts_dir, "inputs", name)
+                path = _safe_join(os.path.join(plan.artifacts_dir, "inputs"), name)
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 with open(path, "w") as fh:
                     fh.write(content)
@@ -93,9 +104,17 @@ class LocalExecutor:
         failures raise so the run fails with the real git error."""
         url = phase.config.get("url")
         if not url:
-            raise RuntimeError("git init phase has no `url`")
+            raise RuntimeError(
+                "git init phase has no `url` (inline or via its connection)")
         revision = phase.config.get("revision")
-        dest = os.path.join(plan.artifacts_dir, phase.path or "repo")
+        # A dash-prefixed "revision" would be parsed as a git option
+        # (e.g. `--force` turns the checkout into a silent no-op).
+        if revision and str(revision).startswith("-"):
+            raise RuntimeError(f"invalid git revision {revision!r}")
+        # The user-controlled path must stay inside the run's artifacts
+        # dir — we rmtree it below, so absolute/`..` escapes are rejected,
+        # and resolving to the artifacts root itself is refused too.
+        dest = _safe_join(plan.artifacts_dir, phase.path or "repo")
         # Idempotent like every other init phase: a preemption-requeued
         # run restarts against the same artifacts dir.
         if os.path.exists(dest):

@@ -113,6 +113,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str, parts: list[str], query: dict) -> None:
         if parts == ["healthz"]:
             return self._json({"status": "ok"})
+        if parts == ["metrics"]:
+            return self._prometheus()
         if parts[:2] == ["api", "v1"]:
             rest = parts[2:]
             if rest == ["version"]:
@@ -128,6 +130,39 @@ class _Handler(BaseHTTPRequestHandler):
             if len(rest) >= 5 and rest[2] == "runs" and rest[4] == "logs":
                 return self._logs(rest[3], query)
         raise ApiError(404, f"no route for {method} {'/'.join(parts)}")
+
+    def _prometheus(self) -> None:
+        """Prometheus text exposition of control-plane state (the
+        reference's haupt exposes server metrics the same way —
+        SURVEY.md §5.5)."""
+        import time
+
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        counts: dict[str, int] = {s.value: 0 for s in V1Statuses}
+        for record in self.plane.list_runs():
+            counts[record.status.value] = counts.get(record.status.value, 0) + 1
+        started = getattr(self.server, "started_at", None)
+        lines = [
+            "# TYPE polyaxon_tpu_info gauge",
+            f'polyaxon_tpu_info{{version="{__version__}"}} 1',
+            "# TYPE polyaxon_runs gauge",
+        ]
+        lines += [
+            f'polyaxon_runs{{status="{status}"}} {n}'
+            for status, n in sorted(counts.items())
+        ]
+        if started is not None:
+            lines += [
+                "# TYPE polyaxon_uptime_seconds gauge",
+                f"polyaxon_uptime_seconds {time.time() - started:.1f}",
+            ]
+        body = ("\n".join(lines) + "\n").encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     # -- runs --------------------------------------------------------------
     def _runs(self, method: str, project: str, rest: list[str], query: dict) -> None:
@@ -264,8 +299,11 @@ class ApiServer:
     """Owns the HTTP server thread; ``with ApiServer(plane) as s: s.port``."""
 
     def __init__(self, plane: ControlPlane, host: str = "127.0.0.1", port: int = 0):
+        import time
+
         handler = type("BoundHandler", (_Handler,), {"plane": plane})
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.started_at = time.time()
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

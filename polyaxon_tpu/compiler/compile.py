@@ -89,13 +89,25 @@ def _container_cmd(container) -> tuple[list[str], list[str]]:
     return command, [str(a) for a in args]
 
 
-def _init_phases(run, plugins) -> list[V1InitPhase]:
+def _init_phases(run, plugins, catalog=None) -> list[V1InitPhase]:
     phases: list[V1InitPhase] = []
     if plugins is None or plugins.auth is not False:
         phases.append(V1InitPhase(kind="auth", config={}))
     for init in getattr(run, "init", None) or []:
         if init.git is not None:
-            phases.append(V1InitPhase(kind="git", config=init.git,
+            config = dict(init.git)
+            # Canonical upstream form: the url lives on the git
+            # connection, only e.g. `revision` is inline. Resolve it at
+            # compile time so the executor sees a complete phase.
+            if not config.get("url") and init.connection and catalog is not None:
+                try:
+                    conn = catalog.get(init.connection)
+                except ValueError as exc:
+                    raise CompilerError(str(exc)) from exc
+                url = (conn.schema_ or {}).get("url")
+                if url:
+                    config["url"] = url
+            phases.append(V1InitPhase(kind="git", config=config,
                                       connection=init.connection, path=init.path))
         elif init.artifacts is not None:
             phases.append(V1InitPhase(kind="artifacts", config=init.artifacts,
@@ -403,7 +415,7 @@ def compile_operation(
         resources=resources,
         num_processes=len(processes),
         processes=processes,
-        init=_init_phases(run, plugins),
+        init=_init_phases(run, plugins, catalog),
         sidecars=_sidecars(run, plugins, artifacts_dir, store_dir),
         termination=termination,
         queue=op.queue or component.queue,

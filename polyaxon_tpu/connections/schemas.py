@@ -24,16 +24,17 @@ class V1ConnectionKind:
     GIT = "git"
     REGISTRY = "registry"
     SLACK = "slack"
+    DISCORD = "discord"
     WEBHOOK = "webhook"
     PAGERDUTY = "pagerduty"
     CUSTOM = "custom"
 
     VALUES = frozenset({
         HOST_PATH, VOLUME_CLAIM, GCS, S3, WASB, GIT, REGISTRY,
-        SLACK, WEBHOOK, PAGERDUTY, CUSTOM,
+        SLACK, DISCORD, WEBHOOK, PAGERDUTY, CUSTOM,
     })
     ARTIFACT_STORES = frozenset({HOST_PATH, VOLUME_CLAIM, GCS, S3, WASB})
-    NOTIFIERS = frozenset({SLACK, WEBHOOK, PAGERDUTY})
+    NOTIFIERS = frozenset({SLACK, DISCORD, WEBHOOK, PAGERDUTY})
 
 
 class V1ConnectionResource(BaseSchema):

@@ -25,6 +25,7 @@ from polyaxon_tpu.polyflow.matrix import (
     V1Bayes,
     V1GridSearch,
     V1Hyperband,
+    V1Hyperopt,
     V1Iterative,
     V1Mapping,
     V1RandomSearch,
@@ -35,6 +36,7 @@ from polyaxon_tpu.tune import (
     BayesManager,
     GridSearchManager,
     HyperbandManager,
+    HyperoptManager,
     IterativeManager,
     MappingManager,
     Observation,
@@ -442,7 +444,17 @@ class Scheduler:
         elif isinstance(matrix, V1Hyperband):
             actions += self._tick_hyperband(record, op, matrix, tuner, meta, children)
         elif isinstance(matrix, V1Bayes):
-            actions += self._tick_bayes(record, op, matrix, tuner, meta, children)
+            actions += self._tick_smbo(
+                record, op, matrix, BayesManager(matrix), tuner, meta, children,
+                num_initial=matrix.num_initial_runs,
+                total_budget=matrix.num_initial_runs + matrix.max_iterations,
+                reason="BayesDone")
+        elif isinstance(matrix, V1Hyperopt):
+            actions += self._tick_smbo(
+                record, op, matrix, HyperoptManager(matrix), tuner, meta, children,
+                num_initial=matrix.startup_trials,
+                total_budget=matrix.total_budget,
+                reason="HyperoptDone")
         elif isinstance(matrix, V1Iterative):
             actions += self._tick_iterative(record, op, matrix, tuner, meta, children)
         else:
@@ -620,15 +632,29 @@ class Scheduler:
         )
         return actions + 1
 
-    def _tick_bayes(self, record, op, matrix: V1Bayes, tuner, meta, children) -> int:
-        manager = BayesManager(matrix)
+    def _tick_smbo(self, record, op, matrix, manager, tuner, meta, children,
+                   *, num_initial: int, total_budget: int, reason: str) -> int:
+        """Sequential model-based optimization loop shared by Bayes and
+        Hyperopt sweeps: spawn the initial random batch (respecting the
+        concurrency cap), then one model-guided suggestion per free
+        concurrency slot until the budget is spent."""
         actions = 0
+        concurrency = matrix.concurrency or 1
         if not tuner:
-            tuner = {"spawned": 0, "phase": "initial"}
-            for params in manager.initial_suggestions():
-                self._spawn_trial(record, op, params, tuner["spawned"], iteration=0)
+            tuner = {"spawned": 0, "phase": "initial",
+                     "pending_initial": manager.initial_suggestions()}
+
+        # Drain the startup batch first, never exceeding concurrency.
+        pending = list(tuner.get("pending_initial") or [])
+        if pending:
+            active_n = len([c for c in children if not c.is_done])
+            while pending and active_n < concurrency:
+                self._spawn_trial(record, op, pending.pop(0),
+                                  tuner["spawned"], iteration=0)
                 tuner["spawned"] += 1
+                active_n += 1
                 actions += 1
+            tuner["pending_initial"] = pending
             meta["tuner"] = tuner
             self.store.update_run(record.uuid, meta=meta)
             return actions
@@ -636,27 +662,25 @@ class Scheduler:
         active = [c for c in children if not c.is_done]
         obs = self._observations(record, matrix.metric.name, children)
         finished = [o for o in obs if o.status != "preempted"]
-        total_budget = matrix.num_initial_runs + matrix.max_iterations
         if tuner["spawned"] >= total_budget:
             if not active:
                 any_ok = any(c.status == V1Statuses.SUCCEEDED for c in children)
                 self.store.transition(
                     record.uuid,
                     V1Statuses.SUCCEEDED if any_ok else V1Statuses.FAILED,
-                    reason="BayesDone",
+                    reason=reason,
                     message=None if any_ok else "all trials failed",
                 )
                 actions += 1
             return actions
-        concurrency = matrix.concurrency or 1
         if len(active) >= concurrency:
             return 0
-        if len(finished) < matrix.num_initial_runs:
+        if len(finished) < num_initial:
             return 0  # wait for the initial batch before modeling
         count = min(concurrency - len(active), total_budget - tuner["spawned"])
         for params in manager.get_suggestions(obs, count=count):
             self._spawn_trial(record, op, params, tuner["spawned"],
-                              iteration=tuner["spawned"] - matrix.num_initial_runs + 1)
+                              iteration=tuner["spawned"] - num_initial + 1)
             tuner["spawned"] += 1
             actions += 1
         meta["tuner"] = tuner

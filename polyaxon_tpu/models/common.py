@@ -91,6 +91,50 @@ def cross_entropy_loss(
     return loss, acc
 
 
+def chunked_lm_loss(
+    hidden: jax.Array,  # [B, S, D] compute-dtype final hidden states
+    head: jax.Array,  # [D, V] projection (compute dtype)
+    labels: jax.Array,  # [B, S] int32
+    mask: Optional[jax.Array] = None,  # [B, S] 0/1
+    chunk: int = 256,
+) -> tuple[jax.Array, jax.Array]:
+    """Next-token CE without materializing the [B, S, V] logits tensor.
+
+    The lm-head projection + log-softmax run one sequence chunk at a
+    time under ``jax.checkpoint``, so peak HBM holds a [B, chunk, V]
+    slab instead of the full fp32 logits (2 GB+ at 8×2048×32k) — the
+    backward pass recomputes each chunk's logits from the saved hidden
+    slab. Numerics are identical to ``cross_entropy_loss`` over full
+    logits: per-position log-softmax is independent of chunking.
+    """
+    from polyaxon_tpu.ops.flash import pick_block
+
+    B, S, D = hidden.shape
+    chunk = pick_block(S, chunk)
+    n_chunks = S // chunk
+    if mask is None:
+        mask = (labels >= 0)
+    mask = mask.astype(jnp.float32) * (labels >= 0).astype(jnp.float32)
+    labels_clipped = jnp.maximum(labels, 0)
+
+    h = hidden.reshape(B, n_chunks, chunk, D).transpose(1, 0, 2, 3)
+    y = labels_clipped.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+    m = mask.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_stats(args):
+        hc, yc, mc = args  # [B, chunk, D], [B, chunk], [B, chunk]
+        logits = (hc @ head).astype(jnp.float32)  # [B, chunk, V]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(log_probs, yc[..., None], axis=-1)[..., 0]
+        correct = (jnp.argmax(logits, axis=-1) == yc).astype(jnp.float32)
+        return jnp.stack([(nll * mc).sum(), (correct * mc).sum()])
+
+    stats = jax.lax.map(chunk_stats, (h, y, m)).sum(axis=0)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return stats[0] / denom, stats[1] / denom
+
+
 def shift_right(tokens: jax.Array, bos_id: int = 0) -> jax.Array:
     """Next-token LM inputs: tokens shifted right with BOS at position 0."""
     return jnp.concatenate(

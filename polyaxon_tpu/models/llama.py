@@ -26,7 +26,7 @@ from polyaxon_tpu.models.common import (
     Batch,
     ModelDef,
     Variables,
-    cross_entropy_loss,
+    chunked_lm_loss,
     rms_norm,
     scaled_init,
     shift_right,
@@ -200,13 +200,13 @@ def _pipelined_layers(cfg: LlamaConfig, body, layer_params, x: jax.Array) -> jax
         n_microbatches=cfg.pipeline_microbatches)
 
 
-def forward(
+def hidden_states(
     cfg: LlamaConfig,
     params: dict,
     tokens: jax.Array,  # [B, S] int32 input ids
     positions: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Token ids → logits [B, S, vocab]."""
+    """Token ids → final-norm hidden states [B, S, D] (compute dtype)."""
     dt = cfg.dtype
     B, S = tokens.shape
     if positions is None:
@@ -227,10 +227,23 @@ def forward(
             return body(carry, layer_params, positions), None
 
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def lm_head(cfg: LlamaConfig, params: dict) -> jax.Array:
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,  # [B, S] int32 input ids
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token ids → logits [B, S, vocab]."""
+    x = hidden_states(cfg, params, tokens, positions)
     # fp32 logits: the MXU matmul stays bf16; accumulate/softmax in fp32.
-    return (x @ head.astype(dt)).astype(jnp.float32)
+    return (x @ lm_head(cfg, params).astype(cfg.dtype)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------- decode
@@ -289,8 +302,7 @@ def decode_step(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, 0] @ head.astype(dt)).astype(jnp.float32)
+    logits = (x[:, 0] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -332,8 +344,7 @@ def prefill(
         "v": jnp.pad(v_all, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
     }
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, -1] @ head.astype(dt)).astype(jnp.float32)
+    logits = (x[:, -1] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
     return logits, cache
 
 
@@ -380,9 +391,12 @@ def apply(
 ):
     tokens = batch["tokens"]
     inputs = shift_right(tokens)
-    logits = forward(cfg, variables["params"], inputs)
-    mask = batch.get("mask")
-    loss, acc = cross_entropy_loss(logits, tokens, mask)
+    # Chunked lm-head loss: the [B, S, V] fp32 logits tensor is never
+    # materialized (common.chunked_lm_loss) — the dominant HBM saving at
+    # pretraining shapes.
+    x = hidden_states(cfg, variables["params"], inputs)
+    head = lm_head(cfg, variables["params"]).astype(cfg.dtype)
+    loss, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"))
     return loss, {"loss": loss, "accuracy": acc}, variables["state"]
 
 

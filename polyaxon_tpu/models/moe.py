@@ -34,7 +34,7 @@ from polyaxon_tpu.models.common import (
     Batch,
     ModelDef,
     Variables,
-    cross_entropy_loss,
+    chunked_lm_loss,
     rms_norm,
     scaled_init,
     shift_right,
@@ -205,13 +205,13 @@ def _layer(cfg: MoEConfig, carry, layer: dict, positions: jax.Array):
     return (x + moe_out, aux_sum + aux)
 
 
-def forward(
+def hidden_states(
     cfg: MoEConfig,
     params: dict,
     tokens: jax.Array,
     positions: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Token ids → (logits [B,S,vocab] fp32, mean router aux loss)."""
+    """Token ids → (final-norm hidden [B,S,D], mean router aux loss)."""
     dt = cfg.dtype
     B, S = tokens.shape
     if positions is None:
@@ -230,9 +230,19 @@ def forward(
 
     (x, aux_sum), _ = jax.lax.scan(
         scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, aux_sum / cfg.n_layers
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux_sum / cfg.n_layers
+
+
+def forward(
+    cfg: MoEConfig,
+    params: dict,
+    tokens: jax.Array,
+    positions: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Token ids → (logits [B,S,vocab] fp32, mean router aux loss)."""
+    x, aux = hidden_states(cfg, params, tokens, positions)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux
 
 
 def apply(
@@ -244,9 +254,11 @@ def apply(
 ):
     tokens = batch["tokens"]
     inputs = shift_right(tokens)
-    logits, aux = forward(cfg, variables["params"], inputs)
-    mask = batch.get("mask")
-    ce, acc = cross_entropy_loss(logits, tokens, mask)
+    # Chunked lm-head loss (common.chunked_lm_loss): full [B,S,V] fp32
+    # logits are never materialized.
+    x, aux = hidden_states(cfg, variables["params"], inputs)
+    head = variables["params"]["lm_head"].astype(cfg.dtype)
+    ce, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"))
     loss = ce + cfg.router_aux_coef * aux
     return loss, {"loss": loss, "ce_loss": ce, "router_aux": aux,
                   "accuracy": acc}, variables["state"]

@@ -91,6 +91,22 @@ class SlackNotifier(WebhookNotifier):
         }
 
 
+class DiscordNotifier(WebhookNotifier):
+    kind = V1ConnectionKind.DISCORD
+
+    def format(self, run: dict[str, Any], status: str) -> dict[str, Any]:
+        emoji = {"succeeded": "✅", "failed": "❌",
+                 "stopped": "🛑"}.get(status, "🔔")
+        name = run.get("name") or run.get("uuid")
+        return {
+            "content": f"{emoji} Run **{name}** ({run.get('project')}) → **{status}**",
+            "embeds": [{"fields": [
+                {"name": "uuid", "value": str(run.get("uuid")), "inline": True},
+                {"name": "kind", "value": str(run.get("kind")), "inline": True},
+            ]}],
+        }
+
+
 class PagerDutyNotifier(Notifier):
     kind = V1ConnectionKind.PAGERDUTY
 
@@ -131,6 +147,7 @@ class FileNotifier(Notifier):
 _NOTIFIERS = {
     V1ConnectionKind.WEBHOOK: WebhookNotifier,
     V1ConnectionKind.SLACK: SlackNotifier,
+    V1ConnectionKind.DISCORD: DiscordNotifier,
     V1ConnectionKind.PAGERDUTY: PagerDutyNotifier,
     V1ConnectionKind.CUSTOM: FileNotifier,
 }

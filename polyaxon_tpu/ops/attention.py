@@ -66,6 +66,11 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     axis_name: Optional[str] = None,
 ) -> jax.Array:
+    if impl == "auto":
+        # Flash on real TPU (it self-falls-back when shapes don't tile);
+        # einsum reference elsewhere and for packed sequences.
+        impl = ("flash" if segment_ids is None
+                and jax.default_backend() == "tpu" else "xla")
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     if segment_ids is not None:

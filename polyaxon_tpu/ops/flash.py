@@ -42,12 +42,16 @@ def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_block(seq: int, preferred: int) -> int:
-    """Largest power-of-two block <= preferred that divides seq."""
+def pick_block(seq: int, preferred: int) -> int:
+    """Largest power-of-two block <= preferred that divides seq (the
+    shared tiling rule — also used by models.common.chunked_lm_loss)."""
     block = min(preferred, seq)
     while block > 1 and seq % block:
         block //= 2
     return block
+
+
+_pick_block = pick_block  # internal alias
 
 
 def _fwd_kernel(

@@ -22,6 +22,7 @@ from polyaxon_tpu.polyflow.matrix import (
     V1FailureEarlyStopping,
     V1GridSearch,
     V1Hyperband,
+    V1Hyperopt,
     V1HpChoice,
     V1HpLinSpace,
     V1HpLogSpace,

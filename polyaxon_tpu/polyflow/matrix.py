@@ -427,6 +427,59 @@ class V1Bayes(BaseSchema):
     early_stopping: Optional[list[EarlyStopping]] = None
 
 
+class V1Hyperopt(BaseSchema):
+    """Hyperopt-style sequential model-based search (upstream's
+    ``V1Hyperopt`` bridge, SURVEY.md §2 "Polytune" [K] — implemented
+    natively in ``tune/hyperopt.py`` rather than wrapping the hyperopt
+    package, which is not in this environment).
+
+    ``algorithm``: ``tpe`` (tree-structured Parzen estimator),
+    ``anneal`` (shrinking-radius search around the incumbent), or
+    ``rand`` (plain random, upstream parity).
+    """
+
+    kind: Literal["hyperopt"] = "hyperopt"
+    algorithm: str = "tpe"  # tpe | rand | anneal
+    params: dict[str, HpParam]
+    num_runs: int
+    max_iterations: Optional[int] = None
+    metric: V1OptimizationMetric
+    num_startup_trials: Optional[int] = None  # default: max(4, num_runs // 5)
+    seed: Optional[int] = None
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[EarlyStopping]] = None
+
+    @field_validator("algorithm")
+    @classmethod
+    def _check_algorithm(cls, v):
+        if v not in ("tpe", "rand", "anneal"):
+            raise ValueError(f"algorithm must be tpe|rand|anneal, got {v}")
+        return v
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.num_runs < 1:
+            raise ValueError("numRuns must be >= 1")
+        if self.max_iterations is not None and self.max_iterations < 0:
+            raise ValueError("maxIterations must be >= 0")
+        return self
+
+    @property
+    def startup_trials(self) -> int:
+        if self.num_startup_trials is not None:
+            return max(1, min(self.num_startup_trials, self.num_runs))
+        return max(4, min(self.num_runs // 5, 20)) if self.num_runs > 4 else 1
+
+    @property
+    def total_budget(self) -> int:
+        """Total trials: numRuns, optionally tightened by maxIterations
+        (a cap on *model-guided* trials after the startup batch, the
+        V1Bayes analogue)."""
+        if self.max_iterations is not None:
+            return min(self.num_runs, self.startup_trials + self.max_iterations)
+        return self.num_runs
+
+
 class V1Iterative(BaseSchema):
     kind: Literal["iterative"] = "iterative"
     params: dict[str, HpParam]
@@ -448,4 +501,7 @@ class V1Mapping(BaseSchema):
         return len(self.values)
 
 
-Matrix = Union[V1GridSearch, V1RandomSearch, V1Hyperband, V1Bayes, V1Iterative, V1Mapping]
+Matrix = Union[
+    V1GridSearch, V1RandomSearch, V1Hyperband, V1Bayes, V1Hyperopt,
+    V1Iterative, V1Mapping,
+]

@@ -9,12 +9,14 @@ from polyaxon_tpu.tune.base import (
 )
 from polyaxon_tpu.tune.bayes import BayesManager, GaussianProcess, acquisition
 from polyaxon_tpu.tune.hyperband import HyperbandManager, Rung
+from polyaxon_tpu.tune.hyperopt import HyperoptManager
 
 __all__ = [
     "BayesManager",
     "GaussianProcess",
     "GridSearchManager",
     "HyperbandManager",
+    "HyperoptManager",
     "IterativeManager",
     "MappingManager",
     "Observation",

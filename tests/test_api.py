@@ -62,6 +62,24 @@ class TestApi:
 
         assert client.version() == __version__
 
+    def test_prometheus_metrics(self, stack):
+        import urllib.request
+
+        plane, server = stack
+        plane.submit({"kind": "component", "run": {
+            "kind": "job", "container": {"command": ["python", "-c", "1"]}}})
+        with urllib.request.urlopen(server.url + "/metrics", timeout=5) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "polyaxon_tpu_info{version=" in text
+        assert 'polyaxon_runs{status="' in text
+        assert "polyaxon_uptime_seconds" in text
+        # One run exists in some status — the per-status gauges sum to >= 1.
+        total = sum(int(line.rsplit(" ", 1)[1])
+                    for line in text.splitlines()
+                    if line.startswith("polyaxon_runs{"))
+        assert total >= 1
+
     def test_run_end_to_end(self, stack, tmp_path):
         _, server = stack
         run = RunClient(host=server.url)

@@ -162,6 +162,16 @@ class TestNotifiers:
             {"uuid": "u", "name": "train", "project": "p"}, "succeeded")
         assert ":white_check_mark:" in body["text"] and "train" in body["text"]
 
+    def test_discord_format(self):
+        from polyaxon_tpu.notifiers.service import DiscordNotifier
+
+        conn = V1Connection(name="d", kind=V1ConnectionKind.DISCORD,
+                            schema={"url": "http://x"})
+        body = DiscordNotifier(conn).format(
+            {"uuid": "u", "name": "train", "project": "p"}, "failed")
+        assert "train" in body["content"] and "failed" in body["content"]
+        assert body["embeds"][0]["fields"][0]["value"] == "u"
+
 
 class TestCompilerIntegration:
     def test_dangling_connection_fails_compile(self, tmp_path):

@@ -261,6 +261,57 @@ class TestMatrixPipelines:
         for child in rung1:
             assert child.meta["trial_params"]["epochs"] > 1
 
+    def test_hyperopt_tpe_sweep(self, plane, agent):
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "hyperopt",
+                    "algorithm": "tpe",
+                    "numRuns": 8,
+                    "numStartupTrials": 4,
+                    "seed": 3,
+                    "concurrency": 2,
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=180)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        assert len(children) == 8
+        best = min(plane.get_metric(c.uuid, "score") for c in children)
+        assert best < 0.1  # TPE should close in on lr=0.3
+
+    def test_smbo_startup_batch_respects_concurrency(self, plane, agent):
+        """The initial random batch must also honor the concurrency cap
+        (preemptible-slice quota), not fan out all at once."""
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "hyperopt",
+                    "algorithm": "rand",
+                    "numRuns": 6,
+                    "numStartupTrials": 5,
+                    "seed": 1,
+                    "concurrency": 2,
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        agent.reconcile_once()
+        assert len(plane.list_runs(pipeline_uuid=record.uuid)) <= 2
+        status = agent.run_until_done(record.uuid, timeout=180)
+        assert status == V1Statuses.SUCCEEDED
+        assert len(plane.list_runs(pipeline_uuid=record.uuid)) == 6
+
     def test_bayes_converges_toward_optimum(self, plane, agent):
         record = plane.submit(
             {
@@ -432,6 +483,93 @@ class TestGitInit:
         assert status == V1Statuses.SUCCEEDED
         logs = plane.streams.read_logs(record.uuid, "main-0.log")[0]
         assert "from repo" in logs
+
+    def test_git_init_path_escape_rejected(self, plane, agent, tmp_path):
+        """The git phase rmtree's its dest — an absolute or `..` path
+        must fail the run, never delete outside the artifacts dir."""
+        victim = tmp_path / "victim"
+        victim.mkdir()
+        (victim / "keep.txt").write_text("precious")
+        for bad in (str(victim), "../../escape", "."):
+            record = plane.submit({
+                "kind": "component",
+                "run": {
+                    "kind": "job",
+                    "init": [{"git": {"url": str(tmp_path / "whatever")},
+                              "path": bad}],
+                    "container": {"command": ["python", "-c", "print(1)"]},
+                },
+            })
+            status = agent.run_until_done(record.uuid, timeout=60)
+            assert status == V1Statuses.FAILED, bad
+            last = plane.get_statuses(record.uuid)[-1]
+            assert "escapes" in (last.get("message") or ""), bad
+        assert (victim / "keep.txt").read_text() == "precious"
+
+    def test_git_init_url_from_connection(self, plane, agent, tmp_path):
+        """Upstream's canonical form: the repo url lives on a git
+        connection; only e.g. revision is inline."""
+        import subprocess as sp
+
+        src = tmp_path / "connrepo"
+        src.mkdir()
+        sp.run(["git", "init", "-q", str(src)], check=True)
+        (src / "f.py").write_text("print('via connection')\n")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "HOME": str(tmp_path), "PATH": os.environ["PATH"]}
+        sp.run(["git", "-C", str(src), "add", "-A"], check=True, env=env)
+        sp.run(["git", "-C", str(src), "commit", "-qm", "i"], check=True, env=env)
+
+        from polyaxon_tpu.connections import ConnectionCatalog, V1Connection
+
+        plane.connections = ConnectionCatalog([V1Connection.from_dict(
+            {"name": "my-repo", "kind": "git", "schema": {"url": str(src)}})])
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"git": {}, "connection": "my-repo", "path": "code"}],
+                "container": {"command": [
+                    "python", "-c",
+                    "import os\n"
+                    "d = os.environ['POLYAXON_RUN_ARTIFACTS_PATH']\n"
+                    "exec(open(d + '/code/f.py').read())\n",
+                ]},
+            },
+        })
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.SUCCEEDED
+        logs = plane.streams.read_logs(record.uuid, "main-0.log")[0]
+        assert "via connection" in logs
+
+    def test_git_init_dash_revision_rejected(self, plane, agent, tmp_path):
+        """A dash-prefixed revision would be parsed as a git option
+        (`--force` → silent no-op checkout); it must fail the run."""
+        import subprocess as sp
+
+        src = tmp_path / "revrepo"
+        src.mkdir()
+        sp.run(["git", "init", "-q", str(src)], check=True)
+        (src / "f.txt").write_text("x")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+               "HOME": str(tmp_path), "PATH": os.environ["PATH"]}
+        sp.run(["git", "-C", str(src), "add", "-A"], check=True, env=env)
+        sp.run(["git", "-C", str(src), "commit", "-qm", "i"], check=True, env=env)
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "init": [{"git": {"url": str(src), "revision": "--force"},
+                          "path": "code"}],
+                "container": {"command": ["python", "-c", "print(1)"]},
+            },
+        })
+        status = agent.run_until_done(record.uuid, timeout=60)
+        assert status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "invalid git revision" in (last.get("message") or "")
 
     def test_git_init_bad_url_fails_run(self, plane, agent, tmp_path):
         record = plane.submit({

@@ -45,6 +45,30 @@ class TestLlama:
         )(v["params"])
         assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
 
+    def test_chunked_lm_loss_matches_full_logits(self):
+        """apply() uses common.chunked_lm_loss; its loss/grads must equal
+        the materialized-logits path exactly (chunking is numerics-free)."""
+        import dataclasses
+
+        from polyaxon_tpu.models.common import cross_entropy_loss, shift_right
+
+        cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"], dtype=jnp.float32)
+        v = llama.init(cfg, jax.random.key(0))
+        batch = {"tokens": _tokens(jax.random.key(1), 2, 64, cfg.vocab_size)}
+
+        def full_loss(p):
+            logits = llama.forward(cfg, p, shift_right(batch["tokens"]))
+            return cross_entropy_loss(logits, batch["tokens"])[0]
+
+        def chunked_loss(p):
+            return llama.apply(cfg, {"params": p, "state": {}}, batch)[0]
+
+        l1, g1 = jax.value_and_grad(full_loss)(v["params"])
+        l2, g2 = jax.value_and_grad(chunked_loss)(v["params"])
+        assert abs(float(l1 - l2)) < 1e-5
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
     def test_remat_matches(self):
         import dataclasses
 

@@ -38,6 +38,17 @@ class TestLoading:
             round_tripped = check_polyaxonfile(op.to_dict())
             assert round_tripped.to_dict() == op.to_dict()
 
+    def test_all_shipped_examples_parse(self):
+        """Every Polyaxonfile under examples/ must validate (deploy.yaml
+        is a deploy-values file, validated by test_deploy)."""
+        examples = os.path.join(os.path.dirname(FIXTURES), "..", "examples")
+        names = [n for n in sorted(os.listdir(examples))
+                 if n.endswith(".yaml") and n != "deploy.yaml"]
+        assert len(names) >= 7
+        for name in names:
+            op = check_polyaxonfile(os.path.join(examples, name))
+            assert op.component is not None, name
+
     def test_kind_detection(self):
         assert spec_kind({"kind": "component", "run": {}}) == "component"
         assert spec_kind({"run": {}}) == "component"

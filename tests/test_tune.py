@@ -10,6 +10,7 @@ from polyaxon_tpu.polyflow.matrix import (
     V1Bayes,
     V1GridSearch,
     V1Hyperband,
+    V1Hyperopt,
     V1Mapping,
     V1RandomSearch,
 )
@@ -18,6 +19,7 @@ from polyaxon_tpu.tune import (
     GaussianProcess,
     GridSearchManager,
     HyperbandManager,
+    HyperoptManager,
     MappingManager,
     Observation,
     RandomSearchManager,
@@ -197,6 +199,113 @@ class TestBayes:
 
 
 from tests.test_controlplane import TRIAL_COMPONENT  # noqa: E402
+
+
+class TestHyperopt:
+    def _config(self, algorithm="tpe", **kw):
+        spec = {
+            "kind": "hyperopt",
+            "algorithm": algorithm,
+            "numRuns": 20,
+            "seed": 7,
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"x": {"kind": "uniform", "value": {"low": 0.0, "high": 1.0}}},
+        }
+        spec.update(kw)
+        return V1Hyperopt.from_dict(spec)
+
+    def test_schema_validates_algorithm(self):
+        with pytest.raises(Exception):
+            self._config(algorithm="cmaes")
+        cfg = self._config()
+        assert cfg.startup_trials == 4
+
+    def test_rand_is_plain_random(self):
+        mgr = HyperoptManager(self._config(algorithm="rand"))
+        obs = [Observation(params={"x": 0.5}, metric=0.0)] * 5
+        out = mgr.get_suggestions(obs, count=6)
+        assert len(out) == 6
+        assert all(0.0 <= s["x"] <= 1.0 for s in out)
+
+    def test_tpe_focuses_near_good_region(self):
+        mgr = HyperoptManager(self._config())
+        # loss = (x - 0.3)^2; spread observations across the range.
+        obs = [Observation(params={"x": x}, metric=(x - 0.3) ** 2)
+               for x in (0.05, 0.15, 0.28, 0.32, 0.5, 0.7, 0.85, 0.95)]
+        suggestions = mgr.get_suggestions(obs, count=8)
+        mean_dist = sum(abs(s["x"] - 0.3) for s in suggestions) / len(suggestions)
+        assert mean_dist < 0.25  # uniform would average ~0.29; TPE tighter
+
+    def test_tpe_handles_discrete_and_log_params(self):
+        cfg = self._config(params={
+            "layers": {"kind": "choice", "value": [2, 4, 8]},
+            "lr": {"kind": "loguniform",
+                   "value": {"low": math.log(1e-5), "high": math.log(1e-1)}},
+        })
+        mgr = HyperoptManager(cfg)
+        obs = [
+            Observation(params={"layers": 4, "lr": 1e-3}, metric=0.1),
+            Observation(params={"layers": 4, "lr": 3e-3}, metric=0.12),
+            Observation(params={"layers": 2, "lr": 1e-5}, metric=0.9),
+            Observation(params={"layers": 8, "lr": 1e-1}, metric=1.0),
+        ]
+        for s in mgr.get_suggestions(obs, count=5):
+            assert s["layers"] in (2, 4, 8)
+            assert 1e-5 * 0.99 <= s["lr"] <= 1e-1 * 1.01
+
+    def test_anneal_shrinks_toward_incumbent(self):
+        mgr = HyperoptManager(self._config(algorithm="anneal"))
+        best = Observation(params={"x": 0.4}, metric=0.0)
+        far = Observation(params={"x": 0.95}, metric=1.0)
+        # Many observations → low temperature → samples hug the incumbent.
+        obs = [best, far] + [Observation(params={"x": 0.9}, metric=0.8)] * 30
+        out = [mgr._anneal_one([best, far], len(obs)) for _ in range(10)]
+        mean_dist = sum(abs(s["x"] - 0.4) for s in out) / len(out)
+        assert mean_dist < 0.2
+
+    def test_quantized_params_stay_on_grid(self):
+        cfg = self._config(params={
+            "bs": {"kind": "quniform", "value": {"low": 8, "high": 64, "q": 8}},
+        })
+        mgr = HyperoptManager(cfg)
+        obs = [Observation(params={"bs": 16.0}, metric=0.1),
+               Observation(params={"bs": 24.0}, metric=0.2),
+               Observation(params={"bs": 56.0}, metric=0.9)]
+        for s in mgr.get_suggestions(obs, count=6):
+            assert s["bs"] % 8 == 0
+
+    def test_seeded_rand_varies_across_ticks(self):
+        """The scheduler rebuilds the manager per tick — a fixed seed must
+        not replay the same RNG stream (duplicate trials)."""
+        cfg = self._config(algorithm="rand")
+        obs3 = [Observation(params={"x": 0.5}, metric=1.0)] * 3
+        obs4 = obs3 + [Observation(params={"x": 0.6}, metric=1.0)]
+        a = HyperoptManager(cfg).get_suggestions(obs3, count=1)[0]
+        b = HyperoptManager(cfg).get_suggestions(obs4, count=1)[0]
+        again = HyperoptManager(cfg).get_suggestions(obs3, count=1)[0]
+        assert a != b          # new observations → new draw
+        assert a == again      # still deterministic per round
+
+    def test_negative_max_iterations_rejected(self):
+        with pytest.raises(Exception, match="maxIterations"):
+            self._config(maxIterations=-3)
+
+    def test_max_iterations_caps_model_guided_trials(self):
+        cfg = self._config(numRuns=50, maxIterations=5, numStartupTrials=4)
+        assert cfg.total_budget == 9  # startup + capped iterations
+        mgr = HyperoptManager(cfg)
+        obs = [Observation(params={"x": 0.1}, metric=1.0)] * 9
+        assert mgr.is_done(obs)
+        assert V1Hyperopt.from_dict(
+            {**self._config().to_dict(), "numRuns": 10}).total_budget == 10
+
+    def test_done_counts_exclude_preempted(self):
+        mgr = HyperoptManager(self._config(numRuns=3))
+        obs = [Observation(params={"x": 0.1}, metric=1.0)] * 2
+        assert not mgr.is_done(obs)
+        assert not mgr.is_done(obs + [Observation(params={"x": 0.2}, metric=None,
+                                                  status="preempted")])
+        assert mgr.is_done(obs + [Observation(params={"x": 0.2}, metric=1.0)])
 
 
 class TestIterativeAndEarlyStopping:
