@@ -115,6 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"status": "ok"})
         if parts == ["metrics"]:
             return self._prometheus()
+        if parts in ([], ["ui"]):
+            return self._dashboard()
         if parts[:2] == ["api", "v1"]:
             rest = parts[2:]
             if rest == ["version"]:
@@ -130,6 +132,17 @@ class _Handler(BaseHTTPRequestHandler):
             if len(rest) >= 5 and rest[2] == "runs" and rest[4] == "logs":
                 return self._logs(rest[3], query)
         raise ApiError(404, f"no route for {method} {'/'.join(parts)}")
+
+    def _dashboard(self) -> None:
+        """Polyboard-lite (api.ui): the static runs dashboard."""
+        from polyaxon_tpu.api.ui import DASHBOARD_HTML
+
+        body = DASHBOARD_HTML.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _prometheus(self) -> None:
         """Prometheus text exposition of control-plane state (the

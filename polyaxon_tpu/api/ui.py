@@ -1,0 +1,282 @@
+"""Polyboard-lite: a dependency-free runs dashboard served by the API
+server at ``/ui``.
+
+The reference ships a ~100k-LoC React SPA (SURVEY.md §2 "UI"); the
+capability core here is a single static page over the same REST surface:
+run list + status filter, per-run metric charts (inline SVG, crosshair +
+tooltip), raw-table fallback per chart, and live log tail over the SSE
+streams endpoint. Light/dark both ship; colors follow the chart-role
+tokens (series color only on marks, text in ink tokens, status always
+icon + label — never color alone).
+"""
+
+from __future__ import annotations
+
+DASHBOARD_HTML = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>polyaxon_tpu — runs</title>
+<style>
+  :root {
+    color-scheme: light;
+    --page: #f9f9f7; --surface: #fcfcfb;
+    --ink: #0b0b0b; --ink-2: #52514e; --muted: #898781;
+    --grid: #e1e0d9; --axis: #c3c2b7; --ring: rgba(11,11,11,0.10);
+    --series-1: #2a78d6;
+    --status-good: #0ca30c; --status-warning: #fab219;
+    --status-serious: #ec835a; --status-critical: #d03b3b;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root:not([data-theme="light"]) {
+      color-scheme: dark;
+      --page: #0d0d0d; --surface: #1a1a19;
+      --ink: #ffffff; --ink-2: #c3c2b7; --muted: #898781;
+      --grid: #2c2c2a; --axis: #383835; --ring: rgba(255,255,255,0.10);
+      --series-1: #3987e5;
+    }
+  }
+  :root[data-theme="dark"] {
+    color-scheme: dark;
+    --page: #0d0d0d; --surface: #1a1a19;
+    --ink: #ffffff; --ink-2: #c3c2b7; --muted: #898781;
+    --grid: #2c2c2a; --axis: #383835; --ring: rgba(255,255,255,0.10);
+    --series-1: #3987e5;
+  }
+  * { box-sizing: border-box; }
+  body { margin: 0; background: var(--page); color: var(--ink);
+         font: 14px/1.45 system-ui, -apple-system, "Segoe UI", sans-serif; }
+  header { display: flex; align-items: center; gap: 12px;
+           padding: 14px 20px; border-bottom: 1px solid var(--ring); }
+  header h1 { font-size: 16px; margin: 0; font-weight: 650; }
+  header .spacer { flex: 1; }
+  select, button {
+    font: inherit; color: var(--ink); background: var(--surface);
+    border: 1px solid var(--ring); border-radius: 6px; padding: 4px 10px;
+    cursor: pointer;
+  }
+  main { padding: 16px 20px; max-width: 1100px; margin: 0 auto; }
+  .tiles { display: flex; gap: 12px; flex-wrap: wrap; margin-bottom: 16px; }
+  .tile { background: var(--surface); border: 1px solid var(--ring);
+          border-radius: 8px; padding: 10px 16px; min-width: 120px; }
+  .tile .v { font-size: 22px; font-weight: 650; }
+  .tile .k { color: var(--ink-2); font-size: 12px; }
+  table { width: 100%; border-collapse: collapse; background: var(--surface);
+          border: 1px solid var(--ring); border-radius: 8px; overflow: hidden; }
+  th { text-align: left; color: var(--muted); font-weight: 500; font-size: 12px; }
+  th, td { padding: 7px 12px; border-bottom: 1px solid var(--grid); }
+  td.num { font-variant-numeric: tabular-nums; }
+  tr.run { cursor: pointer; }
+  tr.run:hover td { background: color-mix(in srgb, var(--ink) 4%, transparent); }
+  .pill { display: inline-flex; align-items: center; gap: 6px; font-size: 12px;
+          color: var(--ink-2); }
+  .pill .dot { width: 8px; height: 8px; border-radius: 50%; }
+  #detail { margin-top: 20px; }
+  .charts { display: grid; grid-template-columns: repeat(auto-fill, minmax(320px, 1fr));
+            gap: 14px; margin-top: 10px; }
+  .chart { background: var(--surface); border: 1px solid var(--ring);
+           border-radius: 8px; padding: 10px 12px; }
+  .chart h3 { margin: 0 0 4px; font-size: 13px; font-weight: 600; }
+  .chart .sub { color: var(--muted); font-size: 11px; }
+  .chart svg { display: block; width: 100%; height: 150px; }
+  .chart .tbl { display: none; max-height: 150px; overflow: auto; }
+  .chart.show-table svg { display: none; }
+  .chart.show-table .tbl { display: block; }
+  .chart .tools { float: right; }
+  .chart .tools button { font-size: 11px; padding: 1px 7px; }
+  .tooltip { position: fixed; pointer-events: none; background: var(--surface);
+             border: 1px solid var(--ring); border-radius: 6px; padding: 4px 8px;
+             font-size: 12px; box-shadow: 0 2px 8px rgba(0,0,0,.15); display: none;
+             z-index: 10; }
+  #logs { background: var(--surface); border: 1px solid var(--ring);
+          border-radius: 8px; margin-top: 14px; padding: 10px 12px;
+          max-height: 260px; overflow: auto; white-space: pre-wrap;
+          font: 12px/1.5 ui-monospace, monospace; color: var(--ink-2); }
+  a.uuid { color: var(--series-1); text-decoration: none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>polyaxon_tpu</h1>
+  <span class="spacer"></span>
+  <select id="statusFilter" aria-label="status filter">
+    <option value="">all statuses</option>
+    <option>running</option><option>succeeded</option>
+    <option>failed</option><option>stopped</option>
+    <option>queued</option><option>preempted</option>
+  </select>
+  <button id="refresh">refresh</button>
+  <button id="themeToggle" aria-label="toggle theme">◐</button>
+</header>
+<main>
+  <div class="tiles" id="tiles"></div>
+  <table id="runs">
+    <thead><tr>
+      <th>run</th><th>name</th><th>kind</th><th>project</th>
+      <th>status</th><th>created</th>
+    </tr></thead>
+    <tbody></tbody>
+  </table>
+  <section id="detail"></section>
+</main>
+<div class="tooltip" id="tooltip"></div>
+<script>
+"use strict";
+// Status → {color role, glyph}: icon + label always travel together.
+const STATUS = {
+  succeeded: ["var(--status-good)", "✓"],
+  running:   ["var(--series-1)", "▶"],
+  queued:    ["var(--muted)", "…"],
+  scheduled: ["var(--muted)", "…"],
+  starting:  ["var(--muted)", "…"],
+  compiled:  ["var(--muted)", "…"],
+  created:   ["var(--muted)", "…"],
+  stopped:   ["var(--status-warning)", "■"],
+  preempted: ["var(--status-warning)", "⏸"],
+  failed:    ["var(--status-critical)", "✕"],
+};
+const $ = (sel, el) => (el || document).querySelector(sel);
+const api = (p) => fetch(p).then(r => { if (!r.ok) throw new Error(r.status); return r.json(); });
+// All user-controlled strings (run names, projects, metric names) go
+// through esc() before any innerHTML interpolation — stored XSS guard.
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+
+function pill(status) {
+  const [color, glyph] = STATUS[status] || ["var(--muted)", "•"];
+  return `<span class="pill"><span class="dot" style="background:${color}"></span>${glyph} ${esc(status)}</span>`;
+}
+
+function tile(k, v) {
+  return `<div class="tile"><div class="v">${v}</div><div class="k">${k}</div></div>`;
+}
+
+async function loadRuns() {
+  const status = $("#statusFilter").value;
+  const q = status ? `?status=${encodeURIComponent(status)}` : "";
+  const data = await api(`/api/v1/default/default/runs${q}`);
+  const rows = data.results || [];
+  const counts = {};
+  for (const r of rows) counts[r.status] = (counts[r.status] || 0) + 1;
+  $("#tiles").innerHTML =
+    tile("total", rows.length) +
+    ["running", "succeeded", "failed"].map(s => tile(s, counts[s] || 0)).join("");
+  $("#runs tbody").innerHTML = rows.map(r => `
+    <tr class="run" data-uuid="${esc(r.uuid)}">
+      <td><a class="uuid">${esc(String(r.uuid).slice(0, 12))}</a></td>
+      <td>${esc(r.name)}</td><td>${esc(r.kind)}</td><td>${esc(r.project)}</td>
+      <td>${pill(r.status)}</td>
+      <td class="num">${r.created_at ? new Date(r.created_at * 1000).toLocaleString() : ""}</td>
+    </tr>`).join("");
+  for (const tr of document.querySelectorAll("tr.run"))
+    tr.onclick = () => showRun(tr.dataset.uuid);
+}
+
+function lineChart(name, points) {
+  // Single series per chart: the title names it, so no legend box.
+  const W = 320, H = 150, P = {l: 42, r: 10, t: 8, b: 20};
+  const xs = points.map(p => p.step), ys = points.map(p => p.value);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  let y0 = Math.min(...ys), y1 = Math.max(...ys);
+  if (y0 === y1) { y0 -= 1; y1 += 1; }
+  const sx = s => P.l + (W - P.l - P.r) * (x1 === x0 ? 0.5 : (s - x0) / (x1 - x0));
+  const sy = v => H - P.b - (H - P.t - P.b) * ((v - y0) / (y1 - y0));
+  const fmt = v => Math.abs(v) >= 1000 ? v.toPrecision(4) : +v.toPrecision(3);
+  const grid = [0, 0.5, 1].map(f => {
+    const y = sy(y0 + f * (y1 - y0));
+    return `<line x1="${P.l}" y1="${y}" x2="${W - P.r}" y2="${y}" stroke="var(--grid)" stroke-width="1"/>
+            <text x="${P.l - 6}" y="${y + 4}" text-anchor="end" font-size="10" fill="var(--muted)">${fmt(y0 + f * (y1 - y0))}</text>`;
+  }).join("");
+  const path = points.map((p, i) => `${i ? "L" : "M"}${sx(p.step).toFixed(1)},${sy(p.value).toFixed(1)}`).join("");
+  const table = `<table><thead><tr><th>step</th><th>value</th></tr></thead><tbody>
+    ${points.map(p => `<tr><td class="num">${p.step}</td><td class="num">${fmt(p.value)}</td></tr>`).join("")}
+  </tbody></table>`;
+  return `<div class="chart" data-name="${esc(name)}">
+    <div class="tools"><button class="toTable">table</button></div>
+    <h3>${esc(name)}</h3>
+    <div class="sub">${points.length} points · last ${fmt(ys[ys.length - 1])}</div>
+    <svg viewBox="0 0 ${W} ${H}" data-points='${esc(JSON.stringify(points))}'
+         data-x0="${x0}" data-x1="${x1}" role="img" aria-label="${esc(name)} over steps">
+      ${grid}
+      <line x1="${P.l}" y1="${H - P.b}" x2="${W - P.r}" y2="${H - P.b}" stroke="var(--axis)" stroke-width="1"/>
+      <text x="${W - P.r}" y="${H - 6}" text-anchor="end" font-size="10" fill="var(--muted)">step ${x1}</text>
+      <path d="${path}" fill="none" stroke="var(--series-1)" stroke-width="2"
+            stroke-linejoin="round" stroke-linecap="round"/>
+      <line class="xhair" y1="${P.t}" y2="${H - P.b}" stroke="var(--axis)" stroke-width="1" visibility="hidden"/>
+      <circle class="dot" r="4" fill="var(--series-1)" stroke="var(--surface)" stroke-width="2" visibility="hidden"/>
+    </svg>
+    <div class="tbl">${table}</div>
+  </div>`;
+}
+
+function wireChart(el) {
+  $(".toTable", el).onclick = () => el.classList.toggle("show-table");
+  const svg = $("svg", el);
+  if (!svg) return;
+  const points = JSON.parse(svg.dataset.points);
+  const tooltip = $("#tooltip");
+  svg.addEventListener("mousemove", (ev) => {
+    const rect = svg.getBoundingClientRect();
+    const W = 320, P = {l: 42, r: 10, t: 8, b: 20};
+    const fx = (ev.clientX - rect.left) / rect.width * W;
+    const x0 = +svg.dataset.x0, x1 = +svg.dataset.x1;
+    const step = x0 + (fx - P.l) / (W - P.l - P.r) * (x1 - x0);
+    let best = points[0];
+    for (const p of points) if (Math.abs(p.step - step) < Math.abs(best.step - step)) best = p;
+    const ys = points.map(p => p.value);
+    let y0 = Math.min(...ys), y1 = Math.max(...ys);
+    if (y0 === y1) { y0 -= 1; y1 += 1; }
+    const sx = P.l + (W - P.l - P.r) * (x1 === x0 ? 0.5 : (best.step - x0) / (x1 - x0));
+    const sy = 150 - P.b - (150 - P.t - P.b) * ((best.value - y0) / (y1 - y0));
+    const xh = $(".xhair", svg), dot = $(".dot", svg);
+    xh.setAttribute("x1", sx); xh.setAttribute("x2", sx); xh.setAttribute("visibility", "visible");
+    dot.setAttribute("cx", sx); dot.setAttribute("cy", sy); dot.setAttribute("visibility", "visible");
+    tooltip.style.display = "block";
+    tooltip.style.left = (ev.clientX + 12) + "px";
+    tooltip.style.top = (ev.clientY - 10) + "px";
+    tooltip.textContent = `step ${best.step} · ${+best.value.toPrecision(4)}`;
+  });
+  svg.addEventListener("mouseleave", () => {
+    tooltip.style.display = "none";
+    $(".xhair", svg).setAttribute("visibility", "hidden");
+    $(".dot", svg).setAttribute("visibility", "hidden");
+  });
+}
+
+let logSource = null;
+async function showRun(uuid) {
+  const detail = $("#detail");
+  const [run, metrics] = await Promise.all([
+    api(`/api/v1/default/default/runs/${uuid}`),
+    api(`/api/v1/default/default/runs/${uuid}/metrics`).catch(() => ({})),
+  ]);
+  const charts = Object.entries(metrics)
+    .filter(([, pts]) => Array.isArray(pts) && pts.length)
+    .map(([name, pts]) => lineChart(name, pts)).join("");
+  detail.innerHTML = `
+    <h2 style="font-size:15px">${esc(run.name || run.uuid)} ${pill(run.status)}</h2>
+    <div class="charts">${charts || "<div class='sub' style='color:var(--muted)'>no metrics yet</div>"}</div>
+    <div id="logs" aria-label="run logs"></div>`;
+  for (const el of detail.querySelectorAll(".chart")) wireChart(el);
+  if (logSource) { logSource.close(); logSource = null; }
+  const logs = $("#logs");
+  logSource = new EventSource(`/streams/v1/default/default/runs/${uuid}/logs?follow=true`);
+  logSource.onmessage = (ev) => { logs.textContent += ev.data + "\n"; logs.scrollTop = logs.scrollHeight; };
+  logSource.addEventListener("done", () => { logSource.close(); logSource = null; });
+  detail.scrollIntoView({behavior: "smooth"});
+}
+
+$("#refresh").onclick = loadRuns;
+$("#statusFilter").onchange = loadRuns;
+$("#themeToggle").onclick = () => {
+  const root = document.documentElement;
+  const dark = getComputedStyle(document.body).colorScheme.includes("dark");
+  root.dataset.theme = dark ? "light" : "dark";
+};
+loadRuns();
+setInterval(loadRuns, 10000);
+</script>
+</body>
+</html>
+"""

@@ -654,9 +654,10 @@ class Scheduler:
                 tuner["spawned"] += 1
                 active_n += 1
                 actions += 1
-            tuner["pending_initial"] = pending
-            meta["tuner"] = tuner
-            self.store.update_run(record.uuid, meta=meta)
+            if actions:
+                tuner["pending_initial"] = pending
+                meta["tuner"] = tuner
+                self.store.update_run(record.uuid, meta=meta)
             return actions
 
         active = [c for c in children if not c.is_done]

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Callable
 
-from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, vit
+from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, t5, vit
 from polyaxon_tpu.models.common import ModelDef
 
 _FACTORIES: dict[str, Callable[..., ModelDef]] = {}
@@ -26,6 +26,8 @@ for _name in resnet.CONFIGS:
     _FACTORIES[_name] = (lambda n: lambda **kw: resnet.model_def(n, **kw))(_name)
 for _name in mnist.CONFIGS:
     _FACTORIES[_name] = (lambda n: lambda **kw: mnist.model_def(n, **kw))(_name)
+for _name in t5.CONFIGS:
+    _FACTORIES[_name] = (lambda n: lambda **kw: t5.model_def(n, **kw))(_name)
 
 
 def get_model(name: str, **overrides) -> ModelDef:

@@ -54,6 +54,19 @@ def scaled_init(rng, shape, dtype=jnp.float32, *, fan_in: Optional[int] = None):
     return truncated_normal_init(rng, shape, dtype, stddev=stddev)
 
 
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embeddings on [B, S, H, D] with fp32 trig (shared
+    by the Llama decoder and the T5-style decoder self-attention)."""
+    d_half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, d_half, dtype=jnp.float32) / d_half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, d_half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
     dtype = x.dtype
     x32 = x.astype(jnp.float32)

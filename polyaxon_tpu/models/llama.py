@@ -28,6 +28,7 @@ from polyaxon_tpu.models.common import (
     Variables,
     chunked_lm_loss,
     rms_norm,
+    rope,
     scaled_init,
     shift_right,
     truncated_normal_init,
@@ -124,16 +125,7 @@ def logical_axes(cfg: LlamaConfig) -> Variables:
     return {"params": params, "state": {}}
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embeddings on [B, S, H, D] with fp32 trig."""
-    d_half = x.shape[-1] // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, d_half, dtype=jnp.float32) / d_half))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, d_half]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return rotated.astype(x.dtype)
+_rope = rope  # shared impl (models.common.rope)
 
 
 def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) -> jax.Array:

@@ -1,0 +1,249 @@
+"""T5-style encoder-decoder seq2seq family (net-new model zoo surface;
+the reference ships no model math — SURVEY.md §2b delegates everything
+to user containers).
+
+TPU-first construction, consistent with the rest of the zoo:
+
+- encoder stack reuses ``models.encoder`` (stacked params + ``lax.scan``,
+  bf16 compute, fp32 norms/softmax);
+- decoder: pre-RMSNorm causal self-attention with RoPE (instead of T5's
+  relative-position buckets — rotary keeps the attention kernel shared
+  with the Llama/flash/ring paths and avoids a gather per layer),
+  cross-attention over encoder outputs, and a T5.1.1-style gated-GELU
+  FFN;
+- decoder lm-head loss goes through ``common.chunked_lm_loss`` so the
+  [B, S, V] logits tensor is never materialized;
+- logical axes on every param so the FSDP/TP rule tables place them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import encoder
+from polyaxon_tpu.models.common import (
+    Batch,
+    ModelDef,
+    Variables,
+    chunked_lm_loss,
+    rms_norm,
+    rope,
+    scaled_init,
+    shift_right,
+    truncated_normal_init,
+)
+from polyaxon_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32_128
+    dim: int = 768
+    n_layers: int = 12        # per stack (encoder and decoder)
+    n_heads: int = 12
+    ffn_dim: int = 2048
+    max_seq_len: int = 512
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: str = "none"
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def encoder_config(self) -> encoder.EncoderConfig:
+        return encoder.EncoderConfig(
+            dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
+            ffn_dim=self.ffn_dim, dtype=self.dtype, remat=self.remat,
+            attention_impl=self.attention_impl,
+        )
+
+
+CONFIGS: dict[str, T5Config] = {
+    "t5_base": T5Config(),
+    "t5_small": T5Config(dim=512, n_layers=6, n_heads=8, ffn_dim=1024),
+    "t5_tiny": T5Config(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        ffn_dim=128, max_seq_len=64),
+}
+
+
+def _init_decoder_layers(cfg: T5Config, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, 8)
+    L, D, F, H, Hd = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_heads, cfg.head_dim
+    return {
+        "self_norm": jnp.ones((L, D)),
+        "wq": scaled_init(keys[0], (L, D, H * Hd), fan_in=D),
+        "wk": scaled_init(keys[1], (L, D, H * Hd), fan_in=D),
+        "wv": scaled_init(keys[2], (L, D, H * Hd), fan_in=D),
+        "wo": scaled_init(keys[3], (L, H * Hd, D), fan_in=H * Hd),
+        "cross_norm": jnp.ones((L, D)),
+        "xq": scaled_init(keys[4], (L, D, H * Hd), fan_in=D),
+        "xkv": scaled_init(keys[5], (L, D, 2 * H * Hd), fan_in=D),
+        "xo": scaled_init(keys[6], (L, H * Hd, D), fan_in=H * Hd),
+        "mlp_norm": jnp.ones((L, D)),
+        "w_gate": scaled_init(keys[7], (L, D, F), fan_in=D),
+        "w_up": scaled_init(jax.random.fold_in(keys[7], 1), (L, D, F), fan_in=D),
+        "w_down": scaled_init(jax.random.fold_in(keys[7], 2), (L, F, D), fan_in=F),
+    }
+
+
+def _decoder_logical_axes() -> dict:
+    return {
+        "self_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "heads"),
+        "wv": ("layers", "embed", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "cross_norm": ("layers", "embed"),
+        "xq": ("layers", "embed", "heads"),
+        "xkv": ("layers", "embed", "heads"),
+        "xo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+
+
+def init(cfg: T5Config, rng: jax.Array) -> Variables:
+    keys = jax.random.split(rng, 4)
+    params = {
+        "embed": truncated_normal_init(keys[0], (cfg.vocab_size, cfg.dim)),
+        # The shared encoder block carries no positional information
+        # (BERT/ViT add their own before calling it) — without this the
+        # whole model is permutation-invariant in the input sequence.
+        "enc_pos": truncated_normal_init(
+            jax.random.fold_in(keys[1], 7), (cfg.max_seq_len, cfg.dim)),
+        "enc_layers": encoder.init_layers(cfg.encoder_config(), keys[1]),
+        "enc_norm": jnp.ones((cfg.dim,)),
+        "dec_layers": _init_decoder_layers(cfg, keys[2]),
+        "dec_norm": jnp.ones((cfg.dim,)),
+        "lm_head": truncated_normal_init(keys[3], (cfg.dim, cfg.vocab_size)),
+    }
+    return {"params": params, "state": {}}
+
+
+def logical_axes(cfg: T5Config) -> Variables:
+    return {
+        "params": {
+            "embed": ("vocab", "embed"),
+            "enc_pos": ("seq", "embed"),
+            "enc_layers": encoder.layers_logical_axes(),
+            "enc_norm": ("embed",),
+            "dec_layers": _decoder_logical_axes(),
+            "dec_norm": ("embed",),
+            "lm_head": ("embed", "vocab"),
+        },
+        "state": {},
+    }
+
+
+_rope = rope  # shared impl (models.common.rope)
+
+
+def _decoder_layer(cfg: T5Config, x: jax.Array, enc_out: jax.Array,
+                   layer: dict, positions: jax.Array) -> jax.Array:
+    B, S, D = x.shape
+    Se = enc_out.shape[1]
+    H, Hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    # Causal self-attention with RoPE.
+    h = rms_norm(x, layer["self_norm"], cfg.norm_eps)
+    q = _rope((h @ layer["wq"].astype(dt)).reshape(B, S, H, Hd),
+              positions, cfg.rope_theta)
+    k = _rope((h @ layer["wk"].astype(dt)).reshape(B, S, H, Hd),
+              positions, cfg.rope_theta)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, S, H, Hd)
+    attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
+
+    # Cross-attention over the encoder output (bidirectional, no RoPE —
+    # encoder positions carry no causal structure for the decoder).
+    h = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
+    q = (h @ layer["xq"].astype(dt)).reshape(B, S, H, Hd)
+    kv = enc_out @ layer["xkv"].astype(dt)
+    k, v = jnp.split(kv, 2, axis=-1)
+    k = k.reshape(B, Se, H, Hd)
+    v = v.reshape(B, Se, H, Hd)
+    attn = dot_product_attention(q, k, v, causal=False, impl="xla")
+    x = x + attn.reshape(B, S, H * Hd) @ layer["xo"].astype(dt)
+
+    # Gated-GELU FFN (T5.1.1 style).
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.gelu(h @ layer["w_gate"].astype(dt))
+    up = h @ layer["w_up"].astype(dt)
+    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    return x
+
+
+def encode(cfg: T5Config, params: dict, inputs: jax.Array) -> jax.Array:
+    """Input token ids [B, Se] → encoder states [B, Se, D]."""
+    dt = cfg.dtype
+    Se = inputs.shape[1]
+    x = params["embed"].astype(dt)[inputs] + params["enc_pos"].astype(dt)[None, :Se]
+    x = encoder.encode(cfg.encoder_config(), params["enc_layers"], x)
+    return rms_norm(x, params["enc_norm"], cfg.norm_eps)
+
+
+def decode_hidden(cfg: T5Config, params: dict, enc_out: jax.Array,
+                  targets_in: jax.Array) -> jax.Array:
+    """Decoder input ids [B, Sd] + encoder states → hidden [B, Sd, D]."""
+    dt = cfg.dtype
+    B, S = targets_in.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    x = params["embed"].astype(dt)[targets_in]
+
+    body = functools.partial(_decoder_layer, cfg)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+
+    def scan_body(carry, layer_params):
+        return body(carry, enc_out, layer_params, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["dec_layers"])
+    return rms_norm(x, params["dec_norm"], cfg.norm_eps)
+
+
+def forward(cfg: T5Config, params: dict, inputs: jax.Array,
+            targets_in: jax.Array) -> jax.Array:
+    """(input ids, decoder-input ids) → logits [B, Sd, vocab] fp32."""
+    enc_out = encode(cfg, params, inputs)
+    x = decode_hidden(cfg, params, enc_out, targets_in)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def apply(
+    cfg: T5Config,
+    variables: Variables,
+    batch: Batch,
+    train: bool = True,
+    rng: Optional[jax.Array] = None,
+):
+    inputs, targets = batch["inputs"], batch["targets"]
+    enc_out = encode(cfg, variables["params"], inputs)
+    x = decode_hidden(cfg, variables["params"], enc_out, shift_right(targets))
+    head = variables["params"]["lm_head"].astype(cfg.dtype)
+    loss, acc = chunked_lm_loss(x, head, targets, batch.get("mask"))
+    return loss, {"loss": loss, "accuracy": acc}, variables["state"]
+
+
+def model_def(name: str, **overrides) -> ModelDef:
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ModelDef(
+        name=name,
+        init=functools.partial(init, cfg),
+        apply=functools.partial(apply, cfg),
+        logical_axes=functools.partial(logical_axes, cfg),
+        unit="tokens",
+    )

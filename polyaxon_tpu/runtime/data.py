@@ -64,6 +64,16 @@ def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
         yield {"tokens": np.stack([tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
 
 
+def seq2seq_synthetic(batch_size: int, seq_len: int = 128, vocab_size: int = 32_000,
+                      seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+    """Copy task (targets == inputs): learnable through cross-attention,
+    so seq2seq training curves actually move."""
+    rng = _rng(seed)
+    while True:
+        tokens = rng.integers(2, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        yield {"inputs": tokens, "targets": tokens.copy()}
+
+
 def mlm_synthetic(batch_size: int, seq_len: int = 128, vocab_size: int = 30_522,
                   mask_rate: float = 0.15, mask_id: int = 103, seed: int = 0,
                   **_) -> Iterator[dict[str, np.ndarray]]:
@@ -99,6 +109,7 @@ def mnist_synthetic(batch_size: int, seed: int = 0, **_) -> Iterator[dict[str, n
 DATASETS: dict[str, Callable[..., Iterator[dict[str, np.ndarray]]]] = {
     "lm_synthetic": lm_synthetic,
     "lm_file": lm_file,
+    "seq2seq_synthetic": seq2seq_synthetic,
     "mlm_synthetic": mlm_synthetic,
     "imagenet_synthetic": image_synthetic,
     "image_synthetic": image_synthetic,
@@ -134,6 +145,8 @@ def shard_batches(
 def dataset_for_model(model_name: str) -> str:
     if model_name.startswith(("llama",)):
         return "lm_synthetic"
+    if model_name.startswith("t5"):
+        return "seq2seq_synthetic"
     if model_name.startswith("bert"):
         return "mlm_synthetic"
     if model_name.startswith(("vit", "resnet")):

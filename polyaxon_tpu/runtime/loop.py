@@ -41,9 +41,9 @@ class TrainResult:
 
 
 def _model_config_cls(model_name: str):
-    from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, vit
+    from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, t5, vit
 
-    for mod in (llama, moe, vit, bert, resnet, mnist):
+    for mod in (llama, moe, vit, bert, resnet, mnist, t5):
         if model_name in mod.CONFIGS:
             return type(mod.CONFIGS[model_name])
     raise ValueError(f"Unknown model `{model_name}`")
@@ -194,9 +194,9 @@ def run_jaxjob(
 
 
 def _get_cfg(model_name: str):
-    from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, vit
+    from polyaxon_tpu.models import bert, llama, mnist, moe, resnet, t5, vit
 
-    for mod in (llama, moe, vit, bert, resnet, mnist):
+    for mod in (llama, moe, vit, bert, resnet, mnist, t5):
         if model_name in mod.CONFIGS:
             return mod.CONFIGS[model_name]
     raise ValueError(f"Unknown model `{model_name}`")

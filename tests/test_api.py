@@ -62,6 +62,18 @@ class TestApi:
 
         assert client.version() == __version__
 
+    def test_dashboard_served(self, stack):
+        import urllib.request
+
+        _, server = stack
+        for path in ("/ui", "/"):
+            with urllib.request.urlopen(server.url + path, timeout=5) as resp:
+                assert resp.headers["Content-Type"].startswith("text/html")
+                html = resp.read().decode()
+            # Key surface markers: runs table, status filter, chart layer.
+            for marker in ("polyaxon_tpu", "statusFilter", "lineChart", "EventSource"):
+                assert marker in html, marker
+
     def test_prometheus_metrics(self, stack):
         import urllib.request
 

@@ -95,6 +95,75 @@ class TestLlama:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=3e-2)
 
 
+class TestT5:
+    def test_forward_and_init_loss(self):
+        from polyaxon_tpu.models import t5
+
+        cfg = t5.CONFIGS["t5_tiny"]
+        v = t5.init(cfg, jax.random.key(0))
+        inp = _tokens(jax.random.key(1), 2, 32, cfg.vocab_size)
+        tgt = _tokens(jax.random.key(2), 2, 32, cfg.vocab_size)
+        logits = t5.forward(cfg, v["params"], inp, tgt)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+        loss, metrics, _ = t5.apply(cfg, v, {"inputs": inp, "targets": tgt})
+        assert abs(float(loss) - math.log(cfg.vocab_size)) < 0.5
+
+    def test_cross_attention_sees_encoder(self):
+        """Different encoder inputs must change decoder logits (the
+        cross-attention path is live, not a no-op)."""
+        from polyaxon_tpu.models import t5
+
+        cfg = t5.CONFIGS["t5_tiny"]
+        v = t5.init(cfg, jax.random.key(0))
+        tgt = _tokens(jax.random.key(2), 1, 16, cfg.vocab_size)
+        a = t5.forward(cfg, v["params"], _tokens(jax.random.key(3), 1, 16, cfg.vocab_size), tgt)
+        b = t5.forward(cfg, v["params"], _tokens(jax.random.key(4), 1, 16, cfg.vocab_size), tgt)
+        assert float(jnp.abs(a - b).max()) > 1e-3
+
+    def test_encoder_is_order_sensitive(self):
+        """Permuting encoder input tokens must change decoder logits —
+        without encoder position embeddings the model is exactly
+        permutation-invariant (regression for the missing enc_pos)."""
+        from polyaxon_tpu.models import t5
+
+        cfg = t5.CONFIGS["t5_tiny"]
+        v = t5.init(cfg, jax.random.key(0))
+        inp = _tokens(jax.random.key(1), 1, 16, cfg.vocab_size)
+        tgt = _tokens(jax.random.key(2), 1, 16, cfg.vocab_size)
+        a = t5.forward(cfg, v["params"], inp, tgt)
+        b = t5.forward(cfg, v["params"], inp[:, ::-1], tgt)
+        assert float(jnp.abs(a - b).max()) > 1e-3
+
+    def test_grads_finite(self):
+        from polyaxon_tpu.models import t5
+
+        cfg = t5.CONFIGS["t5_tiny"]
+        v = t5.init(cfg, jax.random.key(0))
+        batch = {"inputs": _tokens(jax.random.key(1), 2, 16, cfg.vocab_size),
+                 "targets": _tokens(jax.random.key(2), 2, 16, cfg.vocab_size)}
+        grads = jax.grad(
+            lambda p: t5.apply(cfg, {"params": p, "state": {}}, batch)[0]
+        )(v["params"])
+        assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+
+    def test_runs_sharded_jaxjob(self, cpu_devices):
+        from polyaxon_tpu.polyflow import V1JAXJob
+        from polyaxon_tpu.runtime import run_jaxjob
+
+        job = V1JAXJob.from_dict({
+            "kind": "jaxjob",
+            "mesh": {"axes": {"dp": 2, "fsdp": 2, "tp": 2}},
+            "runtime": {"model": "t5_tiny", "dataset": "seq2seq_synthetic",
+                        "steps": 4, "global_batch_size": 8, "seq_len": 32,
+                        "learning_rate": 1e-3, "log_every": 100},
+        })
+        result = run_jaxjob(job)
+        assert result.steps == 4
+        assert result.unit == "tokens"
+        assert np.isfinite(result.final_metrics["loss"])
+
+
 class TestEncoderModels:
     def test_vit_forward(self):
         cfg = vit.CONFIGS["vit_tiny"]
