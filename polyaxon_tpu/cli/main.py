@@ -446,6 +446,28 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
         server.stop()
 
 
+# -------------------------------------------------------------------- serve
+@cli.command("serve")
+@click.option("--model", required=True, help="model zoo name, e.g. llama3_8b")
+@click.option("--checkpoint", default=None,
+              help="orbax checkpoint dir (a saved JAXJob train state)")
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8080)
+@click.option("--seed", default=0)
+def serve_cmd(model, checkpoint, host, port, seed):
+    """Serve a model for generation (KV-cache decode over HTTP)."""
+    from polyaxon_tpu.serving import ServingServer
+
+    server = ServingServer(model, checkpoint, host=host, port=port, seed=seed)
+    click.echo(f"serving {model} at {server.url}")
+    try:
+        server.httpd.serve_forever()  # foreground; no background thread
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.httpd.server_close()
+
+
 # -------------------------------------------------------------------- agent
 @cli.command("agent")
 @click.option("--poll", default=1.0)

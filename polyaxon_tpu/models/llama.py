@@ -349,16 +349,23 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy (temperature 0) or sampled continuation: [B, max_new]."""
+    """Greedy (temperature 0) or sampled continuation: [B, max_new].
+
+    ``temperature`` may be a traced scalar (the serving path passes it
+    as a jitted argument so sweeping temperatures reuses one
+    executable); the greedy/sampling choice itself is static — a Python
+    float 0.0 selects greedy, anything else selects sampling.
+    """
     B, P = prompt.shape
-    if temperature > 0 and rng is None:
+    sampling = isinstance(temperature, jax.Array) or temperature > 0
+    if sampling and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
     rng = rng if rng is not None else jax.random.key(0)
 
     logits, cache = prefill(cfg, params, prompt, P + max_new_tokens)
 
     def sample(logits, key):
-        if temperature > 0:
+        if sampling:
             return jax.random.categorical(key, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 

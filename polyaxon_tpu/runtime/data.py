@@ -40,70 +40,91 @@ def _rng(seed: int) -> np.random.Generator:
 
 
 def lm_synthetic(batch_size: int, seq_len: int = 2048, vocab_size: int = 32_000,
-                 seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+                 seed: int = 0, start_batch: int = 0,
+                 **_) -> Iterator[dict[str, np.ndarray]]:
     """Zipf-ish token stream — exercises the LM path with a realistic
-    skewed distribution (uniform tokens make CE flat)."""
-    rng = _rng(seed)
+    skewed distribution (uniform tokens make CE flat).
+
+    Batch ``i`` is a pure function of ``(seed, i)`` so checkpoint-resume
+    continues the stream exactly (``start_batch`` = restored step).
+    """
     ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
     probs = 1.0 / ranks
     probs /= probs.sum()
+    i = start_batch
     while True:
+        rng = np.random.default_rng((seed, i))
         yield {"tokens": rng.choice(vocab_size, size=(batch_size, seq_len), p=probs).astype(np.int32)}
+        i += 1
 
 
 def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
-            **_) -> Iterator[dict[str, np.ndarray]]:
-    """Memory-mapped token file: flat int32/int16 .npy of token ids."""
+            start_batch: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+    """Memory-mapped token file: flat int32/int16 .npy of token ids.
+    Batch ``i`` is a pure function of ``(seed, i)`` (resume-exact)."""
     if not path:
         raise ValueError("lm_file dataset requires `path`")
     tokens = np.load(path, mmap_mode="r")
     n = tokens.shape[0] - seq_len - 1
-    rng = _rng(seed)
+    i = start_batch
     while True:
+        rng = np.random.default_rng((seed, i))
         starts = rng.integers(0, n, size=(batch_size,))
         yield {"tokens": np.stack([tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
+        i += 1
 
 
 def seq2seq_synthetic(batch_size: int, seq_len: int = 128, vocab_size: int = 32_000,
-                      seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+                      seed: int = 0, start_batch: int = 0,
+                      **_) -> Iterator[dict[str, np.ndarray]]:
     """Copy task (targets == inputs): learnable through cross-attention,
-    so seq2seq training curves actually move."""
-    rng = _rng(seed)
+    so seq2seq training curves actually move. Resume-exact per batch."""
+    i = start_batch
     while True:
+        rng = np.random.default_rng((seed, i))
         tokens = rng.integers(2, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
         yield {"inputs": tokens, "targets": tokens.copy()}
+        i += 1
 
 
 def mlm_synthetic(batch_size: int, seq_len: int = 128, vocab_size: int = 30_522,
                   mask_rate: float = 0.15, mask_id: int = 103, seed: int = 0,
-                  **_) -> Iterator[dict[str, np.ndarray]]:
-    rng = _rng(seed)
+                  start_batch: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+    i = start_batch
     while True:
+        rng = np.random.default_rng((seed, i))
         tokens = rng.integers(5, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
         mask = rng.random((batch_size, seq_len)) < mask_rate
         labels = np.where(mask, tokens, -1).astype(np.int32)
         masked = np.where(mask, mask_id, tokens).astype(np.int32)
         yield {"tokens": masked, "labels": labels}
+        i += 1
 
 
 def image_synthetic(batch_size: int, image_size: int = 224, num_classes: int = 1000,
-                    seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
-    rng = _rng(seed)
+                    seed: int = 0, start_batch: int = 0,
+                    **_) -> Iterator[dict[str, np.ndarray]]:
+    i = start_batch
     while True:
+        rng = np.random.default_rng((seed, i))
         yield {
             "image": rng.standard_normal((batch_size, image_size, image_size, 3)).astype(np.float32),
             "label": rng.integers(0, num_classes, size=(batch_size,)).astype(np.int32),
         }
+        i += 1
 
 
-def mnist_synthetic(batch_size: int, seed: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
+def mnist_synthetic(batch_size: int, seed: int = 0, start_batch: int = 0,
+                    **_) -> Iterator[dict[str, np.ndarray]]:
     """Class-conditional blobs: learnable, so the quick-start converges."""
-    rng = _rng(seed)
-    protos = rng.standard_normal((10, 28, 28)).astype(np.float32)
+    protos = _rng(seed).standard_normal((10, 28, 28)).astype(np.float32)
+    i = start_batch
     while True:
+        rng = np.random.default_rng((seed, i))
         labels = rng.integers(0, 10, size=(batch_size,)).astype(np.int32)
         images = protos[labels] + 0.3 * rng.standard_normal((batch_size, 28, 28)).astype(np.float32)
         yield {"image": images[..., None], "label": labels}
+        i += 1
 
 
 DATASETS: dict[str, Callable[..., Iterator[dict[str, np.ndarray]]]] = {

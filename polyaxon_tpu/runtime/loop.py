@@ -104,8 +104,6 @@ def run_jaxjob(
 
     dataset_name = cfg.dataset or data_lib.dataset_for_model(cfg.model)
     ds_kwargs = _dataset_kwargs(cfg, model_cfg, per_host_batch)
-    host_iter = data_lib.get_dataset(dataset_name, **ds_kwargs)
-    batches = data_lib.shard_batches(host_iter, mesh, rules)
 
     optimizer = build_optimizer(cfg)
 
@@ -132,6 +130,12 @@ def run_jaxjob(
         units_per_step = global_batch * (seq if model_def.unit == "tokens" else 1)
 
         start_step = int(state["step"])
+        # Data streams are index-addressable (batch i = f(seed, i)), so a
+        # restored run resumes the stream at its step instead of replaying
+        # from batch 0 — the iterator is built only after restore.
+        host_iter = data_lib.get_dataset(dataset_name, start_batch=start_step,
+                                         **ds_kwargs)
+        batches = data_lib.shard_batches(host_iter, mesh, rules)
         if start_step >= cfg.steps:
             if ckpt:
                 ckpt.close()

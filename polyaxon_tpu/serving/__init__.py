@@ -1,0 +1,3 @@
+from polyaxon_tpu.serving.server import ServingServer, load_params
+
+__all__ = ["ServingServer", "load_params"]

@@ -1,0 +1,34 @@
+"""``python -m polyaxon_tpu.serving --model llama3_8b [--checkpoint d]``
+— the container command for a built-in V1Service run."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="polyaxon_tpu.serving")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--checkpoint", default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    from polyaxon_tpu.serving import ServingServer
+
+    with ServingServer(args.model, args.checkpoint,
+                       host=args.host, port=args.port, seed=args.seed) as s:
+        print(f"serving {args.model} at {s.url}", flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

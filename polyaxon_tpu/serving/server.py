@@ -1,0 +1,224 @@
+"""Built-in model-serving runtime for ``V1Service`` runs.
+
+The reference's service kind just exposes a user container's port
+(SURVEY.md §2 "Operator": Deployment+Service) — serving *content* is the
+user's problem. Here the framework owns a TPU-native serving path too:
+KV-cache prefill + decode (models.llama) behind a stdlib HTTP endpoint,
+so a Polyaxonfile service can run
+``python -m polyaxon_tpu.serving --model llama3_8b --checkpoint <dir>``
+with no user code.
+
+TPU-first details:
+- prompt lengths and generation budgets are bucketed to powers of two so
+  the jitted prefill/decode pair compiles a handful of shapes, not one
+  per request;
+- decode runs the whole budget under ``lax.scan`` (one compiled program
+  per bucket), then the host truncates;
+- weights load from an Orbax checkpoint (params tree) or fall back to
+  random init for smoke serving.
+
+API (JSON over HTTP):
+    GET  /healthz              → {"status": "ok", "model": name}
+    GET  /v1/models            → {"models": [name]}
+    POST /v1/generate          {"tokens": [[...]], "max_new_tokens": N,
+                                "temperature": T?, "seed": S?}
+                               → {"tokens": [[...]] }
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0):
+    """Model params: latest step of an Orbax checkpoint dir (a saved
+    JAXJob train state or a bare params tree), else random init."""
+    from polyaxon_tpu.models import llama
+
+    cfg = llama.CONFIGS[model]
+    variables = llama.init(cfg, jax.random.key(seed))
+    params = variables["params"]
+    if checkpoint:
+        import orbax.checkpoint as ocp
+
+        with ocp.CheckpointManager(checkpoint) as mgr:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint under {checkpoint}")
+            # Restore with the on-disk topology (no abstract): the saved
+            # tree is either a full JAXJob train state ({params,
+            # opt_state, step, state} — runtime.checkpoint layout) or a
+            # bare {params: ...}; slice out the params either way and
+            # validate against the model before serving.
+            restored = mgr.restore(step, args=ocp.args.StandardRestore())
+            loaded = restored.get("params", restored)
+            expect = jax.tree.structure(params)
+            got = jax.tree.structure(loaded)
+            if expect != got:
+                raise ValueError(
+                    f"checkpoint {checkpoint} step {step} does not match "
+                    f"model `{model}`: params tree structure differs")
+            params = jax.tree.map(
+                lambda ref, x: jnp.asarray(x, ref.dtype), params, loaded)
+            logger.info("restored %s step=%s", checkpoint, step)
+    return cfg, params
+
+
+class _Engine:
+    """Bucketed, jitted prefill+decode around models.llama.generate."""
+
+    def __init__(self, model: str, cfg, params):
+        self.model = model
+        self.cfg = cfg
+        self.params = params
+        self._lock = threading.Lock()  # one TPU program at a time
+
+        from polyaxon_tpu.models import llama
+
+        @functools.lru_cache(maxsize=16)
+        def compiled(prompt_len: int, max_new: int, sampling: bool):
+            # Temperature is a traced scalar, NOT part of the compile
+            # key — only the greedy/sampling mode switches programs, so
+            # a client sweeping temperatures reuses one executable.
+            def run(params, prompt, rng, temperature):
+                return llama.generate(
+                    self.cfg, params, prompt, max_new_tokens=max_new,
+                    temperature=temperature if sampling else 0.0, rng=rng)
+
+            return jax.jit(run)
+
+        self._compiled = compiled
+
+    def generate(self, token_rows: list[list[int]], max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0) -> list[list[int]]:
+        if not token_rows:
+            return []
+        if min(len(r) for r in token_rows) < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        sampling = temperature > 0
+        n_bucket = _bucket(max_new_tokens, lo=16)
+        # Rows are grouped by EXACT prompt length — padding a causal
+        # prompt (either side) changes what the real tokens attend to,
+        # so correctness wins over a shared bucket; the generation
+        # budget is still bucketed, so the compile count is
+        # O(distinct prompt lengths × budgets), LRU-bounded.
+        groups: dict[int, list[int]] = {}
+        for i, row in enumerate(token_rows):
+            groups.setdefault(len(row), []).append(i)
+        results: list[Optional[list[int]]] = [None] * len(token_rows)
+        for plen, idxs in groups.items():
+            if plen + n_bucket > self.cfg.max_seq_len:
+                raise ValueError(
+                    f"prompt {plen} + generation budget {n_bucket} exceeds "
+                    f"max_seq_len {self.cfg.max_seq_len}")
+            batch = np.asarray([token_rows[i] for i in idxs], np.int32)
+            fn = self._compiled(plen, n_bucket, sampling)
+            with self._lock:
+                out = np.asarray(fn(self.params, jnp.asarray(batch),
+                                    jax.random.key(seed),
+                                    jnp.float32(temperature)))
+            for j, i in enumerate(idxs):
+                results[i] = out[j, :max_new_tokens].tolist()
+        return results  # type: ignore[return-value]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine: _Engine
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload: Any, status: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            return self._json({"status": "ok", "model": self.engine.model})
+        if self.path == "/v1/models":
+            return self._json({"models": [self.engine.model]})
+        return self._json({"error": f"no route {self.path}"}, status=404)
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/v1/generate":
+            return self._json({"error": f"no route {self.path}"}, status=404)
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = json.loads(self.rfile.read(length).decode() or "{}")
+            tokens = req["tokens"]
+            if (not isinstance(tokens, list)
+                    or not all(isinstance(r, list) and r for r in tokens)):
+                raise ValueError("`tokens` must be a non-empty list of "
+                                 "non-empty token-id lists")
+            out = self.engine.generate(
+                tokens,
+                max_new_tokens=int(req.get("max_new_tokens", 32)),
+                temperature=float(req.get("temperature", 0.0)),
+                seed=int(req.get("seed", 0)),
+            )
+            return self._json({"tokens": out})
+        except (KeyError, ValueError, TypeError) as exc:
+            return self._json({"error": str(exc)}, status=400)
+        except Exception as exc:  # pragma: no cover
+            return self._json({"error": f"{type(exc).__name__}: {exc}"},
+                              status=500)
+
+
+class ServingServer:
+    """``with ServingServer("llama_tiny") as s: requests → s.url``"""
+
+    def __init__(self, model: str, checkpoint: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0, seed: int = 0):
+        cfg, params = load_params(model, checkpoint, seed=seed)
+        self.engine = _Engine(model, cfg, params)
+        handler = type("BoundHandler", (_Handler,), {"engine": self.engine})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info("serving %s at %s", self.engine.model, self.url)
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
