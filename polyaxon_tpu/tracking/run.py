@@ -90,6 +90,74 @@ class Run:
     def log_curve(self, name: str, x: list, y: list, step: Optional[int] = None) -> None:
         self._events.write(V1EventKind.CURVE, name, {"step": step, "x": list(x), "y": list(y)})
 
+    def log_html(self, name: str, html: str, step: Optional[int] = None) -> None:
+        self._events.write(V1EventKind.HTML, name, {"step": step, "html": html})
+
+    def _asset_path(self, group: str, rel: str) -> str:
+        """Asset file path under the run tree; creates parent dirs so
+        slash-namespaced names ('eval/sample') work like event names."""
+        dest = os.path.join(self.artifacts_dir, "assets", group, rel)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        return dest
+
+    def _asset_tag(self, step: Optional[int]) -> str:
+        """Unique filename suffix: the step when given, else a
+        monotonically increasing counter (no silent overwrites)."""
+        if step is not None:
+            return str(step)
+        self._asset_seq = getattr(self, "_asset_seq", -1) + 1
+        return f"u{self._asset_seq}"
+
+    def log_image(self, name: str, image: Any, step: Optional[int] = None) -> str:
+        """Array ([H,W] / [H,W,{1,3,4}]; float in 0-1 or integer in
+        0-255) or an existing file path → PNG asset + image event."""
+        import numpy as _np
+
+        tag = self._asset_tag(step)
+        if isinstance(image, (str, os.PathLike)):
+            base = os.path.basename(str(image))
+            dest = self._asset_path("images", f"{name}-{tag}-{base}")
+            shutil.copy2(image, dest)
+        else:
+            from PIL import Image as _Image
+
+            arr = _np.asarray(image)
+            if arr.dtype != _np.uint8:
+                if _np.issubdtype(arr.dtype, _np.integer):
+                    arr = _np.clip(arr, 0, 255).astype(_np.uint8)
+                else:
+                    arr = (_np.clip(arr, 0.0, 1.0) * 255).astype(_np.uint8)
+            if arr.ndim == 3 and arr.shape[-1] == 1:
+                arr = arr[..., 0]
+            dest = self._asset_path("images", f"{name}-{tag}.png")
+            _Image.fromarray(arr).save(dest)
+        self._events.write(V1EventKind.IMAGE, name, {"step": step, "path": dest})
+        return dest
+
+    def log_histogram(self, name: str, values: Any, *, bins: int = 30,
+                      step: Optional[int] = None) -> None:
+        import numpy as _np
+
+        counts, edges = _np.histogram(_np.asarray(values).ravel(), bins=bins)
+        self._events.write(V1EventKind.HISTOGRAM, name, {
+            "step": step, "counts": counts.tolist(), "edges": edges.tolist()})
+
+    def log_confusion_matrix(self, name: str, labels: list, matrix: Any,
+                             step: Optional[int] = None) -> None:
+        import numpy as _np
+
+        self._events.write(V1EventKind.CONFUSION, name, {
+            "step": step, "labels": list(labels),
+            "matrix": _np.asarray(matrix).tolist()})
+
+    def log_dataframe(self, name: str, df: Any, step: Optional[int] = None) -> str:
+        """A pandas DataFrame (or anything with ``to_csv``) → CSV asset +
+        dataframe event."""
+        dest = self._asset_path("dataframes", f"{name}-{self._asset_tag(step)}.csv")
+        df.to_csv(dest, index=False)
+        self._events.write(V1EventKind.DATAFRAME, name, {"step": step, "path": dest})
+        return dest
+
     # -- outputs/lineage ---------------------------------------------------
     def log_outputs(self, **outputs: Any) -> None:
         current: dict[str, Any] = {}

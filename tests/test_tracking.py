@@ -38,6 +38,43 @@ class TestRun:
             run.log_metrics(loss=0.9)
         assert [e["step"] for e in read_events(rd, "metric", "loss")] == [1, 2]
 
+    def test_rich_event_helpers(self, tmp_path):
+        """Image/histogram/confusion/html/dataframe events (traceml
+        parity surface) produce assets + typed jsonl records."""
+        import numpy as np
+
+        rd = str(tmp_path / "rich")
+        with Run("rich", rd) as run:
+            img_path = run.log_image("sample", np.zeros((8, 8, 3)), step=1)
+            assert img_path.endswith(".png") and os.path.exists(img_path)
+            # Namespaced names and repeated unstepped logs must not
+            # collide or overwrite.
+            nested = run.log_image("eval/sample", np.full((4, 4), 200, np.int32))
+            nested2 = run.log_image("eval/sample", np.zeros((4, 4), np.uint8))
+            assert os.path.exists(nested) and nested != nested2
+            # Integer arrays keep their 0-255 scale (not clipped to 0/1).
+            from PIL import Image
+            assert np.asarray(Image.open(nested)).max() == 200
+            run.log_histogram("weights", np.random.default_rng(0).normal(size=100),
+                              bins=10, step=1)
+            run.log_confusion_matrix("cm", ["a", "b"], [[3, 1], [0, 4]], step=1)
+            run.log_html("report", "<b>done</b>")
+
+            class FakeDf:
+                def to_csv(self, path, index=False):
+                    with open(path, "w") as fh:
+                        fh.write("a,b\n1,2\n")
+
+            csv_path = run.log_dataframe("table", FakeDf())
+            assert os.path.exists(csv_path)
+
+        hist = read_events(rd, "histogram", "weights")[0]
+        assert sum(hist["counts"]) == 100 and len(hist["edges"]) == 11
+        cm = read_events(rd, "confusion", "cm")[0]
+        assert cm["matrix"] == [[3, 1], [0, 4]] and cm["labels"] == ["a", "b"]
+        assert read_events(rd, "image", "sample")[0]["path"] == img_path
+        assert "<b>" in read_events(rd, "html", "report")[0]["html"]
+
     def test_outputs_merge_atomic(self, tmp_path):
         rd = str(tmp_path / "r3")
         with Run("r3", rd) as run:
