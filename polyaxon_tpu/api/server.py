@@ -232,6 +232,10 @@ class _Handler(BaseHTTPRequestHandler):
         if action == "metrics":
             names = query.get("names")
             return self._json(plane.streams.get_metrics(uuid, names))
+        if action == "events":
+            kind = (query.get("kind") or ["metric"])[0]
+            names = query.get("names")
+            return self._json(plane.streams.get_events(uuid, kind, names))
         if action == "outputs":
             return self._json(plane.streams.get_outputs(uuid))
         if action == "artifacts":

@@ -258,6 +258,22 @@ def ops_metrics(uid, names):
     click.echo(json.dumps(metrics, indent=2, default=str))
 
 
+def _event_kind_choice():
+    from polyaxon_tpu.tracking.events import V1EventKind
+
+    return click.Choice(sorted(V1EventKind.VALUES))
+
+
+@ops.command("events")
+@click.option("-uid", "--uid", required=True)
+@click.option("--kind", default="metric", type=_event_kind_choice())
+@click.option("--name", "names", multiple=True)
+def ops_events(uid, kind, names):
+    plane = get_plane()
+    events = plane.streams.get_events(uid, kind, list(names) or None)
+    click.echo(json.dumps(events, indent=2, default=str))
+
+
 @ops.command("stop")
 @click.option("-uid", "--uid", required=True)
 def ops_stop(uid):

@@ -166,6 +166,13 @@ class RunClient:
                 f"names={urllib.parse.quote(n)}" for n in names)
         return self.client.get(self._run_path("/metrics") + suffix)
 
+    def get_events(self, kind: str = "metric",
+                   names: Optional[list[str]] = None) -> dict:
+        """Typed event streams (image/histogram/curve/confusion/...)."""
+        params = [f"kind={urllib.parse.quote(kind)}"]
+        params += [f"names={urllib.parse.quote(n)}" for n in (names or [])]
+        return self.client.get(self._run_path("/events") + "?" + "&".join(params))
+
     def get_outputs(self) -> dict:
         return self.client.get(self._run_path("/outputs"))
 

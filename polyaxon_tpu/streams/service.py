@@ -44,7 +44,19 @@ class StreamsService:
 
     def get_events(self, run_uuid: str, kind: str,
                    names: Optional[list[str]] = None) -> dict[str, list[dict]]:
+        from polyaxon_tpu.tracking.events import V1EventKind
+
+        if kind not in V1EventKind.VALUES:
+            raise ValueError(
+                f"unknown event kind `{kind}`; one of {sorted(V1EventKind.VALUES)}")
         rd = self.run_dir(run_uuid)
+        root = os.path.abspath(os.path.join(rd, "events", kind))
+        for name in names or []:
+            # Names may be slash-namespaced but must stay inside the
+            # kind dir (same guard as artifact_path).
+            path = os.path.abspath(os.path.join(root, name))
+            if not path.startswith(root + os.sep):
+                raise ValueError(f"event name escapes the run dir: {name}")
         names = names or list_event_names(rd, kind)
         return {name: read_events(rd, kind, name) for name in names}
 

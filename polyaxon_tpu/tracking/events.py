@@ -99,10 +99,20 @@ def read_events(run_dir: str, kind: str, name: str,
 
 
 def list_event_names(run_dir: str, kind: str) -> list[str]:
+    """All event names of a kind, recursively — slash-namespaced names
+    ('eval/sample') live in nested dirs and are returned with their
+    relative path as the name."""
     root = os.path.join(run_dir, "events", kind)
     if not os.path.isdir(root):
         return []
-    return sorted(f[:-6] for f in os.listdir(root) if f.endswith(".jsonl"))
+    names = []
+    for dirpath, _, files in os.walk(root):
+        rel = os.path.relpath(dirpath, root)
+        for f in files:
+            if f.endswith(".jsonl"):
+                name = f[:-6] if rel == "." else f"{rel}/{f[:-6]}"
+                names.append(name.replace(os.sep, "/"))
+    return sorted(names)
 
 
 def tail_file(path: str, offset: int = 0) -> tuple[str, int]:

@@ -131,7 +131,11 @@ class Run:
                 arr = arr[..., 0]
             dest = self._asset_path("images", f"{name}-{tag}.png")
             _Image.fromarray(arr).save(dest)
-        self._events.write(V1EventKind.IMAGE, name, {"step": step, "path": dest})
+        # Events record the run-relative path: remote consumers compose it
+        # with the artifact endpoints; the producer-local absolute path is
+        # meaningless off-host.
+        self._events.write(V1EventKind.IMAGE, name, {
+            "step": step, "path": os.path.relpath(dest, self.artifacts_dir)})
         return dest
 
     def log_histogram(self, name: str, values: Any, *, bins: int = 30,
@@ -155,7 +159,8 @@ class Run:
         dataframe event."""
         dest = self._asset_path("dataframes", f"{name}-{self._asset_tag(step)}.csv")
         df.to_csv(dest, index=False)
-        self._events.write(V1EventKind.DATAFRAME, name, {"step": step, "path": dest})
+        self._events.write(V1EventKind.DATAFRAME, name, {
+            "step": step, "path": os.path.relpath(dest, self.artifacts_dir)})
         return dest
 
     # -- outputs/lineage ---------------------------------------------------

@@ -111,6 +111,39 @@ class TestApi:
         dest = run.download_artifact(rel, str(tmp_path / "score.jsonl"))
         assert "0.04" in open(dest).read()
 
+    def test_typed_events_endpoint(self, stack):
+        """Rich event kinds (histogram here) flow from in-run tracking
+        through streams to the /events route and RunClient.get_events."""
+        import textwrap
+
+        _, server = stack
+        run = RunClient(host=server.url)
+        script = textwrap.dedent(
+            """
+            import os
+            from polyaxon_tpu.tracking import Run
+            d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+            with Run(os.environ["POLYAXON_RUN_UUID"], d) as r:
+                r.log_histogram("w", [1, 1, 2, 3], bins=3, step=1)
+                r.log_text("note", "hello")
+            """
+        ).strip()
+        run.create({"kind": "component", "run": {
+            "kind": "job", "container": {"command": ["python", "-c", script]}}})
+        assert run.wait(timeout=60) == V1Statuses.SUCCEEDED
+        hist = run.get_events(kind="histogram")["w"]
+        assert sum(hist[0]["counts"]) == 4
+        text = run.get_events(kind="text", names=["note"])["note"]
+        assert text[0]["text"] == "hello"
+        # Unknown kinds and traversal attempts are 400s, not file reads.
+        from polyaxon_tpu.client.client import ApiClientError
+
+        for bad in ({"kind": "histgram"},
+                    {"kind": "metric", "names": ["../../outputs"]}):
+            with pytest.raises(ApiClientError) as err:
+                run.get_events(**bad)
+            assert err.value.status == 400
+
     def test_list_runs_and_filters(self, stack):
         _, server = stack
         client = PolyaxonClient(server.url)

@@ -53,6 +53,21 @@ class TestRunAndOps:
         result = runner.invoke(cli, ["ops", "statuses", "-uid", uid])
         assert "created" in result.output
 
+    def test_ops_events(self, runner, tmp_path):
+        result = runner.invoke(cli, ["run", "-f", FIXTURE])
+        uid = result.output.split("Run created: ")[1].split()[0]
+        # Write a typed event into the run's artifacts dir directly.
+        from polyaxon_tpu.cli.main import get_plane
+
+        rd = get_plane().streams.run_dir(uid)
+        os.makedirs(os.path.join(rd, "events", "histogram"), exist_ok=True)
+        with open(os.path.join(rd, "events", "histogram", "w.jsonl"), "w") as fh:
+            fh.write(json.dumps({"step": 1, "counts": [2, 2], "edges": [0, 1, 2]}) + "\n")
+        result = runner.invoke(cli, ["ops", "events", "-uid", uid,
+                                     "--kind", "histogram"])
+        assert result.exit_code == 0, result.output
+        assert json.loads(result.output)["w"][0]["counts"] == [2, 2]
+
     def test_projects(self, runner):
         assert runner.invoke(cli, ["projects", "create", "--name", "p9"]).exit_code == 0
         result = runner.invoke(cli, ["projects", "ls"])

@@ -72,8 +72,12 @@ class TestRun:
         assert sum(hist["counts"]) == 100 and len(hist["edges"]) == 11
         cm = read_events(rd, "confusion", "cm")[0]
         assert cm["matrix"] == [[3, 1], [0, 4]] and cm["labels"] == ["a", "b"]
-        assert read_events(rd, "image", "sample")[0]["path"] == img_path
+        # Events carry run-relative asset paths (portable off-host).
+        rel = read_events(rd, "image", "sample")[0]["path"]
+        assert not os.path.isabs(rel) and os.path.join(rd, rel) == img_path
         assert "<b>" in read_events(rd, "html", "report")[0]["html"]
+        # Namespaced names are listed recursively.
+        assert "eval/sample" in list_event_names(rd, "image")
 
     def test_outputs_merge_atomic(self, tmp_path):
         rd = str(tmp_path / "r3")
