@@ -8,6 +8,8 @@ from typing import Optional
 
 import click
 
+from polyaxon_tpu.tracking.events import V1EventKind as _V1EventKind
+
 DEFAULT_HOME = os.path.join(os.path.expanduser("~"), ".polyaxon_tpu")
 
 
@@ -258,15 +260,10 @@ def ops_metrics(uid, names):
     click.echo(json.dumps(metrics, indent=2, default=str))
 
 
-def _event_kind_choice():
-    from polyaxon_tpu.tracking.events import V1EventKind
-
-    return click.Choice(sorted(V1EventKind.VALUES))
-
-
 @ops.command("events")
 @click.option("-uid", "--uid", required=True)
-@click.option("--kind", default="metric", type=_event_kind_choice())
+@click.option("--kind", default="metric",
+              type=click.Choice(sorted(_V1EventKind.VALUES)))
 @click.option("--name", "names", multiple=True)
 def ops_events(uid, kind, names):
     plane = get_plane()

@@ -393,8 +393,18 @@ def compile_operation(
     elif kind in (V1RunKind.TFJOB, V1RunKind.PYTORCHJOB, V1RunKind.MPIJOB,
                   V1RunKind.RAYJOB, V1RunKind.DASKJOB):
         resources, processes = _compile_kubeflow(run, kind, plan_args, env_base)
-    elif kind == V1RunKind.JOB or kind == V1RunKind.NOTIFIER or kind == V1RunKind.CLEANER:
+    elif kind in (V1RunKind.JOB, V1RunKind.NOTIFIER, V1RunKind.CLEANER,
+                  V1RunKind.WATCHDOG):
         resources, processes = _compile_job(run, plan_args, env_base)
+        interval = getattr(run, "interval_seconds", None)
+        if kind == V1RunKind.WATCHDOG and interval:
+            # Re-execute on the interval until stopped (utils.watchloop);
+            # a failing iteration fails the run.
+            for proc in processes:
+                proc.command = [
+                    "python", "-m", "polyaxon_tpu.utils.watchloop",
+                    str(interval), "--", *proc.command,
+                ]
     elif kind == V1RunKind.SERVICE:
         resources, processes = _compile_job(run, plan_args, env_base, service=True)
     else:

@@ -63,6 +63,7 @@ from polyaxon_tpu.polyflow.runs import (
     V1Service,
     V1TFJob,
     V1Tuner,
+    V1WatchdogJob,
 )
 from polyaxon_tpu.polyflow.schedules import (
     V1CronSchedule,

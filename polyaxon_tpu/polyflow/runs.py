@@ -316,7 +316,18 @@ class V1CleanerJob(_BaseRun):
     container: Optional[V1Container] = None
 
 
+class V1WatchdogJob(_BaseRun):
+    """Agent-side auxiliary (upstream's watchdog kind): a job-like run
+    that monitors cluster/run health on an interval."""
+
+    kind: Literal["watchdog"] = "watchdog"
+    connections: Optional[list[str]] = None
+    container: Optional[V1Container] = None
+    interval_seconds: Optional[int] = None
+
+
 RunSpec = Union[
     V1Job, V1Service, V1JAXJob, V1TFJob, V1PyTorchJob, V1MPIJob,
     V1RayJob, V1DaskJob, V1Dag, V1Tuner, V1NotifierJob, V1CleanerJob,
+    V1WatchdogJob,
 ]

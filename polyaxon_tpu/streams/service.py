@@ -50,13 +50,8 @@ class StreamsService:
             raise ValueError(
                 f"unknown event kind `{kind}`; one of {sorted(V1EventKind.VALUES)}")
         rd = self.run_dir(run_uuid)
-        root = os.path.abspath(os.path.join(rd, "events", kind))
-        for name in names or []:
-            # Names may be slash-namespaced but must stay inside the
-            # kind dir (same guard as artifact_path).
-            path = os.path.abspath(os.path.join(root, name))
-            if not path.startswith(root + os.sep):
-                raise ValueError(f"event name escapes the run dir: {name}")
+        # Traversal in user-supplied names is rejected inside read_events
+        # (tracking.events.safe_subpath) — the guard covers metrics too.
         names = names or list_event_names(rd, kind)
         return {name: read_events(rd, kind, name) for name in names}
 
@@ -68,7 +63,10 @@ class StreamsService:
         return sorted(os.listdir(root))
 
     def read_logs(self, run_uuid: str, name: str = "main.log", offset: int = 0) -> tuple[str, int]:
-        return tail_file(os.path.join(self.run_dir(run_uuid), "logs", name), offset)
+        from polyaxon_tpu.tracking.events import safe_subpath
+
+        root = os.path.join(self.run_dir(run_uuid), "logs")
+        return tail_file(safe_subpath(root, name), offset)
 
     def follow_logs(
         self, run_uuid: str, name: str = "main.log", *,

@@ -77,9 +77,20 @@ class EventWriter:
         self._handles.clear()
 
 
+def safe_subpath(root: str, rel: str) -> str:
+    """Join a (possibly namespaced) user-supplied name under ``root``,
+    rejecting absolute paths and ``..`` escapes. The single guard every
+    read path (events, metrics, logs) funnels through."""
+    path = os.path.abspath(os.path.join(root, rel))
+    root = os.path.abspath(root)
+    if not path.startswith(root + os.sep):
+        raise ValueError(f"name escapes its directory: {rel!r}")
+    return path
+
+
 def read_events(run_dir: str, kind: str, name: str,
                 since_step: Optional[int] = None) -> list[dict[str, Any]]:
-    path = os.path.join(run_dir, "events", kind, f"{name}.jsonl")
+    path = safe_subpath(os.path.join(run_dir, "events", kind), f"{name}.jsonl")
     if not os.path.exists(path):
         return []
     out = []

@@ -1,0 +1,45 @@
+"""Interval re-execution wrapper for watchdog runs.
+
+``python -m polyaxon_tpu.utils.watchloop <interval_seconds> -- cmd ...``
+runs the command, sleeps, and repeats until SIGTERM/SIGINT (the
+executor's stop path). A failing iteration ends the loop with the
+child's exit code so the run transitions to failed.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+import time
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 3 or argv[1] != "--":
+        print("usage: watchloop <interval_seconds> -- cmd ...", file=sys.stderr)
+        return 2
+    interval = float(argv[0])
+    cmd = argv[2:]
+
+    stopping = False
+
+    def _stop(signum, frame):
+        nonlocal stopping
+        stopping = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    while not stopping:
+        proc = subprocess.run(cmd)
+        if proc.returncode != 0:
+            return proc.returncode
+        # Sleep in small increments so a stop signal lands promptly.
+        deadline = time.monotonic() + interval
+        while not stopping and time.monotonic() < deadline:
+            time.sleep(min(0.5, max(deadline - time.monotonic(), 0.01)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

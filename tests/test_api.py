@@ -143,6 +143,10 @@ class TestApi:
             with pytest.raises(ApiClientError) as err:
                 run.get_events(**bad)
             assert err.value.status == 400
+        # The guard lives in read_events, so /metrics is covered too.
+        with pytest.raises(ApiClientError) as err:
+            run.get_metrics(names=["../../outputs"])
+        assert err.value.status == 400
 
     def test_list_runs_and_filters(self, stack):
         _, server = stack

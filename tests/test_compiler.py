@@ -65,6 +65,28 @@ class TestJaxjobPlan:
         assert [p.kind for p in plan.init][:1] == ["auth"]
 
 
+class TestWatchdogKind:
+    def test_watchdog_interval_wraps_in_watchloop(self):
+        plan = _compile({
+            "kind": "component",
+            "run": {"kind": "watchdog", "intervalSeconds": 30,
+                    "container": {"command": ["python", "-c", "print('wd')"]}},
+        })
+        assert plan.run_kind == "watchdog"
+        cmd = plan.processes[0].command
+        assert cmd[:3] == ["python", "-m", "polyaxon_tpu.utils.watchloop"]
+        assert cmd[3] == "30" and cmd[-1] == "print('wd')"
+
+    def test_watchdog_without_interval_runs_once(self):
+        plan = _compile({
+            "kind": "component",
+            "run": {"kind": "watchdog",
+                    "container": {"command": ["python", "-c", "print('wd')"]}},
+        })
+        assert plan.processes[0].command[-1] == "print('wd')"
+        assert "watchloop" not in " ".join(plan.processes[0].command[:3])
+
+
 class TestKubeflowPlans:
     def test_tfjob_tf_config(self):
         plan = _compile("tests/fixtures/resnet_tfjob.yaml")
