@@ -54,11 +54,40 @@ def scaled_init(rng, shape, dtype=jnp.float32, *, fan_in: Optional[int] = None):
     return truncated_normal_init(rng, shape, dtype, stddev=stddev)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def rope_frequencies(d_half: int, theta: float,
+                     scaling: Optional[dict] = None) -> jax.Array:
+    """Inverse RoPE frequencies, optionally Llama-3.1-style scaled for
+    context extension: low-frequency bands are stretched by ``factor``,
+    high-frequency bands kept, and the transition smoothed — the
+    public "llama3" rope_scaling rule.
+
+    ``scaling``: {"factor": 8, "low_freq_factor": 1,
+                  "high_freq_factor": 4,
+                  "original_max_position_embeddings": 8192}
+    """
+    freqs = 1.0 / (theta ** (jnp.arange(0, d_half, dtype=jnp.float32) / d_half))
+    if not scaling:
+        return freqs
+    factor = float(scaling.get("factor", 8.0))
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling.get("original_max_position_embeddings", 8192))
+    wavelen = 2.0 * jnp.pi / freqs
+    # Per-band rule: long wavelengths (beyond orig/low) are scaled down
+    # by `factor`; short ones (below orig/high) untouched; in between,
+    # linearly interpolated in "smooth" space.
+    smooth = (orig / wavelen - low) / (high - low)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = freqs / factor
+    return (1.0 - smooth) * scaled + smooth * freqs
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         scaling: Optional[dict] = None) -> jax.Array:
     """Rotary position embeddings on [B, S, H, D] with fp32 trig (shared
     by the Llama decoder and the T5-style decoder self-attention)."""
     d_half = x.shape[-1] // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, d_half, dtype=jnp.float32) / d_half))
+    freqs = rope_frequencies(d_half, theta, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, d_half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]

@@ -46,6 +46,10 @@ class LlamaConfig:
     ffn_dim: int = 14_336
     max_seq_len: int = 8192
     rope_theta: float = 500_000.0
+    # Llama-3.1-style context-extension scaling (common.rope_frequencies):
+    # {"factor", "low_freq_factor", "high_freq_factor",
+    #  "original_max_position_embeddings"} or None.
+    rope_scaling: Optional[dict] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
@@ -63,8 +67,16 @@ class LlamaConfig:
 
 # Named configs. llama3_8b matches the Llama-3-8B architecture; the
 # smaller ones are proxies for single-chip benchmarking and tests.
+_LLAMA31_SCALING = {
+    "factor": 8.0, "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 8192,
+}
+
 CONFIGS: dict[str, LlamaConfig] = {
     "llama3_8b": LlamaConfig(),
+    # Llama-3.1 8B: 128k context via scaled RoPE (public rope_scaling rule).
+    "llama31_8b": LlamaConfig(max_seq_len=131_072,
+                              rope_scaling=_LLAMA31_SCALING),
     "llama3_1b": LlamaConfig(
         vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         ffn_dim=8192, max_seq_len=8192,
@@ -137,8 +149,8 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) ->
     q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, Hd)
     k = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, Hd)
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
     x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
 
@@ -269,8 +281,8 @@ def decode_step(
         q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
         k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
         v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
@@ -318,8 +330,8 @@ def prefill(
         q = (h @ layer["wq"].astype(dt)).reshape(B, P, H, Hd)
         k = (h @ layer["wk"].astype(dt)).reshape(B, P, KV, Hd)
         v = (h @ layer["wv"].astype(dt)).reshape(B, P, KV, Hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = dot_product_attention(q, k, v, causal=True,
                                      impl=cfg.attention_impl)
         x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)

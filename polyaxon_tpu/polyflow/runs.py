@@ -325,6 +325,13 @@ class V1WatchdogJob(_BaseRun):
     container: Optional[V1Container] = None
     interval_seconds: Optional[int] = None
 
+    @field_validator("interval_seconds")
+    @classmethod
+    def _check_interval(cls, v):
+        if v is not None and v <= 0:
+            raise ValueError(f"intervalSeconds must be > 0, got {v}")
+        return v
+
 
 RunSpec = Union[
     V1Job, V1Service, V1JAXJob, V1TFJob, V1PyTorchJob, V1MPIJob,

@@ -121,8 +121,9 @@ class StreamsService:
         return sorted(out)
 
     def artifact_path(self, run_uuid: str, rel: str) -> str:
+        from polyaxon_tpu.tracking.events import safe_subpath
+
         root = os.path.abspath(self.run_dir(run_uuid))
-        path = os.path.abspath(os.path.join(root, rel))
-        if path != root and not path.startswith(root + os.sep):
-            raise ValueError(f"Artifact path escapes the run dir: {rel}")
-        return path
+        if os.path.abspath(os.path.join(root, rel)) == root:
+            return root  # the run dir itself (artifact listing root)
+        return safe_subpath(root, rel)

@@ -22,18 +22,26 @@ def main(argv: list[str]) -> int:
     cmd = argv[2:]
 
     stopping = False
+    child: subprocess.Popen | None = None
 
     def _stop(signum, frame):
         nonlocal stopping
         stopping = True
+        # Forward to the active child so a long-running iteration ends
+        # promptly instead of outliving the stop request.
+        if child is not None and child.poll() is None:
+            child.terminate()
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
     while not stopping:
-        proc = subprocess.run(cmd)
-        if proc.returncode != 0:
-            return proc.returncode
+        child = subprocess.Popen(cmd)
+        rc = child.wait()
+        if stopping:
+            return 0  # stop requested mid-iteration: clean shutdown
+        if rc != 0:
+            return rc
         # Sleep in small increments so a stop signal lands promptly.
         deadline = time.monotonic() + interval
         while not stopping and time.monotonic() < deadline:

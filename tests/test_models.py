@@ -45,6 +45,40 @@ class TestLlama:
         )(v["params"])
         assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
 
+    def test_rope_scaling_llama31_rule(self):
+        """Scaled frequencies follow the public llama3 rope_scaling rule:
+        low-frequency bands divided by `factor`, high-frequency bands
+        unchanged, smooth in between — and the model runs with it."""
+        import dataclasses
+
+        import numpy as np_
+
+        from polyaxon_tpu.models.common import rope_frequencies
+
+        scaling = {"factor": 8.0, "low_freq_factor": 1.0,
+                   "high_freq_factor": 4.0,
+                   "original_max_position_embeddings": 8192}
+        base = np_.asarray(rope_frequencies(64, 500_000.0))
+        scaled = np_.asarray(rope_frequencies(64, 500_000.0, scaling))
+        wavelen = 2 * np_.pi / base
+        lowband = wavelen > 8192 / 1.0
+        highband = wavelen < 8192 / 4.0
+        np_.testing.assert_allclose(scaled[lowband], base[lowband] / 8.0,
+                                    rtol=1e-6)
+        np_.testing.assert_allclose(scaled[highband], base[highband],
+                                    rtol=1e-6)
+        mid = ~lowband & ~highband
+        assert np_.all(scaled[mid] <= base[mid] + 1e-9)
+        assert np_.all(scaled[mid] >= base[mid] / 8.0 - 1e-9)
+
+        cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                                  rope_scaling=scaling)
+        v = llama.init(cfg, jax.random.key(0))
+        batch = {"tokens": _tokens(jax.random.key(1), 2, 16, cfg.vocab_size)}
+        loss, _, _ = llama.apply(cfg, v, batch)
+        assert jnp.isfinite(loss)
+        assert "llama31_8b" in llama.CONFIGS
+
     def test_chunked_lm_loss_matches_full_logits(self):
         """apply() uses common.chunked_lm_loss; its loss/grads must equal
         the materialized-logits path exactly (chunking is numerics-free)."""
