@@ -28,6 +28,9 @@ class RuntimeConfig(BaseModel):
     grad_clip_norm: Optional[float] = 1.0
     batch_size: Optional[int] = None          # per-device
     global_batch_size: Optional[int] = None   # overrides batch_size
+    # Microbatch the per-update batch inside the compiled step (grads
+    # accumulate in a lax.scan; peak activations / accum_steps).
+    grad_accum_steps: int = 1
     seq_len: Optional[int] = None
     seed: int = 0
     log_every: int = 10

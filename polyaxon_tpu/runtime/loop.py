@@ -109,7 +109,29 @@ def run_jaxjob(
 
     with mesh:
         init_fn = build_init(model_def, optimizer, mesh, rules)
-        train_step = build_train_step(model_def, optimizer, mesh, rules)
+        accum = max(int(cfg.grad_accum_steps or 1), 1)
+        if accum > 1:
+            if global_batch % accum:
+                raise ValueError(
+                    f"grad_accum_steps {accum} must divide the global "
+                    f"batch {global_batch}")
+            from polyaxon_tpu.parallel.sharding import batch_spec
+
+            spec = batch_spec(mesh, rules)
+            batch_axes = spec[0] if len(spec) else None
+            if isinstance(batch_axes, str):
+                batch_axes = (batch_axes,)
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            shards = 1
+            for axis in batch_axes or ():
+                shards *= sizes[axis]
+            if (global_batch // accum) % max(shards, 1):
+                raise ValueError(
+                    f"microbatch {global_batch // accum} (global batch "
+                    f"{global_batch} / grad_accum_steps {accum}) must stay "
+                    f"divisible by the {shards}-way batch sharding")
+        train_step = build_train_step(model_def, optimizer, mesh, rules,
+                                      accum_steps=accum)
 
         rng = jax.random.key(cfg.seed)
         state = init_fn(rng)

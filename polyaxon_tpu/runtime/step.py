@@ -64,19 +64,79 @@ def build_train_step(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     rules: Rules,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, dict, jax.Array], tuple[TrainState, dict]]:
+    """One optimizer update per call. With ``accum_steps > 1`` the batch
+    (still the full per-update global batch) is split into that many
+    microbatches and gradients accumulate inside a ``lax.scan`` — one
+    compiled program, peak activation memory divided by ``accum_steps``.
+    """
     shardings = state_shardings(model_def, mesh, rules)
 
-    def train_step(state: TrainState, batch: dict, rng: jax.Array):
-        def loss_fn(params):
+    def grads_of(params, mutable, batch, rng):
+        def loss_fn(p):
             loss, metrics, new_mutable = model_def.apply(
-                {"params": params, "state": state["state"]}, batch, True, rng
+                {"params": p, "state": mutable}, batch, True, rng
             )
             return loss, (metrics, new_mutable)
 
         (_, (metrics, new_mutable)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
-        )(state["params"])
+        )(params)
+        return grads, metrics, new_mutable
+
+    def train_step(state: TrainState, batch: dict, rng: jax.Array):
+        if accum_steps == 1:
+            grads, metrics, new_mutable = grads_of(
+                state["params"], state["state"], batch, rng)
+        else:
+            # [G, ...] → [k, G/k, ...] microbatches, re-constrained to
+            # the batch layout so dp/fsdp sharding survives the reshape.
+            from polyaxon_tpu.parallel.sharding import batch_spec
+
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum_steps, x.shape[0] // accum_steps,
+                                    *x.shape[1:]),
+                batch)
+            rngs = jax.random.split(rng, accum_steps)
+
+            def constrain(mb):
+                return jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        x, NamedSharding(
+                            mesh, batch_spec(mesh, rules, ndim=x.ndim))),
+                    mb)
+
+            def weight_of(mb) -> jax.Array:
+                # Masked losses are per-valid-token means; weight each
+                # microbatch's gradient by its valid-token count so the
+                # accumulated gradient equals the full-batch one.
+                if isinstance(mb, dict) and mb.get("mask") is not None:
+                    return mb["mask"].astype(jnp.float32).sum()
+                return jnp.float32(1.0)
+
+            def body(carry, mb_and_rng):
+                grads_acc, w_acc, mutable = carry
+                mb, r = mb_and_rng
+                mb = constrain(mb)
+                w = weight_of(mb)
+                g, m, new_mutable = grads_of(state["params"], mutable, mb, r)
+                grads_acc = jax.tree.map(
+                    lambda acc, gi: acc + w * gi, grads_acc, g)
+                m = jax.tree.map(lambda v: w * v, dict(m))
+                return (grads_acc, w_acc + w, new_mutable), m
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"])
+            (grads, w_total, new_mutable), metrics_seq = jax.lax.scan(
+                body, (zeros, jnp.float32(0.0), state["state"]),
+                (micro, rngs))
+            grads = jax.tree.map(
+                lambda g, p: (g / w_total).astype(p.dtype),
+                grads, state["params"])
+            metrics = jax.tree.map(
+                lambda m: m.sum() / w_total, metrics_seq)
+
         updates, new_opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

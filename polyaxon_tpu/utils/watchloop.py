@@ -37,6 +37,10 @@ def main(argv: list[str]) -> int:
 
     while not stopping:
         child = subprocess.Popen(cmd)
+        if stopping and child.poll() is None:
+            # Signal landed between the loop check and the assignment —
+            # the handler had nothing to terminate, so do it here.
+            child.terminate()
         rc = child.wait()
         if stopping:
             return 0  # stop requested mid-iteration: clean shutdown

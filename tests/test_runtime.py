@@ -77,6 +77,65 @@ class TestTrainLoop:
         run_jaxjob(tiny_job(steps=6), on_metrics=lambda s, m: seen.append((s, m)))
         assert seen and all("loss" in m for _, m in seen)
 
+    def test_grad_accumulation_matches_full_batch(self, cpu_devices):
+        """k microbatches accumulated in-step must produce the same
+        update as one full-batch step (mean-of-grads == grad-of-mean for
+        per-position-mean LM loss over equal-sized microbatches)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
+        from polyaxon_tpu.runtime.config import RuntimeConfig
+        from polyaxon_tpu.runtime.optim import build_optimizer
+        from polyaxon_tpu.runtime.step import build_init, build_train_step
+
+        mesh = build_mesh(axes={"dp": 8})
+        rules = rules_for_mesh(mesh)
+        model_def = llama.model_def("llama_tiny")
+        # SGD: updates are linear in grads, so the comparison is exact
+        # (adaptive optimizers flip sign on near-zero grads under bf16
+        # summation-order noise).
+        cfg = RuntimeConfig(model="llama_tiny", steps=1, learning_rate=1e-2,
+                            optimizer="sgd", lr_schedule="constant",
+                            grad_clip_norm=None)
+        optimizer = build_optimizer(cfg)
+        with mesh:
+            init_fn = build_init(model_def, optimizer, mesh, rules)
+            step1 = build_train_step(model_def, optimizer, mesh, rules)
+            step4 = build_train_step(model_def, optimizer, mesh, rules,
+                                     accum_steps=4)
+            tokens = jax.random.randint(jax.random.key(1), (16, 16), 0, 256)
+            s_a = init_fn(jax.random.key(0))
+            s_a, m_a = step1(s_a, {"tokens": tokens}, jax.random.key(2))
+            s_b = init_fn(jax.random.key(0))
+            s_b, m_b = step4(s_b, {"tokens": tokens}, jax.random.key(2))
+        assert abs(float(m_a["loss"]) - float(m_b["loss"])) < 1e-5
+        for a, b in zip(jax.tree.leaves(s_a["params"]),
+                        jax.tree.leaves(s_b["params"])):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=2e-5)
+
+        # Masked batches with uneven valid-token counts per microbatch:
+        # token-weighted accumulation must still match the full batch.
+        mask = np.ones((16, 16), np.int32)
+        mask[10:, :] = 0
+        mask[10:, 0] = 1  # last 6 rows carry a single valid token each
+        batch = {"tokens": tokens, "mask": jnp.asarray(mask)}
+        with mesh:
+            s_a = init_fn(jax.random.key(0))
+            s_a, m_a = step1(s_a, batch, jax.random.key(2))
+            s_b = init_fn(jax.random.key(0))
+            s_b, m_b = step4(s_b, batch, jax.random.key(2))
+        assert abs(float(m_a["loss"]) - float(m_b["loss"])) < 1e-5
+        for a, b in zip(jax.tree.leaves(s_a["params"]),
+                        jax.tree.leaves(s_b["params"])):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=2e-5)
+
     def test_checkpoint_and_resume(self, cpu_devices, tmp_path):
         art = str(tmp_path / "run")
         job = V1JAXJob.from_dict(
