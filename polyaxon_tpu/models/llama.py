@@ -52,6 +52,9 @@ class LlamaConfig:
     rope_scaling: Optional[dict] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Sliding-window (Mistral-style) causal attention: each position
+    # attends to its last `sliding_window` tokens. None = full causal.
+    sliding_window: Optional[int] = None
     dtype: Any = jnp.bfloat16
     remat: str = "none"  # none | full | dots (checkpoint policy per layer)
     attention_impl: str = "xla"  # xla | flash | ring | ulysses
@@ -77,6 +80,12 @@ CONFIGS: dict[str, LlamaConfig] = {
     # Llama-3.1 8B: 128k context via scaled RoPE (public rope_scaling rule).
     "llama31_8b": LlamaConfig(max_seq_len=131_072,
                               rope_scaling=_LLAMA31_SCALING),
+    # Mistral-7B architecture: sliding-window attention, 32k context.
+    "mistral_7b": LlamaConfig(
+        vocab_size=32_000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        ffn_dim=14_336, max_seq_len=32_768, rope_theta=10_000.0,
+        sliding_window=4096,
+    ),
     "llama3_1b": LlamaConfig(
         vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         ffn_dim=8192, max_seq_len=8192,
@@ -151,7 +160,8 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) ->
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-    attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl,
+                                 window=cfg.sliding_window)
     x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -273,7 +283,10 @@ def decode_step(
     positions = jnp.full((B, 1), pos, jnp.int32)
     x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
 
-    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,S]
+    valid = jnp.arange(max_len) <= pos
+    if cfg.sliding_window is not None:
+        valid &= jnp.arange(max_len) > pos - cfg.sliding_window
+    valid = valid[None, None, None, :]  # [1,1,1,S]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, max_len, KV, Hd]
@@ -333,7 +346,8 @@ def prefill(
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = dot_product_attention(q, k, v, causal=True,
-                                     impl=cfg.attention_impl)
+                                     impl=cfg.attention_impl,
+                                     window=cfg.sliding_window)
         x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))

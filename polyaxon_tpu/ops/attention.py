@@ -38,7 +38,13 @@ def xla_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
+    if window is not None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if not causal:
+            raise ValueError("sliding window requires causal attention")
     n_rep = q.shape[2] // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
@@ -48,6 +54,11 @@ def xla_attention(
         sq, sk = q.shape[1], k.shape[1]
         # Offset supports decode/extension where Sq < Sk.
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        if window is not None:
+            # Sliding window: each query sees the last `window` keys
+            # (its own position included).
+            mask &= jnp.triu(jnp.ones((sq, sk), dtype=bool),
+                             k=sk - sq - window + 1)
         logits = jnp.where(mask[None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
@@ -65,6 +76,7 @@ def dot_product_attention(
     impl: str = "xla",
     segment_ids: Optional[jax.Array] = None,
     axis_name: Optional[str] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     if impl == "auto":
         # Flash on real TPU (it self-falls-back when shapes don't tile);
@@ -72,7 +84,8 @@ def dot_product_attention(
         impl = ("flash" if segment_ids is None
                 and jax.default_backend() == "tpu" else "xla")
     if impl == "xla":
-        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                             window=window)
     if segment_ids is not None:
         raise ValueError(
             f"segment_ids (packed sequences) only supported by impl='xla', got `{impl}`"
@@ -80,7 +93,10 @@ def dot_product_attention(
     if impl == "flash":
         from polyaxon_tpu.ops.flash import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
+    if window is not None:
+        raise ValueError(
+            f"sliding window is supported by impl='xla'/'flash', got `{impl}`")
     if impl == "ring":
         from polyaxon_tpu.ops.ring import ring_attention
 

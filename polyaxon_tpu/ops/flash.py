@@ -68,6 +68,7 @@ def _fwd_kernel(
     scale: float,
     block_q: int,
     block_k: int,
+    window: int,  # 0 = unbounded
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -78,10 +79,14 @@ def _fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: blocks strictly above the diagonal contribute nothing.
+    # Causal: blocks strictly above the diagonal contribute nothing;
+    # with a sliding window, blocks entirely below the band neither.
     should_compute = True
     if causal:
         should_compute = qi * block_q + block_q > ki * block_k
+        if window:
+            in_band = ki * block_k + block_k > qi * block_q - (window - 1)
+            should_compute = jnp.logical_and(should_compute, in_band)
 
     @pl.when(should_compute)
     def _compute():
@@ -100,6 +105,8 @@ def _fwd_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             mask = rows >= cols
+            if window:
+                mask &= rows - cols < window
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
@@ -136,6 +143,7 @@ def _flash_fwd_pallas(
     block_q: int,
     block_k: int,
     interpret: bool,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     b, h, sq, d = q.shape
     kv = k.shape[1]
@@ -144,7 +152,8 @@ def _flash_fwd_pallas(
     grid = (b, h, sq // block_q, sk // block_k)
 
     kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, window=window,
     )
     compiler_params = None
     if pltpu is not None and not interpret:
@@ -189,6 +198,7 @@ def _flash_bwd_xla(
     causal: bool,
     scale: float,
     block_k: int,
+    window: int,
     res,
     do: jax.Array,
 ):
@@ -221,6 +231,8 @@ def _flash_bwd_xla(
         if causal:
             cols = ki * block_k + jnp.arange(block_k)
             mask = rows[:, None] >= cols[None, :]
+            if window:
+                mask &= rows[:, None] - cols[None, :] < window
             p = jnp.where(mask[None, None], jnp.exp(s - lse[..., None]), 0.0)
         else:
             p = jnp.exp(s - lse[..., None])
@@ -254,20 +266,22 @@ def _flash_bwd_xla(
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window):
+    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                             interpret, window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret, window):
+    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                               interpret, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, window, res, do):
     del block_q, interpret
-    return _flash_bwd_xla(causal, scale, block_k, res, do)
+    return _flash_bwd_xla(causal, scale, block_k, window, res, do)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -283,8 +297,13 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] layouts with GQA support.
+
+    ``window``: sliding-window (Mistral-style) causal attention — each
+    query attends to its last ``window`` positions; K/V blocks entirely
+    outside the band are skipped, so compute is O(S·window).
 
     Falls back to the einsum reference (``ops.attention.xla_attention``)
     when shapes don't tile (seq not divisible into >=128 blocks, or
@@ -295,12 +314,15 @@ def flash_attention(
     kv = k.shape[2]
     if h % kv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {kv}")
+    if window is not None and (window < 1 or not causal):
+        raise ValueError("window must be >= 1 and requires causal attention")
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     if pltpu is None or bq < 128 or bk < 128 or (d % 128 and d != 64):
         from polyaxon_tpu.ops.attention import xla_attention
 
-        return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        return xla_attention(q, k, v, causal=causal,
+                             softmax_scale=softmax_scale, window=window)
     if interpret is None:
         interpret = _default_interpret()
     scale = softmax_scale if softmax_scale is not None else d**-0.5
@@ -310,5 +332,5 @@ def flash_attention(
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    o = _flash(qT, kT, vT, causal, scale, bq, bk, interpret)
+    o = _flash(qT, kT, vT, causal, scale, bq, bk, interpret, window or 0)
     return o.transpose(0, 2, 1, 3)

@@ -110,7 +110,11 @@ def build_train_step(
             def weight_of(mb) -> jax.Array:
                 # Masked losses are per-valid-token means; weight each
                 # microbatch's gradient by its valid-token count so the
-                # accumulated gradient equals the full-batch one.
+                # accumulated gradient equals the full-batch one. This
+                # assumes the loss is fully mask-weighted (true for the
+                # LM/CE losses here); a loss mixing mask-independent
+                # terms (e.g. MoE router aux) is approximated — keep
+                # microbatches mask-balanced or use accum_steps=1 there.
                 if isinstance(mb, dict) and mb.get("mask") is not None:
                     return mb["mask"].astype(jnp.float32).sum()
                 return jnp.float32(1.0)
@@ -131,11 +135,14 @@ def build_train_step(
             (grads, w_total, new_mutable), metrics_seq = jax.lax.scan(
                 body, (zeros, jnp.float32(0.0), state["state"]),
                 (micro, rngs))
+            # Clamp: a fully-masked batch (w_total == 0) must yield zero
+            # grads like the accum=1 path, not 0/0 = NaN params.
+            w_safe = jnp.maximum(w_total, 1.0)
             grads = jax.tree.map(
-                lambda g, p: (g / w_total).astype(p.dtype),
+                lambda g, p: (g / w_safe).astype(p.dtype),
                 grads, state["params"])
             metrics = jax.tree.map(
-                lambda m: m.sum() / w_total, metrics_seq)
+                lambda m: m.sum() / w_safe, metrics_seq)
 
         updates, new_opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]

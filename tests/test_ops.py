@@ -50,6 +50,68 @@ class TestFlash:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
+    def test_sliding_window_matches_band_mask(self):
+        """xla window path equals an explicit band-mask softmax, and the
+        Pallas kernel (block skipping + in-block band) matches it."""
+        q, k, v = _qkv(s=256)
+        W = 64
+
+        # Explicit reference: full logits with a band mask.
+        from polyaxon_tpu.ops.attention import repeat_kv
+
+        kf, vf = repeat_kv(k, 2), repeat_kv(v, 2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * (64 ** -0.5)
+        rows = jnp.arange(256)[:, None]
+        cols = jnp.arange(256)[None, :]
+        band = (rows >= cols) & (rows - cols < W)
+        logits = jnp.where(band[None, None], logits, -1e30)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), vf)
+
+        out_xla = xla_attention(q, k, v, causal=True, window=W)
+        np.testing.assert_allclose(out_xla, ref, atol=2e-5, rtol=2e-5)
+        out_flash = flash_attention(q, k, v, causal=True, window=W,
+                                    block_q=128, block_k=128)
+        np.testing.assert_allclose(out_flash, ref, atol=2e-5, rtol=2e-5)
+
+    def test_sliding_window_gradients_match(self):
+        q, k, v = _qkv(s=256)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+        gf = jax.grad(loss(lambda *a: flash_attention(
+            *a, window=64, block_q=128, block_k=128)), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(lambda *a: xla_attention(*a, window=64)),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+    def test_sliding_window_decode_matches_forward(self):
+        """Cache decode with a window reproduces windowed teacher-forced
+        logits at the last position."""
+        import dataclasses
+
+        from polyaxon_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                                  dtype=jnp.float32, sliding_window=8)
+        variables = llama.init(cfg, jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 24), 0, cfg.vocab_size)
+        full = llama.forward(cfg, variables["params"], toks)
+        logits, cache = llama.prefill(cfg, variables["params"], toks[:, :-1], 24)
+        step_logits, _ = llama.decode_step(
+            cfg, variables["params"], cache, toks[:, -1], jnp.int32(23))
+        np.testing.assert_allclose(step_logits, full[:, -1], atol=2e-4,
+                                   rtol=2e-4)
+
+    def test_window_zero_rejected_everywhere(self):
+        q, k, v = _qkv(s=256)
+        for fn in (lambda: xla_attention(q, k, v, causal=True, window=0),
+                   lambda: flash_attention(q, k, v, causal=True, window=0),
+                   lambda: xla_attention(q, k, v, causal=False, window=8)):
+            with pytest.raises(ValueError):
+                fn()
+
     def test_small_seq_falls_back(self):
         q, k, v = _qkv(s=64)  # < 128: cannot tile → xla fallback path
         ref = xla_attention(q, k, v, causal=True)
