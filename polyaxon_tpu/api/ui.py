@@ -244,19 +244,65 @@ function wireChart(el) {
   });
 }
 
+function histChart(name, ev) {
+  // Single-hue bar chart of the latest histogram event: thin bars,
+  // 2px surface gaps, baseline axis, per-bar hover via <title>.
+  const W = 320, H = 150, P = {l: 42, r: 10, t: 8, b: 20};
+  const counts = ev.counts, edges = ev.edges;
+  const maxC = Math.max(...counts, 1);
+  const bw = (W - P.l - P.r) / counts.length;
+  const fmt = v => +Number(v).toPrecision(3);
+  const bars = counts.map((c, i) => {
+    const bh = (H - P.t - P.b) * (c / maxC);
+    return `<rect x="${(P.l + i * bw + 1).toFixed(1)}" y="${(H - P.b - bh).toFixed(1)}"
+      width="${Math.max(bw - 2, 1).toFixed(1)}" height="${bh.toFixed(1)}"
+      rx="2" fill="var(--series-1)"><title>[${fmt(edges[i])}, ${fmt(edges[i + 1])}): ${c}</title></rect>`;
+  }).join("");
+  return `<div class="chart">
+    <h3>${esc(name)}</h3>
+    <div class="sub">histogram · ${counts.reduce((a, b) => a + b, 0)} values${ev.step != null ? ` · step ${ev.step}` : ""}</div>
+    <svg viewBox="0 0 ${W} ${H}" role="img" aria-label="${esc(name)} histogram">
+      <line x1="${P.l}" y1="${H - P.b}" x2="${W - P.r}" y2="${H - P.b}" stroke="var(--axis)" stroke-width="1"/>
+      <text x="${P.l}" y="${H - 6}" font-size="10" fill="var(--muted)">${fmt(edges[0])}</text>
+      <text x="${W - P.r}" y="${H - 6}" text-anchor="end" font-size="10" fill="var(--muted)">${fmt(edges[edges.length - 1])}</text>
+      ${bars}
+    </svg>
+  </div>`;
+}
+
+function imageCard(uuid, name, ev) {
+  // URL-encode each path segment (names may carry spaces/#/%), then
+  // HTML-escape for the attribute context.
+  const rel = String(ev.path).split("/").map(encodeURIComponent).join("/");
+  const src = esc(`/api/v1/default/default/runs/${encodeURIComponent(uuid)}/artifacts/${rel}`);
+  return `<div class="chart">
+    <h3>${esc(name)}</h3>
+    <div class="sub">image${ev.step != null ? ` · step ${ev.step}` : ""}</div>
+    <img src="${src}" alt="${esc(name)}" style="max-width:100%;border-radius:4px">
+  </div>`;
+}
+
 let logSource = null;
 async function showRun(uuid) {
   const detail = $("#detail");
-  const [run, metrics] = await Promise.all([
+  const [run, metrics, images, hists] = await Promise.all([
     api(`/api/v1/default/default/runs/${uuid}`),
     api(`/api/v1/default/default/runs/${uuid}/metrics`).catch(() => ({})),
+    api(`/api/v1/default/default/runs/${uuid}/events?kind=image`).catch(() => ({})),
+    api(`/api/v1/default/default/runs/${uuid}/events?kind=histogram`).catch(() => ({})),
   ]);
   const charts = Object.entries(metrics)
     .filter(([, pts]) => Array.isArray(pts) && pts.length)
     .map(([name, pts]) => lineChart(name, pts)).join("");
+  const media =
+    Object.entries(hists).filter(([, evs]) => evs.length)
+      .map(([name, evs]) => histChart(name, evs[evs.length - 1])).join("") +
+    Object.entries(images).filter(([, evs]) => evs.length)
+      .map(([name, evs]) => imageCard(uuid, name, evs[evs.length - 1])).join("");
   detail.innerHTML = `
     <h2 style="font-size:15px">${esc(run.name || run.uuid)} ${pill(run.status)}</h2>
     <div class="charts">${charts || "<div class='sub' style='color:var(--muted)'>no metrics yet</div>"}</div>
+    ${media ? `<div class="charts">${media}</div>` : ""}
     <div id="logs" aria-label="run logs"></div>`;
   for (const el of detail.querySelectorAll(".chart")) wireChart(el);
   if (logSource) { logSource.close(); logSource = null; }

@@ -217,41 +217,61 @@ def _flash_bwd_xla(
     k_blocks = jnp.moveaxis(k.reshape(b, kv, n_blocks, block_k, dh), 2, 0)
     v_blocks = jnp.moveaxis(v.reshape(b, kv, n_blocks, block_k, dh), 2, 0)
 
+    # With a sliding window only q rows in [kb, kb + block_k + window)
+    # can touch key block kb — restrict the recompute to that span so the
+    # backward, like the forward, does O(S·window) work instead of O(S²).
+    span = min(sq, block_k + window) if (causal and window) else sq
+
     def body(dq_acc, inputs):
         ki, kj, vj = inputs  # kj/vj: [B, KV, block_k, D]
         # GQA: expand kv heads to q heads for this block only.
         kj_h = jnp.repeat(kj, n_rep, axis=1) if n_rep > 1 else kj
         vj_h = jnp.repeat(vj, n_rep, axis=1) if n_rep > 1 else vj
+        if span < sq:
+            start = jnp.clip(ki * block_k, 0, sq - span)
+            q_b = jax.lax.dynamic_slice_in_dim(q, start, span, axis=2)
+            do_b = jax.lax.dynamic_slice_in_dim(do, start, span, axis=2)
+            delta_b = jax.lax.dynamic_slice_in_dim(delta, start, span, axis=2)
+            lse_b = jax.lax.dynamic_slice_in_dim(lse, start, span, axis=2)
+            rows_b = start + jnp.arange(span)
+        else:
+            q_b, do_b, delta_b, lse_b, rows_b = q, do, delta, lse, rows
         s = (
             jnp.einsum(
-                "bhqd,bhkd->bhqk", q, kj_h, preferred_element_type=jnp.float32
+                "bhqd,bhkd->bhqk", q_b, kj_h, preferred_element_type=jnp.float32
             )
             * scale
         )
         if causal:
             cols = ki * block_k + jnp.arange(block_k)
-            mask = rows[:, None] >= cols[None, :]
+            mask = rows_b[:, None] >= cols[None, :]
             if window:
-                mask &= rows[:, None] - cols[None, :] < window
-            p = jnp.where(mask[None, None], jnp.exp(s - lse[..., None]), 0.0)
+                mask &= rows_b[:, None] - cols[None, :] < window
+            p = jnp.where(mask[None, None], jnp.exp(s - lse_b[..., None]), 0.0)
         else:
-            p = jnp.exp(s - lse[..., None])
+            p = jnp.exp(s - lse_b[..., None])
         dv_h = jnp.einsum(
-            "bhqk,bhqd->bhkd", p.astype(do.dtype), do,
+            "bhqk,bhqd->bhkd", p.astype(do.dtype), do_b,
             preferred_element_type=jnp.float32,
         )
         dp = jnp.einsum(
-            "bhqd,bhkd->bhqk", do, vj_h, preferred_element_type=jnp.float32
+            "bhqd,bhkd->bhqk", do_b, vj_h, preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[..., None]) * scale  # [B,H,Sq,block_k] f32
+        ds = p * (dp - delta_b[..., None]) * scale  # [B,H,span,block_k] f32
         dk_h = jnp.einsum(
-            "bhqk,bhqd->bhkd", ds.astype(q.dtype), q,
+            "bhqk,bhqd->bhkd", ds.astype(q.dtype), q_b,
             preferred_element_type=jnp.float32,
         )
-        dq_acc = dq_acc + jnp.einsum(
+        dq_contrib = jnp.einsum(
             "bhqk,bhkd->bhqd", ds.astype(q.dtype), kj_h,
             preferred_element_type=jnp.float32,
         )
+        if span < sq:
+            cur = jax.lax.dynamic_slice_in_dim(dq_acc, start, span, axis=2)
+            dq_acc = jax.lax.dynamic_update_slice_in_dim(
+                dq_acc, cur + dq_contrib, start, axis=2)
+        else:
+            dq_acc = dq_acc + dq_contrib
         if n_rep > 1:  # fold grouped q-heads back onto their kv head
             dk_h = dk_h.reshape(b, kv, n_rep, block_k, dh).sum(axis=2)
             dv_h = dv_h.reshape(b, kv, n_rep, block_k, dh).sum(axis=2)

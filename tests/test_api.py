@@ -71,7 +71,8 @@ class TestApi:
                 assert resp.headers["Content-Type"].startswith("text/html")
                 html = resp.read().decode()
             # Key surface markers: runs table, status filter, chart layer.
-            for marker in ("polyaxon_tpu", "statusFilter", "lineChart", "EventSource"):
+            for marker in ("polyaxon_tpu", "statusFilter", "lineChart",
+                           "histChart", "imageCard", "EventSource"):
                 assert marker in html, marker
 
     def test_prometheus_metrics(self, stack):
