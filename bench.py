@@ -131,6 +131,10 @@ def main() -> int:
                              "reported as trials/hour (BASELINE metric 2)")
     args = parser.parse_args()
 
+    from polyaxon_tpu.utils import apply_jax_platforms_override
+
+    apply_jax_platforms_override()  # honor JAX_PLATFORMS=cpu in CI
+
     if args.tuner:
         return tuner_bench(smoke=args.smoke)
 
