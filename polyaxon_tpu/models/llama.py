@@ -261,9 +261,19 @@ def forward(
 
 
 # ---------------------------------------------------------------- decode
+def cache_len(cfg: LlamaConfig, max_len: int) -> int:
+    """KV-cache length: with a sliding window the cache is a ring buffer
+    of `sliding_window` slots (bounded memory for long generations);
+    otherwise the full sequence length."""
+    if cfg.sliding_window is not None:
+        return min(max_len, cfg.sliding_window)
+    return max_len
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
-    """KV cache [L, B, max_len, KV, Hd] per tensor, in compute dtype."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    """KV cache [L, B, C, KV, Hd] per tensor (C = cache_len), compute dtype."""
+    C = cache_len(cfg, max_len)
+    shape = (cfg.n_layers, batch, C, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
@@ -274,30 +284,41 @@ def decode_step(
     tokens: jax.Array,  # [B] int32 current-position token ids
     pos: jax.Array,  # scalar int32 position being written
 ) -> tuple[jax.Array, dict]:
-    """One autoregressive step: returns (logits [B, V] fp32, new cache)."""
+    """One autoregressive step: returns (logits [B, V] fp32, new cache).
+
+    The cache is addressed as a ring buffer: slot ``pos % C``. With a
+    full-length cache this is plain positional indexing; with a
+    sliding-window cache (C == window) old entries are overwritten in
+    place, so memory stays O(window) for arbitrarily long generations.
+    """
     dt = cfg.dtype
     B = tokens.shape[0]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     n_rep = H // KV
-    max_len = cache["k"].shape[2]
+    C = cache["k"].shape[2]
     positions = jnp.full((B, 1), pos, jnp.int32)
     x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
 
-    valid = jnp.arange(max_len) <= pos
+    slot = jnp.mod(pos, C)
+    # Slot s currently holds position pos - ((pos - s) mod C) (after this
+    # step's write); negative means never written.
+    delta = jnp.mod(pos - jnp.arange(C), C)
+    stored = pos - delta
+    valid = stored >= 0
     if cfg.sliding_window is not None:
-        valid &= jnp.arange(max_len) > pos - cfg.sliding_window
-    valid = valid[None, None, None, :]  # [1,1,1,S]
+        valid &= delta < cfg.sliding_window
+    valid = valid[None, None, None, :]  # [1,1,1,C]
 
     def layer_step(x, inputs):
-        layer, k_cache, v_cache = inputs  # caches [B, max_len, KV, Hd]
+        layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
         k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
         v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, slot, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, slot, 0, 0))
 
         from polyaxon_tpu.ops.attention import repeat_kv
 
@@ -356,11 +377,29 @@ def prefill(
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
-    pad = max_len - P
-    cache = {
-        "k": jnp.pad(k_all, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
-        "v": jnp.pad(v_all, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))),
-    }
+    # Ring-buffer cache assembly: position p lands in slot p % C. With a
+    # full-length cache that is the identity; with a sliding-window ring
+    # only the last C prompt positions are kept (older ones can never be
+    # attended again).
+    C = cache_len(cfg, max_len)
+    if cfg.sliding_window is None and P > max_len:
+        raise ValueError(
+            f"prompt length {P} exceeds cache length {max_len} "
+            "(full attention cannot drop prompt positions)")
+    keep = min(P, C)
+    if keep == P and P <= C:
+        # Common no-wrap case (slots are 0..P-1): cheap pad, no scatter.
+        pad = ((0, 0), (0, 0), (0, C - P), (0, 0), (0, 0))
+        cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad)}
+    else:
+        pos_kept = jnp.arange(P - keep, P)
+        slots = jnp.mod(pos_kept, C)
+        zeros = jnp.zeros(
+            (cfg.n_layers, B, C, cfg.n_kv_heads, Hd), dtype=k_all.dtype)
+        cache = {
+            "k": zeros.at[:, :, slots].set(k_all[:, :, P - keep:]),
+            "v": zeros.at[:, :, slots].set(v_all[:, :, P - keep:]),
+        }
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
     return logits, cache

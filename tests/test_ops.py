@@ -104,6 +104,32 @@ class TestFlash:
         np.testing.assert_allclose(step_logits, full[:, -1], atol=2e-4,
                                    rtol=2e-4)
 
+    def test_rolling_cache_matches_full_forward_across_wraps(self):
+        """Sliding-window decode uses an O(window) ring-buffer cache;
+        greedy generation must match feeding the growing sequence through
+        the full windowed forward pass — across several ring wraps."""
+        import dataclasses
+
+        from polyaxon_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                                  dtype=jnp.float32, sliding_window=8)
+        variables = llama.init(cfg, jax.random.key(0))
+        prompt = jax.random.randint(jax.random.key(1), (1, 4), 0, cfg.vocab_size)
+        n_new = 20  # >> window: the ring wraps multiple times
+
+        out = llama.generate(cfg, variables["params"], prompt,
+                             max_new_tokens=n_new)
+        # Cache really is window-sized (pure shape arithmetic).
+        assert llama.cache_len(cfg, 4 + n_new) == 8
+
+        seq = prompt
+        for _ in range(n_new):
+            logits = llama.forward(cfg, variables["params"], seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 4:]))
+
     def test_window_zero_rejected_everywhere(self):
         q, k, v = _qkv(s=256)
         for fn in (lambda: xla_attention(q, k, v, causal=True, window=0),
