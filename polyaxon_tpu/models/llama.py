@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Any, Optional
 
 import jax
@@ -34,6 +35,8 @@ from polyaxon_tpu.models.common import (
     truncated_normal_init,
 )
 from polyaxon_tpu.ops.attention import dot_product_attention
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,7 +152,8 @@ def logical_axes(cfg: LlamaConfig) -> Variables:
 _rope = rope  # shared impl (models.common.rope)
 
 
-def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) -> jax.Array:
+def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
+           segment_ids: Optional[jax.Array] = None) -> jax.Array:
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -160,7 +164,20 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array) ->
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-    attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl,
+    impl = cfg.attention_impl
+    if segment_ids is not None and impl not in ("xla", "auto"):
+        if impl in ("ring", "ulysses"):
+            raise ValueError(
+                f"attention_impl='{impl}' does not support packed "
+                "sequences (segment_ids); use xla or unpacked data")
+        logger.warning(
+            "attention_impl='%s' has no packed-sequence kernel; falling "
+            "back to xla (O(S^2) logits) for this model", impl)
+        impl = "xla"
+    elif segment_ids is not None:
+        impl = "xla"
+    attn = dot_product_attention(q, k, v, causal=True, impl=impl,
+                                 segment_ids=segment_ids,
                                  window=cfg.sliding_window)
     x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
 
@@ -214,22 +231,49 @@ def _pipelined_layers(cfg: LlamaConfig, body, layer_params, x: jax.Array) -> jax
         n_microbatches=cfg.pipeline_microbatches)
 
 
+def segment_starts(segment_ids: jax.Array) -> jax.Array:
+    """Boolean [..., S] marking the first position of each segment."""
+    return jnp.concatenate(
+        [jnp.ones_like(segment_ids[..., :1], dtype=bool),
+         segment_ids[..., 1:] != segment_ids[..., :-1]], axis=-1)
+
+
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """Within-segment positions for packed rows: [0,0,0,1,1] → [0,1,2,0,1]."""
+    S = segment_ids.shape[-1]
+    idx = jnp.arange(S, dtype=jnp.int32)
+    starts = jax.lax.cummax(jnp.where(segment_starts(segment_ids), idx, 0),
+                            axis=segment_ids.ndim - 1)
+    return idx - starts
+
+
 def hidden_states(
     cfg: LlamaConfig,
     params: dict,
     tokens: jax.Array,  # [B, S] int32 input ids
     positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] packed-sequence ids
 ) -> jax.Array:
-    """Token ids → final-norm hidden states [B, S, D] (compute dtype)."""
+    """Token ids → final-norm hidden states [B, S, D] (compute dtype).
+
+    ``segment_ids`` enables packed-sequence pretraining: attention is
+    restricted within each segment and RoPE positions restart per
+    segment (derived automatically unless ``positions`` is given).
+    """
     dt = cfg.dtype
     B, S = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    elif cfg.pipeline_stages > 1:
+    if cfg.pipeline_stages > 1 and (positions is not None
+                                    or segment_ids is not None):
         raise ValueError(
             "the pipelined path assumes contiguous positions 0..S-1 and "
-            "cannot honor explicit `positions` (packed sequences / decode "
-            "offsets); use pipeline_stages=1 for those")
+            "cannot honor explicit `positions`/`segment_ids` (packed "
+            "sequences / decode offsets); use pipeline_stages=1 for those")
+    if positions is None:
+        if segment_ids is not None:
+            positions = segment_positions(segment_ids)
+        else:
+            positions = jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     x = params["embed"].astype(dt)[tokens]
 
     body = _layer_body(cfg)
@@ -238,7 +282,7 @@ def hidden_states(
         x = _pipelined_layers(cfg, body, params["layers"], x)
     else:
         def scan_body(carry, layer_params):
-            return body(carry, layer_params, positions), None
+            return body(carry, layer_params, positions, segment_ids), None
 
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
@@ -301,13 +345,12 @@ def decode_step(
 
     slot = jnp.mod(pos, C)
     # Slot s currently holds position pos - ((pos - s) mod C) (after this
-    # step's write); negative means never written.
+    # step's write); negative means never written. The sliding window
+    # itself needs no extra mask here: C <= window by cache_len(), so
+    # every live slot is inside the band by construction.
     delta = jnp.mod(pos - jnp.arange(C), C)
     stored = pos - delta
-    valid = stored >= 0
-    if cfg.sliding_window is not None:
-        valid &= delta < cfg.sliding_window
-    valid = valid[None, None, None, :]  # [1,1,1,C]
+    valid = (stored >= 0)[None, None, None, :]  # [1,1,1,C]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
@@ -386,8 +429,13 @@ def prefill(
         raise ValueError(
             f"prompt length {P} exceeds cache length {max_len} "
             "(full attention cannot drop prompt positions)")
+    if cfg.sliding_window is not None and C < min(P, cfg.sliding_window):
+        raise ValueError(
+            f"cache length {C} (max_len {max_len}) cannot hold the last "
+            f"min(P={P}, window={cfg.sliding_window}) prompt positions "
+            "that remain attendable — raise max_len")
     keep = min(P, C)
-    if keep == P and P <= C:
+    if P <= C:
         # Common no-wrap case (slots are 0..P-1): cheap pad, no scatter.
         pad = ((0, 0), (0, 0), (0, C - P), (0, 0), (0, 0))
         cache = {"k": jnp.pad(k_all, pad), "v": jnp.pad(v_all, pad)}
@@ -455,10 +503,18 @@ def apply(
 ):
     tokens = batch["tokens"]
     inputs = shift_right(tokens)
+    segments = batch.get("segments")
+    if segments is not None:
+        # Packed sequences: each segment starts from BOS (no token leaks
+        # across the boundary), attention is segment-restricted, and
+        # RoPE restarts — every segment trains exactly like an unpacked
+        # sequence of its own.
+        inputs = jnp.where(segment_starts(segments),
+                           jnp.zeros_like(inputs), inputs)
     # Chunked lm-head loss: the [B, S, V] fp32 logits tensor is never
     # materialized (common.chunked_lm_loss) — the dominant HBM saving at
     # pretraining shapes.
-    x = hidden_states(cfg, variables["params"], inputs)
+    x = hidden_states(cfg, variables["params"], inputs, segment_ids=segments)
     head = lm_head(cfg, variables["params"]).astype(cfg.dtype)
     loss, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"))
     return loss, {"loss": loss, "accuracy": acc}, variables["state"]

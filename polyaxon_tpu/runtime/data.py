@@ -74,6 +74,30 @@ def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
         i += 1
 
 
+def lm_packed_synthetic(batch_size: int, seq_len: int = 2048,
+                        vocab_size: int = 32_000, mean_doc_len: int = 256,
+                        seed: int = 0, start_batch: int = 0,
+                        **_) -> Iterator[dict[str, np.ndarray]]:
+    """Packed-document LM stream: each row concatenates documents of
+    random length with per-token ``segments`` ids (attention and RoPE
+    restart at each boundary in the model). Resume-exact per batch."""
+    i = start_batch
+    while True:
+        rng = np.random.default_rng((seed, i))
+        tokens = rng.integers(2, vocab_size,
+                              size=(batch_size, seq_len)).astype(np.int32)
+        segments = np.zeros((batch_size, seq_len), np.int32)
+        for b in range(batch_size):
+            pos, seg = 0, 0
+            while pos < seq_len:
+                doc = int(rng.integers(mean_doc_len // 2, mean_doc_len * 2))
+                segments[b, pos:pos + doc] = seg
+                pos += doc
+                seg += 1
+        yield {"tokens": tokens, "segments": segments}
+        i += 1
+
+
 def seq2seq_synthetic(batch_size: int, seq_len: int = 128, vocab_size: int = 32_000,
                       seed: int = 0, start_batch: int = 0,
                       **_) -> Iterator[dict[str, np.ndarray]]:
@@ -130,6 +154,7 @@ def mnist_synthetic(batch_size: int, seed: int = 0, start_batch: int = 0,
 DATASETS: dict[str, Callable[..., Iterator[dict[str, np.ndarray]]]] = {
     "lm_synthetic": lm_synthetic,
     "lm_file": lm_file,
+    "lm_packed_synthetic": lm_packed_synthetic,
     "seq2seq_synthetic": seq2seq_synthetic,
     "mlm_synthetic": mlm_synthetic,
     "imagenet_synthetic": image_synthetic,
