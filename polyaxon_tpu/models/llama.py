@@ -164,17 +164,14 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+    # dot_product_attention owns the support matrix (auto→xla for packed
+    # data, ValueError for ring/ulysses); only the flash downgrade is
+    # handled here so the O(S²) fallback is loud.
     impl = cfg.attention_impl
-    if segment_ids is not None and impl not in ("xla", "auto"):
-        if impl in ("ring", "ulysses"):
-            raise ValueError(
-                f"attention_impl='{impl}' does not support packed "
-                "sequences (segment_ids); use xla or unpacked data")
+    if segment_ids is not None and impl == "flash":
         logger.warning(
-            "attention_impl='%s' has no packed-sequence kernel; falling "
-            "back to xla (O(S^2) logits) for this model", impl)
-        impl = "xla"
-    elif segment_ids is not None:
+            "attention_impl='flash' has no packed-sequence kernel; "
+            "falling back to xla (O(S^2) logits) for this model")
         impl = "xla"
     attn = dot_product_attention(q, k, v, causal=True, impl=impl,
                                  segment_ids=segment_ids,

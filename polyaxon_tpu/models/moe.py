@@ -56,6 +56,15 @@ class MoEConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # "top_k": tokens choose experts (GShard; needs the aux loss for
+    # balance). "expert_choice": experts choose their top-capacity
+    # tokens (Zhou et al. 2022) — perfectly load-balanced by
+    # construction, no aux loss. Caveat: expert-choice selection
+    # competes across ALL positions in the batch, so token t's routing
+    # depends on later tokens — training losses are not strict
+    # autoregressive likelihoods and decode cannot reproduce
+    # training-time routing; prefer it for encoder/non-AR settings.
+    router: str = "top_k"
     max_seq_len: int = 8192
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
@@ -147,6 +156,22 @@ def moe_block(
     tokens = x.reshape(T, D)
     logits = (tokens @ router_w.astype(dt)).astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
+
+    if cfg.router == "expert_choice":
+        # Experts pick their top-`capacity` tokens: balanced by
+        # construction, so no aux loss. Tokens outside every expert's
+        # choice pass through the residual unchanged.
+        g, idx = jax.lax.top_k(probs.T, min(capacity, T))  # [E, C]
+        expert_in = tokens[idx]  # [E, C, D]
+        gate = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dt)))
+        up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dt))
+        expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down.astype(dt))
+        weighted = (g[..., None].astype(dt) * expert_out).reshape(-1, D)
+        out = jnp.zeros((T, D), dt).at[idx.reshape(-1)].add(weighted)
+        return out.reshape(B, S, D), jnp.zeros((), jnp.float32)
+    if cfg.router != "top_k":
+        raise ValueError(f"unknown MoE router `{cfg.router}`")
 
     top_probs, top_idx = jax.lax.top_k(probs, K)  # [T, K]
     top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
@@ -253,6 +278,10 @@ def apply(
     rng: Optional[jax.Array] = None,
 ):
     tokens = batch["tokens"]
+    if batch.get("segments") is not None:
+        raise ValueError(
+            "moe models do not support packed sequences (segments) yet; "
+            "use an unpacked dataset or a llama-family model")
     inputs = shift_right(tokens)
     # Chunked lm-head loss (common.chunked_lm_loss): full [B,S,V] fp32
     # logits are never materialized.

@@ -42,6 +42,46 @@ class TestMoE:
         np.testing.assert_allclose(out.reshape(-1, D), ref, atol=1e-5)
         assert float(aux) > 0.9  # ≈1 for near-uniform routing
 
+    def test_expert_choice_matches_dense_mixture(self):
+        """With capacity >= T every expert takes every token, so
+        expert-choice output equals the fully dense mixture
+        sum_e probs[t,e] * ffn_e(token_t), and aux is exactly 0."""
+        cfg = dataclasses.replace(
+            moe.CONFIGS["moe_tiny"], dtype=jnp.float32,
+            router="expert_choice", capacity_factor=100.0)
+        D, E, F = cfg.dim, cfg.n_experts, cfg.ffn_dim
+        x = jax.random.normal(jax.random.key(0), (2, 8, D), jnp.float32)
+        ks = jax.random.split(jax.random.key(1), 4)
+        rw = jax.random.normal(ks[0], (D, E)) * 0.1
+        wg = jax.random.normal(ks[1], (E, D, F)) * 0.05
+        wu = jax.random.normal(ks[2], (E, D, F)) * 0.05
+        wd = jax.random.normal(ks[3], (E, F, D)) * 0.05
+        out, aux = moe.moe_block(cfg, x, rw, wg, wu, wd)
+        assert float(aux) == 0.0
+
+        tokens = x.reshape(-1, D)
+        probs = jax.nn.softmax(tokens @ rw, -1)
+        ref = jnp.zeros_like(tokens)
+        for e in range(E):
+            h = jax.nn.silu(tokens @ wg[e]) * (tokens @ wu[e]) @ wd[e]
+            ref = ref + probs[:, e:e + 1] * h
+        np.testing.assert_allclose(out.reshape(-1, D), ref, atol=1e-5)
+
+    def test_expert_choice_trains(self, cpu_devices):
+        cfg = dataclasses.replace(moe.CONFIGS["moe_tiny"],
+                                  router="expert_choice")
+        v = moe.init(cfg, jax.random.key(0))
+        batch = {"tokens": jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                              cfg.vocab_size)}
+        loss, metrics, _ = moe.apply(cfg, v, batch)
+        assert np.isfinite(float(loss))
+        grads = jax.grad(
+            lambda p: moe.apply(cfg, {"params": p, "state": {}}, batch)[0]
+        )(v["params"])
+        assert all(bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads))
+        # Router grads must flow through the expert-choice gather/top_k.
+        assert float(jnp.abs(grads["layers"]["router"]).max()) > 0
+
     def test_capacity_drops_overflow_tokens(self):
         """capacity_factor → tiny: most tokens dropped, output ≈ partial."""
         cfg = dataclasses.replace(
