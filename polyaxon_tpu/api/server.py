@@ -236,6 +236,8 @@ class _Handler(BaseHTTPRequestHandler):
             kind = (query.get("kind") or ["metric"])[0]
             names = query.get("names")
             return self._json(plane.streams.get_events(uuid, kind, names))
+        if action == "lineage":
+            return self._json(plane.streams.get_lineage(uuid))
         if action == "outputs":
             return self._json(plane.streams.get_outputs(uuid))
         if action == "artifacts":

@@ -271,6 +271,14 @@ def ops_events(uid, kind, names):
     click.echo(json.dumps(events, indent=2, default=str))
 
 
+@ops.command("lineage")
+@click.option("-uid", "--uid", required=True)
+def ops_lineage(uid):
+    plane = get_plane()
+    click.echo(json.dumps(plane.streams.get_lineage(uid), indent=2,
+                          default=str))
+
+
 @ops.command("stop")
 @click.option("-uid", "--uid", required=True)
 def ops_stop(uid):

@@ -173,6 +173,10 @@ class RunClient:
         params += [f"names={urllib.parse.quote(n)}" for n in (names or [])]
         return self.client.get(self._run_path("/events") + "?" + "&".join(params))
 
+    def get_lineage(self) -> list:
+        """Artifact lineage records (log_artifact/log_model history)."""
+        return self.client.get(self._run_path("/lineage"))
+
     def get_outputs(self) -> dict:
         return self.client.get(self._run_path("/outputs"))
 

@@ -55,6 +55,13 @@ class StreamsService:
         names = names or list_event_names(rd, kind)
         return {name: read_events(rd, kind, name) for name in names}
 
+    def get_lineage(self, run_uuid: str) -> list[dict]:
+        """Artifact-lineage records appended by tracking.log_artifact /
+        log_model (upstream's artifact-lineage API surface)."""
+        from polyaxon_tpu.tracking.events import read_jsonl
+
+        return read_jsonl(os.path.join(self.run_dir(run_uuid), "lineage.jsonl"))
+
     # -- logs -------------------------------------------------------------
     def log_files(self, run_uuid: str) -> list[str]:
         root = os.path.join(self.run_dir(run_uuid), "logs")

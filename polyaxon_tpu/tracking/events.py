@@ -88,9 +88,9 @@ def safe_subpath(root: str, rel: str) -> str:
     return path
 
 
-def read_events(run_dir: str, kind: str, name: str,
-                since_step: Optional[int] = None) -> list[dict[str, Any]]:
-    path = safe_subpath(os.path.join(run_dir, "events", kind), f"{name}.jsonl")
+def read_jsonl(path: str) -> list[dict[str, Any]]:
+    """Tolerant jsonl reader: skips blank and torn lines (a sidecar may
+    sync a file mid-write). Shared by event and lineage readers."""
     if not os.path.exists(path):
         return []
     out = []
@@ -100,13 +100,19 @@ def read_events(run_dir: str, kind: str, name: str,
             if not line:
                 continue
             try:
-                rec = json.loads(line)
+                out.append(json.loads(line))
             except json.JSONDecodeError:
                 continue  # torn tail write mid-sync
-            if since_step is not None and (rec.get("step") or 0) <= since_step:
-                continue
-            out.append(rec)
     return out
+
+
+def read_events(run_dir: str, kind: str, name: str,
+                since_step: Optional[int] = None) -> list[dict[str, Any]]:
+    path = safe_subpath(os.path.join(run_dir, "events", kind), f"{name}.jsonl")
+    records = read_jsonl(path)
+    if since_step is not None:
+        records = [r for r in records if (r.get("step") or 0) > since_step]
+    return records
 
 
 def list_event_names(run_dir: str, kind: str) -> list[str]:

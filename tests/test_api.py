@@ -149,6 +149,30 @@ class TestApi:
             run.get_metrics(names=["../../outputs"])
         assert err.value.status == 400
 
+    def test_lineage_endpoint(self, stack):
+        import textwrap
+
+        _, server = stack
+        run = RunClient(host=server.url)
+        script = textwrap.dedent(
+            """
+            import os
+            from polyaxon_tpu.tracking import Run
+            d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+            with Run(os.environ["POLYAXON_RUN_UUID"], d) as r:
+                p = os.path.join(d, "model.bin")
+                open(p, "w").write("weights")
+                r.log_model(p, name="model.bin")
+            """
+        ).strip()
+        run.create({"kind": "component", "run": {
+            "kind": "job", "container": {"command": ["python", "-c", script]}}})
+        assert run.wait(timeout=60) == V1Statuses.SUCCEEDED
+        lineage = run.get_lineage()
+        assert len(lineage) == 1
+        assert lineage[0]["name"] == "model.bin"
+        assert lineage[0]["kind"] == "model"
+
     def test_list_runs_and_filters(self, stack):
         _, server = stack
         client = PolyaxonClient(server.url)
