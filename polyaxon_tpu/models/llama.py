@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import logging
 from typing import Any, Optional
 
 import jax
@@ -35,8 +34,6 @@ from polyaxon_tpu.models.common import (
     truncated_normal_init,
 )
 from polyaxon_tpu.ops.attention import dot_product_attention
-
-logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,16 +161,10 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-    # dot_product_attention owns the support matrix (auto→xla for packed
-    # data, ValueError for ring/ulysses); only the flash downgrade is
-    # handled here so the O(S²) fallback is loud.
-    impl = cfg.attention_impl
-    if segment_ids is not None and impl == "flash":
-        logger.warning(
-            "attention_impl='flash' has no packed-sequence kernel; "
-            "falling back to xla (O(S^2) logits) for this model")
-        impl = "xla"
-    attn = dot_product_attention(q, k, v, causal=True, impl=impl,
+    # dot_product_attention owns the impl support matrix (xla and flash
+    # both handle packed segment_ids; ring/ulysses raise).
+    attn = dot_product_attention(q, k, v, causal=True,
+                                 impl=cfg.attention_impl,
                                  segment_ids=segment_ids,
                                  window=cfg.sliding_window)
     x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)

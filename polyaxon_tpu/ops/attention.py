@@ -80,20 +80,21 @@ def dot_product_attention(
 ) -> jax.Array:
     if impl == "auto":
         # Flash on real TPU (it self-falls-back when shapes don't tile);
-        # einsum reference elsewhere and for packed sequences.
-        impl = ("flash" if segment_ids is None
-                and jax.default_backend() == "tpu" else "xla")
+        # einsum reference elsewhere.
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                              window=window)
-    if segment_ids is not None:
-        raise ValueError(
-            f"segment_ids (packed sequences) only supported by impl='xla', got `{impl}`"
-        )
     if impl == "flash":
         from polyaxon_tpu.ops.flash import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, window=window)
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               segment_ids=segment_ids)
+    if segment_ids is not None:
+        raise ValueError(
+            f"segment_ids (packed sequences) only supported by "
+            f"impl='xla'/'flash', got `{impl}`"
+        )
     if window is not None:
         raise ValueError(
             f"sliding window is supported by impl='xla'/'flash', got `{impl}`")

@@ -58,18 +58,21 @@ def _fwd_kernel(
     q_ref,  # [1, 1, block_q, D]
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
-    o_ref,  # [1, 1, block_q, D]
-    lse_ref,  # [1, 1, block_q, 1]
-    acc_ref,  # VMEM [block_q, D] f32
-    m_ref,  # VMEM [block_q, LANES] f32
-    l_ref,  # VMEM [block_q, LANES] f32
-    *,
+    *rest,  # [qseg [1,block_q], kseg [1,block_k] when use_segments,]
+            # o [1,1,block_q,D], lse [1,1,block_q,1],
+            # acc/m/l VMEM scratch
     causal: bool,
     scale: float,
     block_q: int,
     block_k: int,
     window: int,  # 0 = unbounded
+    use_segments: bool,
 ):
+    if use_segments:
+        qseg_ref, kseg_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        qseg_ref = kseg_ref = None
     qi, ki = pl.program_id(2), pl.program_id(3)
     n_k = pl.num_programs(3)
 
@@ -97,6 +100,7 @@ def _fwd_kernel(
         )
         s *= scale  # [block_q, block_k]
 
+        mask = None
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -107,12 +111,16 @@ def _fwd_kernel(
             mask = rows >= cols
             if window:
                 mask &= rows - cols < window
+        if use_segments:
+            seg = qseg_ref[0][:, None] == kseg_ref[0][None, :]
+            mask = seg if mask is None else mask & seg
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
         l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -138,6 +146,7 @@ def _flash_fwd_pallas(
     q: jax.Array,  # [B, H, Sq, D]
     k: jax.Array,  # [B, KV, Sk, D]
     v: jax.Array,
+    segments,  # [B, Sq] int32 or None (packed-sequence ids)
     causal: bool,
     scale: float,
     block_q: int,
@@ -151,9 +160,11 @@ def _flash_fwd_pallas(
     n_rep = h // kv
     grid = (b, h, sq // block_q, sk // block_k)
 
+    use_segments = segments is not None
+
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, window=window,
+        block_k=block_k, window=window, use_segments=use_segments,
     )
     compiler_params = None
     if pltpu is not None and not interpret:
@@ -178,7 +189,10 @@ def _flash_fwd_pallas(
                 (1, 1, block_k, d),
                 lambda b_, h_, qi, ki, n_rep=n_rep: (b_, h_ // n_rep, ki, 0),
             ),
-        ],
+        ] + ([
+            pl.BlockSpec((1, block_q), lambda b_, h_, qi, ki: (b_, qi)),
+            pl.BlockSpec((1, block_k), lambda b_, h_, qi, ki: (b_, ki)),
+        ] if use_segments else []),
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
@@ -190,7 +204,7 @@ def _flash_fwd_pallas(
         scratch_shapes=scratch,
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *([segments.astype(jnp.int32)] * 2 if use_segments else []))
     return o, lse[..., 0]
 
 
@@ -203,7 +217,7 @@ def _flash_bwd_xla(
     do: jax.Array,
 ):
     """Chunked recompute backward: O(Sq·block_k) live logits."""
-    q, k, v, o, lse = res  # q,o: [B,H,Sq,D]; k,v: [B,KV,Sk,D]; lse: [B,H,Sq]
+    q, k, v, segments, o, lse = res  # q,o: [B,H,Sq,D]; lse: [B,H,Sq]
     b, h, sq, dh = q.shape
     kv = k.shape[1]
     sk = k.shape[2]
@@ -236,18 +250,28 @@ def _flash_bwd_xla(
             rows_b = start + jnp.arange(span)
         else:
             q_b, do_b, delta_b, lse_b, rows_b = q, do, delta, lse, rows
+        if segments is not None:
+            seg_k = jax.lax.dynamic_slice_in_dim(
+                segments, ki * block_k, block_k, axis=1)  # [B, block_k]
+            seg_q = (jax.lax.dynamic_slice_in_dim(segments, start, span, axis=1)
+                     if span < sq else segments)  # [B, span]
         s = (
             jnp.einsum(
                 "bhqd,bhkd->bhqk", q_b, kj_h, preferred_element_type=jnp.float32
             )
             * scale
         )
+        mask = None  # broadcastable [B?, 1, span, block_k]
         if causal:
             cols = ki * block_k + jnp.arange(block_k)
-            mask = rows_b[:, None] >= cols[None, :]
+            mask = (rows_b[:, None] >= cols[None, :])[None, None]
             if window:
-                mask &= rows_b[:, None] - cols[None, :] < window
-            p = jnp.where(mask[None, None], jnp.exp(s - lse_b[..., None]), 0.0)
+                mask &= (rows_b[:, None] - cols[None, :] < window)[None, None]
+        if segments is not None:
+            seg_mask = (seg_q[:, :, None] == seg_k[:, None, :])[:, None]
+            mask = seg_mask if mask is None else mask & seg_mask
+        if mask is not None:
+            p = jnp.where(mask, jnp.exp(s - lse_b[..., None]), 0.0)
         else:
             p = jnp.exp(s - lse_b[..., None])
         dv_h = jnp.einsum(
@@ -286,22 +310,25 @@ def _flash_bwd_xla(
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window):
-    o, _ = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                             interpret, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, segments, causal, scale, block_q, block_k, interpret,
+           window):
+    o, _ = _flash_fwd_pallas(q, k, v, segments, causal, scale, block_q,
+                             block_k, interpret, window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret, window):
-    o, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                               interpret, window)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, segments, causal, scale, block_q, block_k,
+                    interpret, window):
+    o, lse = _flash_fwd_pallas(q, k, v, segments, causal, scale, block_q,
+                               block_k, interpret, window)
+    return o, (q, k, v, segments, o, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, window, res, do):
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, window,
+                    res, do):
     del block_q, interpret
-    return _flash_bwd_xla(causal, scale, block_k, window, res, do)
+    return _flash_bwd_xla(causal, scale, block_k, window, res, do) + (None,)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -318,12 +345,16 @@ def flash_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] packed-sequence ids
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] layouts with GQA support.
 
     ``window``: sliding-window (Mistral-style) causal attention — each
     query attends to its last ``window`` positions; K/V blocks entirely
     outside the band are skipped, so compute is O(S·window).
+
+    ``segment_ids``: packed sequences — attention is additionally
+    restricted to equal segment ids (requires Sq == Sk).
 
     Falls back to the einsum reference (``ops.attention.xla_attention``)
     when shapes don't tile (seq not divisible into >=128 blocks, or
@@ -336,13 +367,17 @@ def flash_attention(
         raise ValueError(f"q heads {h} not a multiple of kv heads {kv}")
     if window is not None and (window < 1 or not causal):
         raise ValueError("window must be >= 1 and requires causal attention")
+    if segment_ids is not None and sq != sk:
+        raise ValueError(
+            f"segment_ids requires Sq == Sk, got {sq} vs {sk}")
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     if pltpu is None or bq < 128 or bk < 128 or (d % 128 and d != 64):
         from polyaxon_tpu.ops.attention import xla_attention
 
         return xla_attention(q, k, v, causal=causal,
-                             softmax_scale=softmax_scale, window=window)
+                             softmax_scale=softmax_scale, window=window,
+                             segment_ids=segment_ids)
     if interpret is None:
         interpret = _default_interpret()
     scale = softmax_scale if softmax_scale is not None else d**-0.5
@@ -352,5 +387,6 @@ def flash_attention(
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    o = _flash(qT, kT, vT, causal, scale, bq, bk, interpret, window or 0)
+    o = _flash(qT, kT, vT, segment_ids, causal, scale, bq, bk, interpret,
+               window or 0)
     return o.transpose(0, 2, 1, 3)
