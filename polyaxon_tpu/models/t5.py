@@ -223,6 +223,135 @@ def forward(cfg: T5Config, params: dict, inputs: jax.Array,
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
 
 
+# ---------------------------------------------------------------- decode
+def precompute_cross_kv(cfg: T5Config, params: dict,
+                        enc_out: jax.Array) -> dict:
+    """Cross-attention K/V from the encoder output, computed once per
+    request: {k, v: [L, B, Se, H, Hd]}."""
+    B, Se, _ = enc_out.shape
+    H, Hd = cfg.n_heads, cfg.head_dim
+
+    def layer_kv(_, layer):
+        kv = enc_out @ layer["xkv"].astype(cfg.dtype)
+        k, v = jnp.split(kv, 2, axis=-1)
+        return None, (k.reshape(B, Se, H, Hd), v.reshape(B, Se, H, Hd))
+
+    _, (k_all, v_all) = jax.lax.scan(layer_kv, None, params["dec_layers"])
+    return {"k": k_all, "v": v_all}
+
+
+def init_decoder_cache(cfg: T5Config, batch: int, max_len: int) -> dict:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def decode_step(
+    cfg: T5Config,
+    params: dict,
+    cross: dict,  # precompute_cross_kv output
+    cache: dict,  # init_decoder_cache output
+    tokens: jax.Array,  # [B] int32 current decoder-input ids
+    pos: jax.Array,  # scalar int32 position being written
+) -> tuple[jax.Array, dict]:
+    """One autoregressive decoder step → (logits [B, V] fp32, cache).
+
+    Precondition: ``pos < cache length`` — the T5 decoder is full-causal
+    (no sliding window), so the cache cannot wrap like the Llama ring
+    buffer; an out-of-range ``pos`` would silently clamp the write.
+    ``generate`` sizes the cache to ``max_new_tokens`` so this holds.
+    """
+    dt = cfg.dtype
+    B = tokens.shape[0]
+    H, Hd = cfg.n_heads, cfg.head_dim
+    C = cache["k"].shape[2]
+    if isinstance(pos, int) and pos >= C:
+        raise ValueError(f"decode position {pos} out of cache range {C}")
+    positions = jnp.full((B, 1), pos, jnp.int32)
+    x = params["embed"].astype(dt)[tokens][:, None, :]
+
+    valid = (jnp.arange(C) <= pos)[None, None, None, :]
+
+    def layer_step(x, inputs):
+        layer, k_cache, v_cache, xk, xv = inputs
+        # Causal self-attention over the cache.
+        h = rms_norm(x, layer["self_norm"], cfg.norm_eps)
+        q = rope((h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd),
+                 positions, cfg.rope_theta)
+        k = rope((h @ layer["wk"].astype(dt)).reshape(B, 1, H, Hd),
+                 positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, H, Hd)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
+        s = jnp.where(valid, s * (Hd ** -0.5), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+        x = x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt)
+
+        # Cross-attention over the precomputed encoder K/V.
+        h = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
+        q = (h @ layer["xq"].astype(dt)).reshape(B, 1, H, Hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, xk).astype(jnp.float32)
+        p = jax.nn.softmax(s * (Hd ** -0.5), axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, xv)
+        x = x + attn.reshape(B, 1, H * Hd) @ layer["xo"].astype(dt)
+
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.gelu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x,
+        (params["dec_layers"], cache["k"], cache["v"],
+         cross["k"], cross["v"]))
+    x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate(
+    cfg: T5Config,
+    params: dict,
+    inputs: jax.Array,  # [B, Se] encoder input ids
+    *,
+    max_new_tokens: int,
+    bos_id: int = 0,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature 0) or sampled seq2seq generation: [B, max_new].
+    The encoder runs once; the decoder steps through a KV cache starting
+    from BOS (matching apply()'s shift_right convention)."""
+    B = inputs.shape[0]
+    sampling = isinstance(temperature, jax.Array) or temperature > 0
+    if sampling and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+
+    enc_out = encode(cfg, params, inputs)
+    cross = precompute_cross_kv(cfg, params, enc_out)
+    cache = init_decoder_cache(cfg, B, max_new_tokens)
+
+    def sample(logits, key):
+        if sampling:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def decode_loop(carry, t):
+        cache, token, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(cfg, params, cross, cache, token, t)
+        nxt = sample(logits, sub).astype(jnp.int32)
+        return (cache, nxt, key), nxt
+
+    bos = jnp.full((B,), bos_id, jnp.int32)
+    _, tokens = jax.lax.scan(
+        decode_loop, (cache, bos, rng), jnp.arange(max_new_tokens))
+    return tokens.T  # [B, max_new]
+
+
 def apply(
     cfg: T5Config,
     variables: Variables,
