@@ -36,6 +36,9 @@ from polyaxon_tpu.models.common import (
 from polyaxon_tpu.ops.attention import dot_product_attention
 
 
+SEQ2SEQ = False  # serving contract: the prompt is continued in place
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128_256

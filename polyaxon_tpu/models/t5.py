@@ -40,6 +40,9 @@ from polyaxon_tpu.models.common import (
 from polyaxon_tpu.ops.attention import dot_product_attention
 
 
+SEQ2SEQ = True  # serving contract: prompt = encoder input, decode from BOS
+
+
 @dataclasses.dataclass(frozen=True)
 class T5Config:
     vocab_size: int = 32_128

@@ -3,10 +3,12 @@
 The reference's service kind just exposes a user container's port
 (SURVEY.md §2 "Operator": Deployment+Service) — serving *content* is the
 user's problem. Here the framework owns a TPU-native serving path too:
-KV-cache prefill + decode (models.llama) behind a stdlib HTTP endpoint,
-so a Polyaxonfile service can run
+KV-cache generation (llama-family decoders: prefill + ring-buffer
+decode; t5-family seq2seq: encode once + decoder cache from BOS) behind
+a stdlib HTTP endpoint, so a Polyaxonfile service can run
 ``python -m polyaxon_tpu.serving --model llama3_8b --checkpoint <dir>``
-with no user code.
+with no user code. Decoders bound prompt+budget by max_seq_len;
+seq2seq bounds encoder prompt and decode budget separately.
 
 TPU-first details:
 - prompt lengths and generation budgets are bucketed to powers of two so
@@ -48,13 +50,25 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _family(model: str):
+    """Model family module with CONFIGS/init/generate and a SEQ2SEQ
+    flag (llama-style decoders and t5-style encoder-decoders)."""
+    from polyaxon_tpu.models import llama, t5
+
+    for mod in (llama, t5):
+        if model in mod.CONFIGS:
+            return mod
+    raise ValueError(
+        f"model `{model}` is not servable; decoders: "
+        f"{sorted(llama.CONFIGS)}, seq2seq: {sorted(t5.CONFIGS)}")
+
+
 def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0):
     """Model params: latest step of an Orbax checkpoint dir (a saved
     JAXJob train state or a bare params tree), else random init."""
-    from polyaxon_tpu.models import llama
-
-    cfg = llama.CONFIGS[model]
-    variables = llama.init(cfg, jax.random.key(seed))
+    family = _family(model)
+    cfg = family.CONFIGS[model]
+    variables = family.init(cfg, jax.random.key(seed))
     params = variables["params"]
     if checkpoint:
         import orbax.checkpoint as ocp
@@ -83,15 +97,17 @@ def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0):
 
 
 class _Engine:
-    """Bucketed, jitted prefill+decode around models.llama.generate."""
+    """Bucketed, jitted generation around the family's generate()."""
 
     def __init__(self, model: str, cfg, params):
         self.model = model
         self.cfg = cfg
         self.params = params
         self._lock = threading.Lock()  # one TPU program at a time
-
-        from polyaxon_tpu.models import llama
+        family = _family(model)
+        # seq2seq families decode into their own cache; the prompt is
+        # the encoder input, so prompt and budget are bounded separately.
+        self.seq2seq = bool(getattr(family, "SEQ2SEQ", False))
 
         @functools.lru_cache(maxsize=16)
         def compiled(prompt_len: int, max_new: int, sampling: bool):
@@ -99,7 +115,9 @@ class _Engine:
             # key — only the greedy/sampling mode switches programs, so
             # a client sweeping temperatures reuses one executable.
             def run(params, prompt, rng, temperature):
-                return llama.generate(
+                # llama: prompt continues; t5: prompt is the encoder
+                # input and generation starts from BOS.
+                return family.generate(
                     self.cfg, params, prompt, max_new_tokens=max_new,
                     temperature=temperature if sampling else 0.0, rng=rng)
 
@@ -125,12 +143,20 @@ class _Engine:
         groups: dict[int, list[int]] = {}
         for i, row in enumerate(token_rows):
             groups.setdefault(len(row), []).append(i)
-        results: list[Optional[list[int]]] = [None] * len(token_rows)
-        for plen, idxs in groups.items():
-            if plen + n_bucket > self.cfg.max_seq_len:
+        # Validate every group before running any (no TPU work is spent
+        # on a batch that will be rejected).
+        for plen in groups:
+            if self.seq2seq:
+                if max(plen, n_bucket) > self.cfg.max_seq_len:
+                    raise ValueError(
+                        f"prompt {plen} or generation budget {n_bucket} "
+                        f"exceeds max_seq_len {self.cfg.max_seq_len}")
+            elif plen + n_bucket > self.cfg.max_seq_len:
                 raise ValueError(
                     f"prompt {plen} + generation budget {n_bucket} exceeds "
                     f"max_seq_len {self.cfg.max_seq_len}")
+        results: list[Optional[list[int]]] = [None] * len(token_rows)
+        for plen, idxs in groups.items():
             batch = np.asarray([token_rows[i] for i in idxs], np.int32)
             fn = self._compiled(plen, n_bucket, sampling)
             with self._lock:

@@ -107,6 +107,20 @@ class TestServing:
             out = _post(s.url, {"tokens": [[5, 6, 7]], "max_new_tokens": 4})
             assert len(out["tokens"][0]) == 4
 
+    def test_serves_t5_seq2seq(self):
+        with ServingServer("t5_tiny", seed=0) as s:
+            out = _post(s.url, {"tokens": [[5, 6, 7, 8]], "max_new_tokens": 6})
+            assert len(out["tokens"][0]) == 6
+            again = _post(s.url, {"tokens": [[5, 6, 7, 8]],
+                                  "max_new_tokens": 6})
+            assert again["tokens"] == out["tokens"]
+            with urllib.request.urlopen(s.url + "/v1/models", timeout=10) as r:
+                assert json.load(r) == {"models": ["t5_tiny"]}
+
+    def test_unknown_model_rejected(self):
+        with pytest.raises(ValueError, match="not servable"):
+            ServingServer("resnet50")
+
     def test_load_params_restores_checkpoint(self, tmp_path):
         import jax
 
