@@ -245,8 +245,29 @@ def ops_outputs(uid):
 
 @ops.command("artifacts")
 @click.option("-uid", "--uid", required=True)
-def ops_artifacts(uid):
+@click.option("--download", "download_rel", default=None,
+              help="run-relative artifact path to copy out")
+@click.option("-o", "--output", default=".",
+              help="(with --download) destination file or directory")
+def ops_artifacts(uid, download_rel, output):
+    import shutil
+
     plane = get_plane()
+    if download_rel:
+        try:
+            src = plane.streams.artifact_path(uid, download_rel)
+        except ValueError as exc:  # traversal guard → clean CLI error
+            raise click.ClickException(str(exc)) from exc
+        if not os.path.isfile(src):
+            raise click.ClickException(f"artifact not found: {download_rel}")
+        dest = output
+        # A trailing slash or an existing dir both mean "into this dir".
+        if os.path.isdir(dest) or dest.endswith(os.sep):
+            dest = os.path.join(dest, os.path.basename(download_rel))
+        os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        shutil.copy2(src, dest)
+        click.echo(dest)
+        return
     for rel in plane.streams.list_artifacts(uid):
         click.echo(rel)
 

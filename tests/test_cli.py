@@ -68,6 +68,35 @@ class TestRunAndOps:
         assert result.exit_code == 0, result.output
         assert json.loads(result.output)["w"][0]["counts"] == [2, 2]
 
+    def test_ops_artifacts_download(self, runner, tmp_path):
+        result = runner.invoke(cli, ["run", "-f", FIXTURE])
+        uid = result.output.split("Run created: ")[1].split()[0]
+        from polyaxon_tpu.cli.main import get_plane
+
+        rd = get_plane().streams.run_dir(uid)
+        os.makedirs(rd, exist_ok=True)
+        with open(os.path.join(rd, "outputs.json"), "w") as fh:
+            fh.write('{"x": 1}')
+        dest = tmp_path / "dl"
+        dest.mkdir()
+        result = runner.invoke(cli, ["ops", "artifacts", "-uid", uid,
+                                     "--download", "outputs.json",
+                                     "-o", str(dest)])
+        assert result.exit_code == 0, result.output
+        assert (dest / "outputs.json").read_text() == '{"x": 1}'
+        # Traversal through --download is a clean CLI error, not a crash.
+        result = runner.invoke(cli, ["ops", "artifacts", "-uid", uid,
+                                     "--download", "../../etc/passwd"])
+        assert result.exit_code != 0
+        assert result.exception is None or isinstance(
+            result.exception, SystemExit)
+        # A not-yet-existing trailing-slash destination means "into dir".
+        result = runner.invoke(cli, ["ops", "artifacts", "-uid", uid,
+                                     "--download", "outputs.json",
+                                     "-o", str(tmp_path / "newdir") + os.sep])
+        assert result.exit_code == 0, result.output
+        assert (tmp_path / "newdir" / "outputs.json").exists()
+
     def test_projects(self, runner):
         assert runner.invoke(cli, ["projects", "create", "--name", "p9"]).exit_code == 0
         result = runner.invoke(cli, ["projects", "ls"])
