@@ -38,6 +38,12 @@ class ModelDef:
     # tokens (LM) or samples (vision) consumed per batch element; used by
     # the runtime for throughput accounting.
     unit: str = "examples"
+    # Metric keys that are mask-independent per-microbatch means (e.g.
+    # MoE router aux): gradient accumulation averages them uniformly
+    # instead of valid-token-weighted. A model with such a loss term
+    # must also expose it as the differentiable ``loss_unweighted``
+    # metric so the accumulated gradient stays exact.
+    uniform_metrics: tuple = ()
 
 
 def truncated_normal_init(rng, shape, dtype=jnp.float32, stddev=0.02):

@@ -187,7 +187,8 @@ def moe_block(
     keep = pos_in_expert < capacity
 
     # dispatch[t, e, c] = 1 where token t sits in slot c of expert e.
-    slot_onehot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    slot_onehot = jax.nn.one_hot(
+        pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)
     dispatch = jnp.einsum(
         "kte,ktc->tec", oh_km,
         slot_onehot * keep[..., None].astype(jnp.float32))
@@ -289,7 +290,11 @@ def apply(
     head = variables["params"]["lm_head"].astype(cfg.dtype)
     ce, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"))
     loss = ce + cfg.router_aux_coef * aux
+    # ``loss_unweighted``: the mask-independent component, exposed so
+    # gradient accumulation can weight it per-microbatch (1/k) instead
+    # of by valid-token count (runtime/step.py grads_of).
     return loss, {"loss": loss, "ce_loss": ce, "router_aux": aux,
+                  "loss_unweighted": cfg.router_aux_coef * aux,
                   "accuracy": acc}, variables["state"]
 
 
@@ -301,4 +306,5 @@ def model_def(name: str, **overrides) -> ModelDef:
         apply=functools.partial(apply, cfg),
         logical_axes=functools.partial(logical_axes, cfg),
         unit="tokens",
+        uniform_metrics=("router_aux",),
     )

@@ -30,7 +30,7 @@ def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
-def xla_attention(
+def xla_attention_with_lse(
     q: jax.Array,  # [B, Sq, H, D]
     k: jax.Array,  # [B, Sk, Hkv, D]
     v: jax.Array,  # [B, Sk, Hkv, D]
@@ -39,7 +39,13 @@ def xla_attention(
     segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     window: Optional[int] = None,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
+    """Einsum attention that also returns the row logsumexp
+    ``[B, H, Sq]`` (f32) — the flash residual. Partial attentions over
+    key shards merge exactly via (o, lse), which is what ring attention
+    does with the per-block results. Plain differentiable jnp: no
+    custom vjp needed. When jitted with the lse unused, XLA dead-code
+    eliminates it, so ``xla_attention`` is this function's first half."""
     if window is not None:
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
@@ -63,8 +69,27 @@ def xla_attention(
     if segment_ids is not None:
         seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         logits = jnp.where(seg_mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    unnorm = jnp.exp(logits - m)
+    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
+    lse = (m + jnp.log(denom))[..., 0]  # [B, H, Sq]
+    probs = (unnorm / denom).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v), lse
+
+
+def xla_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jax.Array:
+    return xla_attention_with_lse(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        softmax_scale=softmax_scale, window=window)[0]
 
 
 def dot_product_attention(

@@ -10,11 +10,18 @@ the diagonal are skipped with ``pl.when`` (no wasted MXU cycles), and
 GQA is handled in the K/V index maps (kv head = q head // n_rep) so
 grouped heads are never materialized ``n_rep`` times in HBM.
 
-The backward pass is a chunked XLA pass under ``jax.custom_vjp``: it
-recomputes attention probabilities one K/V block at a time from the
-saved logsumexp (the standard flash residual), so the bwd also never
-materializes S×S — while remaining a plain differentiable-free XLA
-program that runs identically on TPU and the CPU test mesh.
+The backward pass under ``jax.custom_vjp`` has two implementations:
+
+- **Pallas** (default on real TPU): the FlashAttention-2 split — a
+  dk/dv kernel gridded over K/V blocks that streams Q blocks (GQA
+  groups accumulate onto their shared kv head inside VMEM scratch, so
+  dk/dv never materialize per-q-head), and a dq kernel gridded like
+  the forward. Both recompute P from the saved logsumexp residual,
+  keep every matmul on the MXU in f32 accumulation, and skip causal /
+  out-of-window blocks with ``pl.when``.
+- **Chunked XLA** (CPU test mesh, non-tiling shapes, and the parity
+  reference): recomputes attention probabilities one K/V block at a
+  time from the same residual, so it also never materializes S×S.
 
 The reference delegates attention entirely to user frameworks
 (SURVEY.md §2b: no model math in-repo); this kernel is owned surface.
@@ -54,6 +61,42 @@ def pick_block(seq: int, preferred: int) -> int:
 _pick_block = pick_block  # internal alias
 
 
+def _block_visible(qi, ki, block_q: int, block_k: int, causal: bool,
+                   window: int):
+    """Whether block (qi, ki) contributes at all — the grid-skip
+    predicate shared by the fwd and both bwd kernels. Causal blocks
+    strictly above the diagonal contribute nothing; with a sliding
+    window, blocks entirely below the band neither."""
+    if not causal:
+        return True
+    visible = qi * block_q + block_q > ki * block_k
+    if window:
+        in_band = ki * block_k + block_k > qi * block_q - (window - 1)
+        visible = jnp.logical_and(visible, in_band)
+    return visible
+
+
+def _block_mask(qi, ki, block_q: int, block_k: int, causal: bool,
+                window: int, qseg_ref, kseg_ref):
+    """The in-block [block_q, block_k] validity mask (or None when the
+    whole block is valid) — single source of truth for the causal
+    triangle, window band, and packed-segment masking used identically
+    by all three kernels."""
+    mask = None
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = rows >= cols
+        if window:
+            mask &= rows - cols < window
+    if qseg_ref is not None:
+        seg = qseg_ref[0][:, None] == kseg_ref[0][None, :]
+        mask = seg if mask is None else mask & seg
+    return mask
+
+
 def _fwd_kernel(
     q_ref,  # [1, 1, block_q, D]
     k_ref,  # [1, 1, block_k, D]
@@ -82,16 +125,7 @@ def _fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: blocks strictly above the diagonal contribute nothing;
-    # with a sliding window, blocks entirely below the band neither.
-    should_compute = True
-    if causal:
-        should_compute = qi * block_q + block_q > ki * block_k
-        if window:
-            in_band = ki * block_k + block_k > qi * block_q - (window - 1)
-            should_compute = jnp.logical_and(should_compute, in_band)
-
-    @pl.when(should_compute)
+    @pl.when(_block_visible(qi, ki, block_q, block_k, causal, window))
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -100,20 +134,8 @@ def _fwd_kernel(
         )
         s *= scale  # [block_q, block_k]
 
-        mask = None
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            mask = rows >= cols
-            if window:
-                mask &= rows - cols < window
-        if use_segments:
-            seg = qseg_ref[0][:, None] == kseg_ref[0][None, :]
-            mask = seg if mask is None else mask & seg
+        mask = _block_mask(qi, ki, block_q, block_k, causal, window,
+                           qseg_ref, kseg_ref)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
@@ -215,6 +237,7 @@ def _flash_bwd_xla(
     window: int,
     res,
     do: jax.Array,
+    dlse: jax.Array,  # [B,H,Sq] cotangent of the lse output
 ):
     """Chunked recompute backward: O(Sq·block_k) live logits."""
     q, k, v, segments, o, lse = res  # q,o: [B,H,Sq,D]; lse: [B,H,Sq]
@@ -247,9 +270,11 @@ def _flash_bwd_xla(
             do_b = jax.lax.dynamic_slice_in_dim(do, start, span, axis=2)
             delta_b = jax.lax.dynamic_slice_in_dim(delta, start, span, axis=2)
             lse_b = jax.lax.dynamic_slice_in_dim(lse, start, span, axis=2)
+            dlse_b = jax.lax.dynamic_slice_in_dim(dlse, start, span, axis=2)
             rows_b = start + jnp.arange(span)
         else:
             q_b, do_b, delta_b, lse_b, rows_b = q, do, delta, lse, rows
+            dlse_b = dlse
         if segments is not None:
             seg_k = jax.lax.dynamic_slice_in_dim(
                 segments, ki * block_k, block_k, axis=1)  # [B, block_k]
@@ -281,7 +306,8 @@ def _flash_bwd_xla(
         dp = jnp.einsum(
             "bhqd,bhkd->bhqk", do_b, vj_h, preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_b[..., None]) * scale  # [B,H,span,block_k] f32
+        # d lse/d s_j = p_j, so the lse cotangent enters ds additively.
+        ds = p * (dp - delta_b[..., None] + dlse_b[..., None]) * scale
         dk_h = jnp.einsum(
             "bhqk,bhqd->bhkd", ds.astype(q.dtype), q_b,
             preferred_element_type=jnp.float32,
@@ -310,25 +336,276 @@ def _flash_bwd_xla(
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _bwd_dkdv_kernel(
+    q_ref,      # [1, 1, block_q, D]   (q head = kv*n_rep + r)
+    k_ref,      # [1, 1, block_k, D]
+    v_ref,      # [1, 1, block_k, D]
+    do_ref,     # [1, 1, block_q, D]
+    delta_ref,  # [1, 1, block_q, 1]
+    lse_ref,    # [1, 1, block_q, 1]
+    dlse_ref,   # [1, 1, block_q, 1]  cotangent of the lse output
+    *rest,      # [qseg [1,block_q], kseg [1,block_k] when use_segments,]
+                # dk [1,1,block_k,D], dv [1,1,block_k,D], scratch x2
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    window: int,
+    use_segments: bool,
+):
+    if use_segments:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        qseg_ref = kseg_ref = None
+    ki = pl.program_id(2)
+    r, qi = pl.program_id(3), pl.program_id(4)
+    n_rep, n_q = pl.num_programs(3), pl.num_programs(4)
+
+    @pl.when(jnp.logical_and(r == 0, qi == 0))
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_visible(qi, ki, block_q, block_k, causal, window))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+
+        mask = _block_mask(qi, ki, block_q, block_k, causal, window,
+                           qseg_ref, kseg_ref)
+        p = jnp.exp(s - lse_ref[0, 0])  # lse block: [block_q, 1]
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+
+        do = do_ref[0, 0]
+        dv_acc[:] += jax.lax.dot_general(  # p^T @ do → [block_k, D]
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(  # do @ v^T → [block_q, block_k]
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # d lse/d s_j = p_j, so an lse cotangent enters ds additively.
+        ds = p * (dp - delta_ref[0, 0] + dlse_ref[0, 0]) * scale
+        dk_acc[:] += jax.lax.dot_general(  # ds^T @ q → [block_k, D]
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(jnp.logical_and(r == n_rep - 1, qi == n_q - 1))
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref,      # [1, 1, block_q, D]
+    k_ref,      # [1, 1, block_k, D]
+    v_ref,      # [1, 1, block_k, D]
+    do_ref,     # [1, 1, block_q, D]
+    delta_ref,  # [1, 1, block_q, 1]
+    lse_ref,    # [1, 1, block_q, 1]
+    dlse_ref,   # [1, 1, block_q, 1]  cotangent of the lse output
+    *rest,      # [qseg, kseg when use_segments,] dq, dq_acc scratch
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    window: int,
+    use_segments: bool,
+):
+    if use_segments:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        qseg_ref = kseg_ref = None
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_visible(qi, ki, block_q, block_k, causal, window))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+        mask = _block_mask(qi, ki, block_q, block_k, causal, window,
+                           qseg_ref, kseg_ref)
+        p = jnp.exp(s - lse_ref[0, 0])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0] + dlse_ref[0, 0]) * scale
+        dq_acc[:] += jax.lax.dot_general(  # ds @ k → [block_q, D]
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    window: int,
+    interpret: bool,
+    res,
+    do: jax.Array,
+    dlse: jax.Array,  # [B,H,Sq] cotangent of the lse output
+):
+    """FlashAttention-2 backward as two Pallas kernels (see module
+    docstring). Gradients accumulate in f32 VMEM scratch; dk/dv for a
+    GQA group accumulate onto the shared kv head inside the kernel, so
+    per-q-head dk/dv tensors are never materialized in HBM."""
+    q, k, v, segments, o, lse = res  # q,o: [B,H,Sq,D]; lse: [B,H,Sq]
+    b, h, sq, d = q.shape
+    kv = k.shape[1]
+    sk = k.shape[2]
+    n_rep = h // kv
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,H,Sq,1]
+    lse4 = lse[..., None]  # [B,H,Sq,1]
+    dlse4 = dlse.astype(jnp.float32)[..., None]  # [B,H,Sq,1]
+    use_segments = segments is not None
+    seg_args = ([segments.astype(jnp.int32)] * 2) if use_segments else []
+
+    n_q, n_k = sq // block_q, sk // block_k
+    common = dict(causal=causal, scale=scale, block_q=block_q,
+                  block_k=block_k, window=window, use_segments=use_segments)
+
+    def cparams(n_parallel: int, n_arbitrary: int):
+        if pltpu is None or interpret:
+            return None
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * n_parallel
+            + ("arbitrary",) * n_arbitrary)
+
+    # dk/dv: grid (b, kv, k_block, group_rep, q_block); the two inner
+    # dims revisit the same (b, kv, k_block) output block, so the
+    # accumulators live in scratch and are written once at the end.
+    dkdv_grid = (b, kv, n_k, n_rep, n_q)
+    qmap = lambda b_, kvh, ki, r, qi, n=n_rep: (b_, kvh * n + r, qi, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, **common),
+        grid=dkdv_grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, kvh, ki, r, qi: (b_, kvh, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, kvh, ki, r, qi: (b_, kvh, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_q, 1), qmap),
+            pl.BlockSpec((1, 1, block_q, 1), qmap),
+            pl.BlockSpec((1, 1, block_q, 1), qmap),
+        ] + ([
+            pl.BlockSpec((1, block_q), lambda b_, kvh, ki, r, qi: (b_, qi)),
+            pl.BlockSpec((1, block_k), lambda b_, kvh, ki, r, qi: (b_, ki)),
+        ] if use_segments else []),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, kvh, ki, r, qi: (b_, kvh, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, kvh, ki, r, qi: (b_, kvh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, kv, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=cparams(3, 2),
+        interpret=interpret,
+    )(q, k, v, do, delta, lse4, dlse4, *seg_args)
+
+    # dq: gridded like the forward, accumulating over k blocks.
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki, n=n_rep: (b_, h_ // n, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, qi, ki, n=n_rep: (b_, h_ // n, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ] + ([
+            pl.BlockSpec((1, block_q), lambda b_, h_, qi, ki: (b_, qi)),
+            pl.BlockSpec((1, block_k), lambda b_, h_, qi, ki: (b_, ki)),
+        ] if use_segments else []),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=cparams(3, 1),
+        interpret=interpret,
+    )(q, k, v, do, delta, lse4, dlse4, *seg_args)[0]
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, segments, causal, scale, block_q, block_k, interpret,
-           window):
-    o, _ = _flash_fwd_pallas(q, k, v, segments, causal, scale, block_q,
+           window, bwd_impl):
+    """Returns (o, lse). Differentiable in both outputs — an lse
+    cotangent (ring attention's online merge uses lse) enters the bwd
+    as an additive term in ds. Callers that only need o discard lse;
+    its cotangent is then structurally zero."""
+    return _flash_fwd_pallas(q, k, v, segments, causal, scale, block_q,
                              block_k, interpret, window)
-    return o
 
 
 def _flash_fwd_rule(q, k, v, segments, causal, scale, block_q, block_k,
-                    interpret, window):
+                    interpret, window, bwd_impl):
     o, lse = _flash_fwd_pallas(q, k, v, segments, causal, scale, block_q,
                                block_k, interpret, window)
-    return o, (q, k, v, segments, o, lse)
+    return (o, lse), (q, k, v, segments, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, window,
-                    res, do):
-    del block_q, interpret
-    return _flash_bwd_xla(causal, scale, block_k, window, res, do) + (None,)
+                    bwd_impl, res, cts):
+    do, dlse = cts
+    if bwd_impl == "pallas":
+        # Smaller default tiles than the fwd: the bwd keeps three
+        # [block_q, block_k] f32 intermediates (s, p, ds) plus two
+        # accumulators live in VMEM at once.
+        bq = pick_block(res[0].shape[2], min(block_q, 256))
+        bk = pick_block(res[1].shape[2], min(block_k, 256))
+        return _flash_bwd_pallas(causal, scale, bq, bk, window, interpret,
+                                 res, do, dlse) + (None,)
+    return _flash_bwd_xla(causal, scale, block_k, window, res, do,
+                          dlse) + (None,)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -346,6 +623,7 @@ def flash_attention(
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
     segment_ids: Optional[jax.Array] = None,  # [B, S] packed-sequence ids
+    bwd_impl: Optional[str] = None,  # "pallas" | "xla"; None = auto
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] layouts with GQA support.
 
@@ -360,6 +638,32 @@ def flash_attention(
     when shapes don't tile (seq not divisible into >=128 blocks, or
     head_dim not lane-aligned) — callers never need to special-case.
     """
+    return flash_attention_with_lse(
+        q, k, v, causal=causal, softmax_scale=softmax_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        window=window, segment_ids=segment_ids, bwd_impl=bwd_impl)[0]
+
+
+def flash_attention_with_lse(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, KV, D]
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+    window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
+    bwd_impl: Optional[str] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """``flash_attention`` that also returns the row logsumexp
+    ``[B, H, Sq]`` (f32) — the residual ring attention needs to merge
+    per-block partial attentions exactly. Differentiable in both
+    outputs (the lse cotangent flows through the bwd kernels). Same
+    fallback rule: non-tiling shapes use the einsum reference, which
+    also returns lse."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv = k.shape[2]
@@ -370,16 +674,24 @@ def flash_attention(
     if segment_ids is not None and sq != sk:
         raise ValueError(
             f"segment_ids requires Sq == Sk, got {sq} vs {sk}")
+    if bwd_impl not in (None, "pallas", "xla"):
+        # Validate before the shape-based fallback so a typo can't ride
+        # silently on non-tiling shapes.
+        raise ValueError(f"unknown bwd_impl `{bwd_impl}`")
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     if pltpu is None or bq < 128 or bk < 128 or (d % 128 and d != 64):
-        from polyaxon_tpu.ops.attention import xla_attention
+        from polyaxon_tpu.ops.attention import xla_attention_with_lse
 
-        return xla_attention(q, k, v, causal=causal,
-                             softmax_scale=softmax_scale, window=window,
-                             segment_ids=segment_ids)
+        return xla_attention_with_lse(
+            q, k, v, causal=causal, softmax_scale=softmax_scale,
+            window=window, segment_ids=segment_ids)
     if interpret is None:
         interpret = _default_interpret()
+    if bwd_impl is None:
+        # Pallas bwd on real TPU; the chunked-XLA bwd is faster than an
+        # interpreted Pallas kernel on the CPU test mesh.
+        bwd_impl = "xla" if interpret else "pallas"
     scale = softmax_scale if softmax_scale is not None else d**-0.5
 
     # Kernel layout: heads-major [B, H, S, D] so (seq, head_dim) is the
@@ -387,6 +699,6 @@ def flash_attention(
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    o = _flash(qT, kT, vT, segment_ids, causal, scale, bq, bk, interpret,
-               window or 0)
-    return o.transpose(0, 2, 1, 3)
+    o, lse = _flash(qT, kT, vT, segment_ids, causal, scale, bq, bk,
+                    interpret, window or 0, bwd_impl)
+    return o.transpose(0, 2, 1, 3), lse

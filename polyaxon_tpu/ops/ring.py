@@ -1,25 +1,33 @@
 """Ring attention: context-parallel attention over the ``cp`` mesh axis.
 
 Net-new surface vs the reference (SURVEY.md §5.7: long-context is
-absent upstream — it ships no model math at all). Design:
+absent upstream — it ships no model math at all). v2 design:
 
-- Every device holds one contiguous sequence block of Q, K, V
-  (``seq → cp`` in the CP rule table). Queries stay resident; K/V
-  blocks rotate around the ICI ring via ``lax.ppermute`` — each step
-  overlaps the matmul for the current block with the DMA of the next.
-- Online-softmax accumulation (flash-style running max/denominator in
-  f32) combines the per-block partial attentions exactly, so the full
-  S×S score matrix never exists on any chip: memory is
-  O(S_local² · heads) per step and activations scale to sequence
-  lengths ∝ number of chips.
-- Causality is a pure position test (global query index ≥ global key
-  index), which uniformly covers the three block cases (fully visible /
-  diagonal / fully masked). Blocks ahead of the diagonal are masked
-  rather than skipped — balanced "zigzag" block placement is a later
-  optimization.
-- The loop is a ``lax.scan`` (not ``fori_loop``) so the whole ring is
-  reverse-differentiable: ppermute transposes to the inverse
-  permutation and the backward pass runs the ring the other way.
+- Every device holds one contiguous sequence shard of Q, K, V
+  (``seq → cp`` in the CP rule table). K/V rotate around the ICI ring
+  via ``lax.ppermute`` — each step overlaps the attention kernel for
+  the current block with the DMA of the next.
+- **Zigzag placement for causal masks.** A contiguous causal layout is
+  ~2× wasteful: device 0's queries see one block while device cp-1's
+  see all of them, and SPMD lockstep bills every device for the worst
+  case. Instead each shard is split into two half-chunks and
+  redistributed (two ppermutes) so device ``i`` holds global chunks
+  ``i`` and ``2·cp-1-i``. Every ring step then needs exactly TWO dense
+  block attentions per device — fully-post-diagonal blocks are never
+  computed (skipped, not masked), and the load is perfectly balanced.
+  The inverse permutation restores contiguous layout on the output.
+- **Flash per block.** Each visible block runs
+  ``flash_attention_with_lse`` (the Pallas kernel on real TPU, the
+  einsum+lse reference for non-tiling block sizes), and the per-block
+  partials merge exactly through (o, lse) online-softmax combination
+  in f32. The S×S score matrix never exists on any chip.
+- GQA K/V travel the ring UNexpanded (kv heads only); the flash kernel
+  expands groups in its index maps, so ring bandwidth is divided by
+  ``n_heads/n_kv_heads``.
+- The loop is a ``lax.scan`` of differentiable pieces (custom-vjp flash
+  blocks, ppermute, lse merges), so the whole ring is reverse-
+  differentiable: ppermute transposes to the inverse permutation and
+  the backward pass runs the ring the other way.
 
 ``ring_attention`` can be called either inside an existing
 ``shard_map`` (axis already bound) or under plain jit, where it wraps
@@ -49,11 +57,17 @@ def _axis_bound(axis_name: str) -> bool:
 
 
 def ambient_mesh():
-    """The mesh entered via ``with mesh:`` (as the runtime loop does)."""
-    try:
-        from jax.interpreters import pxla
+    """The mesh entered via ``with mesh:`` (as the runtime loop does).
 
-        mesh = pxla.thread_resources.env.physical_mesh
+    Reads the resource env through ``jax._src.mesh`` directly: the
+    public re-export (``jax.interpreters.pxla.thread_resources``) is
+    deprecated since 0.8.2, and ``get_abstract_mesh()`` is only
+    populated by ``jax.sharding.use_mesh``, not by ``with mesh:``.
+    """
+    try:
+        from jax._src import mesh as mesh_lib
+
+        mesh = mesh_lib.thread_resources.env.physical_mesh
         if mesh is not None and not mesh.empty:
             return mesh
     except Exception:
@@ -67,15 +81,136 @@ def ambient_mesh():
     return None
 
 
-def _ring_attention_sharded(
-    q: jax.Array,  # [B, S_loc, H, D] local shard
-    k: jax.Array,  # [B, S_loc, KV, D]
-    v: jax.Array,
-    *,
-    causal: bool,
-    scale: float,
-    axis_name: str,
-) -> jax.Array:
+def _merge(o_a, lse_a, o_b, lse_b):
+    """Exact online-softmax combination of two partial attentions.
+    o: [B, S, H, D] f32; lse: [B, H, S] f32."""
+    lse_new = jnp.logaddexp(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - lse_new).transpose(0, 2, 1)[..., None]
+    w_b = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+    return o_a * w_a + o_b * w_b, lse_new
+
+
+def _block_attn(q, k, v, *, causal, scale):
+    """One visible block through flash (Pallas on TPU, einsum+lse
+    reference when the block doesn't tile), partials in f32."""
+    from polyaxon_tpu.ops.flash import flash_attention_with_lse
+
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                      softmax_scale=scale)
+    return o.astype(jnp.float32), lse
+
+
+def _ring_causal_zigzag(q, k, v, *, scale, axis_name):
+    """Causal ring attention with zigzag placement (module docstring)."""
+    cp = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[1]
+    half = s_loc // 2
+    rotate = [(i, (i + 1) % cp) for i in range(cp)]
+
+    # --- redistribute contiguous halves into zigzag placement -------
+    # Device i holds global half-chunks (2i, 2i+1); zigzag wants
+    # (i, 2cp-1-i). Chunk c goes to device c if c < cp else 2cp-1-c;
+    # per-parity that is one ppermute for first halves (A) and one for
+    # second halves (B). Even devices receive their LOW chunk via A,
+    # odd devices via B.
+    perm_a = [(i, 2 * i if 2 * i < cp else 2 * cp - 1 - 2 * i)
+              for i in range(cp)]
+    perm_b = [(i, 2 * i + 1 if 2 * i + 1 < cp else 2 * cp - 2 - 2 * i)
+              for i in range(cp)]
+    even = (idx % 2) == 0
+
+    def to_zigzag(x):
+        ra = jax.lax.ppermute(x[:, :half], axis_name, perm_a)
+        rb = jax.lax.ppermute(x[:, half:], axis_name, perm_b)
+        lo = jnp.where(even, ra, rb)
+        hi = jnp.where(even, rb, ra)
+        return lo, hi
+
+    q_lo, q_hi = to_zigzag(q)
+    k_lo, k_hi = to_zigzag(k)
+    v_lo, v_hi = to_zigzag(v)
+
+    attn = functools.partial(_block_attn, scale=scale)
+
+    # --- step 0: the diagonal chunks this device already holds ------
+    # low = global chunk idx, high = global chunk 2cp-1-idx. The high
+    # chunk always sees the low chunk fully (2cp-1-idx > idx).
+    acc_lo = attn(q_lo, k_lo, v_lo, causal=True)
+    o_hh, l_hh = attn(q_hi, k_hi, v_hi, causal=True)
+    o_hl, l_hl = attn(q_hi, k_lo, v_lo, causal=False)
+    acc_hi = _merge(o_hh, l_hh, o_hl, l_hl)
+
+    # --- ring steps 1..cp-1: exactly two dense blocks per step ------
+    def step(carry, s):
+        (k_lo, k_hi, v_lo, v_hi), (acc_lo, acc_hi) = carry
+        k_lo = jax.lax.ppermute(k_lo, axis_name, rotate)
+        k_hi = jax.lax.ppermute(k_hi, axis_name, rotate)
+        v_lo = jax.lax.ppermute(v_lo, axis_name, rotate)
+        v_hi = jax.lax.ppermute(v_hi, axis_name, rotate)
+        src = (idx - s) % cp  # kv now holds chunks (src, 2cp-1-src)
+
+        # Always visible: q chunk 2cp-1-idx vs kv chunk src (< cp).
+        o1, l1 = attn(q_hi, k_lo, v_lo, causal=False)
+        acc_hi = _merge(*acc_hi, o1, l1)
+
+        # The second visible block depends on the diagonal side:
+        # idx > src → q_lo sees kv_lo (chunk idx > chunk src);
+        # idx < src → q_hi sees kv_hi (2cp-1-idx > 2cp-1-src).
+        # Fully-post-diagonal blocks are never computed at all.
+        take_low = idx > src
+        q2 = jnp.where(take_low, q_lo, q_hi)
+        k2 = jnp.where(take_low, k_lo, k_hi)
+        v2 = jnp.where(take_low, v_lo, v_hi)
+        o2, l2 = attn(q2, k2, v2, causal=False)
+        lo_upd = _merge(*acc_lo, o2, l2)
+        hi_upd = _merge(*acc_hi, o2, l2)
+        acc_lo = tuple(jnp.where(take_low, a, b)
+                       for a, b in zip(lo_upd, acc_lo))
+        acc_hi = tuple(jnp.where(take_low, b, a)
+                       for a, b in zip(hi_upd, acc_hi))
+        return ((k_lo, k_hi, v_lo, v_hi), (acc_lo, acc_hi)), None
+
+    ((_, (acc_lo, acc_hi)), _) = jax.lax.scan(
+        step, ((k_lo, k_hi, v_lo, v_hi), (acc_lo, acc_hi)),
+        jnp.arange(1, cp))
+
+    # --- inverse zigzag: restore contiguous output layout -----------
+    o_lo = acc_lo[0].astype(q.dtype)
+    o_hi = acc_hi[0].astype(q.dtype)
+    inv_a = [(d, s) for (s, d) in perm_a]
+    inv_b = [(d, s) for (s, d) in perm_b]
+    send_a = jnp.where(even, o_lo, o_hi)  # the chunk that arrived via A
+    send_b = jnp.where(even, o_hi, o_lo)
+    back_a = jax.lax.ppermute(send_a, axis_name, inv_a)  # chunk 2i
+    back_b = jax.lax.ppermute(send_b, axis_name, inv_b)  # chunk 2i+1
+    return jnp.concatenate([back_a, back_b], axis=1)
+
+
+def _ring_dense(q, k, v, *, scale, axis_name):
+    """Non-causal ring: every block visible, one flash call per step."""
+    cp = jax.lax.axis_size(axis_name)
+    rotate = [(i, (i + 1) % cp) for i in range(cp)]
+    attn = functools.partial(_block_attn, scale=scale, causal=False)
+
+    acc = attn(q, k, v)
+
+    def step(carry, _):
+        (k_cur, v_cur), acc = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, rotate)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, rotate)
+        o, lse = attn(q, k_cur, v_cur)
+        return ((k_cur, v_cur), _merge(*acc, o, lse)), None
+
+    (((_, _), acc), _) = jax.lax.scan(
+        step, ((k, v), acc), jnp.arange(1, cp))
+    return acc[0].astype(q.dtype)
+
+
+def _ring_einsum_causal(q, k, v, *, scale, axis_name):
+    """Contiguous-layout causal fallback for shapes the zigzag split
+    cannot cover (odd local sequence length). Blocks ahead of the
+    diagonal are masked, not skipped."""
     from polyaxon_tpu.ops.attention import repeat_kv
 
     cp = jax.lax.axis_size(axis_name)
@@ -88,9 +223,6 @@ def _ring_attention_sharded(
     q_f = q.astype(jnp.float32)
     q_pos = idx * s_loc + jnp.arange(s_loc)  # global query positions
     local_pos = jnp.arange(s_loc)
-
-    # Send kv to the next device each step: after step s, device `idx`
-    # holds the block that started at device `(idx - s - 1) mod cp`.
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     def step(carry, s):
@@ -98,20 +230,14 @@ def _ring_attention_sharded(
         src = (idx - s) % cp  # which block this kv shard is
         k_pos = src * s_loc + local_pos
 
-        logits = (
-            jnp.einsum(
-                "bqhd,bkhd->bhqk", q_f, k_cur.astype(jnp.float32),
-            )
-            * scale
-        )  # [B, H, Sq, Sk] f32
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
-            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q_f, k_cur.astype(jnp.float32)) * scale
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))  # [B,H,Sq]
-        p = jnp.exp(logits - m_new[..., None])
-        if causal:
-            p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask[None, None],
+                      jnp.exp(logits - m_new[..., None]), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
@@ -125,11 +251,27 @@ def _ring_attention_sharded(
     m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
     ((_, (o, _, l)), _) = jax.lax.scan(
-        step, ((k, v), (o0, m0, l0)), jnp.arange(cp)
-    )
+        step, ((k, v), (o0, m0, l0)), jnp.arange(cp))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = o / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_attention_sharded(
+    q: jax.Array,  # [B, S_loc, H, D] local shard
+    k: jax.Array,  # [B, S_loc, KV, D]
+    v: jax.Array,
+    *,
+    causal: bool,
+    scale: float,
+    axis_name: str,
+) -> jax.Array:
+    if not causal:
+        return _ring_dense(q, k, v, scale=scale, axis_name=axis_name)
+    if q.shape[1] % 2:
+        return _ring_einsum_causal(q, k, v, scale=scale,
+                                   axis_name=axis_name)
+    return _ring_causal_zigzag(q, k, v, scale=scale, axis_name=axis_name)
 
 
 def ring_attention(

@@ -72,13 +72,42 @@ def build_train_step(
     compiled program, peak activation memory divided by ``accum_steps``.
     """
     shardings = state_shardings(model_def, mesh, rules)
+    uniform_keys = set(model_def.uniform_metrics) | {"loss_unweighted"}
 
-    def grads_of(params, mutable, batch, rng):
+    def grads_of(params, mutable, batch, rng, scales=None):
+        """``scales=(masked_scale, unmasked_scale)`` rescales the loss
+        BEFORE differentiation — grad is linear, so scaling the per-
+        microbatch loss components here makes the accumulated gradient
+        exactly the full-batch one. Models with a mask-independent loss
+        component (MoE router aux) expose it as the differentiable
+        ``loss_unweighted`` metric; everything else in the loss is
+        treated as a per-valid-token mean."""
+
         def loss_fn(p):
             loss, metrics, new_mutable = model_def.apply(
                 {"params": p, "state": mutable}, batch, True, rng
             )
-            return loss, (metrics, new_mutable)
+            if scales is not None:
+                masked_scale, unmasked_scale = scales
+                unweighted = metrics.get("loss_unweighted")
+                if unweighted is None:
+                    if model_def.uniform_metrics:
+                        # Trace-time contract check: declaring uniform
+                        # metrics without exposing the decomposition
+                        # would silently mis-scale the aux loss term.
+                        raise ValueError(
+                            f"model `{model_def.name}` declares "
+                            f"uniform_metrics={model_def.uniform_metrics} "
+                            "but its apply() does not return the "
+                            "differentiable `loss_unweighted` metric "
+                            "required for exact gradient accumulation")
+                    loss_out = masked_scale * loss
+                else:
+                    loss_out = (masked_scale * (loss - unweighted)
+                                + unmasked_scale * unweighted)
+            else:
+                loss_out = loss
+            return loss_out, (metrics, new_mutable)
 
         (_, (metrics, new_mutable)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
@@ -107,42 +136,57 @@ def build_train_step(
                             mesh, batch_spec(mesh, rules, ndim=x.ndim))),
                     mb)
 
-            def weight_of(mb) -> jax.Array:
-                # Masked losses are per-valid-token means; weight each
-                # microbatch's gradient by its valid-token count so the
-                # accumulated gradient equals the full-batch one. This
-                # assumes the loss is fully mask-weighted (true for the
-                # LM/CE losses here); a loss mixing mask-independent
-                # terms (e.g. MoE router aux) is approximated — keep
-                # microbatches mask-balanced or use accum_steps=1 there.
-                if isinstance(mb, dict) and mb.get("mask") is not None:
-                    return mb["mask"].astype(jnp.float32).sum()
-                return jnp.float32(1.0)
+            # Masked losses are per-valid-token means, so each
+            # microbatch's masked component is weighted by its valid-
+            # token share w_i/W; mask-independent components (MoE
+            # router aux, surfaced as the ``loss_unweighted`` metric)
+            # are uniform per-microbatch means and get 1/k each. The
+            # mask is an input, so W is known before the scan and the
+            # scaling happens inside each grad — exact, not approximate.
+            if isinstance(batch, dict) and batch.get("mask") is not None:
+                w_micro = micro["mask"].astype(jnp.float32).sum(
+                    axis=tuple(range(1, micro["mask"].ndim)))
+            else:
+                w_micro = jnp.ones((accum_steps,), jnp.float32)
+            # Clamp: a fully-masked batch (W == 0) must yield zero
+            # masked grads like the accum=1 path, not 0/0 = NaN params.
+            w_total = jnp.maximum(w_micro.sum(), 1.0)
+            uniform_scale = jnp.float32(1.0 / accum_steps)
 
-            def body(carry, mb_and_rng):
-                grads_acc, w_acc, mutable = carry
-                mb, r = mb_and_rng
+            def body(carry, xs):
+                grads_acc, mutable = carry
+                mb, r, w = xs
                 mb = constrain(mb)
-                w = weight_of(mb)
-                g, m, new_mutable = grads_of(state["params"], mutable, mb, r)
+                g, m, new_mutable = grads_of(
+                    state["params"], mutable, mb, r,
+                    scales=(w / w_total, uniform_scale))
                 grads_acc = jax.tree.map(
-                    lambda acc, gi: acc + w * gi, grads_acc, g)
-                m = jax.tree.map(lambda v: w * v, dict(m))
-                return (grads_acc, w_acc + w, new_mutable), m
+                    lambda acc, gi: acc + gi.astype(jnp.float32),
+                    grads_acc, g)
+                return (grads_acc, new_mutable), dict(m)
 
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state["params"])
-            (grads, w_total, new_mutable), metrics_seq = jax.lax.scan(
-                body, (zeros, jnp.float32(0.0), state["state"]),
-                (micro, rngs))
-            # Clamp: a fully-masked batch (w_total == 0) must yield zero
-            # grads like the accum=1 path, not 0/0 = NaN params.
-            w_safe = jnp.maximum(w_total, 1.0)
+            (grads, new_mutable), metrics_seq = jax.lax.scan(
+                body, (zeros, state["state"]), (micro, rngs, w_micro))
             grads = jax.tree.map(
-                lambda g, p: (g / w_safe).astype(p.dtype),
-                grads, state["params"])
-            metrics = jax.tree.map(
-                lambda m: m.sum() / w_safe, metrics_seq)
+                lambda g, p: g.astype(p.dtype), grads, state["params"])
+
+            # Reporting mirrors the grad weighting: mask-weighted means
+            # for masked metrics, uniform means for mask-independent
+            # ones, and ``loss`` recombined from its two components.
+            def agg_masked(v):
+                return (w_micro * v).sum() / w_total
+
+            metrics = {k: agg_masked(v) for k, v in metrics_seq.items()}
+            unweighted = metrics_seq.get("loss_unweighted")
+            if unweighted is not None:
+                for key in uniform_keys:
+                    if key in metrics_seq:
+                        metrics[key] = metrics_seq[key].mean()
+                metrics["loss"] = (
+                    agg_masked(metrics_seq["loss"] - unweighted)
+                    + unweighted.mean())
 
         updates, new_opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]

@@ -19,6 +19,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# NOTE: do NOT enable jax_compilation_cache_dir for this CPU-mesh suite.
+# It was tried (4x warm-run speedup) and reverted: XLA:CPU persists AOT
+# executables whose reload is unreliable on this host (cpu_aot_loader
+# machine-feature mismatch warnings, then sharded executables hang at
+# collective rendezvous until the 40s watchdog hard-aborts the whole
+# pytest process). Reproduced deterministically on cache hits of the
+# dp2xfsdp4 checkpoint tests, 2026-07-30.
+
 import pytest  # noqa: E402
 
 

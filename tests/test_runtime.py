@@ -136,6 +136,86 @@ class TestTrainLoop:
                                        np.asarray(b, np.float32),
                                        atol=2e-5)
 
+    def test_moe_grad_accumulation_exact(self, cpu_devices):
+        """MoE mixes a mask-weighted CE with a mask-independent router
+        aux. The aux is nonlinear in the batch (product of batch means),
+        so accum=k is DEFINED as: token-weighted CE grads + uniform
+        (1/k) aux grads. Verify the accumulated update matches that
+        definition computed manually per-microbatch, with unbalanced
+        masks across microbatches."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from polyaxon_tpu.models import moe
+        from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
+        from polyaxon_tpu.runtime.config import RuntimeConfig
+        from polyaxon_tpu.runtime.optim import build_optimizer
+        from polyaxon_tpu.runtime.step import build_init, build_train_step
+
+        mesh = build_mesh(axes={"dp": 8})
+        rules = rules_for_mesh(mesh)
+        model_def = moe.model_def("moe_tiny")
+        cfg = RuntimeConfig(model="moe_tiny", steps=1, learning_rate=1e-2,
+                            optimizer="sgd", lr_schedule="constant",
+                            grad_clip_norm=None)
+        optimizer = build_optimizer(cfg)
+        k = 4
+        tokens = jax.random.randint(jax.random.key(1), (16, 16), 0, 256)
+        # Unbalanced masks: microbatch 0 fully valid, 1 half-valid,
+        # 2 nearly empty, 3 fully valid.
+        mask = np.ones((16, 16), np.int32)
+        mask[4:8, 8:] = 0
+        mask[8:12, :] = 0
+        mask[8:12, 0] = 1
+        batch = {"tokens": tokens, "mask": jnp.asarray(mask)}
+
+        with mesh:
+            init_fn = build_init(model_def, optimizer, mesh, rules)
+            step_k = build_train_step(model_def, optimizer, mesh, rules,
+                                      accum_steps=k)
+            s = init_fn(jax.random.key(0))
+            s_k, _ = step_k(s, batch, jax.random.key(2))
+
+            # Manual reference: same microbatch split, same rng split.
+            s_ref = init_fn(jax.random.key(0))
+            params0 = s_ref["params"]
+            rngs = jax.random.split(jax.random.key(2), k)
+            w = np.asarray(mask).reshape(k, 4, 16).sum(axis=(1, 2))
+            W = w.sum()
+
+            def masked_part(p, mb, r):
+                loss, m, _ = model_def.apply(
+                    {"params": p, "state": {}}, mb, True, r)
+                return loss - m["loss_unweighted"]
+
+            def unweighted_part(p, mb, r):
+                _, m, _ = model_def.apply(
+                    {"params": p, "state": {}}, mb, True, r)
+                return m["loss_unweighted"]
+
+            acc = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32),
+                               params0)
+            for i in range(k):
+                mb = {"tokens": tokens[i * 4:(i + 1) * 4],
+                      "mask": batch["mask"][i * 4:(i + 1) * 4]}
+                g_ce = jax.grad(masked_part)(params0, mb, rngs[i])
+                g_aux = jax.grad(unweighted_part)(params0, mb, rngs[i])
+                acc = jax.tree.map(
+                    lambda a, gc, ga: a + (w[i] / W) * gc + ga / k,
+                    acc, g_ce, g_aux)
+            updates, _ = optimizer.update(
+                jax.tree.map(lambda g, p: g.astype(p.dtype), acc, params0),
+                s_ref["opt_state"], params0)
+            ref_params = optax.apply_updates(params0, updates)
+
+        for a, b in zip(jax.tree.leaves(s_k["params"]),
+                        jax.tree.leaves(ref_params)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=3e-5)
+
     def test_checkpoint_and_resume(self, cpu_devices, tmp_path):
         art = str(tmp_path / "run")
         job = V1JAXJob.from_dict(
