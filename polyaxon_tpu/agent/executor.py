@@ -25,6 +25,7 @@ import threading
 import traceback
 from dataclasses import dataclass, field
 from typing import Any, Optional
+from urllib.parse import urlparse
 
 from polyaxon_tpu.compiler import COORDINATOR_PLACEHOLDER, ENV_JAXJOB_SPEC
 from polyaxon_tpu.compiler.plan import V1LaunchPlan
@@ -76,6 +77,30 @@ class LocalExecutor:
                     json.dump({"run_uuid": plan.run_uuid, "mode": "local"}, fh)
             elif phase.kind == "artifacts":
                 src = phase.config.get("path") or phase.path
+                scheme = urlparse(src).scheme if src else ""
+                if scheme == "file":
+                    src = urlparse(src).path  # → plain local path below
+                elif src and scheme:
+                    # Store URL (gs://, s3://, ...): download the whole
+                    # prefix through the fs layer (upstream's artifacts
+                    # initializer over fsspec — SURVEY §3.3).
+                    from polyaxon_tpu.fs import StoreError, get_store
+
+                    store = get_store(src)
+                    name = (os.path.basename(urlparse(src).path.rstrip("/"))
+                            or "artifacts")
+                    dest = _safe_join(
+                        os.path.join(plan.artifacts_dir, "inputs"), name)
+                    if store.download_dir("", dest) == 0:
+                        # A single-object URL lists empty: fetch it as
+                        # one file instead.
+                        try:
+                            store.download_file("", dest)
+                        except StoreError as exc:
+                            raise StoreError(
+                                f"artifacts init phase found no objects "
+                                f"at {src!r}") from exc
+                    continue
                 if src and os.path.exists(src):
                     dest = os.path.join(plan.artifacts_dir, "inputs",
                                         os.path.basename(src))

@@ -1,4 +1,5 @@
 from polyaxon_tpu.fs.store import (
+    FsspecStore,
     LocalStore,
     MemoryStore,
     Store,
@@ -8,6 +9,7 @@ from polyaxon_tpu.fs.store import (
 )
 
 __all__ = [
+    "FsspecStore",
     "LocalStore",
     "MemoryStore",
     "Store",

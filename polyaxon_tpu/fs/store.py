@@ -1,17 +1,17 @@
 """Artifact-store IO layer (upstream `polyaxon/fs`: async fsspec
 wrappers over S3/GCS/Azure/volumes — SURVEY.md §2 "fs").
 
-fsspec is not guaranteed in the TPU-VM image and the orchestration
-plane only needs a small surface, so this is a scheme-dispatched store
-abstraction with two native backends:
+A scheme-dispatched store abstraction with two native backends and one
+fsspec-backed one:
 
 - ``file://`` — host paths / mounted volumes (the TPU-VM default);
-- ``memory://`` — in-process, for tests and dry runs.
-
-``gs://``/``s3://``/``wasb://`` resolve through optional deps (gcsfs /
-s3fs via fsspec) when present and raise a typed, actionable error when
-not — the store *interface* (upload/download/sync semantics the sidecar
-and checkpoint manager rely on) is identical either way.
+- ``memory://`` — in-process, for tests and dry runs;
+- ``gs://``/``s3://``/``wasb://``/``abfs://`` — cloud object stores
+  via :class:`FsspecStore`. The protocol package (gcsfs/s3fs/adlfs)
+  must be importable; a missing one raises a typed, actionable
+  ``StoreError`` at construction. The store *interface*
+  (upload/download/sync semantics the sidecar, init phases, and
+  checkpoint manager rely on) is identical across backends.
 """
 
 from __future__ import annotations
@@ -89,11 +89,16 @@ class Store:
     def sync_dir(self, local_dir: str, prefix: str = "",
                  state: Optional[dict[str, float]] = None) -> int:
         """Incremental upload: only files whose mtime advanced since the
-        last call (the sidecar hot loop — SURVEY.md §3.3)."""
+        last call (the sidecar hot loop — SURVEY.md §3.3). In-flight
+        ``.tmp``/``.lock`` files (the atomic-publish convention) are
+        skipped, and files that vanish mid-walk are retried next pass —
+        same guarantees as the local ``sidecar.sync_tree`` path."""
         state = state if state is not None else {}
         count = 0
         for root, _, files in os.walk(local_dir):
             for name in files:
+                if name.endswith((".tmp", ".lock")):
+                    continue
                 path = os.path.join(root, name)
                 try:
                     mtime = os.path.getmtime(path)
@@ -103,7 +108,10 @@ class Store:
                     continue
                 rel = os.path.relpath(path, local_dir)
                 key = f"{prefix}/{rel}".replace(os.sep, "/").lstrip("/")
-                self.upload_file(path, key)
+                try:
+                    self.upload_file(path, key)
+                except OSError:
+                    continue  # vanished/rotating mid-walk: retry next pass
                 state[path] = mtime
                 count += 1
         return count
@@ -211,22 +219,98 @@ class MemoryStore(Store):
         )
 
 
+class FsspecStore(Store):
+    """Cloud object stores through fsspec (upstream `polyaxon/fs`
+    materializes the same protocols via fsspec wrappers — SURVEY.md §2
+    "fs"/"Connections" rows).
+
+    The protocol package does the heavy lifting: ``gs://`` → gcsfs
+    (present in this image), ``s3://`` → s3fs, ``wasb://``/``abfs://``
+    → adlfs. A missing package raises a typed ``StoreError`` at
+    construction — a connection kind either runs or fails loudly at
+    resolution time, never silently. The ``memory://`` fsspec protocol
+    exercises this exact code path offline in tests.
+    """
+
+    # Upstream wasb:// URLs ride the Gen2-compatible adlfs protocol.
+    _SCHEME_ALIASES = {"wasb": "abfs", "wasbs": "abfs", "az": "abfs",
+                       "gcs": "gs"}
+
+    def __init__(self, url: str):
+        try:
+            import fsspec
+        except ImportError as exc:  # pragma: no cover - baked into image
+            raise StoreError(
+                f"store url {url!r} needs fsspec, which is not installed; "
+                "use file:// volumes or register a custom store via "
+                "fs.register_store()") from exc
+        parsed = urlparse(url)
+        self.scheme = parsed.scheme
+        proto = self._SCHEME_ALIASES.get(parsed.scheme, parsed.scheme)
+        resolved = url.replace(f"{parsed.scheme}://", f"{proto}://", 1)
+        try:
+            self.fs, self.root = fsspec.core.url_to_fs(resolved)
+        except ImportError as exc:
+            raise StoreError(
+                f"store url {url!r} needs the fsspec protocol package for "
+                f"`{proto}://` ({exc}); install it in the image or use a "
+                "file:// volume") from exc
+        except ValueError as exc:
+            raise StoreError(f"bad store url {url!r}: {exc}") from exc
+        self.root = self.root.rstrip("/")
+
+    def _key(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.root}/{key}" if key else self.root
+
+    def read_bytes(self, key: str) -> bytes:
+        try:
+            return self.fs.cat_file(self._key(key))
+        except FileNotFoundError as exc:
+            raise StoreError(f"no such key {key!r}") from exc
+
+    def write_bytes(self, key: str, data: bytes) -> None:
+        self.fs.pipe_file(self._key(key), bytes(data))
+
+    def exists(self, key: str) -> bool:
+        return bool(self.fs.exists(self._key(key)))
+
+    def delete(self, key: str) -> None:
+        path = self._key(key)
+        if self.fs.exists(path):
+            self.fs.rm(path, recursive=True)
+
+    def list(self, prefix: str = "") -> list[str]:
+        base = self._key(prefix) if prefix else self.root
+        try:
+            found = self.fs.find(base)
+        except FileNotFoundError:
+            return []
+        out = []
+        for path in found:
+            rel = path[len(self.root):].lstrip("/")
+            if rel:
+                out.append(rel)
+        return sorted(out)
+
+    # Object-store fast paths: stream files instead of buffering bytes.
+    def upload_file(self, local_path: str, key: str) -> None:
+        self.fs.put_file(local_path, self._key(key))
+
+    def download_file(self, key: str, local_path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
+        try:
+            self.fs.get_file(self._key(key), local_path)
+        except FileNotFoundError as exc:
+            raise StoreError(f"no such key {key!r}") from exc
+        return local_path
+
+
 _REGISTRY: dict[str, Callable[[str], Store]] = {}
 
 
 def register_store(scheme: str, factory: Callable[[str], Store]) -> None:
     _REGISTRY[scheme] = factory
-
-
-def _fsspec_store(url: str) -> Store:
-    try:
-        import fsspec  # noqa: F401
-    except ImportError as exc:
-        raise StoreError(
-            f"store url {url!r} needs fsspec (+ gcsfs/s3fs/adlfs) which is "
-            "not installed in this image; use file:// volumes or register "
-            "a custom store via fs.register_store()") from exc
-    raise StoreError(f"no fsspec adapter wired for {url!r} yet")
 
 
 def get_store(url: str) -> Store:
@@ -239,6 +323,6 @@ def get_store(url: str) -> Store:
         return LocalStore(parsed.path or url)
     if scheme == "memory":
         return MemoryStore(parsed.netloc or "default")
-    if scheme in ("gs", "s3", "wasb", "abfs"):
-        return _fsspec_store(url)
+    if scheme in ("gs", "gcs", "s3", "wasb", "wasbs", "az", "abfs"):
+        return FsspecStore(url)
     raise StoreError(f"unknown store scheme {scheme!r} in {url!r}")

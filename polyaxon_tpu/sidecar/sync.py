@@ -1,4 +1,9 @@
-"""Incremental rsync-like tree sync (mtime+size) with a watch loop."""
+"""Incremental rsync-like tree sync (mtime+size) with a watch loop.
+
+The destination is either a local/mounted directory (the TPU-VM
+default) or any artifact-store URL (``gs://``, ``s3://``, ...) — the
+upstream sidecar ships to fsspec stores the same way (SURVEY.md §3.3).
+"""
 
 from __future__ import annotations
 
@@ -7,6 +12,7 @@ import shutil
 import threading
 import time
 from typing import Optional
+from urllib.parse import urlparse
 
 
 def _should_copy(src: str, dest: str) -> bool:
@@ -46,8 +52,24 @@ class SidecarSync:
         self.interval = interval_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # A URL destination ships through the store layer with the
+        # incremental mtime state Store.sync_dir keeps; a plain path
+        # (or file://) stays on the local fast path below.
+        parsed = urlparse(store_dir)
+        if parsed.scheme and parsed.scheme != "file":
+            from polyaxon_tpu.fs import get_store
+
+            self._store = get_store(store_dir)
+            self._store_state: dict[str, float] = {}
+        else:
+            self._store = None
+            if parsed.scheme == "file":
+                self.store_dir = parsed.path
 
     def sync_once(self) -> int:
+        if self._store is not None:
+            return self._store.sync_dir(self.run_dir,
+                                        state=self._store_state)
         return sync_tree(self.run_dir, self.store_dir)
 
     def _loop(self) -> None:

@@ -115,9 +115,14 @@ class TestStores:
         assert get_store("memory://t1").read_text("k") == "v"
         assert isinstance(get_store("file:///tmp/plx-store-test"), LocalStore)
 
-    def test_remote_schemes_raise_actionable(self):
-        with pytest.raises(StoreError, match="fsspec"):
-            get_store("gs://bucket")
+    def test_remote_schemes_dispatch_or_raise_actionable(self):
+        # gs:// is fully backed (gcsfs ships in the image); schemes
+        # whose protocol package is absent raise naming the package.
+        from polyaxon_tpu.fs import FsspecStore
+
+        assert isinstance(get_store("gs://bucket"), FsspecStore)
+        with pytest.raises(StoreError, match="s3fs"):
+            get_store("s3://bucket")
         with pytest.raises(StoreError, match="unknown store scheme"):
             get_store("ftp://x")
 

@@ -1,0 +1,272 @@
+"""fs store layer: scheme dispatch, the fsspec-backed cloud store
+(exercised offline through fsspec's built-in ``memory://`` protocol),
+and the connection-kind → store round trip VERDICT r1 item 6 requires:
+every advertised artifact-store kind either yields a working Store or
+fails loudly with an actionable error — never a silent gap."""
+
+import os
+
+import pytest
+
+from polyaxon_tpu.connections import V1Connection
+from polyaxon_tpu.fs import (
+    FsspecStore,
+    LocalStore,
+    MemoryStore,
+    StoreError,
+    get_store,
+    register_store,
+)
+
+
+def _fsspec_memory_store(ns: str) -> FsspecStore:
+    """An FsspecStore over fsspec's in-process memory filesystem —
+    the same code path gs:// takes, no network needed."""
+    store = FsspecStore(f"memory://{ns}")
+    store.fs.store.clear()  # fsspec MemoryFileSystem state is global
+    return store
+
+
+class TestFsspecStore:
+    def test_round_trip(self):
+        store = _fsspec_memory_store("rt")
+        store.write_bytes("a/b.txt", b"hello")
+        assert store.read_bytes("a/b.txt") == b"hello"
+        assert store.exists("a/b.txt")
+        assert not store.exists("a/missing")
+        store.write_text("a/c.txt", "world")
+        assert store.read_text("a/c.txt") == "world"
+        assert store.list() == ["a/b.txt", "a/c.txt"]
+        assert store.list("a") == ["a/b.txt", "a/c.txt"]
+        store.delete("a/b.txt")
+        assert store.list() == ["a/c.txt"]
+
+    def test_missing_key_raises_typed(self):
+        store = _fsspec_memory_store("miss")
+        with pytest.raises(StoreError, match="no such key"):
+            store.read_bytes("nope")
+
+    def test_dir_upload_download_sync(self, tmp_path):
+        store = _fsspec_memory_store("dirs")
+        src = tmp_path / "src"
+        (src / "sub").mkdir(parents=True)
+        (src / "one.txt").write_text("1")
+        (src / "sub" / "two.txt").write_text("2")
+
+        assert store.upload_dir(str(src), "runs/x") == 2
+        assert store.list("runs/x") == ["runs/x/one.txt",
+                                        "runs/x/sub/two.txt"]
+        dest = tmp_path / "dest"
+        assert store.download_dir("runs/x", str(dest)) == 2
+        assert (dest / "sub" / "two.txt").read_text() == "2"
+
+        # Incremental sync: second call with no changes ships nothing;
+        # touching one file ships exactly it.
+        state: dict[str, float] = {}
+        assert store.sync_dir(str(src), "runs/y", state=state) == 2
+        assert store.sync_dir(str(src), "runs/y", state=state) == 0
+        os.utime(src / "one.txt", (0, 2_000_000_000))
+        assert store.sync_dir(str(src), "runs/y", state=state) == 1
+
+    def test_sync_dir_skips_inflight_files(self, tmp_path):
+        """.tmp/.lock (atomic-publish convention) never ship to the
+        store — parity with the local sidecar sync_tree path."""
+        store = _fsspec_memory_store("inflight")
+        src = tmp_path / "run"
+        src.mkdir()
+        (src / "ckpt.bin").write_text("done")
+        (src / "ckpt.bin.tmp").write_text("half-written")
+        (src / "events.lock").write_text("")
+        assert store.sync_dir(str(src)) == 1
+        assert store.list() == ["ckpt.bin"]
+
+
+class TestGetStoreDispatch:
+    def test_file_and_memory(self, tmp_path):
+        assert isinstance(get_store(f"file://{tmp_path}"), LocalStore)
+        assert isinstance(get_store(str(tmp_path)), LocalStore)
+        assert isinstance(get_store("memory://ns"), MemoryStore)
+
+    def test_gcs_constructs(self):
+        # gcsfs is baked into this image: gs:// must yield a live
+        # FsspecStore (no network touched at construction).
+        store = get_store("gs://some-bucket/prefix")
+        assert isinstance(store, FsspecStore)
+        assert store.root == "some-bucket/prefix"
+
+    def test_missing_protocol_package_raises_actionable(self):
+        # s3fs/adlfs are absent here: the error must name the package.
+        with pytest.raises(StoreError, match="s3fs"):
+            get_store("s3://bucket/x")
+        with pytest.raises(StoreError, match="adlfs"):
+            get_store("wasb://container/x")
+
+    def test_unknown_scheme(self):
+        with pytest.raises(StoreError, match="unknown store scheme"):
+            get_store("ftp://nope")
+
+    def test_register_store_override(self):
+        register_store("customfs", lambda url: MemoryStore("custom"))
+        try:
+            assert isinstance(get_store("customfs://x"), MemoryStore)
+        finally:
+            from polyaxon_tpu.fs import store as store_mod
+
+            store_mod._REGISTRY.pop("customfs", None)
+
+
+class TestConnectionStoreRoundTrip:
+    """Every advertised artifact-store connection kind resolves through
+    store_url() → get_store() to a Store or a loud typed error."""
+
+    def _conn(self, kind, schema):
+        return V1Connection.from_dict(
+            {"name": f"c-{kind}", "kind": kind, "schema": schema})
+
+    def test_host_path_and_volume_claim(self, tmp_path):
+        for kind, schema in (
+            ("host_path", {"hostPath": str(tmp_path)}),
+            ("volume_claim", {"mountPath": str(tmp_path),
+                              "volumeClaim": "pvc-1"}),
+        ):
+            conn = self._conn(kind, schema)
+            store = get_store(conn.store_url())
+            assert isinstance(store, LocalStore)
+            store.write_text("probe.txt", kind)
+            assert store.read_text("probe.txt") == kind
+
+    def test_gcs_resolves_to_fsspec_store(self):
+        conn = self._conn("gcs", {"bucket": "gs://my-ckpts"})
+        assert conn.store_url() == "gs://my-ckpts"
+        assert isinstance(get_store(conn.store_url()), FsspecStore)
+
+    def test_s3_and_wasb_fail_loudly_without_packages(self):
+        s3 = self._conn("s3", {"bucket": "s3://my-data"})
+        with pytest.raises(StoreError, match="s3fs"):
+            get_store(s3.store_url())
+        wasb = self._conn("wasb", {"url": "wasb://logs/x"})
+        with pytest.raises(StoreError, match="adlfs"):
+            get_store(wasb.store_url())
+
+
+class TestSidecarStoreDestination:
+    def test_sidecar_ships_to_store_url(self, tmp_path):
+        """SidecarSync with a store URL destination syncs through the
+        fs layer, incrementally."""
+        from polyaxon_tpu.sidecar import SidecarSync
+
+        register_store("sidecarmem",
+                       lambda url: FsspecStore(
+                           url.replace("sidecarmem://", "memory://", 1)))
+        try:
+            run_dir = tmp_path / "run"
+            (run_dir / "logs").mkdir(parents=True)
+            (run_dir / "logs" / "out.log").write_text("line1\n")
+            sync = SidecarSync(str(run_dir), "sidecarmem://side-ns",
+                               interval_seconds=0.1)
+            assert sync.sync_once() == 1
+            assert sync.sync_once() == 0  # unchanged → nothing shipped
+            (run_dir / "metrics.jsonl").write_text('{"loss": 1}\n')
+            assert sync.sync_once() == 1
+            store = FsspecStore("memory://side-ns")
+            assert store.list() == ["logs/out.log", "metrics.jsonl"]
+            assert store.read_text("metrics.jsonl") == '{"loss": 1}\n'
+        finally:
+            from polyaxon_tpu.fs import store as store_mod
+
+            store_mod._REGISTRY.pop("sidecarmem", None)
+            FsspecStore("memory://side-ns").fs.store.clear()
+
+
+class TestInitArtifactsFromStore:
+    def test_artifacts_init_phase_downloads_store_prefix(self, tmp_path):
+        """An artifacts init phase whose path is a store URL downloads
+        the prefix into the run's inputs dir (SURVEY §3.3)."""
+        from polyaxon_tpu.agent.executor import LocalExecutor
+        from polyaxon_tpu.compiler.plan import (
+            V1InitPhase,
+            V1LaunchPlan,
+            V1ResourceRequest,
+        )
+
+        seed = _fsspec_memory_store("init-src")
+        seed.write_text("data/train.txt", "corpus")
+        seed.write_text("data/valid.txt", "dev")
+
+        register_store("initmem",
+                       lambda url: FsspecStore(
+                           url.replace("initmem://", "memory://", 1)))
+        try:
+            plan = V1LaunchPlan(
+                run_uuid="r1", run_name="init-test", run_kind="jaxjob",
+                artifacts_dir=str(tmp_path / "run"),
+                outputs_dir=str(tmp_path / "run" / "outputs"),
+                resources=V1ResourceRequest(),
+                init=[V1InitPhase(kind="artifacts",
+                                  config={"path": "initmem://init-src/data"})])
+            LocalExecutor.__new__(LocalExecutor)._run_init_phases(plan)
+            inputs = tmp_path / "run" / "inputs" / "data"
+            assert (inputs / "train.txt").read_text() == "corpus"
+            assert (inputs / "valid.txt").read_text() == "dev"
+        finally:
+            from polyaxon_tpu.fs import store as store_mod
+
+            store_mod._REGISTRY.pop("initmem", None)
+            FsspecStore("memory://init-src").fs.store.clear()
+
+    def test_artifacts_init_phase_single_object_url(self, tmp_path):
+        """A store URL naming one object (not a prefix) downloads as a
+        single file instead of erroring on an empty listing."""
+        from polyaxon_tpu.agent.executor import LocalExecutor
+        from polyaxon_tpu.compiler.plan import (
+            V1InitPhase,
+            V1LaunchPlan,
+            V1ResourceRequest,
+        )
+
+        seed = _fsspec_memory_store("single-src")
+        seed.write_text("model.ckpt", "weights")
+        register_store("singlemem",
+                       lambda url: FsspecStore(
+                           url.replace("singlemem://", "memory://", 1)))
+        try:
+            plan = V1LaunchPlan(
+                run_uuid="r2", run_name="single", run_kind="jaxjob",
+                artifacts_dir=str(tmp_path / "run"),
+                outputs_dir=str(tmp_path / "run" / "outputs"),
+                resources=V1ResourceRequest(),
+                init=[V1InitPhase(
+                    kind="artifacts",
+                    config={"path": "singlemem://single-src/model.ckpt"})])
+            LocalExecutor.__new__(LocalExecutor)._run_init_phases(plan)
+            assert (tmp_path / "run" / "inputs"
+                    / "model.ckpt").read_text() == "weights"
+        finally:
+            from polyaxon_tpu.fs import store as store_mod
+
+            store_mod._REGISTRY.pop("singlemem", None)
+            FsspecStore("memory://single-src").fs.store.clear()
+
+    def test_artifacts_init_phase_file_url(self, tmp_path):
+        """file:// URLs resolve to the local copy path — not silently
+        skipped."""
+        from polyaxon_tpu.agent.executor import LocalExecutor
+        from polyaxon_tpu.compiler.plan import (
+            V1InitPhase,
+            V1LaunchPlan,
+            V1ResourceRequest,
+        )
+
+        src = tmp_path / "dataset"
+        src.mkdir()
+        (src / "x.txt").write_text("local")
+        plan = V1LaunchPlan(
+            run_uuid="r3", run_name="fileurl", run_kind="jaxjob",
+            artifacts_dir=str(tmp_path / "run"),
+            outputs_dir=str(tmp_path / "run" / "outputs"),
+            resources=V1ResourceRequest(),
+            init=[V1InitPhase(kind="artifacts",
+                              config={"path": f"file://{src}"})])
+        LocalExecutor.__new__(LocalExecutor)._run_init_phases(plan)
+        assert (tmp_path / "run" / "inputs" / "dataset"
+                / "x.txt").read_text() == "local"
