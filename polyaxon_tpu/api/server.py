@@ -215,7 +215,11 @@ class _Handler(BaseHTTPRequestHandler):
         if action is None:
             if method == "POST":
                 raise ApiError(405, "POST not allowed on run detail")
-            return self._json(_record_json(record))
+            payload = _record_json(record)
+            # Detail view only: the spec carries matrix config (metric
+            # name, bracket budgets) the dashboard's sweep view needs.
+            payload["spec"] = record.spec
+            return self._json(payload)
         if method == "POST":
             if action == "stop":
                 plane.stop(uuid, message=(self._read_body().get("message") or ""))

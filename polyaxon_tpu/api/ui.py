@@ -24,7 +24,8 @@ DASHBOARD_HTML = r"""<!doctype html>
     --page: #f9f9f7; --surface: #fcfcfb;
     --ink: #0b0b0b; --ink-2: #52514e; --muted: #898781;
     --grid: #e1e0d9; --axis: #c3c2b7; --ring: rgba(11,11,11,0.10);
-    --series-1: #2a78d6;
+    --series-1: #2a78d6; --series-2: #d07c2e; --series-3: #2f9e77;
+    --series-4: #8e67c5; --series-5: #c5527a; --series-6: #8a8a2a;
     --status-good: #0ca30c; --status-warning: #fab219;
     --status-serious: #ec835a; --status-critical: #d03b3b;
   }
@@ -34,7 +35,8 @@ DASHBOARD_HTML = r"""<!doctype html>
       --page: #0d0d0d; --surface: #1a1a19;
       --ink: #ffffff; --ink-2: #c3c2b7; --muted: #898781;
       --grid: #2c2c2a; --axis: #383835; --ring: rgba(255,255,255,0.10);
-      --series-1: #3987e5;
+      --series-1: #3987e5; --series-2: #e08a3a; --series-3: #37b389;
+      --series-4: #a07ad6; --series-5: #d66a91; --series-6: #a3a33a;
     }
   }
   :root[data-theme="dark"] {
@@ -42,7 +44,8 @@ DASHBOARD_HTML = r"""<!doctype html>
     --page: #0d0d0d; --surface: #1a1a19;
     --ink: #ffffff; --ink-2: #c3c2b7; --muted: #898781;
     --grid: #2c2c2a; --axis: #383835; --ring: rgba(255,255,255,0.10);
-    --series-1: #3987e5;
+    --series-1: #3987e5; --series-2: #e08a3a; --series-3: #37b389;
+    --series-4: #a07ad6; --series-5: #d66a91; --series-6: #a3a33a;
   }
   * { box-sizing: border-box; }
   body { margin: 0; background: var(--page); color: var(--ink);
@@ -94,6 +97,23 @@ DASHBOARD_HTML = r"""<!doctype html>
           max-height: 260px; overflow: auto; white-space: pre-wrap;
           font: 12px/1.5 ui-monospace, monospace; color: var(--ink-2); }
   a.uuid { color: var(--series-1); text-decoration: none; }
+  .legend { display: flex; gap: 12px; flex-wrap: wrap; font-size: 12px;
+            color: var(--ink-2); margin: 4px 0 2px; }
+  .legend .key { display: inline-flex; align-items: center; gap: 5px; }
+  .legend .swatch { width: 10px; height: 10px; border-radius: 2px; }
+  .bracket { background: var(--surface); border: 1px solid var(--ring);
+             border-radius: 8px; padding: 10px 14px; margin-top: 12px; }
+  .bracket h3 { margin: 0 0 6px; font-size: 13px; font-weight: 600; }
+  .rung { display: flex; align-items: baseline; gap: 10px; padding: 5px 0;
+          border-top: 1px solid var(--grid); flex-wrap: wrap; }
+  .rung .rname { min-width: 130px; color: var(--ink-2); font-size: 12px; }
+  .chip { display: inline-flex; align-items: center; gap: 6px;
+          background: color-mix(in srgb, var(--ink) 4%, transparent);
+          border: 1px solid var(--grid); border-radius: 12px;
+          padding: 2px 9px; font-size: 12px; cursor: pointer; }
+  .chip:hover { border-color: var(--axis); }
+  .chip .val { font-variant-numeric: tabular-nums; color: var(--ink); }
+  td.cmp, th.cmp { width: 26px; padding-right: 0; }
 </style>
 </head>
 <body>
@@ -106,6 +126,7 @@ DASHBOARD_HTML = r"""<!doctype html>
     <option>failed</option><option>stopped</option>
     <option>queued</option><option>preempted</option>
   </select>
+  <button id="compareBtn" hidden>compare</button>
   <button id="refresh">refresh</button>
   <button id="themeToggle" aria-label="toggle theme">◐</button>
 </header>
@@ -113,6 +134,7 @@ DASHBOARD_HTML = r"""<!doctype html>
   <div class="tiles" id="tiles"></div>
   <table id="runs">
     <thead><tr>
+      <th class="cmp" aria-label="compare"></th>
       <th>run</th><th>name</th><th>kind</th><th>project</th>
       <th>status</th><th>created</th>
     </tr></thead>
@@ -153,6 +175,7 @@ function tile(k, v) {
 }
 
 async function loadRuns() {
+  const keep = new Set(selectedRuns().map(r => r.uuid));  // survive refresh
   const status = $("#statusFilter").value;
   const q = status ? `?status=${encodeURIComponent(status)}` : "";
   const data = await api(`/api/v1/default/default/runs${q}`);
@@ -164,31 +187,69 @@ async function loadRuns() {
     ["running", "succeeded", "failed"].map(s => tile(s, counts[s] || 0)).join("");
   $("#runs tbody").innerHTML = rows.map(r => `
     <tr class="run" data-uuid="${esc(r.uuid)}">
+      <td class="cmp"><input type="checkbox" class="cmpBox"
+          data-uuid="${esc(r.uuid)}" data-name="${esc(r.name || String(r.uuid).slice(0, 8))}"
+          aria-label="select for comparison"></td>
       <td><a class="uuid">${esc(String(r.uuid).slice(0, 12))}</a></td>
       <td>${esc(r.name)}</td><td>${esc(r.kind)}</td><td>${esc(r.project)}</td>
       <td>${pill(r.status)}</td>
       <td class="num">${r.created_at ? new Date(r.created_at * 1000).toLocaleString() : ""}</td>
     </tr>`).join("");
   for (const tr of document.querySelectorAll("tr.run"))
-    tr.onclick = () => showRun(tr.dataset.uuid);
+    tr.onclick = (ev) => {
+      if (ev.target.classList.contains("cmpBox")) return;
+      showRun(tr.dataset.uuid);
+    };
+  for (const box of document.querySelectorAll(".cmpBox")) {
+    box.checked = keep.has(box.dataset.uuid);
+    box.onchange = updateCompareBtn;
+  }
+  updateCompareBtn();
 }
 
-function lineChart(name, points) {
-  // Single series per chart: the title names it, so no legend box.
-  const W = 320, H = 150, P = {l: 42, r: 10, t: 8, b: 20};
+function selectedRuns() {
+  return [...document.querySelectorAll(".cmpBox:checked")]
+    .map(b => ({uuid: b.dataset.uuid, name: b.dataset.name}));
+}
+
+function updateCompareBtn() {
+  const n = selectedRuns().length;
+  const btn = $("#compareBtn");
+  btn.hidden = n < 2;
+  btn.textContent = `compare ${n} runs`;
+}
+
+// Shared chart geometry: one source of truth for scales, grid, and
+// baseline across lineChart, overlayChart, and the tooltip math.
+const CW = 320, CH = 150, CP = {l: 42, r: 10, t: 8, b: 20};
+const fmtNum = v => Math.abs(v) >= 1000 ? v.toPrecision(4) : +v.toPrecision(3);
+
+function chartFrame(points) {
   const xs = points.map(p => p.step), ys = points.map(p => p.value);
   const x0 = Math.min(...xs), x1 = Math.max(...xs);
   let y0 = Math.min(...ys), y1 = Math.max(...ys);
   if (y0 === y1) { y0 -= 1; y1 += 1; }
-  const sx = s => P.l + (W - P.l - P.r) * (x1 === x0 ? 0.5 : (s - x0) / (x1 - x0));
-  const sy = v => H - P.b - (H - P.t - P.b) * ((v - y0) / (y1 - y0));
-  const fmt = v => Math.abs(v) >= 1000 ? v.toPrecision(4) : +v.toPrecision(3);
+  const sx = s => CP.l + (CW - CP.l - CP.r) * (x1 === x0 ? 0.5 : (s - x0) / (x1 - x0));
+  const sy = v => CH - CP.b - (CH - CP.t - CP.b) * ((v - y0) / (y1 - y0));
   const grid = [0, 0.5, 1].map(f => {
     const y = sy(y0 + f * (y1 - y0));
-    return `<line x1="${P.l}" y1="${y}" x2="${W - P.r}" y2="${y}" stroke="var(--grid)" stroke-width="1"/>
-            <text x="${P.l - 6}" y="${y + 4}" text-anchor="end" font-size="10" fill="var(--muted)">${fmt(y0 + f * (y1 - y0))}</text>`;
+    return `<line x1="${CP.l}" y1="${y}" x2="${CW - CP.r}" y2="${y}" stroke="var(--grid)" stroke-width="1"/>
+            <text x="${CP.l - 6}" y="${y + 4}" text-anchor="end" font-size="10" fill="var(--muted)">${fmtNum(y0 + f * (y1 - y0))}</text>`;
   }).join("");
-  const path = points.map((p, i) => `${i ? "L" : "M"}${sx(p.step).toFixed(1)},${sy(p.value).toFixed(1)}`).join("");
+  const baseline = `<line x1="${CP.l}" y1="${CH - CP.b}" x2="${CW - CP.r}" y2="${CH - CP.b}" stroke="var(--axis)" stroke-width="1"/>
+    <text x="${CW - CP.r}" y="${CH - 6}" text-anchor="end" font-size="10" fill="var(--muted)">step ${x1}</text>`;
+  const linePath = pts => pts.map((p, i) =>
+    `${i ? "L" : "M"}${sx(p.step).toFixed(1)},${sy(p.value).toFixed(1)}`).join("");
+  return {x0, x1, y0, y1, sx, sy, grid, baseline, linePath};
+}
+
+function lineChart(name, points) {
+  // Single series per chart: the title names it, so no legend box.
+  const W = CW, H = CH, P = CP, fmt = fmtNum;
+  const ys = points.map(p => p.value);
+  const f = chartFrame(points);
+  const {x0, x1, grid} = f;
+  const path = f.linePath(points);
   const table = `<table><thead><tr><th>step</th><th>value</th></tr></thead><tbody>
     ${points.map(p => `<tr><td class="num">${p.step}</td><td class="num">${fmt(p.value)}</td></tr>`).join("")}
   </tbody></table>`;
@@ -199,8 +260,7 @@ function lineChart(name, points) {
     <svg viewBox="0 0 ${W} ${H}" data-points='${esc(JSON.stringify(points))}'
          data-x0="${x0}" data-x1="${x1}" role="img" aria-label="${esc(name)} over steps">
       ${grid}
-      <line x1="${P.l}" y1="${H - P.b}" x2="${W - P.r}" y2="${H - P.b}" stroke="var(--axis)" stroke-width="1"/>
-      <text x="${W - P.r}" y="${H - 6}" text-anchor="end" font-size="10" fill="var(--muted)">step ${x1}</text>
+      ${f.baseline}
       <path d="${path}" fill="none" stroke="var(--series-1)" stroke-width="2"
             stroke-linejoin="round" stroke-linecap="round"/>
       <line class="xhair" y1="${P.t}" y2="${H - P.b}" stroke="var(--axis)" stroke-width="1" visibility="hidden"/>
@@ -218,7 +278,7 @@ function wireChart(el) {
   const tooltip = $("#tooltip");
   svg.addEventListener("mousemove", (ev) => {
     const rect = svg.getBoundingClientRect();
-    const W = 320, P = {l: 42, r: 10, t: 8, b: 20};
+    const W = CW, P = CP;
     const fx = (ev.clientX - rect.left) / rect.width * W;
     const x0 = +svg.dataset.x0, x1 = +svg.dataset.x1;
     const step = x0 + (fx - P.l) / (W - P.l - P.r) * (x1 - x0);
@@ -228,7 +288,7 @@ function wireChart(el) {
     let y0 = Math.min(...ys), y1 = Math.max(...ys);
     if (y0 === y1) { y0 -= 1; y1 += 1; }
     const sx = P.l + (W - P.l - P.r) * (x1 === x0 ? 0.5 : (best.step - x0) / (x1 - x0));
-    const sy = 150 - P.b - (150 - P.t - P.b) * ((best.value - y0) / (y1 - y0));
+    const sy = CH - P.b - (CH - P.t - P.b) * ((best.value - y0) / (y1 - y0));
     const xh = $(".xhair", svg), dot = $(".dot", svg);
     xh.setAttribute("x1", sx); xh.setAttribute("x2", sx); xh.setAttribute("visibility", "visible");
     dot.setAttribute("cx", sx); dot.setAttribute("cy", sy); dot.setAttribute("visibility", "visible");
@@ -282,15 +342,144 @@ function imageCard(uuid, name, ev) {
   </div>`;
 }
 
-let logSource = null;
-async function showRun(uuid) {
+const SERIES = [1, 2, 3, 4, 5, 6].map(i => `var(--series-${i})`);
+
+function overlayChart(name, seriesList) {
+  // Multi-run overlay: one line per run over a shared scale; legend
+  // below the title carries the color key (marks only get color).
+  const all = seriesList.flatMap(s => s.points);
+  if (!all.length) return "";
+  const f = chartFrame(all);
+  const paths = seriesList.map((s, i) =>
+    `<path d="${f.linePath(s.points)}" fill="none" stroke="${SERIES[i % SERIES.length]}"
+      stroke-width="2" stroke-linejoin="round" stroke-linecap="round">
+      <title>${esc(s.label)}</title></path>`).join("");
+  const legend = seriesList.map((s, i) =>
+    `<span class="key"><span class="swatch" style="background:${SERIES[i % SERIES.length]}"></span>${esc(s.label)}</span>`).join("");
+  return `<div class="chart">
+    <h3>${esc(name)}</h3>
+    <div class="legend">${legend}</div>
+    <svg viewBox="0 0 ${CW} ${CH}" role="img" aria-label="${esc(name)} across runs">
+      ${f.grid}
+      ${f.baseline}
+      ${paths}
+    </svg>
+  </div>`;
+}
+
+async function compareRuns() {
+  const sel = selectedRuns();
   const detail = $("#detail");
+  const gen = ++renderGen;
+  stopDetailTimers();
+  const fetched = await Promise.all(sel.map(async r => ({
+    ...r,
+    metrics: await api(`/api/v1/default/default/runs/${r.uuid}/metrics`).catch(() => ({})),
+  })));
+  if (gen !== renderGen) return;  // user navigated mid-fetch
+  const names = [...new Set(fetched.flatMap(f => Object.keys(f.metrics)))].sort();
+  const charts = names.map(name => overlayChart(
+    name,
+    fetched
+      .map(f => ({label: f.name, points: f.metrics[name] || []}))
+      .filter(s => s.points.length)
+  )).join("");
+  detail.innerHTML = `
+    <h2 style="font-size:15px">comparing ${sel.length} runs</h2>
+    <div class="charts">${charts ||
+      "<div class='sub' style='color:var(--muted)'>no shared metrics yet</div>"}</div>`;
+  detail.scrollIntoView({behavior: "smooth"});
+}
+
+function fmtParams(params) {
+  return Object.entries(params || {})
+    .map(([k, v]) => {
+      // Op-level params are stored as V1Param dicts: unwrap .value.
+      if (v && typeof v === "object" && "value" in v) v = v.value;
+      return `${k}=${typeof v === "number" ? +v.toPrecision(3) : v}`;
+    })
+    .join(" ");
+}
+
+// Outputs of terminal trials never change: cache them across the 5s
+// live rerenders so an N-trial sweep costs O(live trials) per tick.
+const outputsCache = new Map();  // uuid -> outputs (terminal only)
+
+async function sweepView(run) {
+  // Hyperband bracket / rung visualization: children grouped by
+  // (bracket, rung) with live trial statuses and observed metric.
+  const children = (await api(
+    `/api/v1/default/default/runs?pipeline=${encodeURIComponent(run.uuid)}`
+  ).catch(() => ({results: []}))).results || [];
+  if (!children.length) return "";
+  const metricName = run.spec?.matrix?.metric?.name;
+  const maximize = run.spec?.matrix?.metric?.optimization === "maximize";
+  const outputs = await Promise.all(children.map(async c => {
+    if (outputsCache.has(c.uuid)) return outputsCache.get(c.uuid);
+    const out = await api(
+      `/api/v1/default/default/runs/${c.uuid}/outputs`).catch(() => ({}));
+    if (TERMINAL.has(c.status)) outputsCache.set(c.uuid, out);
+    return out;
+  }));
+  const trials = children.map((c, i) => {
+    const out = outputs[i] || {};
+    const val = metricName != null
+      ? (out[`final_${metricName}`] ?? out[metricName])
+      : Object.entries(out).find(([k]) => k.startsWith("final_"))?.[1];
+    return {...c, metric: typeof val === "number" ? val : null};
+  });
+  const groups = new Map();
+  for (const t of trials) {
+    const b = t.meta?.bracket, r = t.meta?.rung;
+    const key = b != null ? `bracket ${b} · rung ${r}` : "trials";
+    if (!groups.has(key)) groups.set(key, []);
+    groups.get(key).push(t);
+  }
+  const rows = [...groups.entries()].map(([label, ts]) => {
+    // Best trial first, honoring the sweep's optimization direction.
+    ts.sort((a, b) => {
+      const worst = maximize ? -Infinity : Infinity;
+      const av = a.metric ?? worst, bv = b.metric ?? worst;
+      return maximize ? bv - av : av - bv;
+    });
+    const chips = ts.map(t => `
+      <span class="chip" data-uuid="${esc(t.uuid)}" role="button" tabindex="0">
+        ${pill(t.status)} ${esc(fmtParams(t.meta?.trial_params || t.params))}
+        ${t.metric != null ? `<span class="val">${+t.metric.toPrecision(4)}</span>` : ""}
+      </span>`).join("");
+    return `<div class="rung"><span class="rname">${esc(label)} · ${ts.length} trials</span>${chips}</div>`;
+  }).join("");
+  return `<div class="bracket">
+    <h3>sweep${metricName ? ` · ${maximize ? "maximizing" : "minimizing"} ${esc(metricName)}` : ""}</h3>
+    ${rows}
+  </div>`;
+}
+
+let detailTimer = null;
+// Monotonic render generation: an in-flight fetch chain whose gen is
+// stale (user navigated meanwhile) must not touch the DOM.
+let renderGen = 0;
+function stopDetailTimers() {
+  if (detailTimer) { clearTimeout(detailTimer); detailTimer = null; }
+  if (logSource) { logSource.close(); logSource = null; }
+}
+
+let logSource = null;
+const TERMINAL = new Set(["succeeded", "failed", "stopped", "upstream_failed", "skipped"]);
+async function showRun(uuid, opts) {
+  const rerender = opts && opts.rerender;
+  const detail = $("#detail");
+  const gen = ++renderGen;
+  stopDetailTimers();
   const [run, metrics, images, hists] = await Promise.all([
     api(`/api/v1/default/default/runs/${uuid}`),
     api(`/api/v1/default/default/runs/${uuid}/metrics`).catch(() => ({})),
     api(`/api/v1/default/default/runs/${uuid}/events?kind=image`).catch(() => ({})),
     api(`/api/v1/default/default/runs/${uuid}/events?kind=histogram`).catch(() => ({})),
   ]);
+  const isSweep = run.kind === "matrix";
+  const sweep = isSweep ? await sweepView(run) : "";
+  if (gen !== renderGen) return;  // user navigated mid-fetch
   const charts = Object.entries(metrics)
     .filter(([, pts]) => Array.isArray(pts) && pts.length)
     .map(([name, pts]) => lineChart(name, pts)).join("");
@@ -301,20 +490,35 @@ async function showRun(uuid) {
       .map(([name, evs]) => imageCard(uuid, name, evs[evs.length - 1])).join("");
   detail.innerHTML = `
     <h2 style="font-size:15px">${esc(run.name || run.uuid)} ${pill(run.status)}</h2>
-    <div class="charts">${charts || "<div class='sub' style='color:var(--muted)'>no metrics yet</div>"}</div>
+    ${sweep}
+    <div class="charts">${charts || (isSweep ? "" : "<div class='sub' style='color:var(--muted)'>no metrics yet</div>")}</div>
     ${media ? `<div class="charts">${media}</div>` : ""}
-    <div id="logs" aria-label="run logs"></div>`;
+    <div id="logs" aria-label="run logs"${isSweep ? " hidden" : ""}></div>`;
   for (const el of detail.querySelectorAll(".chart")) wireChart(el);
-  if (logSource) { logSource.close(); logSource = null; }
-  const logs = $("#logs");
-  logSource = new EventSource(`/streams/v1/default/default/runs/${uuid}/logs?follow=true`);
-  logSource.onmessage = (ev) => { logs.textContent += ev.data + "\n"; logs.scrollTop = logs.scrollHeight; };
-  logSource.addEventListener("done", () => { logSource.close(); logSource = null; });
-  detail.scrollIntoView({behavior: "smooth"});
+  for (const chip of detail.querySelectorAll(".chip")) {
+    chip.onclick = () => showRun(chip.dataset.uuid);
+    chip.onkeydown = (ev) => {  // role=button: Enter/Space activate
+      if (ev.key === "Enter" || ev.key === " ") {
+        ev.preventDefault();
+        showRun(chip.dataset.uuid);
+      }
+    };
+  }
+  if (!isSweep) {
+    const logs = $("#logs");
+    logSource = new EventSource(`/streams/v1/default/default/runs/${uuid}/logs?follow=true`);
+    logSource.onmessage = (ev) => { logs.textContent += ev.data + "\n"; logs.scrollTop = logs.scrollHeight; };
+    logSource.addEventListener("done", () => { logSource.close(); logSource = null; });
+  } else if (!TERMINAL.has(run.status)) {
+    // Live sweep: re-render the bracket view while trials advance.
+    detailTimer = setTimeout(() => showRun(uuid, {rerender: true}), 5000);
+  }
+  if (!rerender) detail.scrollIntoView({behavior: "smooth"});
 }
 
 $("#refresh").onclick = loadRuns;
 $("#statusFilter").onchange = loadRuns;
+$("#compareBtn").onclick = compareRuns;
 $("#themeToggle").onclick = () => {
   const root = document.documentElement;
   const dark = getComputedStyle(document.body).colorScheme.includes("dark");

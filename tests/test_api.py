@@ -72,8 +72,28 @@ class TestApi:
                 html = resp.read().decode()
             # Key surface markers: runs table, status filter, chart layer.
             for marker in ("polyaxon_tpu", "statusFilter", "lineChart",
-                           "histChart", "imageCard", "EventSource"):
+                           "histChart", "imageCard", "EventSource",
+                           # r2: multi-run overlay + hyperband brackets
+                           "compareBtn", "overlayChart", "sweepView",
+                           "cmpBox", "trial_params"):
                 assert marker in html, marker
+
+    def test_run_detail_includes_spec(self, stack):
+        """The dashboard's sweep view reads matrix config (metric name)
+        from the run-detail payload; list payloads stay lean."""
+        import json
+        import urllib.request
+
+        plane, server = stack
+        record = plane.submit(TRIAL, params={"lr": 0.25})
+        base = f"{server.url}/api/v1/default/default/runs"
+        with urllib.request.urlopen(f"{base}/{record.uuid}", timeout=5) as r:
+            detail = json.loads(r.read())
+        # Submission normalizes components into operations.
+        assert detail["spec"]["kind"] == "operation"
+        with urllib.request.urlopen(base, timeout=5) as r:
+            listed = json.loads(r.read())["results"]
+        assert all("spec" not in item for item in listed)
 
     def test_prometheus_metrics(self, stack):
         import urllib.request
