@@ -225,6 +225,12 @@ def main() -> int:
     parser.add_argument("--remat", default=None,
                         choices=["none", "dots", "full"],
                         help="checkpoint policy (default: dots, none on --smoke)")
+    parser.add_argument("--block-q", type=int, default=None,
+                        help="flash fwd q-tile size (sweepable)")
+    parser.add_argument("--block-k", type=int, default=None,
+                        help="flash fwd k-tile size (sweepable)")
+    parser.add_argument("--bwd", default=None, choices=["pallas", "xla"],
+                        help="flash backward impl (default: pallas on TPU)")
     parser.add_argument("--tuner", action="store_true",
                         help="measure Polytune throughput instead: a "
                              "Hyperband LR sweep of JAXJob trials, "
@@ -279,6 +285,22 @@ def main() -> int:
         batch = args.batch or 8
         seq = args.seq or 2048
 
+    # A sweep point whose tiles can't actually run in the flash kernel
+    # (pick_block reduces them, or <128 triggers the einsum fallback)
+    # would silently measure something else — refuse it instead.
+    from polyaxon_tpu.ops.flash import pick_block
+
+    for flag, value in (("--block-q", args.block_q),
+                        ("--block-k", args.block_k)):
+        if value is None:
+            continue
+        effective = pick_block(seq, value)
+        if value < 128 or effective != value:
+            parser.error(
+                f"{flag} {value} cannot tile seq {seq} in the flash "
+                f"kernel (effective block {effective}, minimum 128): "
+                "this sweep point would fall back to einsum attention")
+
     n_chips = jax.device_count()
     job = V1JAXJob.from_dict(
         {
@@ -295,6 +317,11 @@ def main() -> int:
                 "log_every": 10**9,
                 "remat": args.remat or ("none" if args.smoke else "dots"),
                 "attention_impl": args.attention,
+                **({"flash_block_q": args.block_q}
+                   if args.block_q is not None else {}),
+                **({"flash_block_k": args.block_k}
+                   if args.block_k is not None else {}),
+                **({"flash_bwd_impl": args.bwd} if args.bwd else {}),
             },
         }
     )

@@ -61,6 +61,14 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: str = "none"  # none | full | dots (checkpoint policy per layer)
     attention_impl: str = "xla"  # xla | flash | ring | ulysses
+    # Flash-kernel tuning (runtime keys flow here via model_overrides):
+    # fwd tile sizes and backward implementation ("pallas" | "xla").
+    # None = the kernel's own defaults (512 fwd tiles; pallas bwd on
+    # real TPU). Sweepable per-run from bench.py; setting one with a
+    # non-flash attention_impl is an error.
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
+    flash_bwd_impl: Optional[str] = None
     # Pipeline parallelism over the `pp` mesh axis (parallel/pipeline.py):
     # >1 splits the layer stack into that many ppermute-chained stages.
     pipeline_stages: int = 1
@@ -169,7 +177,10 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
     attn = dot_product_attention(q, k, v, causal=True,
                                  impl=cfg.attention_impl,
                                  segment_ids=segment_ids,
-                                 window=cfg.sliding_window)
+                                 window=cfg.sliding_window,
+                                 block_q=cfg.flash_block_q,
+                                 block_k=cfg.flash_block_k,
+                                 bwd_impl=cfg.flash_bwd_impl)
     x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
@@ -402,7 +413,10 @@ def prefill(
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = dot_product_attention(q, k, v, causal=True,
                                      impl=cfg.attention_impl,
-                                     window=cfg.sliding_window)
+                                     window=cfg.sliding_window,
+                                     block_q=cfg.flash_block_q,
+                                     block_k=cfg.flash_block_k,
+                                     bwd_impl=cfg.flash_bwd_impl)
         x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))

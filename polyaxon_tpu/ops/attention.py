@@ -102,11 +102,25 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     axis_name: Optional[str] = None,
     window: Optional[int] = None,
+    block_q: Optional[int] = None,   # flash tile tuning (None = default)
+    block_k: Optional[int] = None,
+    bwd_impl: Optional[str] = None,  # flash bwd: "pallas" | "xla"
 ) -> jax.Array:
+    flash_kwargs = {k_: v_ for k_, v_ in (
+        ("block_q", block_q), ("block_k", block_k),
+        ("bwd_impl", bwd_impl)) if v_ is not None}
     if impl == "auto":
         # Flash on real TPU (it self-falls-back when shapes don't tile);
-        # einsum reference elsewhere.
+        # einsum reference elsewhere. Flash knobs are tolerated here —
+        # they apply when flash is picked — so configs stay portable.
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    elif flash_kwargs and impl != "flash":
+        # An explicitly non-flash impl with flash tuning knobs is a
+        # config error, not something to ignore silently (a sweep
+        # against the wrong impl measures nothing).
+        raise ValueError(
+            f"flash tuning knobs {sorted(flash_kwargs)} require "
+            f"impl='flash' (or 'auto'), got `{impl}`")
     if impl == "xla":
         return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids,
                              window=window)
@@ -114,7 +128,7 @@ def dot_product_attention(
         from polyaxon_tpu.ops.flash import flash_attention
 
         return flash_attention(q, k, v, causal=causal, window=window,
-                               segment_ids=segment_ids)
+                               segment_ids=segment_ids, **flash_kwargs)
     if segment_ids is not None:
         raise ValueError(
             f"segment_ids (packed sequences) only supported by "
