@@ -240,6 +240,18 @@ def main() -> int:
     if args.tuner:
         _ACTIVE[:] = ["polytune_hyperband_trials_per_hour", "trials/hour"]
 
+    sweep_flags = [f for f, v in (("--block-q", args.block_q),
+                                  ("--block-k", args.block_k),
+                                  ("--bwd", args.bwd)) if v is not None]
+    if sweep_flags and args.tuner:
+        parser.error(f"{'/'.join(sweep_flags)} have no effect in --tuner "
+                     "mode")
+    if sweep_flags and args.attention != "flash":
+        # 'auto' resolves to einsum off-TPU and would silently drop the
+        # knobs — a sweep must pin the impl it is sweeping.
+        parser.error(f"{'/'.join(sweep_flags)} require --attention flash "
+                     f"(got {args.attention!r})")
+
     from polyaxon_tpu.utils import apply_jax_platforms_override
 
     apply_jax_platforms_override()  # honor JAX_PLATFORMS=cpu in CI

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Real-chip perf sweep: runs the VERDICT-r1 item-3 lever matrix through
+bench.py and reports a ranked table (tokens/sec/chip + MFU).
+
+Levers: per-device batch (8 vs 16), remat policy (dots vs none),
+attention (flash vs xla), flash fwd tile sizes, and backward impl
+(pallas kernels vs chunked-XLA recompute). Each point is an isolated
+bench.py subprocess so an OOM or compile failure poisons nothing.
+
+Usage: python scripts/perf_sweep.py [--steps N] [--quick]
+Writes perf_sweep_results.json next to bench_baseline.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_args(**kw) -> list[str]:
+    args = []
+    for flag, key in (("--batch", "batch"), ("--seq", "seq"),
+                      ("--steps", "steps"), ("--remat", "remat"),
+                      ("--attention", "attention"), ("--block-q", "block_q"),
+                      ("--block-k", "block_k"), ("--bwd", "bwd"),
+                      ("--model", "model")):
+        if kw.get(key) is not None:
+            args += [flag, str(kw[key])]
+    return args
+
+
+def run_point(name: str, timeout_s: float = 1200, **kw):
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")] + bench_args(**kw)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"name": name, "error": f"timeout>{timeout_s:.0f}s", **kw}
+    line = None
+    for ln in reversed(proc.stdout.strip().splitlines()):
+        try:
+            line = json.loads(ln)
+            break
+        except json.JSONDecodeError:
+            continue
+    if line is None:
+        tail = " | ".join(proc.stderr.strip().splitlines()[-3:])[-300:]
+        return {"name": name, "error": f"rc={proc.returncode}: {tail}", **kw}
+    out = {"name": name, "wall_s": round(time.time() - t0, 1), **kw, **line}
+    # OOM shows up as an error field from bench's catch-all.
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--model", default="llama_200m")
+    parser.add_argument("--seq", type=int, default=2048,
+                        help="sequence length (shrink for CPU smokes: the "
+                             "8-thread CPU mesh trips XLA's 40s collective "
+                             "watchdog on large shapes)")
+    parser.add_argument("--quick", action="store_true",
+                        help="baseline + the 3 highest-value levers only")
+    args = parser.parse_args()
+
+    base = dict(model=args.model, steps=args.steps, seq=args.seq)
+    points = [
+        ("baseline-b8-dots-flash", dict(base, batch=8, remat="dots",
+                                        attention="flash")),
+        ("b16-dots-flash", dict(base, batch=16, remat="dots",
+                                attention="flash")),
+        ("b8-dots-flash-bwd-xla", dict(base, batch=8, remat="dots",
+                                       attention="flash", bwd="xla")),
+        ("b8-none-flash", dict(base, batch=8, remat="none",
+                               attention="flash")),
+    ]
+    if not args.quick:
+        points += [
+            ("b16-none-flash", dict(base, batch=16, remat="none",
+                                    attention="flash")),
+            ("b8-dots-xla", dict(base, batch=8, remat="dots",
+                                 attention="xla")),
+            ("b8-dots-flash-q256k512", dict(base, batch=8, remat="dots",
+                                            attention="flash",
+                                            block_q=256, block_k=512)),
+            ("b8-dots-flash-q512k256", dict(base, batch=8, remat="dots",
+                                            attention="flash",
+                                            block_q=512, block_k=256)),
+            ("b8-dots-flash-q256k256", dict(base, batch=8, remat="dots",
+                                            attention="flash",
+                                            block_q=256, block_k=256)),
+            ("b16-dots-flash-bwd-xla", dict(base, batch=16, remat="dots",
+                                            attention="flash", bwd="xla")),
+        ]
+
+    results = []
+    for name, kw in points:
+        print(f"→ {name} ...", flush=True)
+        res = run_point(name, **kw)
+        results.append(res)
+        val = res.get("value")
+        print(f"  {name}: "
+              + (f"{val} tok/s/chip, mfu={res.get('mfu')}"
+                 if val else f"ERROR {res.get('error')}"),
+              flush=True)
+
+    ok = [r for r in results if r.get("value")]
+    ok.sort(key=lambda r: -r["value"])
+    out_path = os.path.join(REPO, "perf_sweep_results.json")
+    with open(out_path, "w") as fh:
+        json.dump({"results": results, "best": ok[0] if ok else None}, fh,
+                  indent=2)
+    print(f"\nwrote {out_path}\n")
+    print(f"{'config':<28} {'tok/s/chip':>12} {'mfu':>8}")
+    for r in ok:
+        print(f"{r['name']:<28} {r['value']:>12} "
+              f"{r.get('mfu') if r.get('mfu') is not None else '-':>8}")
+    for r in results:
+        if not r.get("value"):
+            print(f"{r['name']:<28} ERROR: {r.get('error')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
