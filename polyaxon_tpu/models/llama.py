@@ -336,23 +336,50 @@ def decode_step(
     full-length cache this is plain positional indexing; with a
     sliding-window cache (C == window) old entries are overwritten in
     place, so memory stays O(window) for arbitrarily long generations.
+
+    A scalar position is the all-rows-in-lockstep special case of
+    ``decode_step_ragged`` — one body, no duplicated decode math.
     """
+    B = tokens.shape[0]
+    return decode_step_ragged(
+        cfg, params, cache, tokens,
+        jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
+
+
+def decode_step_ragged(
+    cfg: LlamaConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B] int32 current-position token ids
+    pos: jax.Array,  # [B] int32 per-row position being written (-1 = idle)
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step with PER-ROW positions — the kernel under
+    continuous batching (serving/batching.py), where each cache slot
+    holds a different request at its own depth. Same ring-buffer cache
+    semantics as ``decode_step``, addressed per row; idle rows
+    (``pos < 0``) write only their own slot-0 entry (overwritten by the
+    next admission's prefill insert) and their outputs are ignored by
+    the engine. A row at position p matches ``decode_step`` at scalar
+    position p exactly."""
     dt = cfg.dtype
     B = tokens.shape[0]
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     n_rep = H // KV
     C = cache["k"].shape[2]
-    positions = jnp.full((B, 1), pos, jnp.int32)
+    pos_safe = jnp.maximum(pos, 0)
+    positions = pos_safe[:, None]  # [B, 1] for RoPE
     x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
 
-    slot = jnp.mod(pos, C)
-    # Slot s currently holds position pos - ((pos - s) mod C) (after this
-    # step's write); negative means never written. The sliding window
-    # itself needs no extra mask here: C <= window by cache_len(), so
-    # every live slot is inside the band by construction.
-    delta = jnp.mod(pos - jnp.arange(C), C)
-    stored = pos - delta
-    valid = (stored >= 0)[None, None, None, :]  # [1,1,1,C]
+    slot = jnp.mod(pos_safe, C)  # [B]
+    rows = jnp.arange(B)
+    # Per-row ring-buffer validity: slot s holds position
+    # pos - ((pos - s) mod C) after this write; negative = never
+    # written. The sliding window needs no extra mask: C <= window by
+    # cache_len(), so every live slot is inside the band by
+    # construction.
+    delta = jnp.mod(pos_safe[:, None] - jnp.arange(C)[None, :], C)  # [B, C]
+    stored = pos_safe[:, None] - delta
+    valid = ((stored >= 0) & (pos[:, None] >= 0))[:, None, None, :]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
@@ -362,8 +389,8 @@ def decode_step(
         v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, slot, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, slot, 0, 0))
+        k_cache = k_cache.at[rows, slot].set(k[:, 0])
+        v_cache = v_cache.at[rows, slot].set(v[:, 0])
 
         from polyaxon_tpu.ops.attention import repeat_kv
 

@@ -15,13 +15,20 @@ def main() -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--batching", default="static",
+                        choices=["static", "continuous"],
+                        help="continuous = slot-pool batcher: concurrent "
+                             "requests interleave token-by-token")
+    parser.add_argument("--slots", type=int, default=4,
+                        help="KV-cache slots for --batching continuous")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     from polyaxon_tpu.serving import ServingServer
 
     with ServingServer(args.model, args.checkpoint,
-                       host=args.host, port=args.port, seed=args.seed) as s:
+                       host=args.host, port=args.port, seed=args.seed,
+                       batching=args.batching, slots=args.slots) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

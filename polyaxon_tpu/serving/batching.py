@@ -1,0 +1,281 @@
+"""Continuous batching for the serving runtime.
+
+The static engine (server.py ``_Engine``) runs each request's whole
+generation as one compiled program: a long request blocks the batch and
+short ones pad to the longest. Continuous batching instead keeps a
+fixed pool of KV-cache **slots** and advances all live requests one
+token per loop iteration (``models.llama.decode_step_ragged`` — each
+slot at its own depth), admitting queued requests into freed slots
+between iterations. Throughput scales with slot occupancy instead of
+request alignment — the vLLM-style scheduling model, TPU-first:
+
+- one jitted ragged decode step for the whole pool (static shapes:
+  ``[slots]`` tokens/positions), so iteration never recompiles;
+- admission = a jitted prefill per exact prompt length (LRU-bounded,
+  same rule as the static engine) + an in-place cache-row insert;
+- per-row sampling fused into the step program (greedy and
+  temperature>0 rows coexist in one batch; per-row PRNG keys), so only
+  ``[slots]`` token ids cross the host boundary per iteration.
+
+Decoder-only families (llama) are supported; seq2seq models keep the
+static engine.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Request:
+    tokens: list[int]
+    max_new: int
+    temperature: float
+    seed: int
+    out: list[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[str] = None
+    cancelled: bool = False
+
+    def wait(self, timeout: Optional[float] = None) -> list[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error:
+            raise RuntimeError(self.error)
+        return self.out
+
+
+class ContinuousBatchingEngine:
+    """Slot-pool generation engine. API-compatible with ``_Engine``:
+    ``generate(rows, max_new_tokens, temperature, seed)`` blocks; the
+    lower-level ``submit()`` returns a waitable request for callers
+    that want request-level interleaving (each HTTP thread does)."""
+
+    def __init__(self, model: str, cfg, params, *, slots: int = 4,
+                 max_len: Optional[int] = None):
+        from polyaxon_tpu.models import llama
+
+        if model not in llama.CONFIGS:
+            raise ValueError(
+                f"continuous batching supports decoder-only models, "
+                f"`{model}` is not one (use the static engine)")
+        self.model = model
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len or cfg.max_seq_len
+        self._llama = llama
+
+        self._cache = llama.init_cache(cfg, slots, self.max_len)
+        self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
+        self._cur = np.zeros(slots, np.int32)
+        self._temps = np.zeros(slots, np.float32)
+        self._keys = [jax.random.key(0)] * slots
+        self._slot_req: list[Optional[_Request]] = [None] * slots
+
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._cv = threading.Condition()
+        self._stopped = False
+
+        def step(params, cache, tokens, pos, keys, temps):
+            logits, cache = llama.decode_step_ragged(
+                cfg, params, cache, tokens, pos)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+            nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+            return nxt, cache
+
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+        @lru_cache(maxsize=16)
+        def compiled_prefill(plen: int):
+            def run(params, prompt):
+                _, row_cache = llama.prefill(cfg, params, prompt,
+                                             self.max_len)
+                return row_cache
+
+            return jax.jit(run)
+
+        self._compiled_prefill = compiled_prefill
+
+        def insert(cache, row_k, row_v, b):
+            return {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], row_k, (0, b, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], row_v, (0, b, 0, 0, 0)),
+            }
+
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+
+        self._thread = threading.Thread(
+            target=self._loop, name="plx-serving-batcher", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- public
+    def _validate(self, tokens: list[int], max_new_tokens: int) -> None:
+        if not tokens:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(tokens) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_len {self.max_len}")
+
+    def submit(self, tokens: list[int], max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0) -> _Request:
+        self._validate(tokens, max_new_tokens)
+        req = _Request(list(tokens), max_new_tokens, float(temperature),
+                       int(seed))
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("engine stopped")
+            self._queue.append(req)
+            self._cv.notify()
+        return req
+
+    def cancel(self, req: _Request) -> None:
+        """Drop a request: dequeued if still waiting, retired at the
+        next loop iteration if live. Waiters see error='cancelled'."""
+        req.cancelled = True
+        with self._cv:
+            try:
+                self._queue.remove(req)
+                if not req.done.is_set():
+                    req.error = "cancelled"
+                    req.done.set()
+            except ValueError:
+                pass  # live in a slot (or done): the loop retires it
+
+    def generate(self, token_rows: list[list[int]], max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None) -> list[list[int]]:
+        if not token_rows:
+            return []
+        # Validate the whole batch before submitting ANY row — same
+        # no-wasted-work contract as the static engine: a bad row must
+        # not leave its siblings generating discarded output.
+        for row in token_rows:
+            self._validate(row, max_new_tokens)
+        reqs = [self.submit(row, max_new_tokens, temperature, seed + i)
+                for i, row in enumerate(token_rows)]
+        try:
+            return [r.wait(timeout=timeout) for r in reqs]
+        except TimeoutError:
+            for r in reqs:  # don't keep burning slots on abandoned work
+                if not r.done.is_set():
+                    self.cancel(r)
+            raise
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            # A long compile/step is still in flight; the loop exits at
+            # its next iteration. Don't fail live requests it may yet
+            # complete — just report.
+            logger.warning("batching loop still draining at stop()")
+            return
+        for req in list(self._queue) + self._slot_req:
+            if req is not None and not req.done.is_set():
+                req.error = "engine stopped"
+                req.done.set()
+
+    # -------------------------------------------------------------- loop
+    def _admit(self) -> None:
+        for b in range(self.slots):
+            if self._slot_req[b] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            try:
+                prompt = req.tokens
+                if len(prompt) > 1:
+                    row = jnp.asarray([prompt[:-1]], jnp.int32)
+                    row_cache = self._compiled_prefill(len(prompt) - 1)(
+                        self.params, row)
+                    self._cache = self._insert(
+                        self._cache, row_cache["k"], row_cache["v"],
+                        jnp.int32(b))
+                self._slot_req[b] = req
+                self._pos[b] = len(prompt) - 1
+                self._cur[b] = prompt[-1]
+                self._temps[b] = req.temperature
+                self._keys[b] = jax.random.key(req.seed)
+            except Exception as exc:  # noqa: BLE001 — request-scoped
+                req.error = f"{type(exc).__name__}: {exc}"
+                req.done.set()
+
+    def _retire(self, b: int) -> None:
+        req = self._slot_req[b]
+        self._slot_req[b] = None
+        self._pos[b] = -1
+        self._temps[b] = 0.0
+        if req is not None:
+            if req.cancelled and not req.error:
+                req.error = "cancelled"
+            req.done.set()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._stopped and not self._queue
+                       and all(r is None for r in self._slot_req)):
+                    self._cv.wait()
+                if self._stopped:
+                    return
+            for b in range(self.slots):  # drop cancelled live requests
+                req = self._slot_req[b]
+                if req is not None and req.cancelled:
+                    self._retire(b)
+            self._admit()
+            if all(r is None for r in self._slot_req):
+                continue
+            try:
+                keys = jnp.stack([
+                    jax.random.fold_in(self._keys[b],
+                                       len(r.out) if (r := self._slot_req[b])
+                                       else 0)
+                    for b in range(self.slots)])
+                nxt, self._cache = self._step(
+                    self.params, self._cache,
+                    jnp.asarray(self._cur), jnp.asarray(self._pos),
+                    keys, jnp.asarray(self._temps))
+                nxt = np.asarray(nxt)
+            except Exception as exc:  # noqa: BLE001 — fail live requests
+                logger.exception("decode step failed")
+                for b in range(self.slots):
+                    if self._slot_req[b] is not None:
+                        self._slot_req[b].error = (
+                            f"{type(exc).__name__}: {exc}")
+                        self._retire(b)
+                # The old cache was donated to the failed step — its
+                # buffer is gone (or poisoned). Rebuild so the engine
+                # survives a transient step failure.
+                self._cache = self._llama.init_cache(
+                    self.cfg, self.slots, self.max_len)
+                continue
+            for b in range(self.slots):
+                req = self._slot_req[b]
+                if req is None:
+                    continue
+                req.out.append(int(nxt[b]))
+                self._pos[b] += 1
+                self._cur[b] = int(nxt[b])
+                if len(req.out) >= req.max_new:
+                    self._retire(b)

@@ -216,12 +216,29 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ServingServer:
-    """``with ServingServer("llama_tiny") as s: requests → s.url``"""
+    """``with ServingServer("llama_tiny") as s: requests → s.url``
+
+    ``batching="continuous"`` swaps the static whole-budget engine for
+    the slot-pool continuous batcher (serving/batching.py): concurrent
+    HTTP requests interleave token-by-token instead of queueing behind
+    each other's full generations. Decoder-only models only.
+    """
 
     def __init__(self, model: str, checkpoint: Optional[str] = None,
-                 host: str = "127.0.0.1", port: int = 0, seed: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, seed: int = 0,
+                 batching: str = "static", slots: int = 4):
         cfg, params = load_params(model, checkpoint, seed=seed)
-        self.engine = _Engine(model, cfg, params)
+        if batching == "continuous":
+            from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+            self.engine = ContinuousBatchingEngine(
+                model, cfg, params, slots=slots)
+        elif batching == "static":
+            self.engine = _Engine(model, cfg, params)
+        else:
+            raise ValueError(
+                f"unknown batching mode `{batching}` "
+                "(expected 'static' or 'continuous')")
         handler = type("BoundHandler", (_Handler,), {"engine": self.engine})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host = host
@@ -242,6 +259,8 @@ class ServingServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        if hasattr(self.engine, "stop"):
+            self.engine.stop()
 
     def __enter__(self) -> "ServingServer":
         return self.start()

@@ -139,3 +139,124 @@ class TestServing:
         leaf = jax.tree.leaves(restored)[0]
         orig = jax.tree.leaves(params)[0]
         np.testing.assert_allclose(np.asarray(leaf), np.asarray(orig) + 1.0)
+
+
+class TestContinuousBatching:
+    """Slot-pool engine (serving/batching.py): per-request correctness
+    must be independent of what else occupies the pool."""
+
+    def test_decode_step_ragged_matches_scalar(self):
+        """Rows at different depths in one ragged step == each row run
+        alone with the scalar-position decode_step; idle rows stay
+        finite."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                                  dtype=jnp.float32)
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        max_len = 32
+        rows = [jax.random.randint(jax.random.key(i + 1), (1, 5 + 3 * i),
+                                   0, cfg.vocab_size) for i in range(3)]
+        ref = []
+        for r in rows:
+            _, cache = llama.prefill(cfg, params, r[:, :-1], max_len)
+            lg, _ = llama.decode_step(cfg, params, cache, r[0, -1:],
+                                      jnp.int32(r.shape[1] - 1))
+            ref.append(np.asarray(lg[0]))
+
+        cache = llama.init_cache(cfg, len(rows) + 1, max_len)
+        for i, r in enumerate(rows):
+            _, c1 = llama.prefill(cfg, params, r[:, :-1], max_len)
+            cache = {
+                "k": cache["k"].at[:, i].set(c1["k"][:, 0]),
+                "v": cache["v"].at[:, i].set(c1["v"][:, 0]),
+            }
+        tokens = jnp.asarray([r[0, -1] for r in rows] + [0], jnp.int32)
+        pos = jnp.asarray([r.shape[1] - 1 for r in rows] + [-1], jnp.int32)
+        out, _ = llama.decode_step_ragged(cfg, params, cache, tokens, pos)
+        for i in range(len(rows)):
+            np.testing.assert_allclose(np.asarray(out[i]), ref[i],
+                                       atol=2e-4, rtol=2e-4)
+        assert np.isfinite(np.asarray(out[len(rows)])).all()
+
+    def test_matches_static_engine_greedy(self):
+        """Continuous batching with mixed prompt lengths and budgets,
+        more requests than slots (exercises retire→admit), must equal
+        the whole-budget reference generation per request."""
+        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=2, max_len=64)
+        try:
+            prompts = [[5, 6, 7], [9, 8, 7, 6, 5], [1, 2], [3, 4, 5, 6]]
+            budgets = [6, 9, 4, 7]
+            reqs = [engine.submit(p, b) for p, b in zip(prompts, budgets)]
+            outs = [r.wait(timeout=600) for r in reqs]
+            import jax.numpy as jnp
+
+            for p, b, got in zip(prompts, budgets, outs):
+                expect = np.asarray(llama.generate(
+                    cfg, params, jnp.asarray([p], jnp.int32),
+                    max_new_tokens=b))[0].tolist()
+                assert got == expect, (p, b)
+        finally:
+            engine.stop()
+
+    def test_http_concurrent_requests(self):
+        """Concurrent HTTP clients against --batching continuous each
+        get the same tokens the static server produces."""
+        import threading
+
+        with ServingServer("llama_tiny", seed=0) as static_s, \
+                ServingServer("llama_tiny", seed=0, batching="continuous",
+                              slots=3) as cont_s:
+            rows = [[5, 6, 7], [9, 8, 7, 6, 5], [1, 2, 3, 4]]
+            expect = [
+                _post(static_s.url,
+                      {"tokens": [r], "max_new_tokens": 5})["tokens"][0]
+                for r in rows]
+            got: dict[int, list] = {}
+            errs: list[Exception] = []
+
+            def worker(i):
+                try:
+                    got[i] = _post(
+                        cont_s.url,
+                        {"tokens": [rows[i]],
+                         "max_new_tokens": 5})["tokens"][0]
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(rows))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            assert not errs, errs
+            assert [got[i] for i in range(len(rows))] == expect
+
+    def test_over_budget_rejected(self):
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=16)
+        try:
+            with pytest.raises(ValueError, match="exceeds max_len"):
+                engine.submit([1] * 10, 10)
+        finally:
+            engine.stop()
+
+    def test_seq2seq_rejected(self):
+        with pytest.raises(ValueError, match="decoder-only"):
+            ServingServer("t5_tiny", batching="continuous")
