@@ -496,18 +496,28 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", default=8080)
 @click.option("--seed", default=0)
-def serve_cmd(model, checkpoint, host, port, seed):
+@click.option("--batching", default="static",
+              type=click.Choice(["static", "continuous"]),
+              help="continuous = slot-pool batcher: concurrent requests "
+                   "interleave token-by-token (decoder models)")
+@click.option("--slots", default=4,
+              help="KV-cache slots for --batching continuous")
+def serve_cmd(model, checkpoint, host, port, seed, batching, slots):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
-    server = ServingServer(model, checkpoint, host=host, port=port, seed=seed)
+    server = ServingServer(model, checkpoint, host=host, port=port, seed=seed,
+                           batching=batching, slots=slots)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread
     except KeyboardInterrupt:
         pass
     finally:
-        server.httpd.server_close()
+        # One teardown path: ServingServer.stop() owns the shutdown
+        # sequence (httpd + engine); shutdown() returns immediately
+        # since serve_forever has already exited.
+        server.stop()
 
 
 # -------------------------------------------------------------------- agent

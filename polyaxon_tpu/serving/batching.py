@@ -70,6 +70,8 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"continuous batching supports decoder-only models, "
                 f"`{model}` is not one (use the static engine)")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
         self.model = model
         self.cfg = cfg
         self.params = params
@@ -181,6 +183,17 @@ class ContinuousBatchingEngine:
                     self.cancel(r)
             raise
 
+    def _finalize_stop(self) -> None:
+        """After the loop thread has really exited, unblock every waiter
+        it will never serve. Runs post-join, so it cannot race the
+        loop's own done.set() calls."""
+        self._thread.join()
+        with self._cv:
+            for req in list(self._queue) + self._slot_req:
+                if req is not None and not req.done.is_set():
+                    req.error = "engine stopped"
+                    req.done.set()
+
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
@@ -188,21 +201,28 @@ class ContinuousBatchingEngine:
         self._thread.join(timeout=60)
         if self._thread.is_alive():
             # A long compile/step is still in flight; the loop exits at
-            # its next iteration. Don't fail live requests it may yet
-            # complete — just report.
-            logger.warning("batching loop still draining at stop()")
+            # its next iteration top. Hand the final bookkeeping to a
+            # watcher so waiters are guaranteed to unblock eventually
+            # without stop() hanging on a wedged device.
+            logger.warning("batching loop still draining at stop(); "
+                           "waiters will be released when it exits")
+            threading.Thread(target=self._finalize_stop,
+                             name="plx-batcher-finalize",
+                             daemon=True).start()
             return
-        for req in list(self._queue) + self._slot_req:
-            if req is not None and not req.done.is_set():
-                req.error = "engine stopped"
-                req.done.set()
+        self._finalize_stop()
 
     # -------------------------------------------------------------- loop
     def _admit(self) -> None:
         for b in range(self.slots):
-            if self._slot_req[b] is not None or not self._queue:
+            if self._slot_req[b] is not None:
                 continue
-            req = self._queue.popleft()
+            # Pop under the lock: cancel() mutates the queue from HTTP
+            # threads, and an unsynchronized popleft can race it empty.
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
             try:
                 prompt = req.tokens
                 if len(prompt) > 1:
