@@ -22,6 +22,7 @@ from polyaxon_tpu.controlplane.store import RunRecord
 from polyaxon_tpu.lifecycle import V1Statuses
 from polyaxon_tpu.polyaxonfile import get_operation
 from polyaxon_tpu.polyflow.matrix import (
+    V1Asha,
     V1Bayes,
     V1GridSearch,
     V1Hyperband,
@@ -33,6 +34,7 @@ from polyaxon_tpu.polyflow.matrix import (
 from polyaxon_tpu.polyflow.operation import V1Operation, V1TriggerPolicy
 from polyaxon_tpu.polyflow.runs import V1RunKind
 from polyaxon_tpu.tune import (
+    AshaManager,
     BayesManager,
     GridSearchManager,
     HyperbandManager,
@@ -443,6 +445,8 @@ class Scheduler:
             actions += self._tick_oneshot(record, op, matrix, tuner, meta, children)
         elif isinstance(matrix, V1Hyperband):
             actions += self._tick_hyperband(record, op, matrix, tuner, meta, children)
+        elif isinstance(matrix, V1Asha):
+            actions += self._tick_asha(record, op, matrix, tuner, meta, children)
         elif isinstance(matrix, V1Bayes):
             actions += self._tick_smbo(
                 record, op, matrix, BayesManager(matrix), tuner, meta, children,
@@ -631,6 +635,101 @@ class Scheduler:
             message=None if any_ok else "all trials failed",
         )
         return actions + 1
+
+    def _tick_asha(self, record, op, matrix: V1Asha, tuner, meta,
+                   children) -> int:
+        """Asynchronous successive halving: NO rung barrier. Every tick,
+        (a) any completed trial ranking in the top 1/eta of COMPLETED
+        trials at its rung is promoted to the next rung immediately, and
+        (b) free concurrency slots are filled with fresh bottom-rung
+        trials — so a straggler or preempted sibling (requeued in place
+        by the scheduler's preemption pass) never stalls the sweep. The
+        promotion set is recomputed from children state each tick; the
+        tuner meta records what was already promoted so ticks stay
+        idempotent."""
+        import random as _random
+
+        manager = AshaManager(matrix)
+        tuner = tuner or {"spawned": 0, "promoted": {}}
+        # Unseeded sweeps draw a base seed once (persisted in meta) so
+        # re-launching explores NEW points while each sweep stays
+        # tick-stable.
+        if "seed" not in tuner:
+            tuner["seed"] = (matrix.seed if matrix.seed is not None
+                             else _random.randrange(2**31))
+        actions = 0
+        # Falsy concurrency = unlimited, like every other tuner here.
+        concurrency = matrix.concurrency or float("inf")
+
+        by_rung: dict[int, list[RunRecord]] = {}
+        for child in children:
+            by_rung.setdefault((child.meta or {}).get("rung", 0),
+                               []).append(child)
+        active = sum(1 for c in children if not c.is_done)
+
+        # (a) promotions, bottom-up so a trial can climb one rung/tick.
+        for rung_idx in sorted(by_rung):
+            if rung_idx + 1 >= manager.n_rungs():
+                continue  # top rung is terminal
+            # "Completed" includes failed trials: they stay in the
+            # rung-size denominator and rank worst (metric None) — the
+            # paper's n, not just the success count.
+            completed = [
+                (c.uuid, (c.meta or {}).get("trial_params") or {},
+                 self.plane.get_metric(c.uuid, matrix.metric.name)
+                 if c.status == V1Statuses.SUCCEEDED else None)
+                for c in by_rung[rung_idx]
+                if c.is_done and c.status != V1Statuses.PREEMPTED
+            ]
+            already = set(tuner["promoted"].get(str(rung_idx), []))
+            for uuid in manager.promotable(completed):
+                if uuid in already or active >= concurrency:
+                    continue
+                params = next(p for u, p, _ in completed if u == uuid)
+                trial = dict(params)
+                trial[matrix.resource.name] = manager.rungs[rung_idx + 1]
+                self._spawn_trial(
+                    record, op, trial, tuner["spawned"],
+                    iteration=rung_idx + 1,
+                    extra_meta={"bracket": 0, "rung": rung_idx + 1,
+                                "promoted_from": uuid})
+                tuner["promoted"].setdefault(str(rung_idx), []).append(uuid)
+                tuner["spawned"] += 1
+                active += 1
+                actions += 1
+
+        # (b) fresh bottom-rung trials into remaining capacity.
+        while (tuner.get("sampled", 0) < matrix.num_runs
+               and active < concurrency):
+            index = tuner.get("sampled", 0)
+            trial = manager.sample_params(index, base_seed=tuner["seed"])
+            trial[matrix.resource.name] = manager.rungs[0]
+            self._spawn_trial(record, op, trial, tuner["spawned"],
+                              iteration=0,
+                              extra_meta={"bracket": 0, "rung": 0})
+            tuner["sampled"] = index + 1
+            tuner["spawned"] += 1
+            active += 1
+            actions += 1
+
+        if actions:
+            meta["tuner"] = tuner
+            self.store.update_run(record.uuid, meta=meta)
+            return actions
+
+        # Done when the budget is drawn, everything finished, and the
+        # pass above found nothing left to promote.
+        if (tuner.get("sampled", 0) >= matrix.num_runs
+                and children and all(c.is_done for c in children)):
+            any_ok = any(c.status == V1Statuses.SUCCEEDED for c in children)
+            self.store.transition(
+                record.uuid,
+                V1Statuses.SUCCEEDED if any_ok else V1Statuses.FAILED,
+                reason="AshaDone",
+                message=None if any_ok else "all trials failed",
+            )
+            return 1
+        return 0
 
     def _tick_smbo(self, record, op, matrix, manager, tuner, meta, children,
                    *, num_initial: int, total_budget: int, reason: str) -> int:

@@ -18,6 +18,7 @@ from polyaxon_tpu.polyflow.environment import (
 )
 from polyaxon_tpu.polyflow.io import IOTypes, V1IO, V1Param, validate_params_against_io
 from polyaxon_tpu.polyflow.matrix import (
+    V1Asha,
     V1Bayes,
     V1FailureEarlyStopping,
     V1GridSearch,

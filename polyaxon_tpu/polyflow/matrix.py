@@ -393,6 +393,65 @@ class V1Hyperband(BaseSchema):
         return n, r
 
 
+class V1Asha(BaseSchema):
+    """Asynchronous Successive Halving (Li et al., MLSys 2020).
+
+    Unlike Hyperband's synchronized rungs (a rung must fully complete
+    before promotion), ASHA promotes any trial that ranks in the top
+    1/eta of COMPLETED trials at its rung the moment it finishes — no
+    barrier, so stragglers and preempted trials never stall the sweep.
+    The natural fit for preemptible TPU slices ([B] "trials across
+    preemptible slices"): slot turnover feeds either a promotion or a
+    fresh bottom-rung trial, keeping every slice busy.
+    """
+
+    kind: Literal["asha"] = "asha"
+    params: dict[str, HpParam]
+    num_runs: int  # bottom-rung trials to draw in total
+    max_iterations: int  # R: max resource any trial reaches
+    min_resource: float = 1  # r: bottom-rung resource
+    eta: float = 3
+    resource: V1OptimizationResource
+    metric: V1OptimizationMetric
+    seed: Optional[int] = None
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[EarlyStopping]] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.num_runs < 1:
+            raise ValueError("numRuns must be >= 1")
+        if self.max_iterations < 1:
+            raise ValueError("maxIterations must be >= 1")
+        if self.eta <= 1:
+            raise ValueError("eta must be > 1")
+        if not 0 < self.min_resource <= self.max_iterations:
+            raise ValueError(
+                "minResource must be in (0, maxIterations]")
+        if self.rung_resources()[0] <= 0:
+            # e.g. minResource=0.5 with an int resource casts to 0.
+            raise ValueError(
+                f"minResource {self.min_resource} casts to a non-positive "
+                f"{self.resource.type} resource")
+        return self
+
+    def rung_resources(self) -> list:
+        """Resource per rung: r·eta^k capped at R (the cap rung is
+        terminal). Cast duplicates are dropped — with an int resource
+        and small eta, consecutive rungs can round to the same budget,
+        and promoting at an identical budget would waste a trial."""
+        out: list = []
+        r = float(self.min_resource)
+        while True:
+            capped = min(r, float(self.max_iterations))
+            val = self.resource.cast(capped)
+            if not out or val > out[-1]:
+                out.append(val)
+            if capped >= self.max_iterations:
+                return out
+            r *= self.eta
+
+
 class V1GaussianProcessConfig(BaseSchema):
     kernel: str = "matern"  # matern | rbf
     length_scale: float = 1.0
@@ -502,6 +561,6 @@ class V1Mapping(BaseSchema):
 
 
 Matrix = Union[
-    V1GridSearch, V1RandomSearch, V1Hyperband, V1Bayes, V1Hyperopt,
+    V1GridSearch, V1RandomSearch, V1Hyperband, V1Asha, V1Bayes, V1Hyperopt,
     V1Iterative, V1Mapping,
 ]

@@ -7,11 +7,13 @@ from polyaxon_tpu.tune.base import (
     check_early_stopping,
     top_k,
 )
+from polyaxon_tpu.tune.asha import AshaManager
 from polyaxon_tpu.tune.bayes import BayesManager, GaussianProcess, acquisition
 from polyaxon_tpu.tune.hyperband import HyperbandManager, Rung
 from polyaxon_tpu.tune.hyperopt import HyperoptManager
 
 __all__ = [
+    "AshaManager",
     "BayesManager",
     "GaussianProcess",
     "GridSearchManager",

@@ -261,6 +261,50 @@ class TestMatrixPipelines:
         for child in rung1:
             assert child.meta["trial_params"]["epochs"] > 1
 
+    def test_asha_promotes_asynchronously(self, plane, agent):
+        """ASHA: trials promote rung-by-rung without a rung barrier;
+        the best lr climbs to the max resource, failed/bad trials stay
+        at the bottom, and the sweep terminates once the budget is
+        drawn and promotions drain."""
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "asha",
+                    "numRuns": 6,
+                    "maxIterations": 4,
+                    "minResource": 1,
+                    "eta": 2,
+                    "seed": 11,
+                    "concurrency": 2,
+                    "resource": {"name": "epochs", "type": "int"},
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": TRIAL_COMPONENT,
+            }
+        )
+        status = agent.run_until_done(record.uuid, timeout=240)
+        assert status == V1Statuses.SUCCEEDED
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        bottom = [c for c in children if (c.meta or {}).get("rung") == 0]
+        promoted = [c for c in children if (c.meta or {}).get("rung", 0) >= 1]
+        assert len(bottom) == 6  # the full sampling budget ran
+        assert promoted, "asha never promoted a trial"
+        # Promotions carry provenance and the next rung's resource
+        # (rungs: 1 → 2 → 4 epochs with eta=2, R=4).
+        for child in promoted:
+            assert child.meta["promoted_from"]
+            assert child.meta["trial_params"]["epochs"] in (2, 4)
+        # The globally best completed lr must have reached a higher rung.
+        scores = {c.uuid: plane.get_metric(c.uuid, "score") for c in bottom}
+        best_uuid = min(scores, key=lambda u: scores[u])
+        best_lr = next(c for c in bottom
+                       if c.uuid == best_uuid).meta["trial_params"]["lr"]
+        assert any(c.meta["trial_params"]["lr"] == pytest.approx(best_lr)
+                   for c in promoted), "best trial was never promoted"
+
     def test_hyperopt_tpe_sweep(self, plane, agent):
         record = plane.submit(
             {

@@ -431,3 +431,71 @@ class TestIterativeAndEarlyStopping:
         a = IterativeManager(matrix).get_suggestion(0)
         b = IterativeManager(matrix).get_suggestion(0)
         assert a != b  # OS entropy, not a fixed seed-0 stream
+
+
+class TestAsha:
+    def _matrix(self, **over):
+        from polyaxon_tpu.polyflow.matrix import V1Asha
+
+        spec = {
+            "kind": "asha", "numRuns": 9, "maxIterations": 9,
+            "minResource": 1, "eta": 3, "seed": 3,
+            "resource": {"name": "epochs", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"lr": {"kind": "loguniform",
+                              "value": {"low": -9.2, "high": -2.3}}},
+        }
+        spec.update(over)
+        return V1Asha.from_dict(spec)
+
+    def test_rung_resources(self):
+        assert self._matrix().rung_resources() == [1, 3, 9]
+        # Cap rung: R not a power of eta → last rung clamps to R.
+        assert self._matrix(maxIterations=5).rung_resources() == [1, 3, 5]
+        assert self._matrix(minResource=2,
+                            maxIterations=8).rung_resources() == [2, 6, 8]
+        # Small eta + int resource: cast duplicates are dropped so no
+        # promotion ever re-runs at an identical budget.
+        rungs = self._matrix(eta=1.4, maxIterations=4).rung_resources()
+        assert rungs == sorted(set(rungs)) == [1, 2, 3, 4]
+
+    def test_validation(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            self._matrix(numRuns=0)
+        with _pytest.raises(ValueError):
+            self._matrix(eta=1)
+        with _pytest.raises(ValueError):
+            self._matrix(minResource=20)  # > maxIterations
+        with _pytest.raises(ValueError):
+            self._matrix(minResource=0.5)  # casts to int 0
+
+    def test_sampling_deterministic_per_index(self):
+        from polyaxon_tpu.tune import AshaManager
+
+        m1, m2 = AshaManager(self._matrix()), AshaManager(self._matrix())
+        assert m1.sample_params(4) == m2.sample_params(4)
+        assert m1.sample_params(4) != m1.sample_params(5)
+
+    def test_promotable_top_fraction(self):
+        from polyaxon_tpu.tune import AshaManager
+
+        m = AshaManager(self._matrix())  # eta=3
+        completed = [(f"u{i}", {"lr": i}, float(i)) for i in range(6)]
+        # floor(6/3) = 2 best (minimize): u0, u1.
+        assert m.promotable(completed) == ["u0", "u1"]
+        # Fewer than eta completed → nothing promotes yet (async rule).
+        assert m.promotable(completed[:2]) == []
+
+    def test_promotable_maximize_and_failures(self):
+        from polyaxon_tpu.tune import AshaManager
+
+        m = AshaManager(self._matrix(
+            metric={"name": "acc", "optimization": "maximize"}))
+        completed = [("a", {}, 0.1), ("b", {}, 0.9),
+                     ("fail", {}, None), ("c", {}, 0.5)]
+        # floor(4/3) = 1 → the best by acc; failed trials never promote.
+        assert m.promotable(completed) == ["b"]
+        only_failed = [("x", {}, None), ("y", {}, None), ("z", {}, None)]
+        assert m.promotable(only_failed) == []
