@@ -502,12 +502,25 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
                    "interleave token-by-token (decoder models)")
 @click.option("--slots", default=4,
               help="KV-cache slots for --batching continuous")
-def serve_cmd(model, checkpoint, host, port, seed, batching, slots):
+@click.option("--mesh", "mesh_str", default=None,
+              help="shard weights over a device mesh, e.g. 'tp=4' or "
+                   "'fsdp=-1' (-1 = all devices); decode collectives are "
+                   "GSPMD-inserted")
+def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
+    mesh_axes = None
+    if mesh_str:
+        from polyaxon_tpu.parallel import parse_mesh_axes
+
+        try:
+            mesh_axes = parse_mesh_axes(mesh_str)
+        except ValueError as exc:
+            raise click.BadParameter(str(exc)) from None
     server = ServingServer(model, checkpoint, host=host, port=port, seed=seed,
-                           batching=batching, slots=slots)
+                           batching=batching, slots=slots,
+                           mesh_axes=mesh_axes)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread

@@ -3,6 +3,7 @@ from polyaxon_tpu.parallel.mesh import (
     AXIS_ORDER,
     build_mesh,
     mesh_summary,
+    parse_mesh_axes,
     single_device_mesh,
 )
 from polyaxon_tpu.parallel.sharding import (
@@ -26,6 +27,7 @@ __all__ = [
     "merge_rules",
     "mesh_summary",
     "param_bytes",
+    "parse_mesh_axes",
     "read_env_contract",
     "rules_for_mesh",
     "single_device_mesh",

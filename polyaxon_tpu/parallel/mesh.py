@@ -55,6 +55,26 @@ def order_axes(axes: dict[str, int]) -> dict[str, int]:
     return {**known, **unknown}
 
 
+def parse_mesh_axes(spec: str) -> dict[str, int]:
+    """Parse a CLI-style mesh string — ``"tp=4,dp=2"`` / ``"fsdp=-1"``
+    (-1 = absorb remaining devices) — into an axes dict. Raises
+    ``ValueError`` with an actionable message; entry points convert it
+    to their own usage-error style."""
+    axes: dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        name = name.strip()
+        try:
+            axes[name] = int(size)
+        except ValueError:
+            raise ValueError(
+                f"mesh axes expect name=size pairs "
+                f"(e.g. 'tp=4,dp=2'), got {part.strip()!r}") from None
+        if not name:
+            raise ValueError(f"mesh axis in {part.strip()!r} has no name")
+    return axes
+
+
 def build_mesh(
     mesh_spec: Optional[V1MeshSpec] = None,
     topology: Optional[V1TpuTopology] = None,

@@ -21,14 +21,26 @@ def main() -> int:
                              "requests interleave token-by-token")
     parser.add_argument("--slots", type=int, default=4,
                         help="KV-cache slots for --batching continuous")
+    parser.add_argument("--mesh", default=None,
+                        help="shard weights over a device mesh, e.g. 'tp=4' "
+                             "or 'fsdp=-1' (-1 = all devices)")
     args = parser.parse_args()
+    mesh_axes = None
+    if args.mesh:
+        from polyaxon_tpu.parallel import parse_mesh_axes
+
+        try:
+            mesh_axes = parse_mesh_axes(args.mesh)
+        except ValueError as exc:
+            parser.error(str(exc))
 
     logging.basicConfig(level=logging.INFO)
     from polyaxon_tpu.serving import ServingServer
 
     with ServingServer(args.model, args.checkpoint,
                        host=args.host, port=args.port, seed=args.seed,
-                       batching=args.batching, slots=args.slots) as s:
+                       batching=args.batching, slots=args.slots,
+                       mesh_axes=mesh_axes) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

@@ -63,13 +63,37 @@ def _family(model: str):
         f"{sorted(llama.CONFIGS)}, seq2seq: {sorted(t5.CONFIGS)}")
 
 
-def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0):
+def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0,
+                mesh=None):
     """Model params: latest step of an Orbax checkpoint dir (a saved
-    JAXJob train state or a bare params tree), else random init."""
+    JAXJob train state or a bare params tree), else random init.
+
+    ``mesh``: shard the weights over it using the model's logical axes
+    and the mesh's rule table (the same tables training uses) — serving
+    an 8B-class model then runs tensor/fsdp-parallel across the mesh
+    with GSPMD inserting the decode collectives. The full weight tree
+    is never materialized unsharded on one device: random init is
+    jitted with sharded out_shardings, and checkpoint tensors move
+    host → their own device shards directly.
+    """
+    import numpy as np
+
     family = _family(model)
     cfg = family.CONFIGS[model]
-    variables = family.init(cfg, jax.random.key(seed))
-    params = variables["params"]
+
+    shardings = None
+    if mesh is not None:
+        from polyaxon_tpu.parallel import rules_for_mesh
+        from polyaxon_tpu.parallel.sharding import tree_shardings
+
+        shardings = tree_shardings(
+            family.logical_axes(cfg)["params"], mesh, rules_for_mesh(mesh))
+
+    # Shape/dtype template: no memory, used for structure validation
+    # and dtype casts either way.
+    template = jax.eval_shape(
+        lambda key: family.init(cfg, key)["params"], jax.random.key(0))
+
     if checkpoint:
         import orbax.checkpoint as ocp
 
@@ -84,15 +108,30 @@ def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0):
             # validate against the model before serving.
             restored = mgr.restore(step, args=ocp.args.StandardRestore())
             loaded = restored.get("params", restored)
-            expect = jax.tree.structure(params)
-            got = jax.tree.structure(loaded)
-            if expect != got:
+            if jax.tree.structure(template) != jax.tree.structure(loaded):
                 raise ValueError(
                     f"checkpoint {checkpoint} step {step} does not match "
                     f"model `{model}`: params tree structure differs")
-            params = jax.tree.map(
-                lambda ref, x: jnp.asarray(x, ref.dtype), params, loaded)
+            if shardings is not None:
+                params = jax.tree.map(
+                    lambda ref, x, sh: jax.device_put(
+                        np.asarray(x, ref.dtype), sh),
+                    template, loaded, shardings)
+            else:
+                params = jax.tree.map(
+                    lambda ref, x: jnp.asarray(x, ref.dtype),
+                    template, loaded)
             logger.info("restored %s step=%s", checkpoint, step)
+    elif shardings is not None:
+        init_fn = jax.jit(lambda key: family.init(cfg, key)["params"],
+                          out_shardings=shardings)
+        params = init_fn(jax.random.key(seed))
+    else:
+        params = family.init(cfg, jax.random.key(seed))["params"]
+
+    if mesh is not None:
+        logger.info("sharded %s over mesh %s", model,
+                    dict(zip(mesh.axis_names, mesh.devices.shape)))
     return cfg, params
 
 
@@ -226,8 +265,24 @@ class ServingServer:
 
     def __init__(self, model: str, checkpoint: Optional[str] = None,
                  host: str = "127.0.0.1", port: int = 0, seed: int = 0,
-                 batching: str = "static", slots: int = 4):
-        cfg, params = load_params(model, checkpoint, seed=seed)
+                 batching: str = "static", slots: int = 4,
+                 mesh_axes: Optional[dict] = None):
+        self.mesh = None
+        if mesh_axes:
+            from polyaxon_tpu.parallel import build_mesh
+            from polyaxon_tpu.polyflow.runs import V1MeshSpec
+
+            if any(v == -1 for v in mesh_axes.values()):
+                devices = jax.devices()  # -1 axis absorbs all devices
+            else:
+                n = 1
+                for v in mesh_axes.values():
+                    n *= v
+                devices = jax.devices()[:n]
+            self.mesh = build_mesh(V1MeshSpec(axes=mesh_axes),
+                                   devices=devices)
+        cfg, params = load_params(model, checkpoint, seed=seed,
+                                  mesh=self.mesh)
         if batching == "continuous":
             from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
 

@@ -260,3 +260,33 @@ class TestContinuousBatching:
     def test_seq2seq_rejected(self):
         with pytest.raises(ValueError, match="decoder-only"):
             ServingServer("t5_tiny", batching="continuous")
+
+
+class TestShardedServing:
+    """Mesh-sharded weights: serving an 8B-class model tensor-parallel
+    (SURVEY §2b TP row) must be output-identical to single-device."""
+
+    def test_tp_sharded_matches_unsharded(self):
+        rows = [[5, 6, 7], [9, 8, 7, 6, 5]]
+        with ServingServer("llama_tiny", seed=0) as ref_s:
+            expect = _post(ref_s.url,
+                           {"tokens": rows, "max_new_tokens": 6})["tokens"]
+        with ServingServer("llama_tiny", seed=0,
+                           mesh_axes={"tp": 4}) as tp_s:
+            assert tp_s.mesh is not None
+            got = _post(tp_s.url,
+                        {"tokens": rows, "max_new_tokens": 6})["tokens"]
+        assert got == expect
+
+    def test_fsdp_all_devices_continuous(self):
+        """fsdp=-1 absorbs the whole 8-device mesh; the continuous
+        batcher runs on sharded weights too."""
+        rows = [[5, 6, 7], [1, 2, 3, 4]]
+        with ServingServer("llama_tiny", seed=0) as ref_s:
+            expect = _post(ref_s.url,
+                           {"tokens": rows, "max_new_tokens": 5})["tokens"]
+        with ServingServer("llama_tiny", seed=0, batching="continuous",
+                           slots=2, mesh_axes={"fsdp": -1}) as s:
+            got = _post(s.url,
+                        {"tokens": rows, "max_new_tokens": 5})["tokens"]
+        assert got == expect
