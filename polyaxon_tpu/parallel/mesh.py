@@ -64,6 +64,8 @@ def parse_mesh_axes(spec: str) -> dict[str, int]:
     for part in spec.split(","):
         name, _, size = part.partition("=")
         name = name.strip()
+        if name in axes:
+            raise ValueError(f"mesh axis {name!r} given twice")
         try:
             axes[name] = int(size)
         except ValueError:

@@ -23,8 +23,15 @@ API (JSON over HTTP):
     GET  /healthz              → {"status": "ok", "model": name}
     GET  /v1/models            → {"models": [name]}
     POST /v1/generate          {"tokens": [[...]], "max_new_tokens": N,
-                                "temperature": T?, "seed": S?}
-                               → {"tokens": [[...]] }
+                                "temperature": T?, "seed": S?,
+                                "stream": bool?}
+                               → {"tokens": [[...]] }, or with
+                               stream=true an SSE stream of per-token
+                               events {"index": row, "token": id}
+                               followed by event:done {"tokens": ...}.
+                               Under --batching continuous tokens
+                               arrive as they decode; the static
+                               engine emits one burst per batch.
 """
 
 from __future__ import annotations
@@ -76,8 +83,6 @@ def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0,
     jitted with sharded out_shardings, and checkpoint tensors move
     host → their own device shards directly.
     """
-    import numpy as np
-
     family = _family(model)
     cfg = family.CONFIGS[model]
 
@@ -164,14 +169,33 @@ class _Engine:
 
         self._compiled = compiled
 
+    def _validate(self, tokens: list[int], max_new_tokens: int) -> None:
+        """Request-level checks, shared with the streaming handler so a
+        bad request is rejected before any work (or any SSE header)."""
+        if len(tokens) < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        plen, n_bucket = len(tokens), _bucket(max_new_tokens, lo=16)
+        if self.seq2seq:
+            if max(plen, n_bucket) > self.cfg.max_seq_len:
+                raise ValueError(
+                    f"prompt {plen} or generation budget {n_bucket} "
+                    f"exceeds max_seq_len {self.cfg.max_seq_len}")
+        elif plen + n_bucket > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {plen} + generation budget {n_bucket} exceeds "
+                f"max_seq_len {self.cfg.max_seq_len}")
+
     def generate(self, token_rows: list[list[int]], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0) -> list[list[int]]:
         if not token_rows:
             return []
-        if min(len(r) for r in token_rows) < 1:
-            raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        # Validate every row before running any (no TPU work is spent
+        # on a batch that will be rejected).
+        for row in token_rows:
+            self._validate(row, max_new_tokens)
         sampling = temperature > 0
         n_bucket = _bucket(max_new_tokens, lo=16)
         # Rows are grouped by EXACT prompt length — padding a causal
@@ -182,18 +206,6 @@ class _Engine:
         groups: dict[int, list[int]] = {}
         for i, row in enumerate(token_rows):
             groups.setdefault(len(row), []).append(i)
-        # Validate every group before running any (no TPU work is spent
-        # on a batch that will be rejected).
-        for plen in groups:
-            if self.seq2seq:
-                if max(plen, n_bucket) > self.cfg.max_seq_len:
-                    raise ValueError(
-                        f"prompt {plen} or generation budget {n_bucket} "
-                        f"exceeds max_seq_len {self.cfg.max_seq_len}")
-            elif plen + n_bucket > self.cfg.max_seq_len:
-                raise ValueError(
-                    f"prompt {plen} + generation budget {n_bucket} exceeds "
-                    f"max_seq_len {self.cfg.max_seq_len}")
         results: list[Optional[list[int]]] = [None] * len(token_rows)
         for plen, idxs in groups.items():
             batch = np.asarray([token_rows[i] for i in idxs], np.int32)
@@ -240,18 +252,95 @@ class _Handler(BaseHTTPRequestHandler):
                     or not all(isinstance(r, list) and r for r in tokens)):
                 raise ValueError("`tokens` must be a non-empty list of "
                                  "non-empty token-id lists")
+            max_new = int(req.get("max_new_tokens", 32))
+            temperature = float(req.get("temperature", 0.0))
+            seed = int(req.get("seed", 0))
+            if req.get("stream"):
+                return self._stream_generate(tokens, max_new, temperature,
+                                             seed)
             out = self.engine.generate(
-                tokens,
-                max_new_tokens=int(req.get("max_new_tokens", 32)),
-                temperature=float(req.get("temperature", 0.0)),
-                seed=int(req.get("seed", 0)),
-            )
+                tokens, max_new_tokens=max_new,
+                temperature=temperature, seed=seed)
             return self._json({"tokens": out})
         except (KeyError, ValueError, TypeError) as exc:
             return self._json({"error": str(exc)}, status=400)
         except Exception as exc:  # pragma: no cover
             return self._json({"error": f"{type(exc).__name__}: {exc}"},
                               status=500)
+
+    def _sse(self, payload: Any, event: Optional[str] = None) -> None:
+        msg = ""
+        if event:
+            msg += f"event: {event}\n"
+        msg += f"data: {json.dumps(payload)}\n\n"
+        self.wfile.write(msg.encode())
+        self.wfile.flush()
+
+    def _stream_generate(self, token_rows, max_new: int, temperature: float,
+                         seed: int) -> None:
+        """SSE token streaming. With the continuous engine, per-token
+        events flow as rows decode (the handler polls each request's
+        growing output — appends are GIL-atomic); the static engine
+        emits the whole batch as a burst after its compiled run."""
+        import time as _time
+
+        # Validate before any header goes out, so bad requests are real
+        # HTTP 400s (the caller catches ValueError) rather than error
+        # events on an already-open stream. Both engines expose
+        # _validate.
+        for row in token_rows:
+            self.engine._validate(row, max_new)
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        reqs = []
+        try:
+            if hasattr(self.engine, "submit"):
+                reqs = [self.engine.submit(row, max_new, temperature,
+                                           seed + i)
+                        for i, row in enumerate(token_rows)]
+                emitted = [0] * len(reqs)
+                while True:
+                    progressed = False
+                    for i, r in enumerate(reqs):
+                        while emitted[i] < len(r.out):
+                            self._sse({"index": i,
+                                       "token": r.out[emitted[i]]})
+                            emitted[i] += 1
+                            progressed = True
+                    if all(r.done.is_set() and emitted[i] == len(r.out)
+                           for i, r in enumerate(reqs)):
+                        break
+                    if not progressed:
+                        _time.sleep(0.02)
+                failed = [r.error for r in reqs if r.error]
+                if failed:
+                    return self._sse({"error": failed[0]}, event="error")
+                out = [r.out for r in reqs]
+            else:
+                out = self.engine.generate(
+                    token_rows, max_new_tokens=max_new,
+                    temperature=temperature, seed=seed)
+                for i, row in enumerate(out):
+                    for tok in row:
+                        self._sse({"index": i, "token": tok})
+            self._sse({"tokens": out}, event="done")
+        except (BrokenPipeError, ConnectionResetError):
+            # Client went away mid-stream: stop burning slots on output
+            # nobody will read (same invariant as generate()'s timeout
+            # cancellation).
+            for r in reqs:
+                if not r.done.is_set():
+                    self.engine.cancel(r)
+        except Exception as exc:  # noqa: BLE001 — headers already sent
+            try:
+                self._sse({"error": f"{type(exc).__name__}: {exc}"},
+                          event="error")
+            except OSError:
+                pass
 
 
 class ServingServer:

@@ -290,3 +290,75 @@ class TestShardedServing:
             got = _post(s.url,
                         {"tokens": rows, "max_new_tokens": 5})["tokens"]
         assert got == expect
+
+
+class TestStreaming:
+    @staticmethod
+    def _stream(url, payload, timeout=300):
+        import urllib.request
+
+        req = urllib.request.Request(
+            url + "/v1/generate", method="POST",
+            data=json.dumps(dict(payload, stream=True)).encode(),
+            headers={"Content-Type": "application/json"})
+        events = []
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            event_name = None
+            for raw in resp:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("event: "):
+                    event_name = line[len("event: "):]
+                elif line.startswith("data: "):
+                    events.append((event_name or "token",
+                                   json.loads(line[len("data: "):])))
+                    event_name = None
+        return events
+
+    def test_streaming_matches_nonstreaming_continuous(self):
+        rows = [[5, 6, 7], [9, 8, 7, 6, 5]]
+        with ServingServer("llama_tiny", seed=0, batching="continuous",
+                           slots=2) as s:
+            expect = _post(s.url, {"tokens": rows,
+                                   "max_new_tokens": 6})["tokens"]
+            events = self._stream(s.url, {"tokens": rows,
+                                          "max_new_tokens": 6})
+        done = [p for name, p in events if name == "done"]
+        assert len(done) == 1 and done[0]["tokens"] == expect
+        # Per-token events reassemble into the same rows, in order.
+        streamed = [[], []]
+        for name, p in events:
+            if name == "token":
+                streamed[p["index"]].append(p["token"])
+        assert streamed == expect
+
+    def test_streaming_static_engine_bursts(self):
+        rows = [[5, 6, 7]]
+        with ServingServer("llama_tiny", seed=0) as s:
+            expect = _post(s.url, {"tokens": rows,
+                                   "max_new_tokens": 5})["tokens"]
+            events = self._stream(s.url, {"tokens": rows,
+                                          "max_new_tokens": 5})
+        done = [p for name, p in events if name == "done"]
+        assert done and done[0]["tokens"] == expect
+        assert [p["token"] for n, p in events if n == "token"] == expect[0]
+
+    @pytest.mark.parametrize("batching", ["continuous", "static"])
+    def test_streaming_bad_request_is_http_400(self, batching):
+        """Over-budget streaming requests are proper HTTP 400s on BOTH
+        engines — never a 200 stream carrying an error event."""
+        import urllib.error
+        import urllib.request
+
+        with ServingServer("llama_tiny", seed=0, batching=batching,
+                           slots=1) as s:
+            req = urllib.request.Request(
+                s.url + "/v1/generate", method="POST",
+                data=json.dumps({"tokens": [[1] * 100],
+                                 "max_new_tokens": 10_000,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=60)
+            assert err.value.code == 400
