@@ -305,6 +305,67 @@ class TestMatrixPipelines:
         assert any(c.meta["trial_params"]["lr"] == pytest.approx(best_lr)
                    for c in promoted), "best trial was never promoted"
 
+    def test_asha_survives_preemption(self, plane, agent):
+        """Preempting a live ASHA trial must not poison the sweep: the
+        trial requeues in place (no retry consumed), completes, and the
+        sweep still drains to SUCCEEDED with the full sampling budget."""
+        slow_trial = {
+            "kind": "component",
+            "name": "slow-trial",
+            "inputs": TRIAL_COMPONENT["inputs"],
+            "run": {
+                "kind": "job",
+                "container": {"command": [
+                    "python", "-c",
+                    "import time; time.sleep(1.5)\n" + TRIAL_SCRIPT,
+                ]},
+            },
+        }
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "asha",
+                    "numRuns": 4,
+                    "maxIterations": 2,
+                    "minResource": 1,
+                    "eta": 2,
+                    "seed": 5,
+                    "concurrency": 2,
+                    "resource": {"name": "epochs", "type": "int"},
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": slow_trial,
+            }
+        )
+        preempted_uuid = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            agent.reconcile_once()
+            if preempted_uuid is None:
+                live = [u for u in agent.executor.active_runs
+                        if plane.get_run(u).pipeline_uuid == record.uuid]
+                if live:
+                    assert agent.executor.preempt(live[0])
+                    preempted_uuid = live[0]
+            if plane.get_run(record.uuid).is_done:
+                children = plane.list_runs(pipeline_uuid=record.uuid)
+                if all(c.is_done for c in children):
+                    break
+            time.sleep(0.05)
+        assert preempted_uuid, "never caught a live trial to preempt"
+        assert plane.get_run(record.uuid).status == V1Statuses.SUCCEEDED
+        victim = plane.get_run(preempted_uuid)
+        assert victim.status == V1Statuses.SUCCEEDED  # requeued + finished
+        assert victim.retries == 0  # preemption must not consume a retry
+        conditions = plane.get_statuses(preempted_uuid)
+        assert any(c["type"] == "preempted" for c in conditions)
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        bottom = [c for c in children if (c.meta or {}).get("rung") == 0]
+        assert len(bottom) == 4  # full budget, no duplicate respawns
+
     def test_hyperopt_tpe_sweep(self, plane, agent):
         record = plane.submit(
             {
