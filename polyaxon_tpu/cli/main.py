@@ -177,13 +177,58 @@ def ops():
 @click.option("-p", "--project", default=None)
 @click.option("--status", default=None)
 @click.option("--limit", default=50)
-def ops_ls(project, status, limit):
+@click.option("--pipeline", default=None,
+              help="only children of this sweep/DAG uuid")
+def ops_ls(project, status, limit, pipeline):
     from polyaxon_tpu.lifecycle import V1Statuses
 
     plane = get_plane()
     statuses = [V1Statuses(status)] if status else None
-    for record in plane.list_runs(project=project, statuses=statuses, limit=limit):
+    for record in plane.list_runs(project=project, statuses=statuses,
+                                  limit=limit, pipeline_uuid=pipeline):
         _echo_run(record)
+
+
+@ops.command("trials")
+@click.option("-uid", "--uid", required=True, help="sweep (matrix) run uuid")
+def ops_trials(uid):
+    """Sweep trials grouped by bracket/rung, best metric first — the
+    CLI twin of the dashboard's bracket view."""
+    plane = get_plane()
+    record = get_run_or_fail(plane, uid)
+    # Explicit limit: the store defaults to 1000 and a big sweep's table
+    # must never silently drop (possibly the best) trials.
+    children = plane.list_runs(pipeline_uuid=record.uuid, limit=1_000_000)
+    if not children:
+        click.echo("no trials yet")
+        return
+    matrix = (record.spec or {}).get("matrix") or {}
+    metric = (matrix.get("metric") or {}).get("name")
+    maximize = (matrix.get("metric") or {}).get("optimization") == "maximize"
+    groups: dict[tuple, list] = {}
+    for child in children:
+        meta = child.meta or {}
+        key = (meta.get("bracket"), meta.get("rung"))
+        value = plane.get_metric(child.uuid, metric) if metric else None
+        groups.setdefault(key, []).append((child, value))
+    for key in sorted(groups, key=lambda k: (k[0] is None, k)):
+        bracket, rung = key
+        label = (f"bracket {bracket} rung {rung}"
+                 if bracket is not None else "trials")
+        click.echo(f"{label}:")
+        trials = sorted(  # best first; metric-less rows last
+            groups[key],
+            key=lambda t: (t[1] is None,
+                           0 if t[1] is None
+                           else (-t[1] if maximize else t[1])))
+        for child, value in trials:
+            params = (child.meta or {}).get("trial_params") or {}
+            pstr = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in params.items())
+            vstr = f"{value:.6g}" if value is not None else "-"
+            click.echo(f"  {child.uuid[:12]}  {child.status.value:10s} "
+                       f"{vstr:>12s}  {pstr}")
 
 
 @ops.command("get")

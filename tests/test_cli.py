@@ -112,3 +112,65 @@ class TestRunAndOps:
             cli, ["run", "-f", FIXTURE, "-P", "lr=0.5", "-P", "epochs=3"]
         )
         assert result.exit_code == 0, result.output
+
+
+class TestOpsTrials:
+    def test_trials_table_and_pipeline_filter(self, runner, tmp_path,
+                                              monkeypatch):
+        """`plx ops trials` prints the bracket/rung table of a sweep;
+        `ops ls --pipeline` scopes to its children."""
+        import textwrap
+
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.cli.main import get_plane
+
+        script = textwrap.dedent(
+            """
+            import json, os
+            d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+            os.makedirs(d + "/events/metric", exist_ok=True)
+            score = (float(os.environ["LR"]) - 0.3) ** 2
+            with open(d + "/events/metric/score.jsonl", "a") as fh:
+                fh.write(json.dumps({"step": 1, "value": score}) + "\\n")
+            """
+        ).strip()
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        plane = get_plane()
+        # ASHA with a single rung: metric-driven sweep, no promotions —
+        # exercises the metric lookup and best-first ordering.
+        record = plane.submit({
+            "kind": "operation",
+            "matrix": {
+                "kind": "asha", "numRuns": 3, "maxIterations": 1,
+                "minResource": 1, "eta": 2, "seed": 2, "concurrency": 4,
+                "resource": {"name": "epochs", "type": "int"},
+                "metric": {"name": "score", "optimization": "minimize"},
+                "params": {"lr": {"kind": "uniform",
+                                  "value": {"low": 0.0, "high": 1.0}}},
+            },
+            "component": {
+                "kind": "component", "name": "t",
+                "inputs": [
+                    {"name": "lr", "type": "float", "toEnv": "LR"},
+                    {"name": "epochs", "type": "int", "value": 1,
+                     "isOptional": True},
+                ],
+                "run": {"kind": "job",
+                        "container": {"command": ["python", "-c", script]}},
+            },
+        })
+        Agent(plane).run_until_done(record.uuid, timeout=120)
+
+        result = runner.invoke(cli, ["ops", "trials", "-uid", record.uuid])
+        assert result.exit_code == 0, result.output
+        assert "bracket 0 rung 0" in result.output
+        assert result.output.count("succeeded") == 3
+        # Best metric first: the score column must come out ascending.
+        scores = [float(line.split()[2])
+                  for line in result.output.splitlines()
+                  if "succeeded" in line]
+        assert scores == sorted(scores) and len(scores) == 3
+
+        listed = runner.invoke(cli, ["ops", "ls", "--pipeline", record.uuid])
+        assert listed.exit_code == 0, listed.output
+        assert listed.output.count("\n") == 3  # exactly the children
