@@ -578,6 +578,85 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str):
         server.stop()
 
 
+# ------------------------------------------------------------------ convert
+@cli.command("convert")
+@click.option("--model", required=True,
+              help="target model zoo name, e.g. llama3_8b")
+@click.option("--from-hf", "hf_path", required=True,
+              help="HF checkpoint: a .safetensors/.bin file or a model "
+                   "dir containing them")
+@click.option("--out", "out_dir", required=True,
+              help="output Orbax checkpoint dir (servable via "
+                   "plx serve --checkpoint)")
+def convert_cmd(model, hf_path, out_dir):
+    """Convert a HuggingFace Llama checkpoint into a servable Orbax
+    checkpoint (models/convert.py::from_hf_llama)."""
+    from polyaxon_tpu.models import llama
+    from polyaxon_tpu.models.convert import from_hf_llama
+    from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
+    from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+    if model not in llama.CONFIGS:
+        raise click.BadParameter(
+            f"`{model}` is not a llama-family model "
+            f"(choices: {sorted(llama.CONFIGS)})")
+    cfg = llama.CONFIGS[model]
+
+    def load_state_dict(path):
+        if os.path.isdir(path):
+            names = sorted(os.listdir(path))
+            # Prefer safetensors; otherwise HF weight shards only —
+            # Trainer dirs also hold non-weight pickles like
+            # training_args.bin that torch.load(weights_only) rejects.
+            files = [os.path.join(path, f) for f in names
+                     if f.endswith(".safetensors")]
+            if not files:
+                files = [os.path.join(path, f) for f in names
+                         if f.startswith("pytorch_model")
+                         and f.endswith(".bin")]
+            if not files:
+                raise click.ClickException(
+                    f"no *.safetensors or pytorch_model*.bin under {path}")
+        else:
+            files = [path]
+        state = {}
+        for f in files:
+            if f.endswith(".safetensors"):
+                from safetensors.numpy import load_file
+
+                state.update(load_file(f))
+            else:
+                import torch
+
+                state.update(torch.load(f, map_location="cpu",
+                                        weights_only=True))
+        return state
+
+    ckpt = CheckpointManager(
+        out_dir, V1JaxCheckpointing(enabled=True, interval_steps=1,
+                                    async_save=False))
+    try:
+        if ckpt.latest_step() is not None:
+            raise click.ClickException(
+                f"{out_dir} already contains a checkpoint "
+                f"(step {ckpt.latest_step()}); choose a new --out or "
+                "delete it first")
+        state_dict = load_state_dict(hf_path)
+        try:
+            variables = from_hf_llama(state_dict, cfg)
+        except (KeyError, ValueError) as exc:
+            raise click.ClickException(
+                f"checkpoint does not match model `{model}`: {exc}"
+            ) from exc
+        ckpt.save(0, {"params": variables["params"]}, force=True)
+    finally:
+        ckpt.close()
+    import jax
+
+    n_params = sum(int(p.size) for p in jax.tree.leaves(variables["params"]))
+    click.echo(f"converted {model}: {n_params:,} params → {out_dir}")
+
+
 # -------------------------------------------------------------------- agent
 @cli.command("agent")
 @click.option("--poll", default=1.0)

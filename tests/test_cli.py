@@ -174,3 +174,71 @@ class TestOpsTrials:
         listed = runner.invoke(cli, ["ops", "ls", "--pipeline", record.uuid])
         assert listed.exit_code == 0, listed.output
         assert listed.output.count("\n") == 3  # exactly the children
+
+
+class TestConvert:
+    def test_hf_to_orbax_to_serving(self, runner, tmp_path, monkeypatch):
+        """HF safetensors → plx convert → Orbax → load_params: the
+        converted checkpoint must reproduce transformers' forward
+        logits (the interop chain users take to serve HF weights)."""
+        import dataclasses
+
+        import numpy as np
+
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from safetensors.numpy import save_file
+
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import llama
+
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        cfg = dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                                  dtype=jnp.float32, max_seq_len=64)
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.dim,
+            intermediate_size=cfg.ffn_dim, num_hidden_layers=cfg.n_layers,
+            num_attention_heads=cfg.n_heads,
+            num_key_value_heads=cfg.n_kv_heads,
+            max_position_embeddings=64, rope_theta=cfg.rope_theta,
+            rms_norm_eps=cfg.norm_eps, attention_bias=False,
+            tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+        sd = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+        save_file(sd, str(tmp_path / "model.safetensors"))
+
+        out_dir = str(tmp_path / "ck")
+        result = runner.invoke(cli, [
+            "convert", "--model", "llama_tiny",
+            "--from-hf", str(tmp_path / "model.safetensors"),
+            "--out", out_dir])
+        assert result.exit_code == 0, result.output
+        assert "converted llama_tiny" in result.output
+
+        from polyaxon_tpu.serving import load_params
+
+        _, params = load_params("llama_tiny", out_dir)
+        tokens = np.array([[5, 17, 42, 7]], np.int32)
+        ours = llama.forward(cfg, params, jnp.asarray(tokens))
+        with torch.no_grad():
+            theirs = hf_model(torch.tensor(tokens.astype(np.int64))).logits
+        np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                                   atol=2e-3, rtol=2e-3)
+
+        # Re-running into the same --out is a clean CLI error, not an
+        # orbax StepAlreadyExists traceback.
+        again = runner.invoke(cli, [
+            "convert", "--model", "llama_tiny",
+            "--from-hf", str(tmp_path / "model.safetensors"),
+            "--out", out_dir])
+        assert again.exit_code != 0
+        assert "already contains a checkpoint" in again.output
+
+    def test_convert_rejects_unknown_model(self, runner, tmp_path):
+        result = runner.invoke(cli, [
+            "convert", "--model", "resnet50",
+            "--from-hf", str(tmp_path), "--out", str(tmp_path / "o")])
+        assert result.exit_code != 0
+        assert "llama-family" in result.output
