@@ -231,6 +231,8 @@ def main() -> int:
                         help="flash fwd k-tile size (sweepable)")
     parser.add_argument("--bwd", default=None, choices=["pallas", "xla"],
                         help="flash backward impl (default: pallas on TPU)")
+    parser.add_argument("--loss-chunk", type=int, default=None,
+                        help="chunked lm-head loss slab length (sweepable)")
     parser.add_argument("--tuner", action="store_true",
                         help="measure Polytune throughput instead: a "
                              "Hyperband LR sweep of JAXJob trials, "
@@ -240,17 +242,52 @@ def main() -> int:
     if args.tuner:
         _ACTIVE[:] = ["polytune_hyperband_trials_per_hour", "trials/hour"]
 
-    sweep_flags = [f for f, v in (("--block-q", args.block_q),
+    flash_flags = [f for f, v in (("--block-q", args.block_q),
                                   ("--block-k", args.block_k),
                                   ("--bwd", args.bwd)) if v is not None]
+    sweep_flags = flash_flags + (["--loss-chunk"]
+                                 if args.loss_chunk is not None else [])
     if sweep_flags and args.tuner:
         parser.error(f"{'/'.join(sweep_flags)} have no effect in --tuner "
                      "mode")
-    if sweep_flags and args.attention != "flash":
+    if flash_flags and args.attention != "flash":
         # 'auto' resolves to einsum off-TPU and would silently drop the
         # knobs — a sweep must pin the impl it is sweeping.
-        parser.error(f"{'/'.join(sweep_flags)} require --attention flash "
+        parser.error(f"{'/'.join(flash_flags)} require --attention flash "
                      f"(got {args.attention!r})")
+
+    # Resolve the workload shape and validate sweep points BEFORE the
+    # (up to 90s) backend probe: a bad flag should fail instantly.
+    if args.smoke:
+        model, steps, batch, seq = "llama_tiny", 8, 2, 64
+    else:
+        model = args.model
+        steps = args.steps or 30
+        batch = args.batch or 8
+        seq = args.seq or 2048
+
+    # A sweep point whose tiles can't actually run in the flash kernel
+    # (pick_block reduces them, or <128 triggers the einsum fallback)
+    # would silently measure something else — refuse it instead.
+    from polyaxon_tpu.ops.flash import pick_block
+
+    for flag, value in (("--block-q", args.block_q),
+                        ("--block-k", args.block_k)):
+        if value is None:
+            continue
+        effective = pick_block(seq, value)
+        if value < 128 or effective != value:
+            parser.error(
+                f"{flag} {value} cannot tile seq {seq} in the flash "
+                f"kernel (effective block {effective}, minimum 128): "
+                "this sweep point would fall back to einsum attention")
+    if args.loss_chunk is not None:
+        effective = pick_block(seq, args.loss_chunk)
+        if args.loss_chunk < 1 or effective != args.loss_chunk:
+            parser.error(
+                f"--loss-chunk {args.loss_chunk} does not divide seq "
+                f"{seq} (the loss would silently run chunk "
+                f"{max(effective, 1)}): pick a power-of-two divisor")
 
     from polyaxon_tpu.utils import apply_jax_platforms_override
 
@@ -289,30 +326,6 @@ def main() -> int:
     from polyaxon_tpu.polyflow import V1JAXJob
     from polyaxon_tpu.runtime import run_jaxjob
 
-    if args.smoke:
-        model, steps, batch, seq = "llama_tiny", 8, 2, 64
-    else:
-        model = args.model
-        steps = args.steps or 30
-        batch = args.batch or 8
-        seq = args.seq or 2048
-
-    # A sweep point whose tiles can't actually run in the flash kernel
-    # (pick_block reduces them, or <128 triggers the einsum fallback)
-    # would silently measure something else — refuse it instead.
-    from polyaxon_tpu.ops.flash import pick_block
-
-    for flag, value in (("--block-q", args.block_q),
-                        ("--block-k", args.block_k)):
-        if value is None:
-            continue
-        effective = pick_block(seq, value)
-        if value < 128 or effective != value:
-            parser.error(
-                f"{flag} {value} cannot tile seq {seq} in the flash "
-                f"kernel (effective block {effective}, minimum 128): "
-                "this sweep point would fall back to einsum attention")
-
     n_chips = jax.device_count()
     spec = {
         "kind": "jaxjob",
@@ -333,6 +346,8 @@ def main() -> int:
             **({"flash_block_k": args.block_k}
                if args.block_k is not None else {}),
             **({"flash_bwd_impl": args.bwd} if args.bwd else {}),
+            **({"loss_chunk": args.loss_chunk}
+               if args.loss_chunk is not None else {}),
         },
     }
     fallback = None

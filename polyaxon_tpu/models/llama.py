@@ -69,6 +69,9 @@ class LlamaConfig:
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     flash_bwd_impl: Optional[str] = None
+    # Chunked lm-head loss slab length (peak HBM holds [B, chunk, V]
+    # fp32); sweepable alongside the flash tiles.
+    loss_chunk: int = 256
     # Pipeline parallelism over the `pp` mesh axis (parallel/pipeline.py):
     # >1 splits the layer stack into that many ppermute-chained stages.
     pipeline_stages: int = 1
@@ -548,7 +551,8 @@ def apply(
     # pretraining shapes.
     x = hidden_states(cfg, variables["params"], inputs, segment_ids=segments)
     head = lm_head(cfg, variables["params"]).astype(cfg.dtype)
-    loss, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"))
+    loss, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"),
+                                chunk=cfg.loss_chunk)
     return loss, {"loss": loss, "accuracy": acc}, variables["state"]
 
 

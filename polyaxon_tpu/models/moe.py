@@ -56,6 +56,8 @@ class MoEConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # Chunked lm-head loss slab length (see LlamaConfig.loss_chunk).
+    loss_chunk: int = 256
     # "top_k": tokens choose experts (GShard; needs the aux loss for
     # balance). "expert_choice": experts choose their top-capacity
     # tokens (Zhou et al. 2022) — perfectly load-balanced by
@@ -288,7 +290,8 @@ def apply(
     # logits are never materialized.
     x, aux = hidden_states(cfg, variables["params"], inputs)
     head = variables["params"]["lm_head"].astype(cfg.dtype)
-    ce, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"))
+    ce, acc = chunked_lm_loss(x, head, tokens, batch.get("mask"),
+                              chunk=cfg.loss_chunk)
     loss = ce + cfg.router_aux_coef * aux
     # ``loss_unweighted``: the mask-independent component, exposed so
     # gradient accumulation can weight it per-microbatch (1/k) instead

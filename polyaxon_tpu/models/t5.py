@@ -56,6 +56,8 @@ class T5Config:
     dtype: Any = jnp.bfloat16
     remat: str = "none"
     attention_impl: str = "xla"
+    # Chunked lm-head loss slab length (see LlamaConfig.loss_chunk).
+    loss_chunk: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -366,7 +368,8 @@ def apply(
     enc_out = encode(cfg, variables["params"], inputs)
     x = decode_hidden(cfg, variables["params"], enc_out, shift_right(targets))
     head = variables["params"]["lm_head"].astype(cfg.dtype)
-    loss, acc = chunked_lm_loss(x, head, targets, batch.get("mask"))
+    loss, acc = chunked_lm_loss(x, head, targets, batch.get("mask"),
+                                chunk=cfg.loss_chunk)
     return loss, {"loss": loss, "accuracy": acc}, variables["state"]
 
 

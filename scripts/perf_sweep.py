@@ -29,7 +29,7 @@ def bench_args(**kw) -> list[str]:
                       ("--steps", "steps"), ("--remat", "remat"),
                       ("--attention", "attention"), ("--block-q", "block_q"),
                       ("--block-k", "block_k"), ("--bwd", "bwd"),
-                      ("--model", "model")):
+                      ("--loss-chunk", "loss_chunk"), ("--model", "model")):
         if kw.get(key) is not None:
             args += [flag, str(kw[key])]
     return args
@@ -98,6 +98,12 @@ def main() -> int:
                                             block_q=256, block_k=256)),
             ("b16-dots-flash-bwd-xla", dict(base, batch=16, remat="dots",
                                             attention="flash", bwd="xla")),
+            ("b8-dots-flash-chunk512", dict(base, batch=8, remat="dots",
+                                            attention="flash",
+                                            loss_chunk=512)),
+            ("b8-dots-flash-chunk128", dict(base, batch=8, remat="dots",
+                                            attention="flash",
+                                            loss_chunk=128)),
         ]
 
     results = []
