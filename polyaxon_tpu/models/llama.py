@@ -349,6 +349,61 @@ def decode_step(
         jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
 
 
+def ragged_cache_coords(pos: jax.Array, C: int):
+    """Per-row ring-buffer addressing shared by every cached decode
+    path (llama + moe): for rows at positions ``pos`` ([B], -1 = idle)
+    over a C-slot ring cache, returns (positions [B,1] for RoPE,
+    slot [B] to write, valid [B,1,1,C] attention mask). Slot s holds
+    position pos - ((pos - s) mod C) after this write; negative =
+    never written. A sliding window needs no extra mask: C <= window
+    by cache_len(), so every live slot is inside the band by
+    construction."""
+    pos_safe = jnp.maximum(pos, 0)
+    slot = jnp.mod(pos_safe, C)  # [B]
+    delta = jnp.mod(pos_safe[:, None] - jnp.arange(C)[None, :], C)  # [B, C]
+    stored = pos_safe[:, None] - delta
+    valid = ((stored >= 0) & (pos[:, None] >= 0))[:, None, None, :]
+    return pos_safe[:, None], slot, valid
+
+
+def cached_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
+                     v_cache: jax.Array, positions: jax.Array,
+                     slot: jax.Array, valid: jax.Array):
+    """One cached-attention sublayer for ragged decode — the shared
+    QKV/RoPE/cache-write/masked-softmax kernel both decoder families
+    (llama dense MLP, moe expert FFN) build their decode steps on.
+    ``cfg`` needs n_heads/n_kv_heads/head_dim/dtype/norm_eps/rope_*.
+    Returns (x after the attention residual, new k_cache, new v_cache).
+    """
+    from polyaxon_tpu.ops.attention import repeat_kv
+
+    dt = cfg.dtype
+    B = x.shape[0]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = H // KV
+    rows = jnp.arange(B)
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
+    scaling = getattr(cfg, "rope_scaling", None)
+    q = _rope(q, positions, cfg.rope_theta, scaling)
+    k = _rope(k, positions, cfg.rope_theta, scaling)
+    k_cache = k_cache.at[rows, slot].set(k[:, 0])
+    v_cache = v_cache.at[rows, slot].set(v[:, 0])
+
+    keys = repeat_kv(k_cache, n_rep)
+    vals = repeat_kv(v_cache, n_rep)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+    logits = logits * (Hd ** -0.5)
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+    return x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt), \
+        k_cache, v_cache
+
+
 def decode_step_ragged(
     cfg: LlamaConfig,
     params: dict,
@@ -365,47 +420,14 @@ def decode_step_ragged(
     the engine. A row at position p matches ``decode_step`` at scalar
     position p exactly."""
     dt = cfg.dtype
-    B = tokens.shape[0]
-    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    n_rep = H // KV
     C = cache["k"].shape[2]
-    pos_safe = jnp.maximum(pos, 0)
-    positions = pos_safe[:, None]  # [B, 1] for RoPE
+    positions, slot, valid = ragged_cache_coords(pos, C)
     x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
-
-    slot = jnp.mod(pos_safe, C)  # [B]
-    rows = jnp.arange(B)
-    # Per-row ring-buffer validity: slot s holds position
-    # pos - ((pos - s) mod C) after this write; negative = never
-    # written. The sliding window needs no extra mask: C <= window by
-    # cache_len(), so every live slot is inside the band by
-    # construction.
-    delta = jnp.mod(pos_safe[:, None] - jnp.arange(C)[None, :], C)  # [B, C]
-    stored = pos_safe[:, None] - delta
-    valid = ((stored >= 0) & (pos[:, None] >= 0))[:, None, None, :]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
-        k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
-        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        k_cache = k_cache.at[rows, slot].set(k[:, 0])
-        v_cache = v_cache.at[rows, slot].set(v[:, 0])
-
-        from polyaxon_tpu.ops.attention import repeat_kv
-
-        keys = repeat_kv(k_cache, n_rep)
-        vals = repeat_kv(v_cache, n_rep)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
-        logits = logits * (Hd ** -0.5)
-        logits = jnp.where(valid, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
-        x = x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt)
-
+        x, k_cache, v_cache = cached_attn_step(
+            cfg, layer, x, k_cache, v_cache, positions, slot, valid)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
         up = h @ layer["w_up"].astype(dt)

@@ -273,6 +273,163 @@ def forward(
     return logits, aux
 
 
+# ---------------------------------------------------------------- decode
+def init_cache(cfg: MoEConfig, batch: int, max_len: int) -> dict:
+    """KV cache [L, B, C, KV, Hd] per tensor, compute dtype — the same
+    layout as the llama cache (full-length: MoE configs carry no
+    sliding window)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def prefill(
+    cfg: MoEConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, P] int32
+    max_len: int,
+) -> tuple[jax.Array, dict]:
+    """One batched causal pass over the prompt, filling the KV cache:
+    (last-position logits [B, V] fp32, cache). The MoE FFN replaces the
+    dense MLP of the llama prefill; routing runs over the B·P prompt
+    tokens exactly as in training."""
+    _check_decodable(cfg)
+    dt = cfg.dtype
+    B, P = prompt.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None], (B, P))
+    x = params["embed"].astype(dt)[prompt]
+
+    def layer_step(x, layer):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(dt)).reshape(B, P, H, Hd)
+        k = (h @ layer["wk"].astype(dt)).reshape(B, P, KV, Hd)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, P, KV, Hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        attn = dot_product_attention(q, k, v, causal=True,
+                                     impl=cfg.attention_impl)
+        x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
+        h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
+        moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
+                               layer["w_up"], layer["w_down"])
+        return x + moe_out, (k, v)
+
+    x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    cache = init_cache(cfg, B, max_len)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], k_all, (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], v_all, (0, 0, 0, 0, 0)),
+    }
+    return logits, cache
+
+
+def _check_decodable(cfg: MoEConfig) -> None:
+    """Expert-choice routing selects tokens ACROSS the dispatch group,
+    so a decode-time group (the current tokens only) cannot reproduce
+    training-time selection — generation would silently diverge.
+    Refuse rather than mis-serve; serve top_k-routed configs."""
+    if cfg.router != "top_k":
+        raise ValueError(
+            f"MoE decode/generation requires router='top_k'; "
+            f"'{cfg.router}' routes by group-wide selection that decode "
+            "groups cannot reproduce")
+
+
+def decode_step_ragged(
+    cfg: MoEConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B] int32
+    pos: jax.Array,  # [B] int32 per-row position (-1 = idle)
+) -> tuple[jax.Array, dict]:
+    """One autoregressive step with PER-ROW positions (continuous
+    batching). Built on the same ``cached_attn_step`` kernel as the
+    llama family — the families differ only in the FFN sublayer. The
+    router sees the B current tokens as its dispatch group: top-k
+    selection is per-token, so decode routing matches training routing
+    for the same hidden state (capacity drops excepted — serve with an
+    ample capacity_factor)."""
+    from polyaxon_tpu.models.llama import cached_attn_step, ragged_cache_coords
+
+    _check_decodable(cfg)
+    dt = cfg.dtype
+    C = cache["k"].shape[2]
+    positions, slot, valid = ragged_cache_coords(pos, C)
+    x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
+
+    def layer_step(x, inputs):
+        layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
+        x, k_cache, v_cache = cached_attn_step(
+            cfg, layer, x, k_cache, v_cache, positions, slot, valid)
+        h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
+        moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
+                               layer["w_up"], layer["w_down"])
+        return x + moe_out, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def decode_step(
+    cfg: MoEConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B] int32
+    pos: jax.Array,  # scalar int32 position being written
+) -> tuple[jax.Array, dict]:
+    """Scalar-position decode: the all-rows-in-lockstep special case of
+    ``decode_step_ragged`` (one body, same ring-cache semantics as
+    llama)."""
+    B = tokens.shape[0]
+    return decode_step_ragged(
+        cfg, params, cache, tokens,
+        jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
+
+
+def generate(
+    cfg: MoEConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, P] int32
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature 0) or sampled continuation: [B, max_new] —
+    the same serving contract as llama.generate (temperature may be a
+    traced scalar)."""
+    B, P = prompt.shape
+    sampling = isinstance(temperature, jax.Array) or temperature > 0
+    if sampling and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+
+    logits, cache = prefill(cfg, params, prompt, P + max_new_tokens)
+
+    def sample(logits, key):
+        if sampling:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def decode_loop(carry, t):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        token = sample(logits, sub).astype(jnp.int32)
+        logits, cache = decode_step(cfg, params, cache, token, P + t)
+        return (cache, logits, key), token
+
+    (_, logits, _), tokens = jax.lax.scan(
+        decode_loop, (cache, logits, rng), jnp.arange(max_new_tokens))
+    return tokens.T  # [B, max_new]
+
+
 def apply(
     cfg: MoEConfig,
     variables: Variables,

@@ -4,7 +4,7 @@ The static engine (server.py ``_Engine``) runs each request's whole
 generation as one compiled program: a long request blocks the batch and
 short ones pad to the longest. Continuous batching instead keeps a
 fixed pool of KV-cache **slots** and advances all live requests one
-token per loop iteration (``models.llama.decode_step_ragged`` — each
+token per loop iteration (the family's ``decode_step_ragged`` — each
 slot at its own depth), admitting queued requests into freed slots
 between iterations. Throughput scales with slot occupancy instead of
 request alignment — the vLLM-style scheduling model, TPU-first:
@@ -17,7 +17,8 @@ request alignment — the vLLM-style scheduling model, TPU-first:
   temperature>0 rows coexist in one batch; per-row PRNG keys), so only
   ``[slots]`` token ids cross the host boundary per iteration.
 
-Decoder-only families (llama) are supported; seq2seq models keep the
+Families exposing the ragged-decode surface (llama dense decoders,
+moe expert-FFN decoders) are supported; seq2seq models keep the
 static engine.
 """
 
@@ -64,12 +65,18 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model: str, cfg, params, *, slots: int = 4,
                  max_len: Optional[int] = None):
-        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.serving.server import _family
 
-        if model not in llama.CONFIGS:
+        family = _family(model)
+        # Family-generic: any decoder exposing the ragged-decode surface
+        # (llama dense, moe expert-FFN) batches continuously; seq2seq
+        # models decode against per-request encoder state and keep the
+        # static engine.
+        if not hasattr(family, "decode_step_ragged"):
             raise ValueError(
-                f"continuous batching supports decoder-only models, "
-                f"`{model}` is not one (use the static engine)")
+                f"continuous batching needs a ragged-decode family; "
+                f"`{model}` ({family.__name__}) has none — use the "
+                "static engine")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.model = model
@@ -77,9 +84,9 @@ class ContinuousBatchingEngine:
         self.params = params
         self.slots = slots
         self.max_len = max_len or cfg.max_seq_len
-        self._llama = llama
+        self._family_mod = family
 
-        self._cache = llama.init_cache(cfg, slots, self.max_len)
+        self._cache = family.init_cache(cfg, slots, self.max_len)
         self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
         self._cur = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
@@ -91,7 +98,7 @@ class ContinuousBatchingEngine:
         self._stopped = False
 
         def step(params, cache, tokens, pos, keys, temps):
-            logits, cache = llama.decode_step_ragged(
+            logits, cache = family.decode_step_ragged(
                 cfg, params, cache, tokens, pos)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
@@ -104,7 +111,7 @@ class ContinuousBatchingEngine:
         @lru_cache(maxsize=16)
         def compiled_prefill(plen: int):
             def run(params, prompt):
-                _, row_cache = llama.prefill(cfg, params, prompt,
+                _, row_cache = family.prefill(cfg, params, prompt,
                                              self.max_len)
                 return row_cache
 
@@ -287,7 +294,7 @@ class ContinuousBatchingEngine:
                 # The old cache was donated to the failed step — its
                 # buffer is gone (or poisoned). Rebuild so the engine
                 # survives a transient step failure.
-                self._cache = self._llama.init_cache(
+                self._cache = self._family_mod.init_cache(
                     self.cfg, self.slots, self.max_len)
                 continue
             for b in range(self.slots):

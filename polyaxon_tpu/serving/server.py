@@ -59,15 +59,17 @@ def _bucket(n: int, lo: int = 16) -> int:
 
 def _family(model: str):
     """Model family module with CONFIGS/init/generate and a SEQ2SEQ
-    flag (llama-style decoders and t5-style encoder-decoders)."""
-    from polyaxon_tpu.models import llama, t5
+    flag (llama-style decoders, Mixtral-style MoE decoders, and
+    t5-style encoder-decoders)."""
+    from polyaxon_tpu.models import llama, moe, t5
 
-    for mod in (llama, t5):
+    for mod in (llama, moe, t5):
         if model in mod.CONFIGS:
             return mod
     raise ValueError(
         f"model `{model}` is not servable; decoders: "
-        f"{sorted(llama.CONFIGS)}, seq2seq: {sorted(t5.CONFIGS)}")
+        f"{sorted(llama.CONFIGS) + sorted(moe.CONFIGS)}, "
+        f"seq2seq: {sorted(t5.CONFIGS)}")
 
 
 def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0,

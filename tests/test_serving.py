@@ -258,7 +258,7 @@ class TestContinuousBatching:
             engine.stop()
 
     def test_seq2seq_rejected(self):
-        with pytest.raises(ValueError, match="decoder-only"):
+        with pytest.raises(ValueError, match="ragged-decode"):
             ServingServer("t5_tiny", batching="continuous")
 
 
