@@ -510,6 +510,42 @@ def prefill(
     return logits, cache
 
 
+# ------------------------------------------- continuous batching surface
+# Hooks the slot-pool engine (serving/batching.py) drives; moe reuses
+# these verbatim (same decoder cache shape and admission semantics).
+def cb_validate(cfg, prompt_len: int, max_new: int, max_len: int) -> None:
+    """Decoder-only budget rule: prompt and generation share the cache."""
+    if prompt_len + max_new > max_len:
+        raise ValueError(
+            f"prompt {prompt_len} + max_new_tokens {max_new} exceeds "
+            f"max_len {max_len}")
+
+
+def cb_admission(prompt: list) -> tuple:
+    """(start position, first decode token, prefill tokens): the last
+    prompt token is the first decode input; the rest prefill the cache
+    (none for single-token prompts)."""
+    return (len(prompt) - 1, prompt[-1],
+            list(prompt[:-1]) if len(prompt) > 1 else None)
+
+
+def cb_init_cache(cfg, slots: int, max_len: int) -> dict:
+    return init_cache(cfg, slots, max_len)
+
+
+def cb_prefill(cfg, params: dict, prompt: jax.Array, max_len: int) -> dict:
+    _, cache = prefill(cfg, params, prompt, max_len)
+    return cache
+
+
+def insert_cache_row(cache: dict, row: dict, b) -> dict:
+    return {
+        key: jax.lax.dynamic_update_slice(
+            cache[key], row[key], (0, b, 0, 0, 0))
+        for key in ("k", "v")
+    }
+
+
 def generate(
     cfg: LlamaConfig,
     params: dict,

@@ -393,6 +393,25 @@ def decode_step(
         jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
 
 
+# Continuous-batching hooks: admission/validation semantics are the
+# llama decoder-only ones; cache init/prefill are moe's own.
+from polyaxon_tpu.models.llama import (  # noqa: E402  (re-exported hooks)
+    cb_admission,
+    cb_validate,
+    insert_cache_row,
+)
+
+
+def cb_init_cache(cfg: MoEConfig, slots: int, max_len: int) -> dict:
+    return init_cache(cfg, slots, max_len)
+
+
+def cb_prefill(cfg: MoEConfig, params: dict, prompt: jax.Array,
+               max_len: int) -> dict:
+    _, cache = prefill(cfg, params, prompt, max_len)
+    return cache
+
+
 def generate(
     cfg: MoEConfig,
     params: dict,

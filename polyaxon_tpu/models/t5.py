@@ -264,56 +264,25 @@ def decode_step(
     (no sliding window), so the cache cannot wrap like the Llama ring
     buffer; an out-of-range ``pos`` would silently clamp the write.
     ``generate`` sizes the cache to ``max_new_tokens`` so this holds.
+
+    The all-rows-in-lockstep special case of ``decode_step_ragged``
+    (one decoder body): the cross state is passed per-call here, so it
+    is packed into the pool-cache layout with a full-length mask.
     """
-    dt = cfg.dtype
     B = tokens.shape[0]
-    H, Hd = cfg.n_heads, cfg.head_dim
     C = cache["k"].shape[2]
     if isinstance(pos, int) and pos >= C:
         raise ValueError(f"decode position {pos} out of cache range {C}")
-    positions = jnp.full((B, 1), pos, jnp.int32)
-    x = params["embed"].astype(dt)[tokens][:, None, :]
-
-    valid = (jnp.arange(C) <= pos)[None, None, None, :]
-
-    def layer_step(x, inputs):
-        layer, k_cache, v_cache, xk, xv = inputs
-        # Causal self-attention over the cache.
-        h = rms_norm(x, layer["self_norm"], cfg.norm_eps)
-        q = rope((h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd),
-                 positions, cfg.rope_theta)
-        k = rope((h @ layer["wk"].astype(dt)).reshape(B, 1, H, Hd),
-                 positions, cfg.rope_theta)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, H, Hd)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
-        s = jnp.where(valid, s * (Hd ** -0.5), -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
-        x = x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt)
-
-        # Cross-attention over the precomputed encoder K/V.
-        h = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
-        q = (h @ layer["xq"].astype(dt)).reshape(B, 1, H, Hd)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, xk).astype(jnp.float32)
-        p = jax.nn.softmax(s * (Hd ** -0.5), axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, xv)
-        x = x + attn.reshape(B, 1, H * Hd) @ layer["xo"].astype(dt)
-
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.gelu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
-        return x, (k_cache, v_cache)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x,
-        (params["dec_layers"], cache["k"], cache["v"],
-         cross["k"], cross["v"]))
-    x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v}
+    Se = cross["k"].shape[2]
+    pool = {
+        "k": cache["k"], "v": cache["v"],
+        "xk": cross["k"], "xv": cross["v"],
+        "xmask": jnp.ones((B, Se), bool),
+    }
+    logits, new = decode_step_ragged(
+        cfg, params, pool, tokens,
+        jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
+    return logits, {"k": new["k"], "v": new["v"]}
 
 
 def generate(
@@ -355,6 +324,143 @@ def generate(
     _, tokens = jax.lax.scan(
         decode_loop, (cache, bos, rng), jnp.arange(max_new_tokens))
     return tokens.T  # [B, max_new]
+
+
+# ------------------------------------------- continuous batching surface
+# The slot-pool engine (serving/batching.py) drives any family exposing
+# cb_init_cache / cb_prefill / cb_admission / cb_validate /
+# insert_cache_row / decode_step_ragged. For seq2seq the pool cache
+# carries per-slot encoder state too: padded cross-attention K/V plus a
+# length mask, so requests with different encoder lengths share one
+# jitted ragged decoder step.
+
+BOS_ID = 0  # decoder start token (matches generate()'s default)
+
+
+def cb_validate(cfg: T5Config, prompt_len: int, max_new: int,
+                max_len: int) -> None:
+    """Seq2seq budget rule: the encoder prompt is bounded by the model's
+    max_seq_len; the decode budget by the pool's decoder cache length."""
+    if prompt_len > cfg.max_seq_len:
+        raise ValueError(
+            f"encoder prompt {prompt_len} exceeds max_seq_len "
+            f"{cfg.max_seq_len}")
+    if max_new > max_len:
+        raise ValueError(
+            f"max_new_tokens {max_new} exceeds decoder budget {max_len}")
+
+
+def cb_init_cache(cfg: T5Config, slots: int, max_len: int) -> dict:
+    dec = init_decoder_cache(cfg, slots, max_len)
+    Se = cfg.max_seq_len
+    L, H, Hd = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    return {
+        "k": dec["k"], "v": dec["v"],
+        "xk": jnp.zeros((L, slots, Se, H, Hd), cfg.dtype),
+        "xv": jnp.zeros((L, slots, Se, H, Hd), cfg.dtype),
+        "xmask": jnp.zeros((slots, Se), bool),
+    }
+
+
+def cb_prefill(cfg: T5Config, params: dict, prompt: jax.Array,
+               max_len: int) -> dict:
+    """Admission work for one request: run the encoder once, pad its
+    cross-attention K/V to the pool's encoder bound, pair with fresh
+    decoder self-KV rows."""
+    enc_out = encode(cfg, params, prompt)
+    cross = precompute_cross_kv(cfg, params, enc_out)  # [L, 1, P, H, Hd]
+    P = prompt.shape[1]
+    Se = cfg.max_seq_len
+    pad = ((0, 0), (0, 0), (0, Se - P), (0, 0), (0, 0))
+    dec = init_decoder_cache(cfg, 1, max_len)
+    return {
+        "k": dec["k"], "v": dec["v"],
+        "xk": jnp.pad(cross["k"], pad), "xv": jnp.pad(cross["v"], pad),
+        "xmask": (jnp.arange(Se) < P)[None, :],
+    }
+
+
+def cb_admission(prompt: list) -> tuple:
+    """(decoder start position, first decoder token, prefill tokens):
+    the whole prompt feeds the encoder; decoding starts at BOS/pos 0."""
+    return 0, BOS_ID, list(prompt)
+
+
+def insert_cache_row(cache: dict, row: dict, b) -> dict:
+    out = {
+        key: jax.lax.dynamic_update_slice(
+            cache[key], row[key], (0, b, 0, 0, 0))
+        for key in ("k", "v", "xk", "xv")
+    }
+    out["xmask"] = jax.lax.dynamic_update_slice(
+        cache["xmask"], row["xmask"], (b, 0))
+    return out
+
+
+def decode_step_ragged(
+    cfg: T5Config,
+    params: dict,
+    cache: dict,  # cb_init_cache layout (self-KV + padded cross state)
+    tokens: jax.Array,  # [B] int32 current decoder-input ids
+    pos: jax.Array,  # [B] int32 per-row decoder position (-1 = idle)
+) -> tuple[jax.Array, dict]:
+    """One decoder step with PER-ROW positions over the slot-pool cache.
+    Matches ``decode_step`` at equal positions; idle rows (pos < 0) are
+    fully masked in both attentions and their outputs ignored by the
+    engine. The decoder cache is full-causal (no ring): admission-time
+    validation guarantees pos < cache length."""
+    dt = cfg.dtype
+    B = tokens.shape[0]
+    H, Hd = cfg.n_heads, cfg.head_dim
+    C = cache["k"].shape[2]
+    pos_safe = jnp.maximum(pos, 0)
+    positions = pos_safe[:, None]
+    rows = jnp.arange(B)
+    live = (pos >= 0)[:, None]
+    valid = ((jnp.arange(C)[None, :] <= pos_safe[:, None])
+             & live)[:, None, None, :]
+    xvalid = (cache["xmask"] & live)[:, None, None, :]
+    x = params["embed"].astype(dt)[tokens][:, None, :]
+
+    def layer_step(x, inputs):
+        layer, k_cache, v_cache, xk, xv = inputs
+        # Causal self-attention over the per-row cache.
+        h = rms_norm(x, layer["self_norm"], cfg.norm_eps)
+        q = rope((h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd),
+                 positions, cfg.rope_theta)
+        k = rope((h @ layer["wk"].astype(dt)).reshape(B, 1, H, Hd),
+                 positions, cfg.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, H, Hd)
+        k_cache = k_cache.at[rows, pos_safe].set(k[:, 0])
+        v_cache = v_cache.at[rows, pos_safe].set(v[:, 0])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
+        s = jnp.where(valid, s * (Hd ** -0.5), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+        x = x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt)
+
+        # Cross-attention over the slot's padded encoder K/V.
+        h = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
+        q = (h @ layer["xq"].astype(dt)).reshape(B, 1, H, Hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, xk).astype(jnp.float32)
+        s = jnp.where(xvalid, s * (Hd ** -0.5), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, xv)
+        x = x + attn.reshape(B, 1, H * Hd) @ layer["xo"].astype(dt)
+
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.gelu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x,
+        (params["dec_layers"], cache["k"], cache["v"],
+         cache["xk"], cache["xv"]))
+    x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {**cache, "k": new_k, "v": new_v}
 
 
 def apply(

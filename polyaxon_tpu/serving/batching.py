@@ -17,9 +17,11 @@ request alignment — the vLLM-style scheduling model, TPU-first:
   temperature>0 rows coexist in one batch; per-row PRNG keys), so only
   ``[slots]`` token ids cross the host boundary per iteration.
 
-Families exposing the ragged-decode surface (llama dense decoders,
-moe expert-FFN decoders) are supported; seq2seq models keep the
-static engine.
+Families exposing the continuous-batching surface are supported: llama
+dense decoders, moe expert-FFN decoders, and t5 seq2seq (whose pool
+cache carries per-slot encoder state — padded cross-attention K/V plus
+a length mask — so requests with different encoder lengths share one
+ragged decoder step).
 """
 
 from __future__ import annotations
@@ -68,15 +70,17 @@ class ContinuousBatchingEngine:
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
-        # Family-generic: any decoder exposing the ragged-decode surface
-        # (llama dense, moe expert-FFN) batches continuously; seq2seq
-        # models decode against per-request encoder state and keep the
-        # static engine.
-        if not hasattr(family, "decode_step_ragged"):
+        # Family-generic: any family exposing the continuous-batching
+        # surface (llama dense decoders, moe expert-FFN decoders, t5
+        # seq2seq with per-slot encoder state) batches continuously.
+        required = ("decode_step_ragged", "cb_init_cache", "cb_prefill",
+                    "cb_admission", "cb_validate", "insert_cache_row")
+        missing = [name for name in required if not hasattr(family, name)]
+        if missing:
             raise ValueError(
-                f"continuous batching needs a ragged-decode family; "
-                f"`{model}` ({family.__name__}) has none — use the "
-                "static engine")
+                f"continuous batching needs the ragged-decode surface; "
+                f"`{model}` ({family.__name__}) lacks {missing} — use "
+                "the static engine")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.model = model
@@ -86,7 +90,7 @@ class ContinuousBatchingEngine:
         self.max_len = max_len or cfg.max_seq_len
         self._family_mod = family
 
-        self._cache = family.init_cache(cfg, slots, self.max_len)
+        self._cache = family.cb_init_cache(cfg, slots, self.max_len)
         self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
         self._cur = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
@@ -111,23 +115,12 @@ class ContinuousBatchingEngine:
         @lru_cache(maxsize=16)
         def compiled_prefill(plen: int):
             def run(params, prompt):
-                _, row_cache = family.prefill(cfg, params, prompt,
-                                             self.max_len)
-                return row_cache
+                return family.cb_prefill(cfg, params, prompt, self.max_len)
 
             return jax.jit(run)
 
         self._compiled_prefill = compiled_prefill
-
-        def insert(cache, row_k, row_v, b):
-            return {
-                "k": jax.lax.dynamic_update_slice(
-                    cache["k"], row_k, (0, b, 0, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(
-                    cache["v"], row_v, (0, b, 0, 0, 0)),
-            }
-
-        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._insert = jax.jit(family.insert_cache_row, donate_argnums=(0,))
 
         self._thread = threading.Thread(
             target=self._loop, name="plx-serving-batcher", daemon=True)
@@ -140,10 +133,11 @@ class ContinuousBatchingEngine:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if len(tokens) + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt {len(tokens)} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_len {self.max_len}")
+        # Budget semantics are family-specific: decoder-only models
+        # share one cache between prompt and generation; seq2seq bounds
+        # encoder prompt and decode budget separately.
+        self._family_mod.cb_validate(self.cfg, len(tokens), max_new_tokens,
+                                     self.max_len)
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0) -> _Request:
@@ -231,17 +225,17 @@ class ContinuousBatchingEngine:
                     break
                 req = self._queue.popleft()
             try:
-                prompt = req.tokens
-                if len(prompt) > 1:
-                    row = jnp.asarray([prompt[:-1]], jnp.int32)
-                    row_cache = self._compiled_prefill(len(prompt) - 1)(
+                pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
+                    req.tokens)
+                if prefill_tokens:
+                    row = jnp.asarray([prefill_tokens], jnp.int32)
+                    row_cache = self._compiled_prefill(len(prefill_tokens))(
                         self.params, row)
                     self._cache = self._insert(
-                        self._cache, row_cache["k"], row_cache["v"],
-                        jnp.int32(b))
+                        self._cache, row_cache, jnp.int32(b))
                 self._slot_req[b] = req
-                self._pos[b] = len(prompt) - 1
-                self._cur[b] = prompt[-1]
+                self._pos[b] = pos0
+                self._cur[b] = tok0
                 self._temps[b] = req.temperature
                 self._keys[b] = jax.random.key(req.seed)
             except Exception as exc:  # noqa: BLE001 — request-scoped
@@ -294,7 +288,7 @@ class ContinuousBatchingEngine:
                 # The old cache was donated to the failed step — its
                 # buffer is gone (or poisoned). Rebuild so the engine
                 # survives a transient step failure.
-                self._cache = self._family_mod.init_cache(
+                self._cache = self._family_mod.cb_init_cache(
                     self.cfg, self.slots, self.max_len)
                 continue
             for b in range(self.slots):

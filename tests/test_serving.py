@@ -257,9 +257,85 @@ class TestContinuousBatching:
         finally:
             engine.stop()
 
-    def test_seq2seq_rejected(self):
-        with pytest.raises(ValueError, match="ragged-decode"):
-            ServingServer("t5_tiny", batching="continuous")
+    def test_t5_continuous_matches_static(self, monkeypatch):
+        """Seq2seq continuous batching: per-slot encoder state (padded
+        cross-KV + length mask) lets requests with different encoder
+        lengths share one ragged decoder step — outputs equal the
+        static engine. fp32: bf16 reduction-order noise can flip
+        argmax between the two decode paths."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import t5
+
+        monkeypatch.setitem(
+            t5.CONFIGS, "t5_tiny",
+            dataclasses.replace(t5.CONFIGS["t5_tiny"], dtype=jnp.float32))
+        rows = [[5, 6, 7], [9, 8, 7, 6, 5, 4]]
+        with ServingServer("t5_tiny", seed=0) as static_s:
+            expect = _post(static_s.url, {"tokens": rows,
+                                          "max_new_tokens": 5})["tokens"]
+        with ServingServer("t5_tiny", seed=0, batching="continuous",
+                           slots=2) as cont_s:
+            got = _post(cont_s.url, {"tokens": rows,
+                                     "max_new_tokens": 5})["tokens"]
+        assert got == expect
+
+    def test_t5_ragged_decode_matches_scalar(self):
+        """T5 decode_step_ragged at mixed per-row depths == per-row
+        scalar decode_step with its own cross-KV."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import t5
+
+        cfg = dataclasses.replace(t5.CONFIGS["t5_tiny"], dtype=jnp.float32)
+        params = t5.init(cfg, jax.random.key(0))["params"]
+        max_new = 8
+        prompts = [jnp.asarray([[5, 6, 7]], jnp.int32),
+                   jnp.asarray([[9, 8, 7, 6, 5]], jnp.int32)]
+        # Reference: run each request alone, stepping to depth d_i.
+        depths = [0, 2]
+        refs, pool = [], t5.cb_init_cache(cfg, 3, max_new)
+        toks, poss = [], []
+        for i, (prompt, depth) in enumerate(zip(prompts, depths)):
+            enc = t5.encode(cfg, params, prompt)
+            cross = t5.precompute_cross_kv(cfg, params, enc)
+            cache = t5.init_decoder_cache(cfg, 1, max_new)
+            tok = jnp.asarray([0], jnp.int32)
+            for d in range(depth + 1):
+                lg, cache = t5.decode_step(cfg, params, cross, cache,
+                                           tok, d)
+                tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            refs.append((lg, cache, tok))
+            # Seed the pool slot: encoder row + replayed decoder KV.
+            row = t5.cb_prefill(cfg, params, prompt, max_new)
+            pool = t5.insert_cache_row(pool, row, jnp.int32(i))
+            pool = {
+                **pool,
+                "k": pool["k"].at[:, i].set(cache["k"][:, 0]),
+                "v": pool["v"].at[:, i].set(cache["v"][:, 0]),
+            }
+        # One ragged step at each row's NEXT depth (+ an idle row).
+        import numpy as np
+
+        tokens = jnp.asarray([int(refs[0][2][0]), int(refs[1][2][0]), 0],
+                             jnp.int32)
+        pos = jnp.asarray([depths[0] + 1, depths[1] + 1, -1], jnp.int32)
+        rag_lg, _ = t5.decode_step_ragged(cfg, params, pool, tokens, pos)
+        for i, (prompt, depth) in enumerate(zip(prompts, depths)):
+            enc = t5.encode(cfg, params, prompt)
+            cross = t5.precompute_cross_kv(cfg, params, enc)
+            lg, cache, tok = refs[i]
+            want, _ = t5.decode_step(cfg, params, cross, cache, tok,
+                                     depth + 1)
+            np.testing.assert_allclose(np.asarray(rag_lg[i]),
+                                       np.asarray(want[0]),
+                                       atol=2e-4, rtol=2e-4)
+        assert np.isfinite(np.asarray(rag_lg[2])).all()  # idle row
 
 
 class TestShardedServing:
