@@ -100,6 +100,8 @@ class ContinuousBatchingEngine:
         self._queue: collections.deque[_Request] = collections.deque()
         self._cv = threading.Condition()
         self._stopped = False
+        self._served = 0
+        self._tokens_out = 0
 
         def step(params, cache, tokens, pos, keys, temps):
             logits, cache = family.decode_step_ragged(
@@ -242,6 +244,17 @@ class ContinuousBatchingEngine:
                 req.error = f"{type(exc).__name__}: {exc}"
                 req.done.set()
 
+    def stats(self) -> dict:
+        """Live engine counters for /v1/stats."""
+        return {
+            "engine": "continuous",
+            "slots": self.slots,
+            "active": sum(1 for r in self._slot_req if r is not None),
+            "queued": len(self._queue),
+            "requests_served": self._served,
+            "tokens_generated": self._tokens_out,
+        }
+
     def _retire(self, b: int) -> None:
         req = self._slot_req[b]
         self._slot_req[b] = None
@@ -250,6 +263,9 @@ class ContinuousBatchingEngine:
         if req is not None:
             if req.cancelled and not req.error:
                 req.error = "cancelled"
+            if not req.error:  # count only successfully-served requests
+                self._served += 1
+                self._tokens_out += len(req.out)
             req.done.set()
 
     def _loop(self) -> None:

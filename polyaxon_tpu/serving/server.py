@@ -149,6 +149,8 @@ class _Engine:
         self.model = model
         self.cfg = cfg
         self.params = params
+        self._served = 0
+        self._tokens_out = 0
         self._lock = threading.Lock()  # one TPU program at a time
         family = _family(model)
         # seq2seq families decode into their own cache; the prompt is
@@ -218,7 +220,18 @@ class _Engine:
                                     jnp.float32(temperature)))
             for j, i in enumerate(idxs):
                 results[i] = out[j, :max_new_tokens].tolist()
+        with self._lock:  # ThreadingHTTPServer: += on ints is not atomic
+            self._served += len(token_rows)
+            self._tokens_out += max_new_tokens * len(token_rows)
         return results  # type: ignore[return-value]
+
+    def stats(self) -> dict:
+        """Live engine counters for /v1/stats."""
+        return {
+            "engine": "static",
+            "requests_served": self._served,
+            "tokens_generated": self._tokens_out,
+        }
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -241,6 +254,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"status": "ok", "model": self.engine.model})
         if self.path == "/v1/models":
             return self._json({"models": [self.engine.model]})
+        if self.path == "/v1/stats":
+            return self._json(self.engine.stats())
         return self._json({"error": f"no route {self.path}"}, status=404)
 
     def do_POST(self):  # noqa: N802

@@ -438,3 +438,21 @@ class TestStreaming:
             with pytest.raises(urllib.error.HTTPError) as err:
                 urllib.request.urlopen(req, timeout=60)
             assert err.value.code == 400
+
+
+class TestStats:
+    def test_stats_counters_both_engines(self):
+        for batching, engine_name in (("static", "static"),
+                                      ("continuous", "continuous")):
+            with ServingServer("llama_tiny", seed=0,
+                               batching=batching, slots=2) as s:
+                _post(s.url, {"tokens": [[5, 6, 7], [1, 2, 3]],
+                              "max_new_tokens": 4})
+                with urllib.request.urlopen(s.url + "/v1/stats",
+                                            timeout=10) as r:
+                    stats = json.load(r)
+            assert stats["engine"] == engine_name
+            assert stats["requests_served"] == 2
+            assert stats["tokens_generated"] == 8
+            if batching == "continuous":
+                assert stats["active"] == 0 and stats["queued"] == 0
