@@ -93,20 +93,33 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _flops_per_token(model: str, seq: int, param_count: int) -> int:
-    """Training FLOPs per token: 6N for the matmul params (fwd 2N +
-    bwd 4N) plus the causal-attention score/value matmuls
-    (6 * n_layers * seq * d_model fwd+bwd after halving for causality)."""
-    attn = 0
+def _flops_per_token(model: str, seq: int, param_count: int):
+    """Training FLOPs per token: 6N for the *active* matmul params
+    (fwd 2N + bwd 4N) plus the causal-attention score/value matmuls
+    (6 * n_layers * seq * d_model fwd+bwd after halving for causality).
+
+    For MoE models only K of E experts run per token, so N is the
+    dense params plus K/E of the expert-FFN params — counting all
+    experts would overstate tflops/MFU by roughly E/K on the FFN
+    share. Families without a derivation here (vit/bert/resnet/...)
+    return None → mfu reported null rather than wrong."""
     try:
-        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.models import llama, moe
 
         cfg = llama.CONFIGS.get(model)
         if cfg is not None:
-            attn = 6 * cfg.n_layers * seq * cfg.dim
+            return 6 * param_count + 6 * cfg.n_layers * seq * cfg.dim
+        mcfg = moe.CONFIGS.get(model)
+        if mcfg is not None:
+            expert_params = (mcfg.n_layers * mcfg.n_experts
+                             * 3 * mcfg.dim * mcfg.ffn_dim)
+            active = (param_count - expert_params
+                      + expert_params * mcfg.experts_per_token
+                      // mcfg.n_experts)
+            return 6 * active + 6 * mcfg.n_layers * seq * mcfg.dim
     except Exception:
         pass
-    return 6 * param_count + attn
+    return None
 
 
 def _emit_error(error: str, rc: int = 1) -> int:
@@ -397,7 +410,7 @@ def main() -> int:
         pass
 
     flops_tok = _flops_per_token(model, seq, result.param_count)
-    achieved = tokens_per_sec_per_chip * flops_tok
+    achieved = tokens_per_sec_per_chip * flops_tok if flops_tok else None
     peak = _peak_flops(record["device_kind"])
     print(json.dumps({
         "metric": f"jaxjob_train_tokens_per_sec_per_chip[{model},seq{seq}]",
@@ -405,8 +418,8 @@ def main() -> int:
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs_baseline, 4),
         "flops_per_token": flops_tok,
-        "tflops_per_sec_per_chip": round(achieved / 1e12, 2),
-        "mfu": round(achieved / peak, 4) if peak else None,
+        "tflops_per_sec_per_chip": round(achieved / 1e12, 2) if achieved else None,
+        "mfu": round(achieved / peak, 4) if achieved and peak else None,
         "device_kind": record["device_kind"],
         **({"fallback": fallback} if fallback else {}),
     }))

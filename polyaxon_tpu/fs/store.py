@@ -92,9 +92,17 @@ class Store:
         last call (the sidecar hot loop — SURVEY.md §3.3). In-flight
         ``.tmp``/``.lock`` files (the atomic-publish convention) are
         skipped, and files that vanish mid-walk are retried next pass —
-        same guarantees as the local ``sidecar.sync_tree`` path."""
+        same guarantees as the local ``sidecar.sync_tree`` path.
+
+        Only ``FileNotFoundError`` is treated as vanished-mid-walk;
+        store-side failures (auth/permission/network OSErrors from fsspec
+        backends) are logged at warning (once per path + a rate-limited
+        pass summary — the 5 s sidecar loop must not spam identical
+        lines) so a broken destination is loud, and retried next pass."""
         state = state if state is not None else {}
         count = 0
+        failed = 0
+        first_error = ""
         for root, _, files in os.walk(local_dir):
             for name in files:
                 if name.endswith((".tmp", ".lock")):
@@ -110,10 +118,21 @@ class Store:
                 key = f"{prefix}/{rel}".replace(os.sep, "/").lstrip("/")
                 try:
                     self.upload_file(path, key)
-                except OSError:
+                except FileNotFoundError:
                     continue  # vanished/rotating mid-walk: retry next pass
+                except OSError as exc:
+                    from polyaxon_tpu.sidecar.sync import warn_sync_file
+
+                    failed += 1
+                    first_error = first_error or f"{exc}"
+                    warn_sync_file(path, key, exc)
+                    continue  # retried next pass; mtime not recorded
                 state[path] = mtime
                 count += 1
+        if failed:
+            from polyaxon_tpu.sidecar.sync import warn_sync_failures
+
+            warn_sync_failures(failed, first_error)
         return count
 
 

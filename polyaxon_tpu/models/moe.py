@@ -147,12 +147,20 @@ def moe_block(
     w_gate: jax.Array,  # [E, D, F]
     w_up: jax.Array,
     w_down: jax.Array,  # [E, F, D]
+    min_capacity: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (output [B,S,D], router aux loss scalar fp32)."""
+    """Returns (output [B,S,D], router aux loss scalar fp32).
+
+    ``min_capacity`` floors the per-expert buffer; decode passes the
+    group size T so serving never drops tokens (at decode T is the
+    handful of live slots — capacity from the factor alone would be
+    1-2 slots and silently diverge served outputs from training
+    routing whenever >capacity rows picked one expert)."""
     B, S, D = x.shape
     E, K = cfg.n_experts, cfg.experts_per_token
     T = B * S
-    capacity = max(int(math.ceil(T * cfg.capacity_factor * K / E)), K)
+    capacity = max(int(math.ceil(T * cfg.capacity_factor * K / E)), K,
+                   min_capacity)
     dt = cfg.dtype
 
     tokens = x.reshape(T, D)
@@ -351,8 +359,10 @@ def decode_step_ragged(
     llama family — the families differ only in the FFN sublayer. The
     router sees the B current tokens as its dispatch group: top-k
     selection is per-token, so decode routing matches training routing
-    for the same hidden state (capacity drops excepted — serve with an
-    ample capacity_factor)."""
+    for the same hidden state. Capacity is floored at the group size
+    (``min_capacity=B`` below) so decode NEVER drops: at B live slots
+    the factor-derived capacity would be 1-2 and any routing skew
+    would silently diverge served outputs from training."""
     from polyaxon_tpu.models.llama import cached_attn_step, ragged_cache_coords
 
     _check_decodable(cfg)
@@ -367,7 +377,8 @@ def decode_step_ragged(
             cfg, layer, x, k_cache, v_cache, positions, slot, valid)
         h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
         moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
-                               layer["w_up"], layer["w_down"])
+                               layer["w_up"], layer["w_down"],
+                               min_capacity=h.shape[0])
         return x + moe_out, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(

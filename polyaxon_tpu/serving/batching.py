@@ -102,6 +102,12 @@ class ContinuousBatchingEngine:
         self._stopped = False
         self._served = 0
         self._tokens_out = 0
+        self._step_failures = 0  # lifetime counter (stats)
+        self._consec_step_failures = 0
+        # A device that throws persistently (e.g. OOM) would otherwise
+        # burn one rebuilt-cache step per queued request; after this
+        # many consecutive failures the engine fails fast instead.
+        self.max_step_failures = 3
 
         def step(params, cache, tokens, pos, keys, temps):
             logits, cache = family.decode_step_ragged(
@@ -216,6 +222,28 @@ class ContinuousBatchingEngine:
         self._finalize_stop()
 
     # -------------------------------------------------------------- loop
+    def _fail_fast(self, err: str) -> None:
+        """Persistent device breakage (e.g. OOM): admitting the queue
+        against it would fail serially, one compiled program per
+        request. Fail live slots AND drain the queue, then stop the
+        engine; submit() refuses new work. Live slots must be retired
+        here — the loop thread exits right after, and nothing else
+        would ever set their done events (their waiters would hang)."""
+        logger.error(
+            "%d consecutive device-program failures; draining queue and "
+            "stopping engine", self._consec_step_failures)
+        for b in range(self.slots):
+            if self._slot_req[b] is not None:
+                self._slot_req[b].error = f"engine failed: {err}"
+                self._retire(b)
+        with self._cv:
+            self._stopped = True
+            while self._queue:
+                req = self._queue.popleft()
+                if not req.done.is_set():
+                    req.error = f"engine failed: {err}"
+                    req.done.set()
+
     def _admit(self) -> None:
         for b in range(self.slots):
             if self._slot_req[b] is not None:
@@ -243,6 +271,24 @@ class ContinuousBatchingEngine:
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 req.error = f"{type(exc).__name__}: {exc}"
                 req.done.set()
+                # Persistent device breakage surfaces in the admission
+                # prefill just as readily as in the decode step — count
+                # it toward the same fail-fast budget so a broken
+                # device doesn't burn one prefill per queued request.
+                # Only RuntimeErrors count (XLA device errors subclass
+                # it): a ValueError from a family's cb_admission is a
+                # bad REQUEST, and three of those in a row must not
+                # stop a healthy engine for everyone else. (And only a
+                # successful STEP resets the counter: resetting on
+                # admission would let fail-step/re-admit cycles
+                # alternate forever below the threshold.)
+                if isinstance(exc, RuntimeError):
+                    self._step_failures += 1
+                    self._consec_step_failures += 1
+                    if (self._consec_step_failures
+                            >= self.max_step_failures):
+                        self._fail_fast(f"{type(exc).__name__}: {exc}")
+                        return
 
     def stats(self) -> dict:
         """Live engine counters for /v1/stats."""
@@ -253,6 +299,8 @@ class ContinuousBatchingEngine:
             "queued": len(self._queue),
             "requests_served": self._served,
             "tokens_generated": self._tokens_out,
+            "step_failures": self._step_failures,
+            "stopped": self._stopped,
         }
 
     def _retire(self, b: int) -> None:
@@ -281,6 +329,8 @@ class ContinuousBatchingEngine:
                 if req is not None and req.cancelled:
                     self._retire(b)
             self._admit()
+            if self._stopped:  # _admit may fail-fast mid-pass
+                return
             if all(r is None for r in self._slot_req):
                 continue
             try:
@@ -296,17 +346,23 @@ class ContinuousBatchingEngine:
                 nxt = np.asarray(nxt)
             except Exception as exc:  # noqa: BLE001 — fail live requests
                 logger.exception("decode step failed")
+                self._step_failures += 1
+                self._consec_step_failures += 1
+                err = f"{type(exc).__name__}: {exc}"
                 for b in range(self.slots):
                     if self._slot_req[b] is not None:
-                        self._slot_req[b].error = (
-                            f"{type(exc).__name__}: {exc}")
+                        self._slot_req[b].error = err
                         self._retire(b)
+                if self._consec_step_failures >= self.max_step_failures:
+                    self._fail_fast(err)
+                    return
                 # The old cache was donated to the failed step — its
                 # buffer is gone (or poisoned). Rebuild so the engine
                 # survives a transient step failure.
                 self._cache = self._family_mod.cb_init_cache(
                     self.cfg, self.slots, self.max_len)
                 continue
+            self._consec_step_failures = 0
             for b in range(self.slots):
                 req = self._slot_req[b]
                 if req is None:

@@ -7,12 +7,41 @@ upstream sidecar ships to fsspec stores the same way (SURVEY.md §3.3).
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import threading
 import time
 from typing import Optional
 from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+# Per-path once-only + time-limited summary, shared with the store
+# path: the 5 s hot loop re-hits the same broken destination every
+# pass, and unbounded identical warnings are their own outage.
+_warned_paths: set[str] = set()
+_last_summary_warn = 0.0
+_SUMMARY_INTERVAL_S = 60.0
+
+
+def warn_sync_failures(failed: int, first_error: str) -> None:
+    """Summary warning for a sync pass with failures, at most one per
+    minute process-wide."""
+    global _last_summary_warn
+    now = time.monotonic()
+    if now - _last_summary_warn >= _SUMMARY_INTERVAL_S:
+        _last_summary_warn = now
+        logger.warning(
+            "sync pass: %d file(s) failed to ship (will retry; first "
+            "error: %s)", failed, first_error)
+
+
+def warn_sync_file(path: str, dest: str, exc: Exception) -> None:
+    """Per-file warning, once per source path per process."""
+    if path not in _warned_paths:
+        _warned_paths.add(path)
+        logger.warning("sync failed for %s -> %s: %s", path, dest, exc)
 
 
 def _should_copy(src: str, dest: str) -> bool:
@@ -25,8 +54,14 @@ def _should_copy(src: str, dest: str) -> bool:
 def sync_tree(src_root: str, dest_root: str) -> int:
     """Copy changed files; returns number synced. Append-heavy files
     (jsonl/logs) are whole-file copied — sizes here are small relative to
-    checkpoints, which orbax already writes store-side."""
+    checkpoints, which orbax already writes store-side.
+
+    Only a vanished source (FileNotFoundError) is silently retried; a
+    failing DESTINATION (read-only/full volume) is logged loudly — the
+    same contract as ``Store.sync_dir`` — and retried next pass."""
     synced = 0
+    failed = 0
+    first_error = ""
     for dirpath, _, filenames in os.walk(src_root):
         rel = os.path.relpath(dirpath, src_root)
         dest_dir = os.path.join(dest_root, rel) if rel != "." else dest_root
@@ -36,12 +71,19 @@ def sync_tree(src_root: str, dest_root: str) -> int:
             src = os.path.join(dirpath, name)
             dest = os.path.join(dest_dir, name)
             if _should_copy(src, dest):
-                os.makedirs(dest_dir, exist_ok=True)
                 try:
+                    os.makedirs(dest_dir, exist_ok=True)
                     shutil.copy2(src, dest)
                     synced += 1
-                except OSError:
-                    continue  # file vanished/rotating mid-walk
+                except FileNotFoundError:
+                    continue  # source vanished/rotating mid-walk
+                except OSError as exc:
+                    failed += 1
+                    first_error = first_error or f"{exc}"
+                    warn_sync_file(src, dest, exc)
+                    continue
+    if failed:
+        warn_sync_failures(failed, first_error)
     return synced
 
 
@@ -76,8 +118,8 @@ class SidecarSync:
         while not self._stop.wait(self.interval):
             try:
                 self.sync_once()
-            except Exception:
-                pass
+            except Exception as exc:  # noqa: BLE001 — keep the loop alive
+                warn_sync_failures(1, f"{type(exc).__name__}: {exc}")
 
     def start(self) -> None:
         if self._thread is None:

@@ -80,6 +80,76 @@ class TestFsspecStore:
         assert store.sync_dir(str(src)) == 1
         assert store.list() == ["ckpt.bin"]
 
+    def test_sync_dir_store_failure_is_loud_and_retried(self, tmp_path,
+                                                        caplog):
+        """A store-side OSError (auth/permission/network — NOT a file
+        vanishing mid-walk) must be logged at warning and retried next
+        pass, never silently swallowed: a persistently broken gs://
+        destination that skipped files forever would lose artifacts
+        (ADVICE r2, fs/store.py sync_dir)."""
+        import logging
+
+        from polyaxon_tpu.sidecar import sync as sidecar_sync
+
+        # The warnings are once-per-path + rate-limited process-wide;
+        # reset so this test observes them regardless of suite order.
+        sidecar_sync._warned_paths.clear()
+        sidecar_sync._last_summary_warn = 0.0
+
+        store = _fsspec_memory_store("broken")
+        src = tmp_path / "run"
+        src.mkdir()
+        (src / "a.txt").write_text("a")
+
+        real_upload = store.upload_file
+        calls = {"n": 0}
+
+        def flaky_upload(path, key):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise PermissionError("403 on destination bucket")
+            return real_upload(path, key)
+
+        store.upload_file = flaky_upload
+        state: dict[str, float] = {}
+        with caplog.at_level(logging.WARNING,
+                             "polyaxon_tpu.sidecar.sync"):
+            assert store.sync_dir(str(src), state=state) == 0
+        assert "sync failed for" in caplog.text  # loud, not silent
+        assert "failed to ship" in caplog.text  # pass summary
+        assert state == {}  # mtime NOT recorded → retried next pass
+        assert store.sync_dir(str(src), state=state) == 1  # retry ships it
+        assert store.list() == ["a.txt"]
+
+    def test_sync_tree_dest_failure_is_loud(self, tmp_path, caplog,
+                                            monkeypatch):
+        """The local sidecar fast path has the same contract: a broken
+        DESTINATION volume (read-only/full) warns instead of silently
+        skipping forever; a vanished source stays silent."""
+        import logging
+        import shutil as _shutil
+
+        from polyaxon_tpu.sidecar import sync as sidecar_sync
+
+        sidecar_sync._warned_paths.clear()
+        sidecar_sync._last_summary_warn = 0.0
+
+        src = tmp_path / "run"
+        src.mkdir()
+        (src / "a.txt").write_text("a")
+        dest = tmp_path / "dest"
+
+        def broken_copy(s, d):
+            raise PermissionError("read-only file system")
+
+        monkeypatch.setattr(_shutil, "copy2", broken_copy)
+        with caplog.at_level(logging.WARNING,
+                             "polyaxon_tpu.sidecar.sync"):
+            assert sidecar_sync.sync_tree(str(src), str(dest)) == 0
+        assert "sync failed for" in caplog.text
+        monkeypatch.undo()
+        assert sidecar_sync.sync_tree(str(src), str(dest)) == 1
+
 
 class TestGetStoreDispatch:
     def test_file_and_memory(self, tmp_path):

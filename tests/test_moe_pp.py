@@ -321,3 +321,41 @@ class TestMoEDecode:
         prompt = jnp.ones((1, 4), jnp.int32)
         with _pytest.raises(ValueError, match="top_k"):
             moe.generate(cfg, params, prompt, max_new_tokens=2)
+
+    def test_decode_never_drops_regardless_of_capacity_factor(self):
+        """Decode output must be independent of capacity_factor: the
+        decode dispatch group is only the live slots, so factor-derived
+        capacity is 1-2 slots and any routing skew would silently drop
+        tokens (ADVICE r2, moe.py decode capacity). Capacity is floored
+        at the group size in decode. Zeroed router weights force ALL
+        rows onto the same top-k experts — the worst-case collision a
+        tiny factor would drop."""
+        import dataclasses
+
+        from polyaxon_tpu.models import moe
+
+        base = dataclasses.replace(moe.CONFIGS["moe_tiny"],
+                                   dtype=jnp.float32)
+        params = moe.init(base, jax.random.key(0))["params"]
+        # Uniform router logits → every token picks experts {0, 1}.
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+        params["layers"]["router"] = jnp.zeros_like(
+            params["layers"]["router"])
+
+        prompt = jax.random.randint(jax.random.key(3), (4, 6), 0,
+                                    base.vocab_size)
+        # One shared cache from a no-drop prefill (prefill's dispatch
+        # group is B·P tokens — its factor semantics are training's and
+        # not under test); only the decode step varies the factor.
+        _, cache = moe.prefill(
+            dataclasses.replace(base, capacity_factor=8.0), params,
+            prompt, 8)
+        outs = {}
+        for cf in (0.01, 8.0):
+            cfg = dataclasses.replace(base, capacity_factor=cf)
+            logits, _ = moe.decode_step(
+                cfg, params, cache, prompt[:, -1], jnp.int32(6))
+            outs[cf] = np.asarray(logits)
+        np.testing.assert_allclose(outs[0.01], outs[8.0],
+                                   atol=1e-6, rtol=1e-6)

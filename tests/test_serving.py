@@ -244,6 +244,178 @@ class TestContinuousBatching:
             assert not errs, errs
             assert [got[i] for i in range(len(rows))] == expect
 
+    def test_persistent_step_failure_fails_fast(self):
+        """A device that throws on every decode step (e.g. persistent
+        OOM) must NOT burn one rebuilt-cache step per queued request:
+        after max_step_failures consecutive failures the engine drains
+        the queue and stops (ADVICE r2, batching.py fail loop)."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        calls = {"n": 0}
+
+        def broken_step(*args, **kwargs):
+            calls["n"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: persistent OOM")
+
+        engine._step = broken_step
+        try:
+            reqs = [engine.submit([1, 2, 3], 4) for _ in range(6)]
+            errs = []
+            for r in reqs:
+                with pytest.raises(RuntimeError) as exc_info:
+                    r.wait(timeout=120)
+                errs.append(str(exc_info.value))
+            # Fail-fast: exactly max_step_failures device steps, not
+            # one per request; the rest drained with a typed error.
+            assert calls["n"] == engine.max_step_failures
+            assert sum("engine failed" in e for e in errs) == 3
+            assert engine.stats()["stopped"] is True
+            assert engine.stats()["step_failures"] == 3
+            with pytest.raises(RuntimeError, match="engine stopped"):
+                engine.submit([1, 2, 3], 4)
+        finally:
+            engine.stop()
+
+    def test_persistent_admission_failure_fails_fast(self):
+        """Device breakage can surface in the admission prefill instead
+        of the decode step (each request compiles/runs its own prefill)
+        — it must hit the same fail-fast budget, not burn one prefill
+        per queued request."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        calls = {"n": 0}
+
+        def broken_prefill(plen):
+            def run(params, prompt):
+                calls["n"] += 1
+                raise RuntimeError("RESOURCE_EXHAUSTED: prefill OOM")
+
+            return run
+
+        engine._compiled_prefill = broken_prefill
+        try:
+            reqs = [engine.submit([1, 2, 3], 4) for _ in range(6)]
+            for r in reqs:
+                with pytest.raises(RuntimeError):
+                    r.wait(timeout=120)
+            assert calls["n"] == engine.max_step_failures
+            assert engine.stats()["stopped"] is True
+        finally:
+            engine.stop()
+
+    def test_fail_fast_releases_live_slots(self):
+        """Fail-fast triggered from the admission path must error-and-
+        retire requests still LIVE in slots — the loop thread exits, so
+        an unretired slot's waiter would block forever."""
+        import time as _time
+
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=4, max_len=256)
+        try:
+            live = engine.submit([1, 2, 3], 200)  # long-running
+            deadline = _time.time() + 60
+            while engine.stats()["active"] == 0:
+                assert _time.time() < deadline, "request never went live"
+                _time.sleep(0.05)
+
+            def broken_prefill(plen):
+                def run(params, prompt):
+                    raise RuntimeError("RESOURCE_EXHAUSTED")
+
+                return run
+
+            engine._compiled_prefill = broken_prefill
+            # One _admit pass hits 3 free slots → 3 consecutive
+            # failures before any step can reset the counter.
+            bad = [engine.submit([4, 5], 50) for _ in range(3)]
+            for r in bad:
+                with pytest.raises(RuntimeError):
+                    r.wait(timeout=120)
+            with pytest.raises(RuntimeError, match="engine failed"):
+                live.wait(timeout=120)  # released, not hung
+            assert engine.stats()["stopped"] is True
+        finally:
+            engine.stop()
+
+    def test_bad_request_admission_errors_do_not_stop_engine(self):
+        """Request-scoped admission errors (ValueError — not an XLA
+        RuntimeError) must not trip the device fail-fast: three bad
+        requests in a row would otherwise deny service to everyone."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        real_admission = engine._family_mod.cb_admission
+        state = {"bad": True}
+
+        def sometimes_bad(tokens):
+            if state["bad"]:
+                raise ValueError("family rejected this prompt")
+            return real_admission(tokens)
+
+        import types
+
+        engine._family_mod = types.SimpleNamespace(
+            **{n: getattr(engine._family_mod, n)
+               for n in dir(engine._family_mod) if not n.startswith("__")})
+        engine._family_mod.cb_admission = sometimes_bad
+        try:
+            bad = [engine.submit([1, 2, 3], 4) for _ in range(4)]
+            for r in bad:
+                with pytest.raises(RuntimeError, match="rejected"):
+                    r.wait(timeout=120)
+            assert engine.stats()["stopped"] is False
+            state["bad"] = False
+            good = engine.submit([1, 2, 3], 4)
+            assert len(good.wait(timeout=120)) == 4  # still serving
+        finally:
+            engine.stop()
+
+    def test_transient_step_failure_recovers(self):
+        """One failed step fails only the live requests; the engine
+        rebuilds the cache and keeps serving the queue."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        real_step = engine._step
+        calls = {"n": 0}
+
+        def flaky_step(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return real_step(*args, **kwargs)
+
+        engine._step = flaky_step
+        try:
+            r1 = engine.submit([1, 2, 3], 4)
+            with pytest.raises(RuntimeError, match="transient"):
+                r1.wait(timeout=120)
+            r2 = engine.submit([1, 2, 3], 4)
+            out = r2.wait(timeout=120)
+            assert len(out) == 4
+            assert engine.stats()["stopped"] is False
+            assert engine.stats()["step_failures"] == 1
+        finally:
+            engine.stop()
+
     def test_over_budget_rejected(self):
         from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
         from polyaxon_tpu.serving.server import load_params
