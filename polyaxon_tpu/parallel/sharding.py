@@ -52,9 +52,15 @@ CP_RULES: Rules = (
     ("seq", "cp"),
     ("heads", None),
 )
-# Expert parallel: experts over ep, everything else FSDP-style.
+# Expert parallel: experts over ep; the batch shards over ep TOO — ep
+# devices act as extra data parallelism outside the MoE block (the
+# standard GShard/Mixtral layout: without this, attention and every
+# dense matmul would be computed ep-fold redundantly). Inside the
+# block, tokens reshard token→expert: GSPMD inserts the all-to-alls
+# for the dense one-hot dispatch; dispatch="ragged" does it explicitly
+# with per-expert counts (models/moe.py _moe_ragged).
 EP_RULES: Rules = (
-    ("batch", ("dp", "fsdp")),
+    ("batch", ("dp", "fsdp", "ep")),
     ("embed", "fsdp"),
     ("expert", "ep"),
     ("mlp", None),

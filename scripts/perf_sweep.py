@@ -58,6 +58,82 @@ def run_point(name: str, timeout_s: float = 1200, **kw):
     return out
 
 
+def moe_dispatch_sweep(platform: str, steps: int) -> int:
+    """Dense one-hot vs ragged all_to_all MoE dispatch, measured
+    (VERDICT r2 item 3): train-step wall time at E ∈ {8,16,32} on a
+    dp2×ep4 mesh (8-device virtual CPU mesh by default; single-chip
+    ep=1 on TPU still measures the einsum-elimination term, which
+    dominates as E grows). Writes moe_dispatch_results.json."""
+    if platform == "cpu":
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+    sys.path.insert(0, REPO)
+    import dataclasses
+
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyaxon_tpu.models import moe
+    from polyaxon_tpu.parallel.sharding import rules_for_mesh, tree_shardings
+
+    devices = jax.devices()
+    if len(devices) >= 8:
+        mesh = jax.sharding.Mesh(np.array(devices[:8]).reshape(2, 4),
+                                 ("dp", "ep"))
+    else:
+        mesh = jax.sharding.Mesh(np.array(devices[:1]).reshape(1, 1),
+                                 ("dp", "ep"))
+    results = []
+    for n_experts in (8, 16, 32):
+        cfg0 = dataclasses.replace(
+            moe.CONFIGS["moe_tiny"], dim=256, ffn_dim=512, n_layers=2,
+            n_heads=8, n_kv_heads=4, n_experts=n_experts,
+            experts_per_token=2, capacity_factor=1.25, vocab_size=1024,
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16)
+        variables = moe.init(cfg0, jax.random.key(0))
+        shardings = tree_shardings(moe.logical_axes(cfg0)["params"], mesh,
+                                   rules_for_mesh(mesh))
+        params = jax.device_put(variables["params"], shardings)
+        batch = {"tokens": jax.random.randint(jax.random.key(1), (8, 128),
+                                              0, cfg0.vocab_size)}
+        row = {"n_experts": n_experts}
+        for dispatch in ("dense", "ragged"):
+            cfg = dataclasses.replace(cfg0, dispatch=dispatch)
+
+            def loss_fn(p, b, cfg=cfg):
+                return moe.apply(cfg, {"params": p, "state": {}}, b)[0]
+
+            with mesh:
+                step = jax.jit(jax.grad(loss_fn))
+                g = step(params, batch)  # compile + warm
+                jax.block_until_ready(g)
+                times = []
+                for _ in range(steps):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(step(params, batch))
+                    times.append(time.perf_counter() - t0)
+            row[dispatch + "_ms"] = round(
+                sorted(times)[len(times) // 2] * 1e3, 2)
+        row["ragged_speedup"] = round(row["dense_ms"] / row["ragged_ms"], 3)
+        results.append(row)
+        print(f"E={n_experts}: dense {row['dense_ms']}ms, "
+              f"ragged {row['ragged_ms']}ms, "
+              f"speedup {row['ragged_speedup']}x", flush=True)
+
+    out_path = os.path.join(REPO, "moe_dispatch_results.json")
+    with open(out_path, "w") as fh:
+        json.dump({"platform": jax.devices()[0].platform,
+                   "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+                   "grid": "dim256 ffn512 L2 seq128 batch8 K2 cf1.25",
+                   "results": results}, fh, indent=2)
+    print(f"wrote {out_path}")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=30)
@@ -68,7 +144,18 @@ def main() -> int:
                              "watchdog on large shapes)")
     parser.add_argument("--quick", action="store_true",
                         help="baseline + the 3 highest-value levers only")
+    parser.add_argument("--moe", action="store_true",
+                        help="run the MoE dense-vs-ragged dispatch sweep "
+                             "instead of the llama lever matrix")
+    parser.add_argument("--moe-platform", default="cpu",
+                        choices=("cpu", "tpu"),
+                        help="--moe backend: cpu = 8-device virtual mesh "
+                             "(dp2xep4), tpu = the real chip (ep=1)")
     args = parser.parse_args()
+
+    if args.moe:
+        return moe_dispatch_sweep(args.moe_platform,
+                                  steps=min(args.steps, 15))
 
     base = dict(model=args.model, steps=args.steps, seq=args.seq)
     points = [

@@ -100,6 +100,114 @@ class TestMoE:
         routed_rows = jnp.sum(jnp.any(out.reshape(-1, D) != 0, axis=-1))
         assert int(routed_rows) <= cfg.n_experts
 
+    def test_ragged_matches_dense_no_drop_single_shard(self):
+        """dispatch='ragged' (count-based gather/scatter + batched FFN,
+        no one-hot einsums) == dispatch='dense' at no-drop capacity —
+        the ep=1 degenerate path, no mesh required (VERDICT r2 item 3)."""
+        base = dataclasses.replace(
+            moe.CONFIGS["moe_tiny"], dtype=jnp.float32, capacity_factor=8.0)
+        D, E, F = base.dim, base.n_experts, base.ffn_dim
+        x = jax.random.normal(jax.random.key(0), (2, 16, D), jnp.float32)
+        ks = jax.random.split(jax.random.key(1), 4)
+        args = (x,
+                jax.random.normal(ks[0], (D, E)) * 0.1,
+                jax.random.normal(ks[1], (E, D, F)) * 0.05,
+                jax.random.normal(ks[2], (E, D, F)) * 0.05,
+                jax.random.normal(ks[3], (E, F, D)) * 0.05)
+        dense_out, dense_aux = moe.moe_block(base, *args)
+        ragged_out, ragged_aux = moe.moe_block(
+            dataclasses.replace(base, dispatch="ragged"), *args)
+        np.testing.assert_allclose(np.asarray(ragged_out),
+                                   np.asarray(dense_out), atol=1e-5)
+        np.testing.assert_allclose(float(ragged_aux), float(dense_aux),
+                                   rtol=1e-6)
+
+    def test_ragged_matches_dense_under_ep_mesh(self, cpu_devices):
+        """Full forward parity dense↔ragged under a dp2×ep4 mesh with
+        sharded params: the explicit all_to_all dispatch/combine path
+        computes the same function the GSPMD dense path does."""
+        from polyaxon_tpu.parallel.sharding import (
+            rules_for_mesh,
+            tree_shardings,
+        )
+
+        base = dataclasses.replace(
+            moe.CONFIGS["moe_tiny"], dtype=jnp.float32, capacity_factor=8.0)
+        variables = moe.init(base, jax.random.key(0))
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                    base.vocab_size)
+        want, want_aux = moe.forward(base, variables["params"], tokens)
+
+        mesh = Mesh(np.array(cpu_devices).reshape(2, 4), ("dp", "ep"))
+        shardings = tree_shardings(moe.logical_axes(base)["params"], mesh,
+                                   rules_for_mesh(mesh))
+        params = jax.device_put(variables["params"], shardings)
+        cfg_r = dataclasses.replace(base, dispatch="ragged")
+        with mesh:
+            got, got_aux = jax.jit(
+                lambda p, t: moe.forward(cfg_r, p, t))(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(float(got_aux), float(want_aux),
+                                   rtol=1e-4)
+
+    def test_ragged_gradients_match_dense(self):
+        """Training-path parity: grads through the ragged dispatch
+        (scatter/gather/all_to_all VJPs) == dense one-hot grads at
+        no-drop capacity — the ep=1 path."""
+        base = dataclasses.replace(
+            moe.CONFIGS["moe_tiny"], dtype=jnp.float32, capacity_factor=8.0)
+        variables = moe.init(base, jax.random.key(0))
+        batch = {"tokens": jax.random.randint(jax.random.key(2), (2, 16), 0,
+                                              base.vocab_size)}
+
+        def loss_for(cfg):
+            return lambda p: moe.apply(
+                cfg, {"params": p, "state": {}}, batch)[0]
+
+        g_dense = jax.grad(loss_for(base))(variables["params"])
+        g_ragged = jax.grad(loss_for(
+            dataclasses.replace(base, dispatch="ragged")))(
+                variables["params"])
+        for gd, gr in zip(jax.tree.leaves(g_dense),
+                          jax.tree.leaves(g_ragged)):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       atol=5e-5, rtol=5e-4)
+
+    def test_ragged_gradients_match_dense_under_ep_mesh(self, cpu_devices):
+        """Grad parity through the REAL sharded path — shard_map with
+        all_to_all and pmean VJPs under dp2×ep4, against unsharded
+        dense grads: a wrong psum/pmean scaling in the backward would
+        corrupt every ep>1 training run while passing the ep=1 tests."""
+        from polyaxon_tpu.parallel.sharding import (
+            rules_for_mesh,
+            tree_shardings,
+        )
+
+        base = dataclasses.replace(
+            moe.CONFIGS["moe_tiny"], dtype=jnp.float32, capacity_factor=8.0)
+        variables = moe.init(base, jax.random.key(0))
+        batch = {"tokens": jax.random.randint(jax.random.key(2), (8, 16), 0,
+                                              base.vocab_size)}
+
+        def loss_for(cfg):
+            return lambda p: moe.apply(
+                cfg, {"params": p, "state": {}}, batch)[0]
+
+        g_dense = jax.grad(loss_for(base))(variables["params"])
+
+        mesh = Mesh(np.array(cpu_devices).reshape(2, 4), ("dp", "ep"))
+        shardings = tree_shardings(moe.logical_axes(base)["params"], mesh,
+                                   rules_for_mesh(mesh))
+        params = jax.device_put(variables["params"], shardings)
+        cfg_r = dataclasses.replace(base, dispatch="ragged")
+        with mesh:
+            g_ragged = jax.jit(jax.grad(loss_for(cfg_r)))(params)
+        for gd, gr in zip(jax.tree.leaves(g_dense),
+                          jax.tree.leaves(g_ragged)):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       atol=1e-4, rtol=1e-3)
+
     def test_trains_on_ep_mesh(self, cpu_devices):
         job = V1JAXJob(
             kind="jaxjob", mesh=V1MeshSpec(axes={"dp": 2, "ep": 4}),
