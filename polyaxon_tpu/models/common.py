@@ -188,3 +188,45 @@ def shift_right(tokens: jax.Array, bos_id: int = 0) -> jax.Array:
     return jnp.concatenate(
         [jnp.full_like(tokens[:, :1], bos_id), tokens[:, :-1]], axis=1
     )
+
+
+def sample_row(logits: jax.Array, key: jax.Array, temperature,
+               top_p, top_k) -> jax.Array:
+    """Temperature + nucleus (top-p) + top-k sampling for ONE row of
+    logits [V] — fully jittable, no host round-trip; all knobs may be
+    traced scalars. ``top_p >= 1`` and ``top_k <= 0`` disable their
+    filters. Greedy (temperature == 0) is the caller's branch.
+
+    Sampling happens in descending-sorted space (one ``lax.top_k`` of
+    the full vocab): nucleus keeps the minimal prefix whose mass
+    reaches ``top_p`` (exclusive-cumsum < p — the first token always
+    survives, so the filter can never empty the row), top-k keeps the
+    first ``k`` positions, and the drawn sorted index maps back
+    through the sort permutation — no scatter needed.
+    """
+    V = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sorted_l, sort_idx = jax.lax.top_k(scaled, V)
+    probs = jax.nn.softmax(sorted_l)
+    cum = jnp.cumsum(probs) - probs  # exclusive prefix mass
+    keep = cum < jnp.where(top_p >= 1.0, jnp.inf, top_p)
+    keep &= jnp.arange(V) < jnp.where(top_k > 0, top_k, V)
+    masked = jnp.where(keep, sorted_l, -jnp.inf)
+    return sort_idx[jax.random.categorical(key, masked)].astype(jnp.int32)
+
+
+def sample_logits(logits: jax.Array, key: jax.Array, temperature,
+                  top_p=1.0, top_k=0) -> jax.Array:
+    """Batch sampling [B, V] → [B] int32 with SHARED knobs (the family
+    ``generate`` path). With both filters statically disabled this is
+    exactly the historical ``jax.random.categorical`` draw (bit-stable
+    for existing seeds); otherwise rows sample independently through
+    :func:`sample_row` on split keys."""
+    plain = (not isinstance(top_p, jax.Array) and float(top_p) >= 1.0
+             and not isinstance(top_k, jax.Array) and int(top_k) <= 0)
+    if plain:
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    keys = jax.random.split(key, logits.shape[0])
+    return jax.vmap(sample_row, in_axes=(0, 0, None, None, None))(
+        logits, keys, temperature, top_p, top_k)

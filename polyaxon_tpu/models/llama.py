@@ -29,6 +29,7 @@ from polyaxon_tpu.models.common import (
     chunked_lm_loss,
     rms_norm,
     rope,
+    sample_logits,
     scaled_init,
     shift_right,
     truncated_normal_init,
@@ -553,14 +554,18 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled continuation: [B, max_new].
 
-    ``temperature`` may be a traced scalar (the serving path passes it
-    as a jitted argument so sweeping temperatures reuses one
-    executable); the greedy/sampling choice itself is static — a Python
-    float 0.0 selects greedy, anything else selects sampling.
+    ``temperature``/``top_p``/``top_k`` may be traced scalars (the
+    serving path passes them as jitted arguments so sweeping knobs
+    reuses one executable); the greedy/sampling choice itself is
+    static — a Python float 0.0 selects greedy, anything else selects
+    sampling. ``top_p``/``top_k`` filter inside the compiled loop
+    (models/common.py sample_logits) — no host round-trip.
     """
     B, P = prompt.shape
     sampling = isinstance(temperature, jax.Array) or temperature > 0
@@ -572,7 +577,7 @@ def generate(
 
     def sample(logits, key):
         if sampling:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            return sample_logits(logits, key, temperature, top_p, top_k)
         return jnp.argmax(logits, axis=-1)
 
     def decode_loop(carry, t):

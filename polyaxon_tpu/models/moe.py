@@ -52,6 +52,7 @@ from polyaxon_tpu.models.common import (
     Variables,
     chunked_lm_loss,
     rms_norm,
+    sample_logits,
     scaled_init,
     shift_right,
     truncated_normal_init,
@@ -636,11 +637,14 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled continuation: [B, max_new] —
-    the same serving contract as llama.generate (temperature may be a
-    traced scalar)."""
+    the same serving contract as llama.generate (all sampling knobs
+    may be traced scalars; top_p/top_k filter in-program via
+    models/common.py sample_logits)."""
     B, P = prompt.shape
     sampling = isinstance(temperature, jax.Array) or temperature > 0
     if sampling and rng is None:
@@ -651,7 +655,7 @@ def generate(
 
     def sample(logits, key):
         if sampling:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            return sample_logits(logits, key, temperature, top_p, top_k)
         return jnp.argmax(logits, axis=-1)
 
     def decode_loop(carry, t):

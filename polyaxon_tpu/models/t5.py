@@ -33,6 +33,7 @@ from polyaxon_tpu.models.common import (
     chunked_lm_loss,
     rms_norm,
     rope,
+    sample_logits,
     scaled_init,
     shift_right,
     truncated_normal_init,
@@ -293,11 +294,15 @@ def generate(
     max_new_tokens: int,
     bos_id: int = 0,
     temperature: float = 0.0,
+    top_p: float = 1.0,
+    top_k: int = 0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy (temperature 0) or sampled seq2seq generation: [B, max_new].
     The encoder runs once; the decoder steps through a KV cache starting
-    from BOS (matching apply()'s shift_right convention)."""
+    from BOS (matching apply()'s shift_right convention). Sampling
+    knobs (all traceable) match llama.generate; top_p/top_k filter
+    in-program via models/common.py sample_logits."""
     B = inputs.shape[0]
     sampling = isinstance(temperature, jax.Array) or temperature > 0
     if sampling and rng is None:
@@ -310,7 +315,7 @@ def generate(
 
     def sample(logits, key):
         if sampling:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            return sample_logits(logits, key, temperature, top_p, top_k)
         return jnp.argmax(logits, axis=-1)
 
     def decode_loop(carry, t):

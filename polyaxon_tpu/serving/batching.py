@@ -30,6 +30,7 @@ import collections
 import logging
 import threading
 from dataclasses import dataclass, field
+import functools
 from functools import lru_cache
 from typing import Optional
 
@@ -40,12 +41,25 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def validate_sampling(top_p: float, top_k: int) -> None:
+    """Shared request-sampling validation (HTTP handler AND direct
+    engine callers): out-of-range knobs must raise, not silently
+    degenerate (top_p=0 would collapse to argmax via the all--inf
+    categorical, top_k<0 would silently mean 'disabled')."""
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+
+
 @dataclass
 class _Request:
     tokens: list[int]
     max_new: int
     temperature: float
     seed: int
+    top_p: float = 1.0
+    top_k: int = 0
     out: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None
@@ -94,6 +108,8 @@ class ContinuousBatchingEngine:
         self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
         self._cur = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
+        self._top_ps = np.ones(slots, np.float32)
+        self._top_ks = np.zeros(slots, np.int32)
         self._keys = [jax.random.key(0)] * slots
         self._slot_req: list[Optional[_Request]] = [None] * slots
 
@@ -104,21 +120,46 @@ class ContinuousBatchingEngine:
         self._tokens_out = 0
         self._step_failures = 0  # lifetime counter (stats)
         self._consec_step_failures = 0
+        # Occupancy accounting: continuous batching wins exactly when
+        # slots stay busy — avg_occupancy is THE number that says so.
+        self._steps_total = 0
+        self._live_slot_steps = 0
+        self._queue_depth_peak = 0
         # A device that throws persistently (e.g. OOM) would otherwise
         # burn one rebuilt-cache step per queued request; after this
         # many consecutive failures the engine fails fast instead.
         self.max_step_failures = 3
 
-        def step(params, cache, tokens, pos, keys, temps):
+        def step(params, cache, tokens, pos, keys, temps, top_ps, top_ks,
+                 *, filtered: bool):
+            from polyaxon_tpu.models.common import sample_row
+
             logits, cache = family.decode_step_ragged(
                 cfg, params, cache, tokens, pos)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.vmap(jax.random.categorical)(keys, scaled)
-            nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+            if filtered:
+                # Per-row temperature + top-p/top-k fused into the
+                # step — greedy and filtered rows coexist in one
+                # batch; only [slots] token ids cross the host.
+                sampled = jax.vmap(sample_row)(logits, keys, temps,
+                                               top_ps, top_ks)
+            else:
+                # The historical draw, bit-stable for existing seeds —
+                # and no full-vocab sort in the hot loop when nothing
+                # live uses the filters (the common case).
+                scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+                sampled = jax.vmap(jax.random.categorical)(
+                    keys, scaled).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
             return nxt, cache
 
-        self._step = jax.jit(step, donate_argnums=(1,))
+        # Two executables; the loop picks per iteration by whether any
+        # live row actually uses top-p/top-k (same idea as the static
+        # engine's `filtered` compile key).
+        self._step_plain = jax.jit(functools.partial(step, filtered=False),
+                                   donate_argnums=(1,))
+        self._step_filtered = jax.jit(
+            functools.partial(step, filtered=True), donate_argnums=(1,))
 
         @lru_cache(maxsize=16)
         def compiled_prefill(plen: int):
@@ -148,10 +189,12 @@ class ContinuousBatchingEngine:
                                      self.max_len)
 
     def submit(self, tokens: list[int], max_new_tokens: int,
-               temperature: float = 0.0, seed: int = 0) -> _Request:
+               temperature: float = 0.0, seed: int = 0,
+               top_p: float = 1.0, top_k: int = 0) -> _Request:
         self._validate(tokens, max_new_tokens)
+        validate_sampling(top_p, top_k)
         req = _Request(list(tokens), max_new_tokens, float(temperature),
-                       int(seed))
+                       int(seed), float(top_p), int(top_k))
         with self._cv:
             if self._stopped:
                 raise RuntimeError("engine stopped")
@@ -174,6 +217,7 @@ class ContinuousBatchingEngine:
 
     def generate(self, token_rows: list[list[int]], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
+                 top_p: float = 1.0, top_k: int = 0,
                  timeout: Optional[float] = None) -> list[list[int]]:
         if not token_rows:
             return []
@@ -182,7 +226,8 @@ class ContinuousBatchingEngine:
         # not leave its siblings generating discarded output.
         for row in token_rows:
             self._validate(row, max_new_tokens)
-        reqs = [self.submit(row, max_new_tokens, temperature, seed + i)
+        reqs = [self.submit(row, max_new_tokens, temperature, seed + i,
+                            top_p, top_k)
                 for i, row in enumerate(token_rows)]
         try:
             return [r.wait(timeout=timeout) for r in reqs]
@@ -267,6 +312,8 @@ class ContinuousBatchingEngine:
                 self._pos[b] = pos0
                 self._cur[b] = tok0
                 self._temps[b] = req.temperature
+                self._top_ps[b] = req.top_p
+                self._top_ks[b] = req.top_k
                 self._keys[b] = jax.random.key(req.seed)
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 req.error = f"{type(exc).__name__}: {exc}"
@@ -291,12 +338,21 @@ class ContinuousBatchingEngine:
                         return
 
     def stats(self) -> dict:
-        """Live engine counters for /v1/stats."""
+        """Live engine counters + occupancy gauges for /v1/stats."""
         return {
             "engine": "continuous",
             "slots": self.slots,
             "active": sum(1 for r in self._slot_req if r is not None),
             "queued": len(self._queue),
+            "queue_depth_peak": self._queue_depth_peak,
+            "decode_steps": self._steps_total,
+            # Mean fraction of slots live per decode step: ~1.0 means
+            # continuous batching is actually winning; low values with
+            # a deep queue mean admission (prefill) is the bottleneck.
+            "avg_occupancy": (
+                round(self._live_slot_steps
+                      / (self._steps_total * self.slots), 4)
+                if self._steps_total else None),
             "requests_served": self._served,
             "tokens_generated": self._tokens_out,
             "step_failures": self._step_failures,
@@ -308,6 +364,8 @@ class ContinuousBatchingEngine:
         self._slot_req[b] = None
         self._pos[b] = -1
         self._temps[b] = 0.0
+        self._top_ps[b] = 1.0
+        self._top_ks[b] = 0
         if req is not None:
             if req.cancelled and not req.error:
                 req.error = "cancelled"
@@ -331,18 +389,29 @@ class ContinuousBatchingEngine:
             self._admit()
             if self._stopped:  # _admit may fail-fast mid-pass
                 return
-            if all(r is None for r in self._slot_req):
+            self._queue_depth_peak = max(self._queue_depth_peak,
+                                         len(self._queue))
+            live = sum(1 for r in self._slot_req if r is not None)
+            if live == 0:
                 continue
+            self._steps_total += 1
+            self._live_slot_steps += live
             try:
                 keys = jnp.stack([
                     jax.random.fold_in(self._keys[b],
                                        len(r.out) if (r := self._slot_req[b])
                                        else 0)
                     for b in range(self.slots)])
-                nxt, self._cache = self._step(
+                filtered = any(
+                    r is not None and (r.top_p < 1.0 or r.top_k > 0)
+                    for r in self._slot_req)
+                step_fn = (self._step_filtered if filtered
+                           else self._step_plain)
+                nxt, self._cache = step_fn(
                     self.params, self._cache,
                     jnp.asarray(self._cur), jnp.asarray(self._pos),
-                    keys, jnp.asarray(self._temps))
+                    keys, jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ps), jnp.asarray(self._top_ks))
                 nxt = np.asarray(nxt)
             except Exception as exc:  # noqa: BLE001 — fail live requests
                 logger.exception("decode step failed")

@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from polyaxon_tpu.serving.batching import validate_sampling
+
 logger = logging.getLogger(__name__)
 
 
@@ -158,16 +160,24 @@ class _Engine:
         self.seq2seq = bool(getattr(family, "SEQ2SEQ", False))
 
         @functools.lru_cache(maxsize=16)
-        def compiled(prompt_len: int, max_new: int, sampling: bool):
-            # Temperature is a traced scalar, NOT part of the compile
-            # key — only the greedy/sampling mode switches programs, so
-            # a client sweeping temperatures reuses one executable.
-            def run(params, prompt, rng, temperature):
+        def compiled(prompt_len: int, max_new: int, sampling: bool,
+                     filtered: bool):
+            # Temperature/top_p/top_k are traced scalars, NOT part of
+            # the compile key — only the greedy/sampling/filtered mode
+            # switches programs, so a client sweeping knobs reuses one
+            # executable. `filtered` keeps plain-sampling requests on
+            # the historical categorical draw (bit-stable seeds); only
+            # requests that actually set top_p/top_k pay the sorted
+            # nucleus path.
+            def run(params, prompt, rng, temperature, top_p, top_k):
                 # llama: prompt continues; t5: prompt is the encoder
                 # input and generation starts from BOS.
                 return family.generate(
                     self.cfg, params, prompt, max_new_tokens=max_new,
-                    temperature=temperature if sampling else 0.0, rng=rng)
+                    temperature=temperature if sampling else 0.0,
+                    top_p=top_p if filtered else 1.0,
+                    top_k=top_k if filtered else 0,
+                    rng=rng)
 
             return jax.jit(run)
 
@@ -193,14 +203,17 @@ class _Engine:
                 f"max_seq_len {self.cfg.max_seq_len}")
 
     def generate(self, token_rows: list[list[int]], max_new_tokens: int,
-                 temperature: float = 0.0, seed: int = 0) -> list[list[int]]:
+                 temperature: float = 0.0, seed: int = 0,
+                 top_p: float = 1.0, top_k: int = 0) -> list[list[int]]:
         if not token_rows:
             return []
         # Validate every row before running any (no TPU work is spent
         # on a batch that will be rejected).
         for row in token_rows:
             self._validate(row, max_new_tokens)
+        validate_sampling(top_p, top_k)
         sampling = temperature > 0
+        filtered = sampling and (top_p < 1.0 or top_k > 0)
         n_bucket = _bucket(max_new_tokens, lo=16)
         # Rows are grouped by EXACT prompt length — padding a causal
         # prompt (either side) changes what the real tokens attend to,
@@ -213,11 +226,13 @@ class _Engine:
         results: list[Optional[list[int]]] = [None] * len(token_rows)
         for plen, idxs in groups.items():
             batch = np.asarray([token_rows[i] for i in idxs], np.int32)
-            fn = self._compiled(plen, n_bucket, sampling)
+            fn = self._compiled(plen, n_bucket, sampling, filtered)
             with self._lock:
                 out = np.asarray(fn(self.params, jnp.asarray(batch),
                                     jax.random.key(seed),
-                                    jnp.float32(temperature)))
+                                    jnp.float32(temperature),
+                                    jnp.float32(top_p),
+                                    jnp.int32(top_k)))
             for j, i in enumerate(idxs):
                 results[i] = out[j, :max_new_tokens].tolist()
         with self._lock:  # ThreadingHTTPServer: += on ints is not atomic
@@ -272,12 +287,16 @@ class _Handler(BaseHTTPRequestHandler):
             max_new = int(req.get("max_new_tokens", 32))
             temperature = float(req.get("temperature", 0.0))
             seed = int(req.get("seed", 0))
+            top_p = float(req.get("top_p", 1.0))
+            top_k = int(req.get("top_k", 0))
+            validate_sampling(top_p, top_k)
             if req.get("stream"):
                 return self._stream_generate(tokens, max_new, temperature,
-                                             seed)
+                                             seed, top_p, top_k)
             out = self.engine.generate(
                 tokens, max_new_tokens=max_new,
-                temperature=temperature, seed=seed)
+                temperature=temperature, seed=seed,
+                top_p=top_p, top_k=top_k)
             return self._json({"tokens": out})
         except (KeyError, ValueError, TypeError) as exc:
             return self._json({"error": str(exc)}, status=400)
@@ -294,7 +313,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     def _stream_generate(self, token_rows, max_new: int, temperature: float,
-                         seed: int) -> None:
+                         seed: int, top_p: float = 1.0,
+                         top_k: int = 0) -> None:
         """SSE token streaming. With the continuous engine, per-token
         events flow as rows decode (the handler polls each request's
         growing output — appends are GIL-atomic); the static engine
@@ -317,7 +337,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if hasattr(self.engine, "submit"):
                 reqs = [self.engine.submit(row, max_new, temperature,
-                                           seed + i)
+                                           seed + i, top_p, top_k)
                         for i, row in enumerate(token_rows)]
                 emitted = [0] * len(reqs)
                 while True:
@@ -340,7 +360,8 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 out = self.engine.generate(
                     token_rows, max_new_tokens=max_new,
-                    temperature=temperature, seed=seed)
+                    temperature=temperature, seed=seed,
+                    top_p=top_p, top_k=top_k)
                 for i, row in enumerate(out):
                     for tok in row:
                         self._sse({"index": i, "token": tok})

@@ -261,7 +261,7 @@ class TestContinuousBatching:
             calls["n"] += 1
             raise RuntimeError("RESOURCE_EXHAUSTED: persistent OOM")
 
-        engine._step = broken_step
+        engine._step_plain = engine._step_filtered = broken_step
         try:
             reqs = [engine.submit([1, 2, 3], 4) for _ in range(6)]
             errs = []
@@ -394,7 +394,7 @@ class TestContinuousBatching:
         cfg, params = load_params("llama_tiny", seed=0)
         engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
                                           slots=1, max_len=32)
-        real_step = engine._step
+        real_step = engine._step_plain
         calls = {"n": 0}
 
         def flaky_step(*args, **kwargs):
@@ -403,7 +403,7 @@ class TestContinuousBatching:
                 raise RuntimeError("transient")
             return real_step(*args, **kwargs)
 
-        engine._step = flaky_step
+        engine._step_plain = flaky_step
         try:
             r1 = engine.submit([1, 2, 3], 4)
             with pytest.raises(RuntimeError, match="transient"):
@@ -628,3 +628,173 @@ class TestStats:
             assert stats["tokens_generated"] == 8
             if batching == "continuous":
                 assert stats["active"] == 0 and stats["queued"] == 0
+
+    def test_occupancy_gauges_during_burst(self):
+        """A burst of more requests than slots must surface in the
+        occupancy gauges: queue_depth_peak >= 1 and avg_occupancy in
+        (0, 1] — the number that says continuous batching is winning
+        (VERDICT r2 item 5)."""
+        with ServingServer("llama_tiny", seed=0, batching="continuous",
+                           slots=2) as s:
+            rows = [[5, 6, 7], [9, 8, 7], [1, 2, 3], [4, 5, 6]]
+            _post(s.url, {"tokens": rows, "max_new_tokens": 6},
+                  timeout=300)
+            with urllib.request.urlopen(s.url + "/v1/stats",
+                                        timeout=10) as r:
+                stats = json.load(r)
+        assert stats["decode_steps"] > 0
+        assert stats["queue_depth_peak"] >= 1  # 4 requests, 2 slots
+        assert stats["avg_occupancy"] is not None
+        assert 0.0 < stats["avg_occupancy"] <= 1.0
+
+
+class TestSampling:
+    """top-p/top-k fused into the compiled step (VERDICT r2 item 5):
+    distribution checks at fixed seed, greedy-equivalence over HTTP
+    for all families, and request validation."""
+
+    def test_top_k_one_is_argmax(self):
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.common import sample_row
+
+        logits = jnp.asarray([0.3, 2.0, -1.0, 1.4, 0.0])
+        for seed in range(8):
+            tok = sample_row(logits, jax.random.key(seed),
+                             jnp.float32(3.0), jnp.float32(1.0),
+                             jnp.int32(1))
+            assert int(tok) == 1
+
+    def test_top_k_distribution_matches_renormalized_softmax(self):
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.common import sample_row
+
+        logits = jnp.asarray([2.0, 1.5, 0.5, -0.5, -3.0, 1.0])
+        n = 4000
+        keys = jax.random.split(jax.random.key(0), n)
+        draws = np.asarray(jax.vmap(
+            lambda k: sample_row(logits, k, jnp.float32(1.0),
+                                 jnp.float32(1.0), jnp.int32(2)))(keys))
+        assert set(np.unique(draws)) <= {0, 1}  # only the top-2 ids
+        p = jax.nn.softmax(jnp.asarray([2.0, 1.5]))  # renormalized pair
+        freq0 = float(np.mean(draws == 0))
+        assert abs(freq0 - float(p[0])) < 0.03
+
+    def test_top_p_keeps_minimal_nucleus(self):
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.common import sample_row
+
+        # softmax ≈ [0.63, 0.23, 0.09, 0.03, 0.01]: p=0.5 → nucleus is
+        # exactly the argmax; p=0.8 → the top-2.
+        logits = jnp.asarray([3.0, 2.0, 1.0, 0.0, -1.0])
+        keys = jax.random.split(jax.random.key(1), 500)
+
+        def draw(p):
+            return np.asarray(jax.vmap(
+                lambda k: sample_row(logits, k, jnp.float32(1.0),
+                                     jnp.float32(p), jnp.int32(0)))(keys))
+
+        assert set(np.unique(draw(0.5))) == {0}
+        assert set(np.unique(draw(0.8))) <= {0, 1}
+
+    def test_plain_sampling_bit_stable_with_historical_draw(self):
+        """sample_logits with filters disabled must reproduce the exact
+        jax.random.categorical draw older clients' seeds produced."""
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models.common import sample_logits
+
+        logits = jax.random.normal(jax.random.key(3), (4, 16))
+        key = jax.random.key(7)
+        want = jax.random.categorical(key, logits / 0.7, axis=-1)
+        got = sample_logits(logits, key, jnp.float32(0.7))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("model,batching", [
+        ("llama_tiny", "static"), ("llama_tiny", "continuous"),
+        ("t5_tiny", "static"), ("t5_tiny", "continuous"),
+    ])
+    def test_top_k_one_equals_greedy_over_http(self, model, batching):
+        """temperature high + top_k=1 must equal greedy output for
+        every family on both engines — the end-to-end proof the filter
+        runs inside the step."""
+        kw = {"batching": batching, "slots": 2} if batching == "continuous" \
+            else {}
+        with ServingServer(model, seed=0, **kw) as s:
+            greedy = _post(s.url, {"tokens": [[5, 6, 7]],
+                                   "max_new_tokens": 6}, timeout=300)
+            topk1 = _post(s.url, {"tokens": [[5, 6, 7]],
+                                  "max_new_tokens": 6,
+                                  "temperature": 4.0, "top_k": 1,
+                                  "seed": 9}, timeout=300)
+        assert topk1["tokens"] == greedy["tokens"]
+
+    def test_invalid_sampling_params_rejected(self, server):
+        for payload in ({"top_p": 0.0}, {"top_p": 1.5}, {"top_k": -1}):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(server.url, {"tokens": [[1, 2]],
+                                   "max_new_tokens": 2, **payload})
+            assert err.value.code == 400
+
+    def test_direct_engine_callers_validated_too(self):
+        """Range checks live in the engines, not just the HTTP layer:
+        a Python caller passing top_p=0 must get a ValueError, not a
+        silent argmax degeneration."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        try:
+            with pytest.raises(ValueError, match="top_p"):
+                engine.submit([1, 2], 2, temperature=1.0, top_p=0.0)
+            with pytest.raises(ValueError, match="top_k"):
+                engine.submit([1, 2], 2, temperature=1.0, top_k=-1)
+        finally:
+            engine.stop()
+
+    def test_plain_temperature_continuous_seed_stable(self):
+        """The continuous engine keeps the historical per-row
+        categorical draw when no filter is active — the filtered step
+        variant (full-vocab sort) only engages for rows that use
+        top_p/top_k, so pre-existing (seed → tokens) mappings hold."""
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        try:
+            got = engine.submit([5, 6, 7], 3, temperature=0.8,
+                                seed=42).wait(timeout=300)
+            # Reference: the engine's documented draw — fold_in(step)
+            # per emitted token over the ragged decode step's logits.
+            cache = engine._family_mod.cb_init_cache(cfg, 1, 32)
+            pos0, tok0, pre = engine._family_mod.cb_admission([5, 6, 7])
+            row_cache = engine._family_mod.cb_prefill(
+                cfg, params, jnp.asarray([pre], jnp.int32), 32)
+            cache = engine._family_mod.insert_cache_row(
+                cache, row_cache, jnp.int32(0))
+            key, cur, pos, want = jax.random.key(42), tok0, pos0, []
+            for step_i in range(3):
+                logits, cache = llama.decode_step_ragged(
+                    cfg, params, cache, jnp.asarray([cur], jnp.int32),
+                    jnp.asarray([pos], jnp.int32))
+                k = jax.random.fold_in(key, step_i)
+                nxt = int(jax.random.categorical(k, logits[0] / 0.8))
+                want.append(nxt)
+                cur, pos = nxt, pos + 1
+            assert got == want
+        finally:
+            engine.stop()
