@@ -25,16 +25,8 @@ import os
 import subprocess
 import sys
 
-# bf16 peak matmul throughput per chip, for MFU. Keyed by substring of
-# jax's device_kind; unknown kinds (e.g. the CPU test mesh) report
-# mfu=null rather than a fabricated number.
-_PEAK_FLOPS = {
-    "v5 lite": 197e12,  # v5e ("TPU v5 lite")
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v4": 275e12,
-    "v6": 918e12,  # Trillium
-}
+# FLOPs accounting + peak tables live in the package so the runtime
+# loop self-reports the same MFU numbers (runtime/flops.py).
 
 # (metric, unit) of the mode actually running — set once args are
 # parsed; the probe-failure path and the top-level catch-all both use it
@@ -86,40 +78,15 @@ def _probe_backend(timeout_s: float = 90.0):
 
 
 def _peak_flops(device_kind: str):
-    kind = (device_kind or "").lower()
-    for key, peak in _PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return None
+    from polyaxon_tpu.runtime.flops import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def _flops_per_token(model: str, seq: int, param_count: int):
-    """Training FLOPs per token: 6N for the *active* matmul params
-    (fwd 2N + bwd 4N) plus the causal-attention score/value matmuls
-    (6 * n_layers * seq * d_model fwd+bwd after halving for causality).
+    from polyaxon_tpu.runtime.flops import train_flops_per_token
 
-    For MoE models only K of E experts run per token, so N is the
-    dense params plus K/E of the expert-FFN params — counting all
-    experts would overstate tflops/MFU by roughly E/K on the FFN
-    share. Families without a derivation here (vit/bert/resnet/...)
-    return None → mfu reported null rather than wrong."""
-    try:
-        from polyaxon_tpu.models import llama, moe
-
-        cfg = llama.CONFIGS.get(model)
-        if cfg is not None:
-            return 6 * param_count + 6 * cfg.n_layers * seq * cfg.dim
-        mcfg = moe.CONFIGS.get(model)
-        if mcfg is not None:
-            expert_params = (mcfg.n_layers * mcfg.n_experts
-                             * 3 * mcfg.dim * mcfg.ffn_dim)
-            active = (param_count - expert_params
-                      + expert_params * mcfg.experts_per_token
-                      // mcfg.n_experts)
-            return 6 * active + 6 * mcfg.n_layers * seq * mcfg.dim
-    except Exception:
-        pass
-    return None
+    return train_flops_per_token(model, seq, param_count)
 
 
 def _emit_error(error: str, rc: int = 1) -> int:

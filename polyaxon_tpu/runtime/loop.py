@@ -177,6 +177,20 @@ def run_jaxjob(
         first_batch = next(batches)
         state, metrics = train_step(state, first_batch, step_rng)
         jax.block_until_ready(metrics["loss"])
+
+        # Per-step MFU self-reporting (SURVEY §5.1): every emission
+        # carries tokens/sec + achieved TFLOPs/chip, and MFU when both
+        # the analytic FLOPs/token and the chip's peak are known
+        # (CPU mesh → flops known, peak unknown → mfu omitted).
+        from polyaxon_tpu.runtime.flops import peak_flops, train_flops_per_token
+
+        n_chips = int(mesh.devices.size)
+        flops_unit = (train_flops_per_token(cfg.model, seq, int(n_params))
+                      if model_def.unit == "tokens" else None)
+        peak = peak_flops(getattr(jax.devices()[0], "device_kind", ""))
+        t_emit = time.perf_counter()
+        steps_since_emit = 0
+
         t0 = time.perf_counter()
         timed_steps = 0
         for step in range(start_step + 1, cfg.steps):
@@ -189,14 +203,37 @@ def run_jaxjob(
             batch = next(batches)
             state, metrics = train_step(state, batch, step_rng)
             timed_steps += 1
+            steps_since_emit += 1
             if profiling:
                 jax.block_until_ready(metrics["loss"])
                 jax.profiler.stop_trace()
             if on_metrics and (step % cfg.log_every == 0 or step == cfg.steps - 1):
                 vals = {k: float(v) for k, v in metrics.items()}
+                # Rolling window since the last emission; block so the
+                # window covers completed device work, not dispatch.
+                jax.block_until_ready(metrics["loss"])
+                window = time.perf_counter() - t_emit
+                if window > 0 and steps_since_emit:
+                    ups = units_per_step * steps_since_emit / window
+                    vals[f"{model_def.unit}_per_sec"] = ups
+                    vals["step_time_ms"] = 1e3 * window / steps_since_emit
+                    if flops_unit:
+                        achieved = ups * flops_unit / n_chips
+                        vals["tflops_per_sec_per_chip"] = achieved / 1e12
+                        if peak:
+                            vals["mfu"] = achieved / peak
+                steps_since_emit = 0
                 on_metrics(step, vals)
+                # Stamp AFTER the callback: tracking I/O must not
+                # deflate the next window's reported throughput.
+                t_emit = time.perf_counter()
             if ckpt and ckpt.should_save(step):
+                t_save = time.perf_counter()
                 ckpt.save(step, state)
+                # Exclude (synchronous) checkpoint time too — an MFU
+                # dip every save interval would make real regressions
+                # indistinguishable from checkpoint cadence.
+                t_emit += time.perf_counter() - t_save
         jax.block_until_ready(state["params"])
         wall = time.perf_counter() - t0
         final_metrics = {k: float(v) for k, v in metrics.items()}

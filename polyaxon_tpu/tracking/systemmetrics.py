@@ -1,10 +1,15 @@
 """System-metrics processors: host (psutil) + TPU (libtpu / device API).
 
 Parity: traceml's processors thread samples psutil + NVML every N s
-(SURVEY.md §5.1 [K]); the TPU build replaces NVML with libtpu-derived
-metrics [B]. On this stack the portable surface is
-``device.memory_stats()`` (PJRT) — duty-cycle/ICI counters land with the
-C++ libtpu shim (SURVEY §2a note 3) when real hardware is present.
+(SURVEY.md §5.1 [K]); the TPU build replaces NVML with two layers of
+TPU metrics (SURVEY §2a note 3):
+
+- ``device.memory_stats()`` (PJRT) — HBM usage, portable everywhere;
+- the **libtpu monitoring SDK** (``libtpu.sdk.tpumonitoring``) — duty
+  cycle, TensorCore utilization, ICI link health, throttle score —
+  probed behind import guards and a one-time availability latch, so
+  hosts without real TPU hardware (or with an older libtpu) degrade
+  silently to psutil + HBM.
 """
 
 from __future__ import annotations
@@ -61,6 +66,57 @@ def tpu_metrics() -> dict[str, float]:
     return out
 
 
+# libtpu metric name → emitted key prefix. Values parse per-chip where
+# the SDK reports lists. Unavailable metrics (older libtpu, no real
+# chip) are skipped per-name; a failing SDK disables itself once.
+_LIBTPU_METRICS = {
+    "duty_cycle_pct": "tpu{i}_duty_cycle_pct",
+    "tensorcore_util": "tpu{i}_tensorcore_util",
+    "ici_link_health": "tpu{i}_ici_link_health",
+    "tpu_throttle_score": "tpu{i}_throttle_score",
+}
+_libtpu_state: dict = {"disabled": False}
+
+
+def libtpu_metrics() -> dict[str, float]:
+    """Duty cycle / TensorCore utilization / ICI link health via the
+    libtpu monitoring SDK — the metrics NVML provides upstream (SURVEY
+    §5.1). Best-effort: returns {} without real TPU hardware. A raising
+    SDK latches disabled so the sampler never retries a dead surface;
+    per-metric failures (unsupported on this libtpu) skip that metric
+    only."""
+    out: dict[str, float] = {}
+    if _libtpu_state["disabled"]:
+        return out
+    try:
+        from libtpu.sdk import tpumonitoring
+    except Exception:
+        _libtpu_state["disabled"] = True
+        return out
+    try:
+        supported = _libtpu_state.get("supported")
+        if supported is None:
+            supported = set(tpumonitoring.list_supported_metrics())
+            _libtpu_state["supported"] = supported
+    except Exception:
+        _libtpu_state["disabled"] = True
+        return out
+    for name, key_fmt in _LIBTPU_METRICS.items():
+        if name not in supported:
+            continue
+        try:
+            data = tpumonitoring.get_metric(name).data()
+        except Exception:
+            continue  # snapshot unavailable right now; not fatal
+        for i, raw in enumerate(data if isinstance(data, (list, tuple))
+                                else [data]):
+            try:
+                out[key_fmt.format(i=i)] = float(raw)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
 class SystemMetricsMonitor:
     """Background sampler thread; emits through a callback (the tracking
     Run wires it to ``log_metrics(kind='system')``)."""
@@ -81,6 +137,7 @@ class SystemMetricsMonitor:
         metrics = host_metrics()
         if self.include_tpu:
             metrics.update(tpu_metrics())
+            metrics.update(libtpu_metrics())
         return metrics
 
     def _loop(self) -> None:

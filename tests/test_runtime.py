@@ -77,6 +77,21 @@ class TestTrainLoop:
         run_jaxjob(tiny_job(steps=6), on_metrics=lambda s, m: seen.append((s, m)))
         assert seen and all("loss" in m for _, m in seen)
 
+    def test_metrics_self_report_throughput_and_tflops(self, cpu_devices):
+        """Every emission carries the MFU self-report (VERDICT r2 item
+        4): tokens/sec + step time always; achieved TFLOPs/chip for
+        families with a FLOPs derivation (llama); mfu only when the
+        chip's peak is known — absent on the CPU mesh, never wrong."""
+        seen = []
+        run_jaxjob(tiny_job(steps=6),
+                   on_metrics=lambda s, m: seen.append(m))
+        assert seen
+        for m in seen:
+            assert m["tokens_per_sec"] > 0
+            assert m["step_time_ms"] > 0
+            assert m["tflops_per_sec_per_chip"] > 0  # llama_tiny derives
+            assert "mfu" not in m  # cpu device_kind has no peak entry
+
     def test_grad_accumulation_matches_full_batch(self, cpu_devices):
         """k microbatches accumulated in-step must produce the same
         update as one full-batch step (mean-of-grads == grad-of-mean for
