@@ -105,6 +105,166 @@ def _emit_error(error: str, rc: int = 1) -> int:
     return rc
 
 
+def estimate_bench(model: str, seq: int, per_chip_batch: int,
+                   target_chips: int) -> int:
+    """Roofline projection for models too big to measure on one chip
+    (VERDICT r2 item 8 / SURVEY §6 north star: llama3_8b FSDP on
+    v5e-64). Compiles the REAL sharded train step (8-device virtual
+    CPU mesh, FSDP rules, abstract inputs — no weights materialized)
+    as a does-it-compile + memory check, and projects tokens/sec/chip
+    as ``bf16 peak / analytic flops_per_token × measured MFU``.
+
+    Why the projection is ANALYTIC flops × measured MFU rather than
+    raw cost-analysis output: XLA's HLO cost analysis counts a
+    ``lax.scan`` body ONCE regardless of trip count (the layer stack),
+    undercounting flops ~n_layers-fold, and its bytes-accessed ignores
+    fusion — both were verified empirically to produce a "roofline"
+    BELOW the already-measured 200M throughput. The compile is still
+    load-bearing: it validates that the sharded step program for the
+    target model actually compiles on the FSDP mesh, and its XLA
+    memory analysis is reported as an HBM-fit diagnostic.
+
+    Labeled assumptions (also emitted in the JSON):
+    - per-device program ≈ the v5e-64 one at equal per-chip batch
+      (FSDP all-gather/reduce-scatter volumes are shard-count-
+      invariant; ICI latency differences ignored);
+    - v5e peak 197 bf16 TFLOP/s; roofline = peak / flops_per_token is
+      the MFU=1 UPPER BOUND;
+    - the realistic line transfers the MEASURED MFU of the recorded
+      bench_baseline.json run (same kernels, same FSDP rules) to the
+      target model — absent a measured baseline only the bound is
+      reported;
+    - CPU-backend compile: einsum attention stands in for the Pallas
+      kernel, so the memory diagnostic OVERSTATES activation temps at
+      long seq (the S^2 score tensor never exists on the TPU path).
+    """
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    from polyaxon_tpu.models import get_model
+    from polyaxon_tpu.parallel.sharding import rules_for_mesh
+    from polyaxon_tpu.runtime.config import RuntimeConfig
+    from polyaxon_tpu.runtime.flops import PEAK_FLOPS, train_flops_per_token
+    from polyaxon_tpu.runtime.optim import build_optimizer
+    from polyaxon_tpu.runtime.step import build_init, build_train_step
+
+    V5E_PEAK = PEAK_FLOPS["v5e"]
+    V5E_HBM_GB = 16.0  # per chip
+
+    def compile_check(model_name: str, seq_len: int, batch_per_chip: int):
+        """Compile the real sharded step with abstract inputs (no
+        weights materialized) → (param_count, memory diagnostic)."""
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:8]).reshape(1, 8), ("dp", "fsdp"))
+        cfg = RuntimeConfig(model=model_name, steps=1, seq_len=seq_len)
+        # remat must reach the MODEL config (the measured baseline runs
+        # with dots remat; the memory diagnostic should describe the
+        # same program).
+        model_def = get_model(model_name, max_seq_len=seq_len,
+                              remat="dots")
+        rules = rules_for_mesh(mesh)
+        optimizer = build_optimizer(cfg)
+        with mesh:
+            init_fn = build_init(model_def, optimizer, mesh, rules)
+            train_step = build_train_step(model_def, optimizer, mesh, rules)
+            rng_aval = jax.eval_shape(lambda: jax.random.key(0))
+            state_aval = jax.eval_shape(init_fn, rng_aval)
+            batch_aval = {"tokens": jax.ShapeDtypeStruct(
+                (batch_per_chip * 8, seq_len), jnp.int32)}
+            compiled = jax.jit(train_step).lower(
+                state_aval, batch_aval, rng_aval).compile()
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree.leaves(state_aval["params"]))
+        mem = {}
+        try:
+            ma = compiled.memory_analysis()
+            if isinstance(ma, (list, tuple)):
+                ma = ma[0]
+            # memory_analysis describes the per-device SPMD executable.
+            mem = {
+                "state_gb_per_chip": round(
+                    ma.argument_size_in_bytes / 2**30, 2),
+                "temp_gb_per_chip": round(
+                    ma.temp_size_in_bytes / 2**30, 2),
+            }
+        except Exception:
+            pass
+        return n_params, mem
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    measured_mfu = None
+    measured_ref = None
+    try:
+        with open(baseline_path) as fh:
+            prior = json.load(fh)
+        measured = prior.get("tokens_per_sec_per_chip")
+        if measured and prior.get("backend") == "tpu":
+            ref_flops = train_flops_per_token(
+                prior["model"], prior["seq"], prior["params"])
+            # MFU must be computed against the peak of the chip the
+            # baseline was MEASURED on (which may not be a v5e).
+            ref_peak = _peak_flops(prior.get("device_kind", ""))
+            if ref_flops and ref_peak:
+                measured_mfu = measured * ref_flops / ref_peak
+                measured_ref = (f"{prior['model']} seq{prior['seq']} "
+                                f"{measured:.0f} tok/s/chip on "
+                                f"{prior.get('device_kind')}")
+    except (OSError, json.JSONDecodeError, KeyError):
+        pass
+
+    n_params, mem = compile_check(model, seq, per_chip_batch)
+    flops_tok = train_flops_per_token(model, seq, n_params)
+    if not flops_tok:
+        return _emit_error(f"no flops derivation for {model}", rc=1)
+    roof = V5E_PEAK / flops_tok  # tokens/sec/chip at MFU=1
+    projected = roof * measured_mfu if measured_mfu else None
+    print(json.dumps({
+        "metric": f"estimate_tokens_per_sec_per_chip[{model},seq{seq},"
+                  f"v5e-{target_chips},fsdp]",
+        "value": round(projected if projected else roof, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "kind": ("mfu_transfer_estimate" if projected
+                 else "roofline_upper_bound_mfu1"),
+        "roofline_upper_bound_mfu1": round(roof, 2),
+        "assumed_mfu": round(measured_mfu, 4) if measured_mfu else None,
+        "mfu_source": measured_ref or "none (no measured TPU baseline)",
+        "params": n_params,
+        "flops_per_token": flops_tok,
+        "sharded_step_compiles": True,
+        "memory_diagnostic": {
+            **mem,
+            "hbm_gb_per_chip": V5E_HBM_GB,
+            "caveat": "cpu compile; einsum attention inflates temps "
+                      "(the TPU flash path never builds S^2 scores)",
+        },
+        "assumptions": {
+            "per_chip_batch": per_chip_batch,
+            "target": f"v5e-{target_chips} fsdp",
+            "peak_bf16_tflops": V5E_PEAK / 1e12,
+            "mfu_transfer": "target achieves the measured baseline "
+                            "run's MFU (same kernels + FSDP rules); "
+                            "ICI scale-out losses ignored",
+            "flops_model": "6N(active) + causal attention term "
+                           "(runtime/flops.py)",
+            "cost_analysis_not_used": "XLA HLO cost analysis counts "
+                                      "lax.scan bodies once and "
+                                      "ignores fusion for bytes — "
+                                      "verified to undercount vs "
+                                      "measured 200M throughput",
+        },
+    }))
+    return 0
+
+
 def tuner_bench(smoke: bool = False) -> int:
     """Polytune trials/hour: a Hyperband LR sweep whose trials are real
     JAXJobs driven by the embedded plane + agent (the BASELINE "trials/
@@ -217,7 +377,21 @@ def main() -> int:
                         help="measure Polytune throughput instead: a "
                              "Hyperband LR sweep of JAXJob trials, "
                              "reported as trials/hour (BASELINE metric 2)")
+    parser.add_argument("--estimate", metavar="MODEL", default=None,
+                        help="no measurement: compiled-HLO roofline "
+                             "projection of tokens/sec/chip for MODEL "
+                             "(e.g. llama3_8b) on a v5e-64 FSDP mesh, "
+                             "calibrated by the measured baseline when "
+                             "one exists")
+    parser.add_argument("--estimate-chips", type=int, default=64,
+                        help="target slice size for --estimate")
     args = parser.parse_args()
+
+    if args.estimate:
+        _ACTIVE[:] = [f"estimate_tokens_per_sec_per_chip[{args.estimate}]",
+                      "tokens/sec/chip"]
+        return estimate_bench(args.estimate, args.seq or 8192,
+                              args.batch or 8, args.estimate_chips)
 
     if args.tuner:
         _ACTIVE[:] = ["polytune_hyperband_trials_per_hour", "trials/hour"]

@@ -60,6 +60,7 @@ SMOKE_NODES = (
     "test_tune.py::TestOneShotManagers",
     "test_tune.py::TestHyperband::test_rung_shapes_paper_table",
     "test_convert_decode.py::TestDecode::test_decode_step_logits_match_forward",
+    "test_acceptance.py::TestEstimate",
 )
 
 

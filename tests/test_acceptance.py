@@ -130,3 +130,31 @@ class TestNorthStar:
             steps_by_rung.setdefault(rung, set()).add(
                 t.meta["trial_params"]["steps"])
         assert min(steps_by_rung[max(rungs)]) > min(steps_by_rung[0])
+
+
+class TestEstimate:
+    def test_bench_estimate_contract(self):
+        """bench.py --estimate: the roofline/MFU-transfer projection
+        (VERDICT r2 item 8) emits one JSON line with labeled
+        assumptions and proves the sharded step compiles — exercised
+        on the tiny config so CI stays fast."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--estimate", "llama_tiny", "--seq", "64", "--batch", "2"],
+            capture_output=True, text=True, timeout=600, cwd=repo)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["unit"] == "tokens/sec/chip"
+        assert line["value"] > 0
+        assert line["sharded_step_compiles"] is True
+        assert line["roofline_upper_bound_mfu1"] >= line["value"]
+        assert line["kind"] in ("mfu_transfer_estimate",
+                                "roofline_upper_bound_mfu1")
+        assert "peak_bf16_tflops" in line["assumptions"]
+        assert line["flops_per_token"] > 0
