@@ -247,18 +247,30 @@ class _Handler(BaseHTTPRequestHandler):
         if action == "artifacts":
             if len(rest) > 2:
                 return self._artifact(uuid, "/".join(rest[2:]))
+            if (query.get("detail") or ["0"])[0] in ("1", "true"):
+                return self._json(plane.streams.list_artifacts_detail(uuid))
             return self._json(plane.streams.list_artifacts(uuid))
         raise ApiError(404, f"unknown sub-resource {action}")
 
     def _artifact(self, uuid: str, rel: str) -> None:
+        import mimetypes
         import os
 
         path = self.plane.streams.artifact_path(uuid, rel)
         if not os.path.isfile(path):
             raise ApiError(404, f"artifact {rel} not found")
         size = os.path.getsize(path)
+        # Real content types so the dashboard renders logged images/
+        # html inline (a jsonl/log/unknown file stays a download).
+        # CSP sandbox: artifacts are run-produced content served from
+        # the API origin — an html/svg artifact must render without
+        # script execution or API credentials (stored-XSS guard).
+        ctype = (mimetypes.guess_type(path)[0]
+                 or "application/octet-stream")
         self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Type", ctype)
+        self.send_header("X-Content-Type-Options", "nosniff")
+        self.send_header("Content-Security-Policy", "sandbox")
         self.send_header("Content-Length", str(size))
         self.end_headers()
         with open(path, "rb") as fh:

@@ -342,6 +342,63 @@ function imageCard(uuid, name, ev) {
   </div>`;
 }
 
+function fmtSize(n) {
+  if (n == null) return "";
+  if (n >= 1 << 30) return (n / (1 << 30)).toFixed(2) + " GB";
+  if (n >= 1 << 20) return (n / (1 << 20)).toFixed(1) + " MB";
+  if (n >= 1024) return (n / 1024).toFixed(1) + " KB";
+  return n + " B";
+}
+
+function artUrl(uuid, rel) {
+  const enc = String(rel).split("/").map(encodeURIComponent).join("/");
+  return `/api/v1/default/default/runs/${encodeURIComponent(uuid)}/artifacts/${enc}`;
+}
+
+function artifactsPanel(uuid, lineage, files) {
+  // Run-detail artifact browser: lineage records (kind/name/size) with
+  // download links through the streams service, inline <img> for
+  // image artifacts and open-in-tab for html (served with real
+  // content types), plus the full file listing.
+  if (!lineage.length && !files.length) return "";
+  const isImg = (p) => /\.(png|jpe?g|gif|svg|webp)$/i.test(p);
+  const isHtml = (p) => /\.html?$/i.test(p);
+  const rows = lineage.map((r) => {
+    const rel = r.rel_path;
+    const label = esc(r.name || rel || "(external)");
+    // Directories aren't downloadable through the file route — their
+    // contents appear in the file listing below.
+    const link = rel && !r.is_dir
+      ? `<a class="uuid" href="${esc(artUrl(uuid, rel))}" download>${label}</a>`
+      : label;
+    let preview = "";
+    if (r.is_dir) {
+      preview = "";
+    } else if (rel && isImg(rel)) {
+      preview = `<img src="${esc(artUrl(uuid, rel))}" alt="${label}"
+                   style="max-height:72px;border-radius:4px">`;
+    } else if (rel && isHtml(rel)) {
+      preview = `<a class="uuid" href="${esc(artUrl(uuid, rel))}" target="_blank">open</a>`;
+    }
+    return `<tr><td>${esc(r.kind || "artifact")}</td><td>${link}</td>
+      <td class="num">${fmtSize(r.size_bytes)}</td><td>${preview}</td></tr>`;
+  }).join("");
+  const MAX_FILES = 200;
+  const fileRows = files.slice(0, MAX_FILES).map((f) =>
+    `<tr><td><a class="uuid" href="${esc(artUrl(uuid, f.path))}" download>${esc(f.path)}</a></td>
+     <td class="num">${fmtSize(f.size_bytes)}</td></tr>`).join("");
+  return `<details class="chart" style="margin-top:14px" open>
+    <summary style="cursor:pointer;font-weight:600;font-size:13px">artifacts
+      <span class="sub">${files.length} file${files.length === 1 ? "" : "s"}${
+        lineage.length ? ` · ${lineage.length} lineage record${lineage.length === 1 ? "" : "s"}` : ""}</span></summary>
+    ${rows ? `<table style="margin-top:8px" aria-label="lineage artifacts">
+      <tr><th>kind</th><th>artifact</th><th>size</th><th>preview</th></tr>${rows}</table>` : ""}
+    ${fileRows ? `<div style="max-height:220px;overflow:auto;margin-top:8px">
+      <table aria-label="artifact files"><tr><th>file</th><th>size</th></tr>${fileRows}</table></div>` : ""}
+    ${files.length > MAX_FILES ? `<div class="sub">showing ${MAX_FILES} of ${files.length} files</div>` : ""}
+  </details>`;
+}
+
 const SERIES = [1, 2, 3, 4, 5, 6].map(i => `var(--series-${i})`);
 
 function overlayChart(name, seriesList) {
@@ -478,6 +535,13 @@ async function showRun(uuid, opts) {
     api(`/api/v1/default/default/runs/${uuid}/events?kind=histogram`).catch(() => ({})),
   ]);
   const isSweep = run.kind === "matrix";
+  // Artifact listing stats the whole run tree server-side — skip it
+  // for sweeps (their artifacts live in child runs) so the 5 s live
+  // rerender loop doesn't re-walk the tree forever.
+  const [lineage, files] = isSweep ? [[], []] : await Promise.all([
+    api(`/api/v1/default/default/runs/${uuid}/lineage`).catch(() => []),
+    api(`/api/v1/default/default/runs/${uuid}/artifacts?detail=1`).catch(() => []),
+  ]);
   const sweep = isSweep ? await sweepView(run) : "";
   if (gen !== renderGen) return;  // user navigated mid-fetch
   const charts = Object.entries(metrics)
@@ -493,6 +557,8 @@ async function showRun(uuid, opts) {
     ${sweep}
     <div class="charts">${charts || (isSweep ? "" : "<div class='sub' style='color:var(--muted)'>no metrics yet</div>")}</div>
     ${media ? `<div class="charts">${media}</div>` : ""}
+    ${artifactsPanel(uuid, Array.isArray(lineage) ? lineage : [],
+                     Array.isArray(files) ? files : [])}
     <div id="logs" aria-label="run logs"${isSweep ? " hidden" : ""}></div>`;
   for (const el of detail.querySelectorAll(".chart")) wireChart(el);
   for (const chip of detail.querySelectorAll(".chip")) {

@@ -57,10 +57,45 @@ class StreamsService:
 
     def get_lineage(self, run_uuid: str) -> list[dict]:
         """Artifact-lineage records appended by tracking.log_artifact /
-        log_model (upstream's artifact-lineage API surface)."""
+        log_model (upstream's artifact-lineage API surface), enriched
+        with ``rel_path`` (run-dir-relative, usable against the
+        artifacts download route) and ``size_bytes`` when the recorded
+        path still exists under the run tree — the fields the
+        dashboard's artifact browser lists."""
         from polyaxon_tpu.tracking.events import read_jsonl
 
-        return read_jsonl(os.path.join(self.run_dir(run_uuid), "lineage.jsonl"))
+        root = os.path.abspath(self.run_dir(run_uuid))
+        records = read_jsonl(os.path.join(root, "lineage.jsonl"))
+        for rec in records:
+            path = os.path.abspath(str(rec.get("path", "")))
+            if not path.startswith(root + os.sep):
+                continue  # registered without copy: outside the run tree
+            if not os.path.exists(path):
+                continue  # deleted/not-yet-synced: no dead links
+            rec["rel_path"] = os.path.relpath(path, root).replace(os.sep, "/")
+            rec["is_dir"] = os.path.isdir(path)
+            try:
+                rec["size_bytes"] = (
+                    sum(os.path.getsize(os.path.join(r, f))
+                        for r, _, fs in os.walk(path) for f in fs)
+                    if rec["is_dir"] else os.path.getsize(path))
+            except OSError:
+                pass
+        return records
+
+    def list_artifacts_detail(self, run_uuid: str,
+                              prefix: str = "") -> list[dict]:
+        """File listing with sizes, for the dashboard browser."""
+        root = os.path.abspath(self.run_dir(run_uuid))
+        out = []
+        for rel in self.list_artifacts(run_uuid, prefix):
+            try:
+                size = os.path.getsize(os.path.join(root, rel))
+            except OSError:
+                continue  # vanished mid-listing
+            out.append({"path": rel.replace(os.sep, "/"),
+                        "size_bytes": size})
+        return out
 
     # -- logs -------------------------------------------------------------
     def log_files(self, run_uuid: str) -> list[str]:

@@ -192,6 +192,71 @@ class TestApi:
         assert len(lineage) == 1
         assert lineage[0]["name"] == "model.bin"
         assert lineage[0]["kind"] == "model"
+        # Browser enrichment: records carry rel_path + size so the
+        # dashboard lists and downloads them (VERDICT r2 item 7).
+        assert lineage[0]["rel_path"].endswith("model.bin")
+        assert lineage[0]["size_bytes"] == len("weights")
+
+    def test_artifact_browser_endpoints(self, stack):
+        """The run-detail artifact browser's API surface end-to-end:
+        detail listing with sizes, enriched lineage, and inline-
+        renderable content types on download."""
+        import json as _json
+        import textwrap
+        import urllib.request
+
+        _, server = stack
+        run = RunClient(host=server.url)
+        script = textwrap.dedent(
+            """
+            import os
+            from polyaxon_tpu.tracking import Run
+            d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+            with Run(os.environ["POLYAXON_RUN_UUID"], d) as r:
+                import numpy as np
+                r.log_image("sample", np.zeros((4, 4), dtype=np.uint8))
+                p = os.path.join(d, "report.html")
+                open(p, "w").write("<h1>eval</h1>")
+                r.log_artifact(p, name="report.html")
+            """
+        ).strip()
+        record = run.create({"kind": "component", "run": {
+            "kind": "job", "container": {"command": ["python", "-c", script]}}})
+        assert run.wait(timeout=60) == V1Statuses.SUCCEEDED
+
+        base = f"{server.url}/api/v1/default/default/runs/{record['uuid']}"
+        with urllib.request.urlopen(base + "/artifacts?detail=1",
+                                    timeout=10) as r:
+            files = _json.load(r)
+        by_path = {f["path"]: f["size_bytes"] for f in files}
+        assert all(isinstance(s, int) and s >= 0 for s in by_path.values())
+        png = next(p for p in by_path if p.endswith(".png"))
+        assert by_path[png] > 0
+
+        with urllib.request.urlopen(base + "/lineage", timeout=10) as r:
+            lineage = _json.load(r)
+        html_rec = next(rec for rec in lineage
+                        if rec["name"] == "report.html")
+        assert html_rec["size_bytes"] > 0
+
+        # Inline rendering depends on real content types.
+        assert html_rec["is_dir"] is False
+        with urllib.request.urlopen(
+                base + "/artifacts/" + html_rec["rel_path"],
+                timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/html")
+            # Stored-XSS guard: run-produced html renders sandboxed
+            # (no scripts, no same-origin API credentials).
+            assert r.headers["Content-Security-Policy"] == "sandbox"
+            assert b"eval" in r.read()
+        with urllib.request.urlopen(base + "/artifacts/" + png,
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"] == "image/png"
+
+        # The dashboard page ships the browser panel.
+        with urllib.request.urlopen(f"{server.url}/ui", timeout=10) as r:
+            page = r.read().decode()
+        assert "artifactsPanel" in page and "artifacts?detail=1" in page
 
     def test_list_runs_and_filters(self, stack):
         _, server = stack
