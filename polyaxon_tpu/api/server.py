@@ -273,11 +273,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Security-Policy", "sandbox")
         self.send_header("Content-Length", str(size))
         self.end_headers()
+        # Stream exactly `size` bytes: a live run may append between the
+        # stat and the read, and extra bytes would corrupt keep-alive
+        # framing (the client parses them as the next response).
+        remaining = size
         with open(path, "rb") as fh:
-            while True:
-                chunk = fh.read(1 << 16)
+            while remaining > 0:
+                chunk = fh.read(min(1 << 16, remaining))
                 if not chunk:
                     break
+                remaining -= len(chunk)
                 self.wfile.write(chunk)
 
     # -- streams -----------------------------------------------------------

@@ -551,7 +551,12 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
               help="shard weights over a device mesh, e.g. 'tp=4' or "
                    "'fsdp=-1' (-1 = all devices); decode collectives are "
                    "GSPMD-inserted")
-def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str):
+@click.option("--quantize", default=None, type=click.Choice(["int8"]),
+              help="weight-only quantization at load: int8 + per-channel "
+                   "scales (halves HBM-resident weight bytes; decode is "
+                   "bandwidth-bound)")
+def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
+              quantize):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
@@ -565,7 +570,7 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str):
             raise click.BadParameter(str(exc)) from None
     server = ServingServer(model, checkpoint, host=host, port=port, seed=seed,
                            batching=batching, slots=slots,
-                           mesh_axes=mesh_axes)
+                           mesh_axes=mesh_axes, quantize=quantize)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread

@@ -21,12 +21,15 @@ Axis convention (outermost → innermost):
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
 
 from polyaxon_tpu.polyflow.environment import V1TpuTopology
 from polyaxon_tpu.polyflow.runs import V1MeshSpec
@@ -123,6 +126,8 @@ def build_mesh(
                 devices=devices,
                 allow_split_physical_axes=bool(mesh_spec and mesh_spec.allow_split_physical_axes),
             )
+            logger.info("hybrid mesh: dcn_axes=%s over %d hardware slices",
+                        sorted(dcn_axes), slices)
         except ValueError:
             # Devices without slice_index (CPU mesh, emulator): emulate the
             # slice granularity by putting DCN axes slowest-varying so each
@@ -132,6 +137,10 @@ def build_mesh(
             arr = np.asarray(devices).reshape(permuted_sizes)
             inverse = np.argsort(perm)
             device_array = arr.transpose(tuple(inverse))
+            logger.info(
+                "hybrid mesh: dcn_axes=%s over %d emulated slices "
+                "(devices lack slice_index; DCN axes placed slowest-varying)",
+                sorted(dcn_axes), slices)
     else:
         try:
             device_array = mesh_utils.create_device_mesh(

@@ -24,6 +24,9 @@ def main() -> int:
     parser.add_argument("--mesh", default=None,
                         help="shard weights over a device mesh, e.g. 'tp=4' "
                              "or 'fsdp=-1' (-1 = all devices)")
+    parser.add_argument("--quantize", default=None, choices=["int8"],
+                        help="weight-only quantization at load (int8 + "
+                             "per-channel scales)")
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -40,7 +43,7 @@ def main() -> int:
     with ServingServer(args.model, args.checkpoint,
                        host=args.host, port=args.port, seed=args.seed,
                        batching=args.batching, slots=args.slots,
-                       mesh_axes=mesh_axes) as s:
+                       mesh_axes=mesh_axes, quantize=args.quantize) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

@@ -133,7 +133,9 @@ class ContinuousBatchingEngine:
         def step(params, cache, tokens, pos, keys, temps, top_ps, top_ks,
                  *, filtered: bool):
             from polyaxon_tpu.models.common import sample_row
+            from polyaxon_tpu.serving.quantize import dequantize_tree
 
+            params = dequantize_tree(params)  # identity for plain trees
             logits, cache = family.decode_step_ragged(
                 cfg, params, cache, tokens, pos)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -164,7 +166,10 @@ class ContinuousBatchingEngine:
         @lru_cache(maxsize=16)
         def compiled_prefill(plen: int):
             def run(params, prompt):
-                return family.cb_prefill(cfg, params, prompt, self.max_len)
+                from polyaxon_tpu.serving.quantize import dequantize_tree
+
+                return family.cb_prefill(cfg, dequantize_tree(params),
+                                         prompt, self.max_len)
 
             return jax.jit(run)
 

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from polyaxon_tpu.serving.batching import validate_sampling
+from polyaxon_tpu.serving.quantize import dequantize_tree, quantize_tree, tree_bytes
 
 logger = logging.getLogger(__name__)
 
@@ -170,6 +171,10 @@ class _Engine:
             # requests that actually set top_p/top_k pay the sorted
             # nucleus path.
             def run(params, prompt, rng, temperature, top_p, top_k):
+                # Identity for plain trees; int8 weights dequantize
+                # here, inside jit, so the multiply fuses into the
+                # consuming matmuls (serving/quantize.py contract).
+                params = dequantize_tree(params)
                 # llama: prompt continues; t5: prompt is the encoder
                 # input and generation starts from BOS.
                 return family.generate(
@@ -393,7 +398,8 @@ class ServingServer:
     def __init__(self, model: str, checkpoint: Optional[str] = None,
                  host: str = "127.0.0.1", port: int = 0, seed: int = 0,
                  batching: str = "static", slots: int = 4,
-                 mesh_axes: Optional[dict] = None):
+                 mesh_axes: Optional[dict] = None,
+                 quantize: Optional[str] = None):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -410,6 +416,12 @@ class ServingServer:
                                    devices=devices)
         cfg, params = load_params(model, checkpoint, seed=seed,
                                   mesh=self.mesh)
+        if quantize:
+            full = tree_bytes(params)
+            params = quantize_tree(params, mode=quantize)
+            logger.info("quantized %s weights %s: %.1f MiB -> %.1f MiB",
+                        model, quantize, full / 2**20,
+                        tree_bytes(params) / 2**20)
         if batching == "continuous":
             from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
 

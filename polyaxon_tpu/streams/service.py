@@ -21,6 +21,23 @@ from polyaxon_tpu.tracking.events import list_event_names, read_events, tail_fil
 class StreamsService:
     def __init__(self, store_root: str):
         self.store_root = store_root
+        # TTL cache for tree-walk results (dir sizes, detail listings):
+        # the dashboard polls every ~5s per viewer, and re-walking a
+        # thousand-file run tree per poll is continuous I/O for numbers
+        # that change slowly. Expired entries are purged on insert so a
+        # long-lived server doesn't accumulate keys for deleted runs.
+        self._walk_cache: dict[Any, tuple[float, Any]] = {}
+
+    def _cached_walk(self, key: Any, compute, ttl: float = 10.0):
+        now = time.monotonic()
+        hit = self._walk_cache.get(key)
+        if hit and hit[0] > now:
+            return hit[1]
+        value = compute()
+        for k in [k for k, (exp, _) in self._walk_cache.items() if exp <= now]:
+            del self._walk_cache[k]
+        self._walk_cache[key] = (now + ttl, value)
+        return value
 
     def run_dir(self, run_uuid: str) -> str:
         return os.path.join(self.store_root, run_uuid)
@@ -75,27 +92,58 @@ class StreamsService:
             rec["rel_path"] = os.path.relpath(path, root).replace(os.sep, "/")
             rec["is_dir"] = os.path.isdir(path)
             try:
-                rec["size_bytes"] = (
-                    sum(os.path.getsize(os.path.join(r, f))
-                        for r, _, fs in os.walk(path) for f in fs)
-                    if rec["is_dir"] else os.path.getsize(path))
+                rec["size_bytes"] = (self._dir_size(path) if rec["is_dir"]
+                                     else os.path.getsize(path))
             except OSError:
                 pass
         return records
 
+    def _dir_size(self, path: str) -> int:
+        """Recursive size of a directory artifact (TTL-cached)."""
+        def compute() -> int:
+            total = 0
+            for dirpath, _, filenames in os.walk(path):
+                for name in filenames:
+                    try:
+                        total += os.path.getsize(os.path.join(dirpath, name))
+                    except OSError:
+                        pass  # vanished mid-walk
+            return total
+
+        return self._cached_walk(("dir_size", path), compute)
+
     def list_artifacts_detail(self, run_uuid: str,
                               prefix: str = "") -> list[dict]:
-        """File listing with sizes, for the dashboard browser."""
-        root = os.path.abspath(self.run_dir(run_uuid))
-        out = []
-        for rel in self.list_artifacts(run_uuid, prefix):
-            try:
-                size = os.path.getsize(os.path.join(root, rel))
-            except OSError:
-                continue  # vanished mid-listing
-            out.append({"path": rel.replace(os.sep, "/"),
-                        "size_bytes": size})
-        return out
+        """File listing with sizes, for the dashboard browser. One walk
+        with scandir-cached stats (not list_artifacts + a getsize per
+        file — that stats the whole tree twice), TTL-cached against the
+        dashboard's live-rerender polling."""
+        run_root = self.run_dir(run_uuid)
+        root = os.path.join(run_root, prefix)
+        if not os.path.isdir(root):
+            return []
+
+        def compute() -> list[dict]:
+            out = []
+            for dirpath, _, _ in os.walk(root):
+                try:
+                    entries = list(os.scandir(dirpath))
+                except OSError:
+                    continue  # vanished mid-walk
+                for entry in entries:
+                    try:
+                        if not entry.is_file():
+                            continue
+                        rel = os.path.relpath(entry.path, run_root)
+                        out.append({"path": rel.replace(os.sep, "/"),
+                                    "size_bytes": entry.stat().st_size})
+                    except OSError:
+                        continue
+            out.sort(key=lambda rec: rec["path"])
+            return out
+
+        return self._cached_walk(("detail", run_uuid, prefix), compute,
+                                 ttl=5.0)
 
     # -- logs -------------------------------------------------------------
     def log_files(self, run_uuid: str) -> list[str]:

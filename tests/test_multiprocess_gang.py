@@ -72,6 +72,45 @@ class TestMultiProcessGang:
         assert outputs["steps"] == 3
         assert plane.streams.get_metrics(record.uuid, ["loss"])["loss"]
 
+    def test_eight_process_multislice_dp_over_dcn(self, plane, monkeypatch):
+        """8-rank gang as two 4-host virtual slices: dp laid over DCN,
+        fsdp over "ICI" — the hybrid-mesh bootstrap path executed
+        multi-process, not just in the in-process dryrun (VERDICT r2
+        item 6, SURVEY §2c cross-slice row). Each rank contributes one
+        CPU device; topology says 2 slices × 4 single-chip hosts, and
+        build_mesh's emulated-slice path must put the dp (DCN) axis
+        slowest-varying so every fsdp group stays inside one slice's
+        contiguous process block."""
+        monkeypatch.setenv("XLA_FLAGS", "")
+        record = plane.submit({
+            "kind": "component",
+            "name": "gang8-multislice",
+            "run": {
+                "kind": "jaxjob",
+                "numProcesses": 8,
+                "topology": {"accelerator": "v5e", "topology": "4",
+                             "chipsPerHost": 1, "slices": 2},
+                "mesh": {"axes": {"dp": 2, "fsdp": 4},
+                         "dcnAxes": ["dp"]},
+                "runtime": {"model": "llama_tiny", "dataset": "lm_synthetic",
+                            "steps": 2, "seq_len": 64,
+                            "global_batch_size": 8, "log_every": 1},
+            },
+        })
+        agent = Agent(plane)
+        status = agent.run_until_done(record.uuid, timeout=900)
+        assert status == V1Statuses.SUCCEEDED
+        logs = plane.streams.log_files(record.uuid)
+        assert {f"main-{i}.log" for i in range(8)} <= set(logs)
+        outputs = plane.streams.get_outputs(record.uuid)
+        assert outputs["steps"] == 2
+        assert plane.streams.get_metrics(record.uuid, ["loss"])["loss"]
+        # The lead rank must have gone down the hybrid (DCN-aware) mesh
+        # path with the requested logical shape — not a plain reshape.
+        lead_log, _ = plane.streams.read_logs(record.uuid, "main-0.log")
+        assert "hybrid mesh: dcn_axes=['dp']" in lead_log
+        assert "'dp': 2" in lead_log and "'fsdp': 4" in lead_log
+
     def test_preempted_gang_resumes_checkpoint_exact(self, plane,
                                                      monkeypatch):
         """Preempt a LIVE multi-process gang mid-training; the scheduler
