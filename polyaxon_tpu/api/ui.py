@@ -114,6 +114,13 @@ DASHBOARD_HTML = r"""<!doctype html>
   .chip:hover { border-color: var(--axis); }
   .chip .val { font-variant-numeric: tabular-nums; color: var(--ink); }
   td.cmp, th.cmp { width: 26px; padding-right: 0; }
+  .dag svg { display: block; width: 100%; }
+  .dag .dagnode { cursor: pointer; }
+  .dag .dagnode rect { fill: var(--surface); stroke-width: 1.5; rx: 7; }
+  .dag .dagnode:hover rect { filter: brightness(1.06); }
+  .dag .dagnode text { fill: var(--ink); font-size: 12px; }
+  .dag .dagnode .st { fill: var(--ink-2); font-size: 10px; }
+  .dag .edge { fill: none; stroke: var(--axis); stroke-width: 1.3; }
 </style>
 </head>
 <body>
@@ -512,6 +519,72 @@ async function sweepView(run) {
   </div>`;
 }
 
+async function dagView(run) {
+  // Pipeline graph: nodes from the dag spec's operations, statuses
+  // from the child runs (created lazily as upstreams finish — a node
+  // with no child yet renders as pending). Upstream's flow viz, lite.
+  const ops = run.spec?.component?.run?.operations || [];
+  if (!ops.length) return "";
+  const children = (await api(
+    `/api/v1/default/default/runs?pipeline=${encodeURIComponent(run.uuid)}`
+  ).catch(() => ({results: []}))).results || [];
+  const byName = new Map(children.map(c => [c.name, c]));
+  // Longest-path layering (deps are validated acyclic at submit).
+  const deps = new Map(ops.map(o => [o.name, o.dependencies || []]));
+  const layerOf = new Map();
+  const layer = (name, seen) => {
+    if (layerOf.has(name)) return layerOf.get(name);
+    if (!seen) seen = new Set();
+    if (seen.has(name) || !deps.has(name)) return 0;
+    seen.add(name);
+    const ds = deps.get(name);
+    const v = ds.length ? 1 + Math.max(...ds.map(d => layer(d, seen))) : 0;
+    layerOf.set(name, v);
+    return v;
+  };
+  const W = 150, H = 40, GX = 70, GY = 18, PAD = 14;
+  const cols = new Map();  // layer -> next row index
+  const pos = new Map();
+  for (const o of ops) {
+    const l = layer(o.name);
+    const row = cols.get(l) || 0;
+    cols.set(l, row + 1);
+    pos.set(o.name, {x: PAD + l * (W + GX), y: PAD + row * (H + GY)});
+  }
+  const width = PAD * 2 + (Math.max(...[...layerOf.values(), 0]) + 1) * (W + GX) - GX;
+  const height = PAD * 2 + Math.max(...[...cols.values()]) * (H + GY) - GY;
+  const edges = ops.flatMap(o => (deps.get(o.name) || []).map(d => {
+    const a = pos.get(d), b = pos.get(o.name);
+    if (!a || !b) return "";
+    const x1 = a.x + W, y1 = a.y + H / 2, x2 = b.x, y2 = b.y + H / 2;
+    const mx = (x1 + x2) / 2;
+    return `<path class="edge" marker-end="url(#dagarrow)"
+      d="M ${x1} ${y1} C ${mx} ${y1}, ${mx} ${y2}, ${x2 - 4} ${y2}"/>`;
+  })).join("");
+  const nodes = ops.map(o => {
+    const c = byName.get(o.name);
+    const status = c ? c.status : "pending";
+    const [color, glyph] = STATUS[status] || ["var(--muted)", "•"];
+    const p = pos.get(o.name);
+    const label = o.name.length > 18 ? o.name.slice(0, 17) + "…" : o.name;
+    return `<g class="dagnode" ${c ? `data-uuid="${esc(c.uuid)}"` : ""}
+        role="button" tabindex="0" aria-label="${esc(o.name)}: ${esc(status)}">
+      <rect x="${p.x}" y="${p.y}" width="${W}" height="${H}" rx="7"
+            stroke="${color}"/>
+      <text x="${p.x + 10}" y="${p.y + 17}">${esc(label)}</text>
+      <text class="st" x="${p.x + 10}" y="${p.y + 31}">${glyph} ${esc(status)}</text>
+    </g>`;
+  }).join("");
+  return `<div class="bracket dag"><h3>pipeline · ${ops.length} operations</h3>
+    <svg viewBox="0 0 ${width} ${height}" style="height:${Math.min(height, 420)}px"
+         aria-label="pipeline graph">
+      <defs><marker id="dagarrow" viewBox="0 0 8 8" refX="7" refY="4"
+        markerWidth="7" markerHeight="7" orient="auto">
+        <path d="M 0 0 L 8 4 L 0 8 z" fill="var(--axis)"/></marker></defs>
+      ${edges}${nodes}
+    </svg></div>`;
+}
+
 let detailTimer = null;
 // Monotonic render generation: an in-flight fetch chain whose gen is
 // stale (user navigated meanwhile) must not touch the DOM.
@@ -535,14 +608,17 @@ async function showRun(uuid, opts) {
     api(`/api/v1/default/default/runs/${uuid}/events?kind=histogram`).catch(() => ({})),
   ]);
   const isSweep = run.kind === "matrix";
+  const isDag = run.kind === "dag";
+  const isPipeline = isSweep || isDag;
   // Artifact listing stats the whole run tree server-side — skip it
-  // for sweeps (their artifacts live in child runs) so the 5 s live
+  // for pipelines (their artifacts live in child runs) so the 5 s live
   // rerender loop doesn't re-walk the tree forever.
-  const [lineage, files] = isSweep ? [[], []] : await Promise.all([
+  const [lineage, files] = isPipeline ? [[], []] : await Promise.all([
     api(`/api/v1/default/default/runs/${uuid}/lineage`).catch(() => []),
     api(`/api/v1/default/default/runs/${uuid}/artifacts?detail=1`).catch(() => []),
   ]);
-  const sweep = isSweep ? await sweepView(run) : "";
+  const sweep = isSweep ? await sweepView(run)
+    : isDag ? await dagView(run) : "";
   if (gen !== renderGen) return;  // user navigated mid-fetch
   const charts = Object.entries(metrics)
     .filter(([, pts]) => Array.isArray(pts) && pts.length)
@@ -555,13 +631,13 @@ async function showRun(uuid, opts) {
   detail.innerHTML = `
     <h2 style="font-size:15px">${esc(run.name || run.uuid)} ${pill(run.status)}</h2>
     ${sweep}
-    <div class="charts">${charts || (isSweep ? "" : "<div class='sub' style='color:var(--muted)'>no metrics yet</div>")}</div>
+    <div class="charts">${charts || (isPipeline ? "" : "<div class='sub' style='color:var(--muted)'>no metrics yet</div>")}</div>
     ${media ? `<div class="charts">${media}</div>` : ""}
     ${artifactsPanel(uuid, Array.isArray(lineage) ? lineage : [],
                      Array.isArray(files) ? files : [])}
-    <div id="logs" aria-label="run logs"${isSweep ? " hidden" : ""}></div>`;
+    <div id="logs" aria-label="run logs"${isPipeline ? " hidden" : ""}></div>`;
   for (const el of detail.querySelectorAll(".chart")) wireChart(el);
-  for (const chip of detail.querySelectorAll(".chip")) {
+  for (const chip of detail.querySelectorAll(".chip, .dagnode[data-uuid]")) {
     chip.onclick = () => showRun(chip.dataset.uuid);
     chip.onkeydown = (ev) => {  // role=button: Enter/Space activate
       if (ev.key === "Enter" || ev.key === " ") {
@@ -570,13 +646,13 @@ async function showRun(uuid, opts) {
       }
     };
   }
-  if (!isSweep) {
+  if (!isPipeline) {
     const logs = $("#logs");
     logSource = new EventSource(`/streams/v1/default/default/runs/${uuid}/logs?follow=true`);
     logSource.onmessage = (ev) => { logs.textContent += ev.data + "\n"; logs.scrollTop = logs.scrollHeight; };
     logSource.addEventListener("done", () => { logSource.close(); logSource = null; });
   } else if (!TERMINAL.has(run.status)) {
-    // Live sweep: re-render the bracket view while trials advance.
+    // Live pipeline (sweep or dag): re-render while children advance.
     detailTimer = setTimeout(() => showRun(uuid, {rerender: true}), 5000);
   }
   if (!rerender) detail.scrollIntoView({behavior: "smooth"});

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Iterator, Optional
 
@@ -26,17 +27,23 @@ class StreamsService:
         # thousand-file run tree per poll is continuous I/O for numbers
         # that change slowly. Expired entries are purged on insert so a
         # long-lived server doesn't accumulate keys for deleted runs.
+        # Locked: the API's ThreadingHTTPServer calls this from
+        # concurrent handler threads.
         self._walk_cache: dict[Any, tuple[float, Any]] = {}
+        self._walk_cache_lock = threading.Lock()
 
     def _cached_walk(self, key: Any, compute, ttl: float = 10.0):
         now = time.monotonic()
-        hit = self._walk_cache.get(key)
-        if hit and hit[0] > now:
-            return hit[1]
-        value = compute()
-        for k in [k for k, (exp, _) in self._walk_cache.items() if exp <= now]:
-            del self._walk_cache[k]
-        self._walk_cache[key] = (now + ttl, value)
+        with self._walk_cache_lock:
+            hit = self._walk_cache.get(key)
+            if hit and hit[0] > now:
+                return hit[1]
+        value = compute()  # the walk itself runs unlocked
+        with self._walk_cache_lock:
+            for k in [k for k, (exp, _) in self._walk_cache.items()
+                      if exp <= now]:
+                del self._walk_cache[k]
+            self._walk_cache[key] = (now + ttl, value)
         return value
 
     def run_dir(self, run_uuid: str) -> str:

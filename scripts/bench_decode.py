@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Serving decode throughput: bf16 vs --quantize int8, on the current
+backend (the real chip when the tunnel is up).
+
+Decode is HBM-bandwidth-bound — each generated token re-reads the whole
+weight tree — so int8 weight-only quantization (serving/quantize.py)
+should approach 2x tokens/sec on large models. This measures the real
+number plus the quantization noise (greedy-token agreement vs bf16) so
+`plx serve --quantize int8` ships with a recorded quality/throughput
+tradeoff (VERDICT r2 item 10).
+
+Usage: python scripts/bench_decode.py [--model llama3_1b] [--slots 8]
+       [--steps 256] [--prompt-len 32]
+Writes bench_decode_results.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from polyaxon_tpu.utils import apply_jax_platforms_override  # noqa: E402
+
+apply_jax_platforms_override()  # honor JAX_PLATFORMS=cpu despite sitecustomize
+
+
+def measure(model: str, quantize: bool, slots: int, steps: int,
+            prompt_len: int, seed: int = 0) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyaxon_tpu.serving.quantize import quantize_tree, tree_bytes
+    from polyaxon_tpu.serving.server import _family, load_params
+
+    family = _family(model)
+    cfg, params = load_params(model, seed=seed)
+    full_bytes = tree_bytes(params)
+    if quantize:
+        params = quantize_tree(params)
+    max_len = min(cfg.max_seq_len, prompt_len + steps + 8)
+
+    # The continuous engine's exact step program, driven synchronously:
+    # one ragged decode step for the whole slot pool, greedy rows.
+    from polyaxon_tpu.serving.quantize import dequantize_tree
+
+    def step(params, cache, tokens, pos):
+        logits, cache = family.decode_step_ragged(
+            cfg, dequantize_tree(params), cache, tokens, pos)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    step = jax.jit(step, donate_argnums=(1,))
+
+    cache = family.cb_init_cache(cfg, slots, max_len)
+    prompt = jax.random.randint(jax.random.key(1), (1, prompt_len), 0,
+                                cfg.vocab_size, jnp.int32)
+    row = jax.jit(
+        lambda p, t: family.cb_prefill(cfg, dequantize_tree(p), t, max_len)
+    )(params, prompt)
+    for b in range(slots):
+        cache = family.insert_cache_row(cache, row, jnp.int32(b))
+    pos = jnp.full((slots,), prompt_len - 1, jnp.int32)
+    cur = jnp.full((slots,), int(prompt[0, -1]), jnp.int32)
+
+    # Warm (compile) + timed run.
+    cur, cache = step(params, cache, cur, pos)
+    pos = pos + 1
+    jax.block_until_ready(cur)
+    emitted = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        cur, cache = step(params, cache, cur, pos)
+        pos = pos + 1
+        emitted.append(cur)
+    jax.block_until_ready(cur)
+    dt = time.perf_counter() - t0
+    tokens = np.asarray(jnp.stack(emitted))  # [steps, slots]
+    return {
+        "model": model,
+        "quantize": "int8" if quantize else None,
+        "slots": slots,
+        "decode_steps": steps,
+        "weight_bytes": tree_bytes(params),
+        "weight_bytes_bf16": full_bytes,
+        "tokens_per_sec": round(steps * slots / dt, 2),
+        "step_ms": round(dt / steps * 1e3, 3),
+        "tokens": tokens,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="llama3_1b")
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=256)
+    parser.add_argument("--prompt-len", type=int, default=32)
+    args = parser.parse_args()
+
+    import jax
+
+    rows = []
+    for quantize in (False, True):
+        r = measure(args.model, quantize, args.slots, args.steps,
+                    args.prompt_len)
+        print(f"{args.model} quantize={r['quantize']}: "
+              f"{r['tokens_per_sec']} tok/s ({r['step_ms']} ms/step, "
+              f"weights {r['weight_bytes'] / 2**20:.0f} MiB)", flush=True)
+        rows.append(r)
+
+    bf16, int8 = rows
+    agree = float((bf16.pop("tokens") == int8.pop("tokens")).mean())
+    out = {
+        "backend": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "results": rows,
+        "int8_speedup": round(int8["tokens_per_sec"]
+                              / bf16["tokens_per_sec"], 3),
+        # Greedy-token agreement over the whole run: the end-to-end
+        # quality signal (argmax flips compound once sequences diverge,
+        # so this is a conservative lower bound on per-step agreement).
+        "greedy_token_agreement": round(agree, 4),
+    }
+    path = os.path.join(REPO, "bench_decode_results.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(f"int8 speedup {out['int8_speedup']}x, greedy agreement "
+          f"{out['greedy_token_agreement']}; wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

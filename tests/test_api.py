@@ -258,6 +258,47 @@ class TestApi:
             page = r.read().decode()
         assert "artifactsPanel" in page and "artifacts?detail=1" in page
 
+    def test_dag_view_data_surface(self, stack):
+        """Everything the dashboard's pipeline graph consumes: run-detail
+        spec carries the dag operations + dependencies, the pipeline
+        filter lists the children by operation name, and the page ships
+        the dagView renderer."""
+        import json as _json
+        import urllib.request
+
+        _, server = stack
+        run = RunClient(host=server.url)
+        ok = {"kind": "job",
+              "container": {"command": ["python", "-c", "print('ok')"]}}
+        record = run.create({
+            "kind": "component", "name": "pipe",
+            "run": {"kind": "dag", "operations": [
+                {"name": "a", "component": {"run": ok}},
+                {"name": "b", "dependencies": ["a"],
+                 "component": {"run": ok}},
+            ]},
+        })
+        assert run.wait(timeout=120) == V1Statuses.SUCCEEDED
+
+        base = f"{server.url}/api/v1/default/default/runs"
+        with urllib.request.urlopen(f"{base}/{record['uuid']}",
+                                    timeout=10) as r:
+            detail = _json.load(r)
+        assert detail["kind"] == "dag"
+        ops = detail["spec"]["component"]["run"]["operations"]
+        assert [o["name"] for o in ops] == ["a", "b"]
+        assert ops[1]["dependencies"] == ["a"]
+
+        with urllib.request.urlopen(
+                f"{base}?pipeline={record['uuid']}", timeout=10) as r:
+            children = _json.load(r)["results"]
+        assert {c["name"] for c in children} == {"a", "b"}
+        assert all(c["status"] == "succeeded" for c in children)
+
+        with urllib.request.urlopen(f"{server.url}/ui", timeout=10) as r:
+            page = r.read().decode()
+        assert "dagView" in page and "dagnode" in page
+
     def test_list_runs_and_filters(self, stack):
         _, server = stack
         client = PolyaxonClient(server.url)
