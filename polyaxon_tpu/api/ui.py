@@ -116,6 +116,7 @@ DASHBOARD_HTML = r"""<!doctype html>
   td.cmp, th.cmp { width: 26px; padding-right: 0; }
   .dag svg { display: block; width: 100%; }
   .dag .dagnode { cursor: pointer; }
+  .dag .dagnode.inert { cursor: default; }
   .dag .dagnode rect { fill: var(--surface); stroke-width: 1.5; rx: 7; }
   .dag .dagnode:hover rect { filter: brightness(1.06); }
   .dag .dagnode text { fill: var(--ink); font-size: 12px; }
@@ -567,8 +568,11 @@ async function dagView(run) {
     const [color, glyph] = STATUS[status] || ["var(--muted)", "•"];
     const p = pos.get(o.name);
     const label = o.name.length > 18 ? o.name.slice(0, 17) + "…" : o.name;
-    return `<g class="dagnode" ${c ? `data-uuid="${esc(c.uuid)}"` : ""}
-        role="button" tabindex="0" aria-label="${esc(o.name)}: ${esc(status)}">
+    // Only nodes with a child run are interactive: a pending node as a
+    // focusable dead "button" misleads keyboard/screen-reader users.
+    const act = c ? `data-uuid="${esc(c.uuid)}" role="button" tabindex="0"` : "";
+    return `<g class="dagnode${c ? "" : " inert"}" ${act}
+        aria-label="${esc(o.name)}: ${esc(status)}">
       <rect x="${p.x}" y="${p.y}" width="${W}" height="${H}" rx="7"
             stroke="${color}"/>
       <text x="${p.x + 10}" y="${p.y + 17}">${esc(label)}</text>

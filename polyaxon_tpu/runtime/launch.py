@@ -25,9 +25,15 @@ logger = logging.getLogger(__name__)
 
 
 def main() -> int:
+    # force=True: the module imports above pull in jax, whose absl
+    # bridge may already have attached a root handler — without force,
+    # basicConfig is a silent no-op and root stays at WARNING, so no
+    # framework INFO line (mesh shape, bootstrap, step logs) ever
+    # reaches the gang's log files.
     logging.basicConfig(
         level=os.environ.get("POLYAXON_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        force=True,
     )
     from polyaxon_tpu.utils import apply_jax_platforms_override
 

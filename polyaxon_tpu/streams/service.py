@@ -31,6 +31,7 @@ class StreamsService:
         # concurrent handler threads.
         self._walk_cache: dict[Any, tuple[float, Any]] = {}
         self._walk_cache_lock = threading.Lock()
+        self._walk_inflight: dict[Any, threading.Event] = {}
 
     def _cached_walk(self, key: Any, compute, ttl: float = 10.0):
         now = time.monotonic()
@@ -38,13 +39,35 @@ class StreamsService:
             hit = self._walk_cache.get(key)
             if hit and hit[0] > now:
                 return hit[1]
-        value = compute()  # the walk itself runs unlocked
-        with self._walk_cache_lock:
-            for k in [k for k, (exp, _) in self._walk_cache.items()
-                      if exp <= now]:
-                del self._walk_cache[k]
-            self._walk_cache[key] = (now + ttl, value)
-        return value
+            # Single-flight per key: when a TTL lapses with N viewers
+            # polling, one thread walks and the rest wait for its
+            # result instead of N simultaneous tree walks.
+            waiting = self._walk_inflight.get(key)
+            if waiting is None:
+                self._walk_inflight[key] = threading.Event()
+        if waiting is not None:
+            waiting.wait(timeout=30)
+            with self._walk_cache_lock:
+                hit = self._walk_cache.get(key)
+            if hit:  # possibly expired, still the freshest walk we have
+                return hit[1]
+            return compute()  # walker died/timed out: fall through
+        try:
+            value = compute()  # the walk itself runs unlocked
+            with self._walk_cache_lock:
+                for k in [k for k, (exp, _) in self._walk_cache.items()
+                          if exp <= now]:
+                    del self._walk_cache[k]
+                self._walk_cache[key] = (now + ttl, value)
+            return value
+        finally:
+            # Cache insert happens BEFORE the event fires (walker
+            # success path), so woken waiters find the fresh entry; on
+            # a compute() exception they fall through to their own walk.
+            with self._walk_cache_lock:
+                event = self._walk_inflight.pop(key, None)
+            if event is not None:
+                event.set()
 
     def run_dir(self, run_uuid: str) -> str:
         return os.path.join(self.store_root, run_uuid)

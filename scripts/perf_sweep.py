@@ -38,11 +38,26 @@ def bench_args(**kw) -> list[str]:
 def run_point(name: str, timeout_s: float = 1200, **kw):
     cmd = [sys.executable, os.path.join(REPO, "bench.py")] + bench_args(**kw)
     t0 = time.time()
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout_s, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return {"name": name, "error": f"timeout>{timeout_s:.0f}s", **kw}
+    # Popen + SIGTERM-then-SIGKILL, not subprocess.run(timeout=...):
+    # run() SIGKILLs on timeout, and a bench killed mid-TPU-program can
+    # wedge the tunnel for every later client (observed 2026-07-31:
+    # init hangs >90s for all followers after one hard kill). SIGTERM
+    # lets the PJRT client unwind its device lease first.
+    with subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE, text=True,
+                          cwd=REPO) as popen:
+        try:
+            stdout, stderr = popen.communicate(timeout=timeout_s)
+            proc = subprocess.CompletedProcess(cmd, popen.returncode,
+                                               stdout, stderr)
+        except subprocess.TimeoutExpired:
+            popen.terminate()
+            try:
+                popen.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                popen.kill()
+                popen.communicate()
+            return {"name": name, "error": f"timeout>{timeout_s:.0f}s", **kw}
     line = None
     for ln in reversed(proc.stdout.strip().splitlines()):
         try:
@@ -151,6 +166,10 @@ def main() -> int:
                         choices=("cpu", "tpu"),
                         help="--moe backend: cpu = 8-device virtual mesh "
                              "(dp2xep4), tpu = the real chip (ep=1)")
+    parser.add_argument("--resume", action="store_true",
+                        help="rerun only the points that errored in the "
+                             "existing perf_sweep_results.json (tunnel "
+                             "flakes), keeping prior successes")
     args = parser.parse_args()
 
     if args.moe:
@@ -191,25 +210,57 @@ def main() -> int:
             ("b8-dots-flash-chunk128", dict(base, batch=8, remat="dots",
                                             attention="flash",
                                             loss_chunk=128)),
+            # Bigger proxy: dim-2048 matmuls fill the MXU better than
+            # the 200M's dim-1024; reconciles the --estimate projection
+            # against a measured point one step closer to the 8B star.
+            ("1b-b4-dots-flash", dict(model="llama3_1b", steps=args.steps,
+                                      seq=args.seq, batch=4, remat="dots",
+                                      attention="flash")),
+            ("1b-b8-dots-flash", dict(model="llama3_1b", steps=args.steps,
+                                      seq=args.seq, batch=8, remat="dots",
+                                      attention="flash")),
         ]
+
+    out_path = os.path.join(REPO, "perf_sweep_results.json")
+    prior: dict[str, dict] = {}
+    if args.resume and os.path.exists(out_path):
+        with open(out_path) as fh:
+            prior = {r["name"]: r for r in json.load(fh).get("results", [])}
+
+    def dump(results):
+        # After every point, not just at the end: a Ctrl-C (or a hang
+        # killed from outside) must not lose completed measurements —
+        # --resume exists for exactly that situation.
+        ok = [r for r in results if r.get("value")]
+        ok.sort(key=lambda r: -r["value"])
+        with open(out_path, "w") as fh:
+            json.dump({"results": results, "best": ok[0] if ok else None},
+                      fh, indent=2)
+        return ok
 
     results = []
     for name, kw in points:
+        kept = prior.get(name) if args.resume else None
+        # Reuse only if the prior point measured the SAME config —
+        # name alone would merge e.g. a --seq 512 smoke into a
+        # seq-2048 table with no warning.
+        if kept and kept.get("value") and all(
+                kept.get(k) == v for k, v in kw.items()):
+            results.append(kept)
+            print(f"→ {name}: kept prior "
+                  f"{kept['value']} tok/s/chip", flush=True)
+            continue
         print(f"→ {name} ...", flush=True)
         res = run_point(name, **kw)
         results.append(res)
+        dump(results)
         val = res.get("value")
         print(f"  {name}: "
               + (f"{val} tok/s/chip, mfu={res.get('mfu')}"
                  if val else f"ERROR {res.get('error')}"),
               flush=True)
 
-    ok = [r for r in results if r.get("value")]
-    ok.sort(key=lambda r: -r["value"])
-    out_path = os.path.join(REPO, "perf_sweep_results.json")
-    with open(out_path, "w") as fh:
-        json.dump({"results": results, "best": ok[0] if ok else None}, fh,
-                  indent=2)
+    ok = dump(results)
     print(f"\nwrote {out_path}\n")
     print(f"{'config':<28} {'tok/s/chip':>12} {'mfu':>8}")
     for r in ok:

@@ -56,6 +56,7 @@ SMOKE_NODES = (
     "test_runtime.py::TestTrainLoop::test_loss_decreases",
     "test_serving.py::TestServing::test_health_and_models",
     "test_serving.py::TestServing::test_generate_shapes_and_determinism",
+    "test_serving.py::TestQuantize::test_static_serving_end_to_end_int8",
     "test_moe_pp.py::TestMoE::test_ragged_matches_dense_no_drop_single_shard",
     "test_tune.py::TestOneShotManagers",
     "test_tune.py::TestHyperband::test_rung_shapes_paper_table",

@@ -232,3 +232,34 @@ class TestSidecarAndStreams:
         (metric_dir / "loss.jsonl").write_text('{"value": 1.0}\n{"valu')
         events = read_events(str(rd), "metric", "loss")
         assert len(events) == 1
+
+
+class TestWalkCache:
+    def test_single_flight_under_concurrency(self, tmp_path):
+        """N dashboard viewers missing the same TTL'd key concurrently
+        must trigger ONE tree walk, with everyone getting its result."""
+        svc = StreamsService(str(tmp_path))
+        calls = []
+        started = threading.Barrier(4)
+
+        def compute():
+            calls.append(1)
+            time.sleep(0.15)  # long enough for all waiters to pile up
+            return 42
+
+        results = []
+
+        def worker():
+            started.wait()
+            results.append(svc._cached_walk("k", compute))
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [42] * 4
+        assert len(calls) == 1, f"{len(calls)} concurrent walks ran"
+        # And the TTL hit path returns without recomputing.
+        assert svc._cached_walk("k", compute) == 42
+        assert len(calls) == 1
