@@ -555,8 +555,19 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
               help="weight-only quantization at load: int8 + per-channel "
                    "scales (halves HBM-resident weight bytes; decode is "
                    "bandwidth-bound)")
+@click.option("--kv", default="dense", type=click.Choice(["dense", "paged"]),
+              help="KV-cache layout for --batching continuous: paged = "
+                   "vLLM-style shared page pool with per-slot block "
+                   "tables (memory scales with held tokens, not "
+                   "slots x max_len)")
+@click.option("--kv-page-size", default=16,
+              help="tokens per KV page (--kv paged)")
+@click.option("--kv-pages", default=None, type=int,
+              help="total pages in the pool (--kv paged); default = the "
+                   "dense-equivalent reservation, lower = deliberate "
+                   "oversubscription with admission backpressure")
 def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
-              quantize):
+              quantize, kv, kv_page_size, kv_pages):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
@@ -570,7 +581,8 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
             raise click.BadParameter(str(exc)) from None
     server = ServingServer(model, checkpoint, host=host, port=port, seed=seed,
                            batching=batching, slots=slots,
-                           mesh_axes=mesh_axes, quantize=quantize)
+                           mesh_axes=mesh_axes, quantize=quantize,
+                           kv=kv, page_size=kv_page_size, kv_pages=kv_pages)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread

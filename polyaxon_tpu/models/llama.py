@@ -442,15 +442,12 @@ def decode_step_ragged(
     return logits, {"k": new_k, "v": new_v}
 
 
-def prefill(
-    cfg: LlamaConfig,
-    params: dict,
-    prompt: jax.Array,  # [B, P] int32
-    max_len: int,
-) -> tuple[jax.Array, dict]:
-    """One batched causal pass over the prompt, filling the KV cache:
-    returns (last-position logits [B, V] fp32, cache). O(1) layer sweeps
-    instead of P sequential decode steps."""
+def _prompt_pass(cfg: LlamaConfig, params: dict, prompt: jax.Array):
+    """The shared causal prompt sweep: one batched pass over [B, P]
+    token ids → (final hidden x [B, P, D], k_all, v_all [L, B, P, KV,
+    Hd]). Both prefill flavours (ring-buffer assembly below, raw-KV
+    paged insert) build on this one body so the prompt math can never
+    diverge between the dense and paged engines."""
     dt = cfg.dtype
     B, P = prompt.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -478,6 +475,22 @@ def prefill(
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
+    return x, k_all, v_all
+
+
+def prefill(
+    cfg: LlamaConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, P] int32
+    max_len: int,
+) -> tuple[jax.Array, dict]:
+    """One batched causal pass over the prompt, filling the KV cache:
+    returns (last-position logits [B, V] fp32, cache). O(1) layer sweeps
+    instead of P sequential decode steps."""
+    dt = cfg.dtype
+    B, P = prompt.shape
+    Hd = cfg.head_dim
+    x, k_all, v_all = _prompt_pass(cfg, params, prompt)
     # Ring-buffer cache assembly: position p lands in slot p % C. With a
     # full-length cache that is the identity; with a sliding-window ring
     # only the last C prompt positions are kept (older ones can never be
@@ -544,6 +557,148 @@ def insert_cache_row(cache: dict, row: dict, b) -> dict:
         key: jax.lax.dynamic_update_slice(
             cache[key], row[key], (0, b, 0, 0, 0))
         for key in ("k", "v")
+    }
+
+
+# ------------------------------------------------- paged KV decode surface
+# vLLM-style paged attention, TPU-first: the KV cache is a shared pool
+# of fixed-size pages ([L, P, page, KV, Hd]) addressed through per-row
+# block tables, so serving memory scales with tokens actually held, not
+# slots x max_len reservations (the allocator lives in serving/paged.py;
+# the reference orchestrator has no serving path at all — net-new
+# surface, SURVEY.md §2). Page 0 is scratch: idle rows and unallocated
+# coordinates write there, and masks keep it unread.
+
+def paged_cache_len(n_pages: int, page_size: int) -> int:
+    """Max positions one gathered row can cover (all non-scratch pages)."""
+    return (n_pages - 1) * page_size
+
+
+def paged_init_cache(cfg: LlamaConfig, n_pages: int, page_size: int) -> dict:
+    if cfg.sliding_window is not None:
+        raise ValueError(
+            "paged KV does not support sliding_window yet — the ring "
+            "buffer already bounds that cache; use kv='dense'")
+    shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def paged_attn_step(cfg, layer: dict, x: jax.Array, k_pages: jax.Array,
+                    v_pages: jax.Array, positions: jax.Array,
+                    write_page: jax.Array, write_off: jax.Array,
+                    tables: jax.Array, valid: jax.Array):
+    """Paged analogue of ``cached_attn_step``: writes this step's K/V
+    into each row's current page slot and attends over the row's pages
+    gathered via its block table. ``tables`` [B, maxp] (-1 = not
+    allocated, clamped to scratch page 0 for the gather), ``valid``
+    [B, 1, 1, maxp*page] masks real positions."""
+    from polyaxon_tpu.ops.attention import repeat_kv
+
+    dt = cfg.dtype
+    B = x.shape[0]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = H // KV
+    page = k_pages.shape[2]
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
+    scaling = getattr(cfg, "rope_scaling", None)
+    q = _rope(q, positions, cfg.rope_theta, scaling)
+    k = _rope(k, positions, cfg.rope_theta, scaling)
+    k_pages = k_pages.at[write_page, write_off].set(k[:, 0])
+    v_pages = v_pages.at[write_page, write_off].set(v[:, 0])
+
+    gathered = jnp.maximum(tables, 0)  # [B, maxp] — scratch for holes
+    keys = k_pages[gathered].reshape(B, -1, KV, Hd)  # [B, maxp*page, ...]
+    vals = v_pages[gathered].reshape(B, -1, KV, Hd)
+    keys = repeat_kv(keys, n_rep)
+    vals = repeat_kv(vals, n_rep)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+    logits = logits * (Hd ** -0.5)
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+    return x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt), \
+        k_pages, v_pages
+
+
+def paged_coords(pos: jax.Array, tables: jax.Array, page: int):
+    """Shared paged addressing: per-row positions [B] (-1 = idle) +
+    block tables [B, maxp] → (positions [B,1] for RoPE, write_page [B],
+    write_off [B], attention mask [B,1,1,maxp*page]). Idle/unallocated
+    writes land on scratch page 0; the mask admits exactly positions
+    0..pos through allocated pages."""
+    B, maxp = tables.shape
+    pos_safe = jnp.maximum(pos, 0)
+    rows = jnp.arange(B)
+    write_page = jnp.where(
+        pos >= 0, tables[rows, pos_safe // page], 0)
+    write_page = jnp.maximum(write_page, 0)  # unallocated → scratch
+    write_off = pos_safe % page
+    j = jnp.arange(maxp * page)[None, :]  # global position per column
+    allocated = jnp.repeat(tables >= 0, page, axis=1)  # [B, maxp*page]
+    valid = ((j <= pos_safe[:, None]) & (pos[:, None] >= 0)
+             & allocated)[:, None, None, :]
+    return pos_safe[:, None], write_page, write_off, valid
+
+
+def decode_step_paged(
+    cfg: LlamaConfig,
+    params: dict,
+    cache: dict,  # {"k"/"v": [L, P, page, KV, Hd]}
+    tokens: jax.Array,  # [B] int32
+    pos: jax.Array,  # [B] int32 per-row position being written (-1 idle)
+    tables: jax.Array,  # [B, maxp] int32 page ids (-1 = unallocated)
+) -> tuple[jax.Array, dict]:
+    """`decode_step_ragged` over the paged pool: a row at position p
+    with pages covering 0..p matches the dense ragged step at p exactly
+    (parity-tested)."""
+    dt = cfg.dtype
+    page = cache["k"].shape[2]
+    positions, write_page, write_off, valid = paged_coords(pos, tables, page)
+    x = params["embed"].astype(dt)[tokens][:, None, :]
+
+    def layer_step(x, inputs):
+        layer, k_pages, v_pages = inputs
+        x, k_pages, v_pages = paged_attn_step(
+            cfg, layer, x, k_pages, v_pages, positions,
+            write_page, write_off, tables, valid)
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        return x, (k_pages, v_pages)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def paged_prefill_kv(cfg: LlamaConfig, params: dict, prompt: jax.Array):
+    """Prompt pass returning raw per-position KV (no ring assembly):
+    (k_all, v_all) [L, P, KV, Hd] for a single row [1, P] — the paged
+    insert scatters these into the row's pages. Same ``_prompt_pass``
+    body as ``prefill``, so the engines cannot diverge."""
+    _, k_all, v_all = _prompt_pass(cfg, params, prompt)
+    return k_all[:, 0], v_all[:, 0]  # [L, P, KV, Hd]
+
+
+def paged_insert_prefill(cache: dict, k_all: jax.Array, v_all: jax.Array,
+                         page_ids: jax.Array, page_size: int) -> dict:
+    """Scatter a prefilled row's KV ([L, P, KV, Hd]) into its allocated
+    pages. ``page_ids`` [maxp] int32 (-1 padding beyond the row's
+    pages; positions < P always map into real ids)."""
+    P = k_all.shape[1]
+    t = jnp.arange(P)
+    pidx = jnp.maximum(page_ids[t // page_size], 0)
+    off = t % page_size
+    return {
+        "k": cache["k"].at[:, pidx, off].set(k_all),
+        "v": cache["v"].at[:, pidx, off].set(v_all),
     }
 
 

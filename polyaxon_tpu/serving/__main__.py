@@ -27,6 +27,11 @@ def main() -> int:
     parser.add_argument("--quantize", default=None, choices=["int8"],
                         help="weight-only quantization at load (int8 + "
                              "per-channel scales)")
+    parser.add_argument("--kv", default="dense", choices=["dense", "paged"],
+                        help="KV layout for continuous batching: paged = "
+                             "shared page pool + block tables")
+    parser.add_argument("--kv-page-size", type=int, default=16)
+    parser.add_argument("--kv-pages", type=int, default=None)
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -43,7 +48,9 @@ def main() -> int:
     with ServingServer(args.model, args.checkpoint,
                        host=args.host, port=args.port, seed=args.seed,
                        batching=args.batching, slots=args.slots,
-                       mesh_axes=mesh_axes, quantize=args.quantize) as s:
+                       mesh_axes=mesh_axes, quantize=args.quantize,
+                       kv=args.kv, page_size=args.kv_page_size,
+                       kv_pages=args.kv_pages) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

@@ -80,7 +80,8 @@ class ContinuousBatchingEngine:
     that want request-level interleaving (each HTTP thread does)."""
 
     def __init__(self, model: str, cfg, params, *, slots: int = 4,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None, kv: str = "dense",
+                 page_size: int = 16, kv_pages: Optional[int] = None):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
@@ -89,12 +90,18 @@ class ContinuousBatchingEngine:
         # seq2seq with per-slot encoder state) batches continuously.
         required = ("decode_step_ragged", "cb_init_cache", "cb_prefill",
                     "cb_admission", "cb_validate", "insert_cache_row")
+        if kv == "paged":
+            required += ("decode_step_paged", "paged_init_cache",
+                         "paged_prefill_kv", "paged_insert_prefill")
+        elif kv != "dense":
+            raise ValueError(f"unknown kv mode `{kv}` "
+                             "(expected 'dense' or 'paged')")
         missing = [name for name in required if not hasattr(family, name)]
         if missing:
+            alt = "kv='dense'" if kv == "paged" else "the static engine"
             raise ValueError(
                 f"continuous batching needs the ragged-decode surface; "
-                f"`{model}` ({family.__name__}) lacks {missing} — use "
-                "the static engine")
+                f"`{model}` ({family.__name__}) lacks {missing} — use {alt}")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.model = model
@@ -103,8 +110,21 @@ class ContinuousBatchingEngine:
         self.slots = slots
         self.max_len = max_len or cfg.max_seq_len
         self._family_mod = family
+        self.kv = kv
+        self._pool = None
+        if kv == "paged":
+            from polyaxon_tpu.serving.paged import PagePool
 
-        self._cache = family.cb_init_cache(cfg, slots, self.max_len)
+            if kv_pages is None:
+                self._pool = PagePool.dense_equivalent(
+                    slots, self.max_len, page_size)
+            else:
+                self._pool = PagePool(slots, self.max_len, page_size,
+                                      kv_pages)
+            self._cache = family.paged_init_cache(
+                cfg, self._pool.n_pages, page_size)
+        else:
+            self._cache = family.cb_init_cache(cfg, slots, self.max_len)
         self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
         self._cur = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
@@ -131,13 +151,17 @@ class ContinuousBatchingEngine:
         self.max_step_failures = 3
 
         def step(params, cache, tokens, pos, keys, temps, top_ps, top_ks,
-                 *, filtered: bool):
+                 tables, *, filtered: bool):
             from polyaxon_tpu.models.common import sample_row
             from polyaxon_tpu.serving.quantize import dequantize_tree
 
             params = dequantize_tree(params)  # identity for plain trees
-            logits, cache = family.decode_step_ragged(
-                cfg, params, cache, tokens, pos)
+            if tables is None:
+                logits, cache = family.decode_step_ragged(
+                    cfg, params, cache, tokens, pos)
+            else:
+                logits, cache = family.decode_step_paged(
+                    cfg, params, cache, tokens, pos, tables)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if filtered:
                 # Per-row temperature + top-p/top-k fused into the
@@ -168,13 +192,26 @@ class ContinuousBatchingEngine:
             def run(params, prompt):
                 from polyaxon_tpu.serving.quantize import dequantize_tree
 
+                if self.kv == "paged":
+                    return family.paged_prefill_kv(
+                        cfg, dequantize_tree(params), prompt)
                 return family.cb_prefill(cfg, dequantize_tree(params),
                                          prompt, self.max_len)
 
             return jax.jit(run)
 
         self._compiled_prefill = compiled_prefill
-        self._insert = jax.jit(family.insert_cache_row, donate_argnums=(0,))
+        if kv == "paged":
+            ps = page_size
+
+            def paged_insert(cache, kv_row, page_ids):
+                return family.paged_insert_prefill(
+                    cache, kv_row[0], kv_row[1], page_ids, ps)
+
+            self._insert = jax.jit(paged_insert, donate_argnums=(0,))
+        else:
+            self._insert = jax.jit(family.insert_cache_row,
+                                   donate_argnums=(0,))
 
         self._thread = threading.Thread(
             target=self._loop, name="plx-serving-batcher", daemon=True)
@@ -192,6 +229,18 @@ class ContinuousBatchingEngine:
         # encoder prompt and decode budget separately.
         self._family_mod.cb_validate(self.cfg, len(tokens), max_new_tokens,
                                      self.max_len)
+        if self._pool is not None:
+            # A request that cannot fit the pool even when it is the
+            # only tenant would wait at the FIFO head forever (and
+            # block everyone behind it) — reject it up front. Written
+            # positions span 0..len+max_new-2.
+            need = self._pool.pages_for(len(tokens) + max_new_tokens - 1)
+            capacity = self._pool.n_pages - 1
+            if need > capacity:
+                raise ValueError(
+                    f"request needs {need} KV pages (prompt {len(tokens)} "
+                    f"+ {max_new_tokens} new) but the pool holds "
+                    f"{capacity}; raise --kv-pages or shorten the request")
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
@@ -303,7 +352,16 @@ class ContinuousBatchingEngine:
             with self._cv:
                 if not self._queue:
                     break
+                # Paged backpressure: admission is FIFO — if the head
+                # request's pages don't fit the pool right now, wait
+                # for retirements to free pages instead of skipping it
+                # (skipping would starve long prompts behind short).
+                if (self._pool is not None and not
+                        self._pool.can_admit(len(self._queue[0].tokens))):
+                    break
                 req = self._queue.popleft()
+            if self._pool is not None:
+                self._pool.admit(b, len(req.tokens))
             try:
                 pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
                     req.tokens)
@@ -311,8 +369,13 @@ class ContinuousBatchingEngine:
                     row = jnp.asarray([prefill_tokens], jnp.int32)
                     row_cache = self._compiled_prefill(len(prefill_tokens))(
                         self.params, row)
-                    self._cache = self._insert(
-                        self._cache, row_cache, jnp.int32(b))
+                    if self._pool is not None:
+                        self._cache = self._insert(
+                            self._cache, row_cache,
+                            jnp.asarray(self._pool.padded_row(b)))
+                    else:
+                        self._cache = self._insert(
+                            self._cache, row_cache, jnp.int32(b))
                 self._slot_req[b] = req
                 self._pos[b] = pos0
                 self._cur[b] = tok0
@@ -321,6 +384,8 @@ class ContinuousBatchingEngine:
                 self._top_ks[b] = req.top_k
                 self._keys[b] = jax.random.key(req.seed)
             except Exception as exc:  # noqa: BLE001 — request-scoped
+                if self._pool is not None:
+                    self._pool.release(b)  # failed admission frees pages
                 req.error = f"{type(exc).__name__}: {exc}"
                 req.done.set()
                 # Persistent device breakage surfaces in the admission
@@ -362,12 +427,19 @@ class ContinuousBatchingEngine:
             "tokens_generated": self._tokens_out,
             "step_failures": self._step_failures,
             "stopped": self._stopped,
+            "kv": self.kv,
+            **({"kv_pages_total": self._pool.n_pages - 1,
+                "kv_pages_free": self._pool.free_pages,
+                "kv_page_size": self._pool.page_size}
+               if self._pool is not None else {}),
         }
 
     def _retire(self, b: int) -> None:
         req = self._slot_req[b]
         self._slot_req[b] = None
         self._pos[b] = -1
+        if self._pool is not None:
+            self._pool.release(b)
         self._temps[b] = 0.0
         self._top_ps[b] = 1.0
         self._top_ks[b] = 0
@@ -412,11 +484,14 @@ class ContinuousBatchingEngine:
                     for r in self._slot_req)
                 step_fn = (self._step_filtered if filtered
                            else self._step_plain)
+                tables = (jnp.asarray(self._pool.tables)
+                          if self._pool is not None else None)
                 nxt, self._cache = step_fn(
                     self.params, self._cache,
                     jnp.asarray(self._cur), jnp.asarray(self._pos),
                     keys, jnp.asarray(self._temps),
-                    jnp.asarray(self._top_ps), jnp.asarray(self._top_ks))
+                    jnp.asarray(self._top_ps), jnp.asarray(self._top_ks),
+                    tables)
                 nxt = np.asarray(nxt)
             except Exception as exc:  # noqa: BLE001 — fail live requests
                 logger.exception("decode step failed")
@@ -432,9 +507,14 @@ class ContinuousBatchingEngine:
                     return
                 # The old cache was donated to the failed step — its
                 # buffer is gone (or poisoned). Rebuild so the engine
-                # survives a transient step failure.
-                self._cache = self._family_mod.cb_init_cache(
-                    self.cfg, self.slots, self.max_len)
+                # survives a transient step failure. (Every live row
+                # was retired above, so a paged pool is fully free.)
+                if self._pool is not None:
+                    self._cache = self._family_mod.paged_init_cache(
+                        self.cfg, self._pool.n_pages, self._pool.page_size)
+                else:
+                    self._cache = self._family_mod.cb_init_cache(
+                        self.cfg, self.slots, self.max_len)
                 continue
             self._consec_step_failures = 0
             for b in range(self.slots):
@@ -445,4 +525,15 @@ class ContinuousBatchingEngine:
                 self._pos[b] += 1
                 self._cur[b] = int(nxt[b])
                 if len(req.out) >= req.max_new:
+                    self._retire(b)
+                elif (self._pool is not None
+                      and not self._pool.ensure(b, int(self._pos[b]))):
+                    # An oversubscribed pool ran dry mid-generation:
+                    # fail THIS row loudly (its output so far is
+                    # surfaced in the error path) rather than let it
+                    # scribble over a neighbour's pages.
+                    req.error = (
+                        "kv page pool exhausted mid-generation "
+                        f"(pos {int(self._pos[b])}); raise --kv-pages "
+                        "or lower concurrency")
                     self._retire(b)

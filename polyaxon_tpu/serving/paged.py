@@ -1,0 +1,86 @@
+"""Page-pool allocator for paged-KV continuous batching.
+
+Host-side bookkeeping for the device-side paged cache
+(``models/llama.py`` paged surface): a fixed pool of KV pages shared by
+all slots, per-slot block tables mapping position//page_size → page id.
+Memory then scales with tokens actually held instead of the dense
+engine's slots × max_len reservation, so `--kv-pages` can deliberately
+oversubscribe (admission waits for pages; a live row that cannot
+extend fails loudly rather than corrupting a neighbour).
+
+Page 0 is scratch — never allocated; idle rows and masked holes write
+there (see ``paged_coords``). The allocator is plain numpy/ints on the
+host: allocation happens between decode steps at Python speed, never
+inside the compiled program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PagePool:
+    def __init__(self, slots: int, max_len: int, page_size: int,
+                 n_pages: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.max_pages_per_row = -(-max_len // page_size)
+        # Page 0 is scratch: usable pages are 1..n_pages-1.
+        if n_pages < 2:
+            raise ValueError(f"kv pool needs >= 2 pages, got {n_pages}")
+        self.n_pages = n_pages
+        self._free = list(range(n_pages - 1, 0, -1))
+        self.tables = np.full((slots, self.max_pages_per_row), -1, np.int32)
+
+    @classmethod
+    def dense_equivalent(cls, slots: int, max_len: int,
+                         page_size: int) -> "PagePool":
+        """Pool sized to the dense engine's reservation (+ scratch)."""
+        maxp = -(-max_len // page_size)
+        return cls(slots, max_len, page_size, slots * maxp + 1)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, length: int) -> int:
+        return -(-max(length, 1) // self.page_size)
+
+    def can_admit(self, length: int) -> bool:
+        return self.pages_for(length) <= len(self._free)
+
+    def admit(self, slot: int, length: int) -> bool:
+        """Allocate pages covering positions 0..length-1 for ``slot``.
+        False (nothing allocated) if the pool cannot cover it."""
+        need = self.pages_for(length)
+        if need > len(self._free):
+            return False
+        row = self.tables[slot]
+        assert (row < 0).all(), f"slot {slot} admitted while still holding pages"
+        for i in range(need):
+            row[i] = self._free.pop()
+        return True
+
+    def ensure(self, slot: int, pos: int) -> bool:
+        """Make position ``pos`` writable for ``slot`` (allocating its
+        page if new). False = pool exhausted; the row keeps its pages."""
+        idx = pos // self.page_size
+        if idx >= self.max_pages_per_row:
+            return False
+        if self.tables[slot, idx] >= 0:
+            return True
+        if not self._free:
+            return False
+        self.tables[slot, idx] = self._free.pop()
+        return True
+
+    def release(self, slot: int) -> None:
+        row = self.tables[slot]
+        for idx in np.flatnonzero(row >= 0):
+            self._free.append(int(row[idx]))
+        row[:] = -1
+
+    def padded_row(self, slot: int) -> np.ndarray:
+        """The slot's block-table row (fixed [max_pages_per_row])."""
+        return self.tables[slot]

@@ -399,7 +399,8 @@ class ServingServer:
                  host: str = "127.0.0.1", port: int = 0, seed: int = 0,
                  batching: str = "static", slots: int = 4,
                  mesh_axes: Optional[dict] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None, kv: str = "dense",
+                 page_size: int = 16, kv_pages: Optional[int] = None):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -426,8 +427,14 @@ class ServingServer:
             from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
 
             self.engine = ContinuousBatchingEngine(
-                model, cfg, params, slots=slots)
+                model, cfg, params, slots=slots, kv=kv,
+                page_size=page_size, kv_pages=kv_pages)
         elif batching == "static":
+            if kv != "dense":
+                raise ValueError(
+                    "kv='paged' requires --batching continuous (the "
+                    "static engine compiles whole generations, not "
+                    "pooled steps)")
             self.engine = _Engine(model, cfg, params)
         else:
             raise ValueError(

@@ -34,10 +34,9 @@ class StreamsService:
         self._walk_inflight: dict[Any, threading.Event] = {}
 
     def _cached_walk(self, key: Any, compute, ttl: float = 10.0):
-        now = time.monotonic()
         with self._walk_cache_lock:
             hit = self._walk_cache.get(key)
-            if hit and hit[0] > now:
+            if hit and hit[0] > time.monotonic():
                 return hit[1]
             # Single-flight per key: when a TTL lapses with N viewers
             # polling, one thread walks and the rest wait for its
@@ -51,19 +50,25 @@ class StreamsService:
                 hit = self._walk_cache.get(key)
             if hit:  # possibly expired, still the freshest walk we have
                 return hit[1]
-            return compute()  # walker died/timed out: fall through
+            # Walker failed or timed out: re-enter the single-flight
+            # path so ONE waiter becomes the new walker (and caches the
+            # result) instead of all of them stampeding compute().
+            return self._cached_walk(key, compute, ttl)
         try:
             value = compute()  # the walk itself runs unlocked
+            done = time.monotonic()  # expiry from walk END: a walk
+            # slower than the TTL must not insert already-expired
             with self._walk_cache_lock:
                 for k in [k for k, (exp, _) in self._walk_cache.items()
-                          if exp <= now]:
+                          if exp <= done]:
                     del self._walk_cache[k]
-                self._walk_cache[key] = (now + ttl, value)
+                self._walk_cache[key] = (done + ttl, value)
             return value
         finally:
             # Cache insert happens BEFORE the event fires (walker
             # success path), so woken waiters find the fresh entry; on
-            # a compute() exception they fall through to their own walk.
+            # a compute() exception they re-enter and one becomes the
+            # new walker.
             with self._walk_cache_lock:
                 event = self._walk_inflight.pop(key, None)
             if event is not None:
