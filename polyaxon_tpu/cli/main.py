@@ -563,8 +563,9 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
 @click.option("--kv-page-size", default=16,
               help="tokens per KV page (--kv paged)")
 @click.option("--kv-pages", default=None, type=int,
-              help="total pages in the pool (--kv paged); default = the "
-                   "dense-equivalent reservation, lower = deliberate "
+              help="usable KV pages in the pool (--kv paged; matches "
+                   "kv_pages_total in /v1/stats); default = the dense-"
+                   "equivalent reservation, lower = deliberate "
                    "oversubscription with admission backpressure")
 def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
               quantize, kv, kv_page_size, kv_pages):

@@ -569,11 +569,6 @@ def insert_cache_row(cache: dict, row: dict, b) -> dict:
 # surface, SURVEY.md §2). Page 0 is scratch: idle rows and unallocated
 # coordinates write there, and masks keep it unread.
 
-def paged_cache_len(n_pages: int, page_size: int) -> int:
-    """Max positions one gathered row can cover (all non-scratch pages)."""
-    return (n_pages - 1) * page_size
-
-
 def paged_init_cache(cfg: LlamaConfig, n_pages: int, page_size: int) -> dict:
     if cfg.sliding_window is not None:
         raise ValueError(

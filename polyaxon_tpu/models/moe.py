@@ -497,17 +497,11 @@ def init_cache(cfg: MoEConfig, batch: int, max_len: int) -> dict:
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
-def prefill(
-    cfg: MoEConfig,
-    params: dict,
-    prompt: jax.Array,  # [B, P] int32
-    max_len: int,
-) -> tuple[jax.Array, dict]:
-    """One batched causal pass over the prompt, filling the KV cache:
-    (last-position logits [B, V] fp32, cache). The MoE FFN replaces the
-    dense MLP of the llama prefill; routing runs over the B·P prompt
-    tokens exactly as in training."""
-    _check_decodable(cfg)
+def _prompt_pass(cfg: MoEConfig, params: dict, prompt: jax.Array):
+    """Shared causal prompt sweep (one body for both prefill flavours,
+    same contract as llama's): (final hidden x [B, P, D], k_all, v_all
+    [L, B, P, KV, Hd]). The MoE FFN replaces the dense MLP; routing
+    runs over the B·P prompt tokens exactly as in training."""
     dt = cfg.dtype
     B, P = prompt.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -530,6 +524,21 @@ def prefill(
         return x + moe_out, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
+    return x, k_all, v_all
+
+
+def prefill(
+    cfg: MoEConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, P] int32
+    max_len: int,
+) -> tuple[jax.Array, dict]:
+    """One batched causal pass over the prompt, filling the KV cache:
+    (last-position logits [B, V] fp32, cache)."""
+    _check_decodable(cfg)
+    dt = cfg.dtype
+    B = prompt.shape[0]
+    x, k_all, v_all = _prompt_pass(cfg, params, prompt)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, -1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
     cache = init_cache(cfg, B, max_len)
@@ -611,12 +620,65 @@ def decode_step(
         jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
 
 
+def decode_step_paged(
+    cfg: MoEConfig,
+    params: dict,
+    cache: dict,  # {"k"/"v": [L, P, page, KV, Hd]}
+    tokens: jax.Array,  # [B] int32
+    pos: jax.Array,  # [B] int32 per-row position (-1 = idle)
+    tables: jax.Array,  # [B, maxp] int32 page ids (-1 = unallocated)
+) -> tuple[jax.Array, dict]:
+    """Paged-pool ragged decode (llama's block-table semantics, the
+    expert FFN in the MLP slot) — parity with ``decode_step_ragged``
+    for rows whose pages cover 0..p."""
+    from polyaxon_tpu.models.llama import paged_attn_step, paged_coords
+
+    _check_decodable(cfg)
+    dt = cfg.dtype
+    page = cache["k"].shape[2]
+    positions, write_page, write_off, valid = paged_coords(pos, tables, page)
+    x = params["embed"].astype(dt)[tokens][:, None, :]
+
+    def layer_step(x, inputs):
+        layer, k_pages, v_pages = inputs
+        x, k_pages, v_pages = paged_attn_step(
+            cfg, layer, x, k_pages, v_pages, positions,
+            write_page, write_off, tables, valid)
+        h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
+        moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
+                               layer["w_up"], layer["w_down"],
+                               min_capacity=h.shape[0])
+        return x + moe_out, (k_pages, v_pages)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def paged_init_cache(cfg: MoEConfig, n_pages: int, page_size: int) -> dict:
+    """Paged pool (MoE configs carry no sliding window)."""
+    shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def paged_prefill_kv(cfg: MoEConfig, params: dict, prompt: jax.Array):
+    """Raw per-position KV for the paged insert ([L, P, KV, Hd], single
+    row) — same ``_prompt_pass`` body as ``prefill``."""
+    _check_decodable(cfg)
+    _, k_all, v_all = _prompt_pass(cfg, params, prompt)
+    return k_all[:, 0], v_all[:, 0]
+
+
 # Continuous-batching hooks: admission/validation semantics are the
-# llama decoder-only ones; cache init/prefill are moe's own.
+# llama decoder-only ones; cache init/prefill are moe's own; the paged
+# insert is pure indexing shared verbatim.
 from polyaxon_tpu.models.llama import (  # noqa: E402  (re-exported hooks)
     cb_admission,
     cb_validate,
     insert_cache_row,
+    paged_insert_prefill,
 )
 
 

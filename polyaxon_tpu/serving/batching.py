@@ -119,8 +119,10 @@ class ContinuousBatchingEngine:
                 self._pool = PagePool.dense_equivalent(
                     slots, self.max_len, page_size)
             else:
+                # kv_pages counts USABLE pages (what /v1/stats reports
+                # as kv_pages_total); the scratch page is internal.
                 self._pool = PagePool(slots, self.max_len, page_size,
-                                      kv_pages)
+                                      kv_pages + 1)
             self._cache = family.paged_init_cache(
                 cfg, self._pool.n_pages, page_size)
         else:
@@ -187,31 +189,35 @@ class ContinuousBatchingEngine:
         self._step_filtered = jax.jit(
             functools.partial(step, filtered=True), donate_argnums=(1,))
 
+        # One lru-bounded executable per prompt length for BOTH kv
+        # modes; paged folds the page scatter into the same program
+        # (a separate jit of the [L, P, ...] insert would accumulate
+        # an unbounded compile cache over prompt-length diversity).
         @lru_cache(maxsize=16)
         def compiled_prefill(plen: int):
-            def run(params, prompt):
-                from polyaxon_tpu.serving.quantize import dequantize_tree
+            from polyaxon_tpu.serving.quantize import dequantize_tree
 
-                if self.kv == "paged":
-                    return family.paged_prefill_kv(
+            if self.kv == "paged":
+                ps = page_size
+
+                def run(params, prompt, cache, page_ids):
+                    k_all, v_all = family.paged_prefill_kv(
                         cfg, dequantize_tree(params), prompt)
+                    return family.paged_insert_prefill(
+                        cache, k_all, v_all, page_ids, ps)
+
+                return jax.jit(run, donate_argnums=(2,))
+
+            def run(params, prompt):
                 return family.cb_prefill(cfg, dequantize_tree(params),
                                          prompt, self.max_len)
 
             return jax.jit(run)
 
         self._compiled_prefill = compiled_prefill
-        if kv == "paged":
-            ps = page_size
-
-            def paged_insert(cache, kv_row, page_ids):
-                return family.paged_insert_prefill(
-                    cache, kv_row[0], kv_row[1], page_ids, ps)
-
-            self._insert = jax.jit(paged_insert, donate_argnums=(0,))
-        else:
-            self._insert = jax.jit(family.insert_cache_row,
-                                   donate_argnums=(0,))
+        self._insert = (None if kv == "paged" else
+                        jax.jit(family.insert_cache_row,
+                                donate_argnums=(0,)))
 
         self._thread = threading.Thread(
             target=self._loop, name="plx-serving-batcher", daemon=True)
@@ -367,13 +373,13 @@ class ContinuousBatchingEngine:
                     req.tokens)
                 if prefill_tokens:
                     row = jnp.asarray([prefill_tokens], jnp.int32)
-                    row_cache = self._compiled_prefill(len(prefill_tokens))(
-                        self.params, row)
+                    fn = self._compiled_prefill(len(prefill_tokens))
                     if self._pool is not None:
-                        self._cache = self._insert(
-                            self._cache, row_cache,
+                        self._cache = fn(
+                            self.params, row, self._cache,
                             jnp.asarray(self._pool.padded_row(b)))
                     else:
+                        row_cache = fn(self.params, row)
                         self._cache = self._insert(
                             self._cache, row_cache, jnp.int32(b))
                 self._slot_req[b] = req

@@ -48,11 +48,17 @@ class StreamsService:
             waiting.wait(timeout=30)
             with self._walk_cache_lock:
                 hit = self._walk_cache.get(key)
+                walker_stuck = self._walk_inflight.get(key) is waiting
             if hit:  # possibly expired, still the freshest walk we have
                 return hit[1]
-            # Walker failed or timed out: re-enter the single-flight
-            # path so ONE waiter becomes the new walker (and caches the
-            # result) instead of all of them stampeding compute().
+            if walker_stuck:
+                # The walker is still running after 30s (hung FS?):
+                # degrade to an uncached own walk — bounded latency
+                # beats waiting (or recursing) behind it forever.
+                return compute()
+            # Walker finished-with-failure or died: re-enter ONCE —
+            # the inflight entry is gone, so one waiter becomes the
+            # new walker (and caches); the rest wait on it.
             return self._cached_walk(key, compute, ttl)
         try:
             value = compute()  # the walk itself runs unlocked

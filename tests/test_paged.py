@@ -139,10 +139,11 @@ class TestPagedEngine:
         cfg = _cfg()
         params = self._params(cfg)
         rows = [[5, 6, 7], [1, 2, 3, 4], [9, 8, 7]]
-        # slots=2, max_len=32, page=4 → dense-equivalent 16 pages; use 8.
+        # slots=2, max_len=32, page=4 → dense-equivalent 16 pages; use 8
+        # (kv_pages counts usable pages; scratch is internal).
         engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
                                           slots=2, max_len=32, kv="paged",
-                                          page_size=4, kv_pages=9)
+                                          page_size=4, kv_pages=8)
         try:
             out = engine.generate(rows, max_new_tokens=5, timeout=300)
             assert all(len(r) == 5 for r in out)
@@ -162,7 +163,7 @@ class TestPagedEngine:
         # at pos 8 — slot 0 fails first, its release frees slot 1.
         engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
                                           slots=2, max_len=32, kv="paged",
-                                          page_size=4, kv_pages=5)
+                                          page_size=4, kv_pages=4)
         try:
             req_a = engine.submit([5, 6, 7], max_new_tokens=8)
             req_b = engine.submit([9, 8, 7], max_new_tokens=8)
@@ -194,7 +195,7 @@ class TestPagedEngine:
         params = self._params(cfg)
         engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
                                           slots=1, max_len=32, kv="paged",
-                                          page_size=4, kv_pages=3)
+                                          page_size=4, kv_pages=2)
         try:
             with pytest.raises(ValueError, match="KV pages"):
                 engine.submit([1] * 10, max_new_tokens=10)  # needs 5 pages
@@ -203,3 +204,30 @@ class TestPagedEngine:
                                        timeout=300)[0]) == 4
         finally:
             engine.stop()
+
+
+class TestMoEPaged:
+    def test_moe_paged_matches_dense_engine(self):
+        """The MoE family over the paged pool: greedy parity with its
+        own dense engine (expert routing sees the same hidden states
+        either way)."""
+        from polyaxon_tpu.models import moe
+
+        cfg = dataclasses.replace(moe.CONFIGS["moe_tiny"],
+                                  dtype=jnp.float32)
+        params = moe.init(cfg, jax.random.key(0))["params"]
+        rows = [[5, 6, 7], [1, 2, 3, 4], [9, 8]]
+        dense = ContinuousBatchingEngine("moe_tiny", cfg, params,
+                                         slots=2, max_len=32)
+        try:
+            want = dense.generate(rows, max_new_tokens=5, timeout=300)
+        finally:
+            dense.stop()
+        paged = ContinuousBatchingEngine("moe_tiny", cfg, params,
+                                         slots=2, max_len=32,
+                                         kv="paged", page_size=4)
+        try:
+            got = paged.generate(rows, max_new_tokens=5, timeout=300)
+        finally:
+            paged.stop()
+        assert got == want
