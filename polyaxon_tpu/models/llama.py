@@ -62,6 +62,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: str = "none"  # none | full | dots (checkpoint policy per layer)
     attention_impl: str = "xla"  # xla | flash | ring | ulysses
+    # Paged decode attention: "auto" = the Pallas page-streaming kernel
+    # on real TPU (ops/paged_attention.py), gather+masked-softmax
+    # elsewhere; "gather" / "pallas" force one.
+    paged_attention_impl: str = "auto"
     # Flash-kernel tuning (runtime keys flow here via model_overrides):
     # fwd tile sizes and backward implementation ("pallas" | "xla").
     # None = the kernel's own defaults (512 fwd tiles; pallas bwd on
@@ -605,16 +609,32 @@ def paged_attn_step(cfg, layer: dict, x: jax.Array, k_pages: jax.Array,
     k_pages = k_pages.at[write_page, write_off].set(k[:, 0])
     v_pages = v_pages.at[write_page, write_off].set(v[:, 0])
 
-    gathered = jnp.maximum(tables, 0)  # [B, maxp] — scratch for holes
-    keys = k_pages[gathered].reshape(B, -1, KV, Hd)  # [B, maxp*page, ...]
-    vals = v_pages[gathered].reshape(B, -1, KV, Hd)
-    keys = repeat_kv(keys, n_rep)
-    vals = repeat_kv(vals, n_rep)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
-    logits = logits * (Hd ** -0.5)
-    logits = jnp.where(valid, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+    impl = getattr(cfg, "paged_attention_impl", "gather")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if impl == "pallas":
+        # Stream pages straight from the pool (skipping holes and
+        # pages past pos) instead of materializing the gather — see
+        # ops/paged_attention.py. `pos` is recovered from the RoPE
+        # positions + the valid mask's idle bit.
+        from polyaxon_tpu.ops.paged_attention import paged_decode_attention
+
+        live = valid[:, 0, 0, :].any(axis=-1)  # [B] — idle rows all-False
+        pos_vec = jnp.where(live, positions[:, 0], -1)
+        attn = paged_decode_attention(
+            q[:, 0].reshape(B, H, Hd), k_pages, v_pages, tables,
+            pos_vec).astype(dt)[:, None]
+    else:
+        gathered = jnp.maximum(tables, 0)  # [B, maxp] — scratch for holes
+        keys = k_pages[gathered].reshape(B, -1, KV, Hd)  # [B, maxp*page, .]
+        vals = v_pages[gathered].reshape(B, -1, KV, Hd)
+        keys = repeat_kv(keys, n_rep)
+        vals = repeat_kv(vals, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+        logits = logits * (Hd ** -0.5)
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
     return x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt), \
         k_pages, v_pages
 

@@ -111,6 +111,9 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     remat: str = "none"
     attention_impl: str = "xla"
+    # Paged decode attention (same semantics as LlamaConfig's field):
+    # "auto" = Pallas page-streaming kernel on real TPU, gather off it.
+    paged_attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:

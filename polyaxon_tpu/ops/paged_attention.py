@@ -1,0 +1,166 @@
+"""Pallas paged-attention decode kernel (TPU).
+
+The XLA gather formulation of paged decode (``models/llama.py``
+``paged_attn_step``) materializes every row's gathered pages
+([B, maxp·page, KV, Hd]) in HBM each step — 2× the cache traffic of
+reading it once. This kernel streams each row's pages straight from
+the pool through VMEM with an online-softmax accumulator (the flash
+recipe from ``ops/flash.py``, specialized to q-length 1), using
+scalar-prefetched block tables to drive the page DMA — and pages that
+are unallocated or wholly past the row's position are skipped, so
+compute tracks actual sequence lengths, not the table width.
+
+Decode attention is HBM-bandwidth-bound (tiny matmuls, whole-cache
+reads), which is exactly the regime where cutting bytes moved wins.
+Reference for the paged memory model: vLLM; for the TPU scalar-
+prefetch pattern: the Pallas guide §PrefetchScalarGridSpec. Written
+against this repo's own flash kernel conventions — not a port.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports cleanly where libtpu/mosaic is present
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _decode_kernel(
+    tables_ref,  # scalar prefetch: [B, maxp] int32 page ids (-1 = hole)
+    pos_ref,  # scalar prefetch: [B] int32 row positions (-1 = idle)
+    q_ref,  # [1, 1, rep, Hd]
+    k_ref,  # [1, page, 1, Hd] — page selected by the index map
+    v_ref,  # [1, page, 1, Hd]
+    o_ref,  # [1, 1, rep, Hd]
+    acc_ref,  # VMEM [rep, Hd] f32
+    m_ref,  # VMEM [rep, LANES] f32
+    l_ref,  # VMEM [rep, LANES] f32
+    *,
+    scale: float,
+    page: int,
+):
+    b, j = pl.program_id(0), pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    pos = pos_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # A page contributes iff the row is live, the page is allocated,
+    # and it starts at or before the row's current position.
+    @pl.when((pos >= 0) & (tables_ref[b, j] >= 0) & (j * page <= pos))
+    def _compute():
+        q = q_ref[0, 0]  # [rep, Hd]
+        k = k_ref[0, :, 0]  # [page, Hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s *= scale  # [rep, page]
+
+        cols = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = cols <= pos
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, :, 0]  # [page, Hd]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # idle row → zeros
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, Hd] — the single decode position per row
+    k_pages: jax.Array,  # [P, page, KV, Hd]
+    v_pages: jax.Array,
+    tables: jax.Array,  # [B, maxp] int32 (-1 = unallocated)
+    pos: jax.Array,  # [B] int32 (-1 = idle row → zeros out)
+    *,
+    interpret: bool | None = None,  # None = interpret off-TPU
+) -> jax.Array:
+    """Attention of each row's query against its pages (positions
+    0..pos inclusive — the current step's K/V must already be written
+    to the pool). Returns [B, H, Hd]."""
+    if pltpu is None:
+        raise ImportError(
+            "paged_decode_attention needs jax.experimental.pallas.tpu "
+            "(unavailable in this jax install) — use "
+            "paged_attention_impl='gather'")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Hd = q.shape
+    P, page, KV, _ = k_pages.shape
+    maxp = tables.shape[1]
+    rep = H // KV
+    scale = Hd ** -0.5
+
+    q4 = q.reshape(B, KV, rep, Hd)
+    grid = (B, KV, maxp)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, page=page)
+    compiler_params = None
+    if pltpu is not None and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, Hd),
+                         lambda b, h, j, tables_ref, pos_ref: (b, h, 0, 0)),
+            # The page DMA: block index along the pool axis comes from
+            # the row's block table (clamped — holes are skipped by the
+            # kernel predicate, the clamp only keeps the index legal).
+            pl.BlockSpec(
+                (1, page, 1, Hd),
+                lambda b, h, j, tables_ref, pos_ref: (
+                    jnp.maximum(tables_ref[b, j], 0), 0, h, 0)),
+            pl.BlockSpec(
+                (1, page, 1, Hd),
+                lambda b, h, j, tables_ref, pos_ref: (
+                    jnp.maximum(tables_ref[b, j], 0), 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rep, Hd),
+            lambda b, h, j, tables_ref, pos_ref: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, Hd), jnp.float32),
+            pltpu.VMEM((rep, LANES), jnp.float32),
+            pltpu.VMEM((rep, LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, rep, Hd), q.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(tables.astype(jnp.int32), pos.astype(jnp.int32), q4, k_pages, v_pages)
+    return out.reshape(B, H, Hd)

@@ -120,7 +120,11 @@ class ContinuousBatchingEngine:
                     slots, self.max_len, page_size)
             else:
                 # kv_pages counts USABLE pages (what /v1/stats reports
-                # as kv_pages_total); the scratch page is internal.
+                # as kv_pages_total); the scratch page is internal —
+                # validate in the user's units before adding it.
+                if kv_pages < 1:
+                    raise ValueError(
+                        f"kv_pages must be >= 1, got {kv_pages}")
                 self._pool = PagePool(slots, self.max_len, page_size,
                                       kv_pages + 1)
             self._cache = family.paged_init_cache(

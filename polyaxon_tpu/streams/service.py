@@ -53,9 +53,16 @@ class StreamsService:
                 return hit[1]
             if walker_stuck:
                 # The walker is still running after 30s (hung FS?):
-                # degrade to an uncached own walk — bounded latency
-                # beats waiting (or recursing) behind it forever.
-                return compute()
+                # degrade to an own walk — bounded latency beats
+                # waiting (or recursing) behind it forever — and CACHE
+                # the result so pollers arriving during the hang get a
+                # hit instead of each launching another walk against
+                # the already-slow store.
+                value = compute()
+                done = time.monotonic()
+                with self._walk_cache_lock:
+                    self._walk_cache[key] = (done + ttl, value)
+                return value
             # Walker finished-with-failure or died: re-enter ONCE —
             # the inflight entry is gone, so one waiter becomes the
             # new walker (and caches); the rest wait on it.
