@@ -367,11 +367,19 @@ class ContinuousBatchingEngine:
                 # for retirements to free pages instead of skipping it
                 # (skipping would starve long prompts behind short).
                 if (self._pool is not None and not
-                        self._pool.can_admit(len(self._queue[0].tokens))):
+                        self._pool.can_admit(len(self._queue[0].tokens),
+                                             self._queue[0].tokens)):
                     break
                 req = self._queue.popleft()
-            if self._pool is not None:
-                self._pool.admit(b, len(req.tokens))
+            if self._pool is not None and not self._pool.admit(
+                    b, len(req.tokens), req.tokens):
+                # can_admit raced/drifted: put the request back at the
+                # head (FIFO preserved) and wait for retirements —
+                # running without pages would stream scratch-page
+                # garbage.
+                with self._cv:
+                    self._queue.appendleft(req)
+                break
             try:
                 pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
                     req.tokens)
@@ -395,7 +403,10 @@ class ContinuousBatchingEngine:
                 self._keys[b] = jax.random.key(req.seed)
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 if self._pool is not None:
-                    self._pool.release(b)  # failed admission frees pages
+                    # Failed admission frees pages AND forgets any
+                    # prefix keys registered for content the prefill
+                    # never wrote.
+                    self._pool.release(b, invalidate_prefix=True)
                 req.error = f"{type(exc).__name__}: {exc}"
                 req.done.set()
                 # Persistent device breakage surfaces in the admission
@@ -440,7 +451,9 @@ class ContinuousBatchingEngine:
             "kv": self.kv,
             **({"kv_pages_total": self._pool.n_pages - 1,
                 "kv_pages_free": self._pool.free_pages,
-                "kv_page_size": self._pool.page_size}
+                "kv_page_size": self._pool.page_size,
+                "kv_prefix_hits": self._pool.prefix_hits,
+                "kv_prefix_misses": self._pool.prefix_misses}
                if self._pool is not None else {}),
         }
 
@@ -522,6 +535,9 @@ class ContinuousBatchingEngine:
                 if self._pool is not None:
                     self._cache = self._family_mod.paged_init_cache(
                         self.cfg, self._pool.n_pages, self._pool.page_size)
+                    # The rebuilt cache is zeros: resident prefix pages
+                    # no longer hold the content their keys promise.
+                    self._pool.invalidate_prefix_cache()
                 else:
                     self._cache = self._family_mod.cb_init_cache(
                         self.cfg, self.slots, self.max_len)

@@ -16,12 +16,14 @@ inside the compiled program.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 
 class PagePool:
     def __init__(self, slots: int, max_len: int, page_size: int,
-                 n_pages: int):
+                 n_pages: int, prefix_cache: bool = True):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
@@ -32,34 +34,129 @@ class PagePool:
         self.n_pages = n_pages
         self._free = list(range(n_pages - 1, 0, -1))
         self.tables = np.full((slots, self.max_pages_per_row), -1, np.int32)
+        # Prefix cache: prompt pages FULLY covered by prefill positions
+        # are content-addressed by their token chain, shared via
+        # refcounts, and kept resident after release (LRU-evicted only
+        # under allocation pressure) — a repeated system prompt costs
+        # its KV once. Decode pages are never shared: their content
+        # diverges per request.
+        self.prefix_cache = prefix_cache
+        self._ref = np.zeros(n_pages, np.int32)
+        self._by_key: dict = {}  # token-chain key -> page id
+        self._key_of: dict = {}  # page id -> key
+        self._cached: dict = {}  # retired-but-resident pages, LRU order
+        # Pages whose prefix key THIS slot registered during its
+        # current tenancy — the only keys a failed admission must
+        # invalidate (hit pages hold content from completed prefills).
+        self._fresh_keys: dict[int, set] = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
 
     @classmethod
-    def dense_equivalent(cls, slots: int, max_len: int,
-                         page_size: int) -> "PagePool":
+    def dense_equivalent(cls, slots: int, max_len: int, page_size: int,
+                         prefix_cache: bool = True) -> "PagePool":
         """Pool sized to the dense engine's reservation (+ scratch)."""
         maxp = -(-max_len // page_size)
-        return cls(slots, max_len, page_size, slots * maxp + 1)
+        return cls(slots, max_len, page_size, slots * maxp + 1,
+                   prefix_cache=prefix_cache)
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free + retired-but-resident cache."""
+        return len(self._free) + len(self._cached)
 
     def pages_for(self, length: int) -> int:
         return -(-max(length, 1) // self.page_size)
 
-    def can_admit(self, length: int) -> bool:
-        return self.pages_for(length) <= len(self._free)
+    def _shareable(self, length: int, tokens) -> int:
+        if not (self.prefix_cache and tokens is not None):
+            return 0
+        return min((length - 1) // self.page_size, self.pages_for(length))
 
-    def admit(self, slot: int, length: int) -> bool:
-        """Allocate pages covering positions 0..length-1 for ``slot``.
-        False (nothing allocated) if the pool cannot cover it."""
+    def _plan(self, length: int, tokens) -> int:
+        """Allocatable units this admission actually consumes: prefix
+        hits on LIVE pages (shared with another row) cost nothing;
+        hits on resident pages and every miss/private page cost one."""
         need = self.pages_for(length)
-        if need > len(self._free):
+        consume = 0
+        shareable = self._shareable(length, tokens)
+        for i in range(need):
+            if i < shareable:
+                page = self._by_key.get(
+                    tuple(tokens[:(i + 1) * self.page_size]))
+                if page is not None and self._ref[page] > 0:
+                    continue  # live share: no new allocation
+            consume += 1
+        return consume
+
+    def can_admit(self, length: int, tokens=None) -> bool:
+        return self._plan(length, tokens) <= self.free_pages
+
+    def _alloc_one(self):
+        """One page: free list first, then evict the LRU resident
+        prefix page. None = pool genuinely dry."""
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            page = next(iter(self._cached))
+            del self._cached[page]
+            key = self._key_of.pop(page, None)
+            if key is not None:
+                self._by_key.pop(key, None)
+            return page
+        return None
+
+    def _take(self, page: int) -> None:
+        """Claim a specific resident page out of the retired cache."""
+        del self._cached[page]
+
+    def admit(self, slot: int, length: int,
+              tokens: Optional[list] = None) -> bool:
+        """Allocate pages covering positions 0..length-1 for ``slot``.
+        With ``tokens`` (the full prompt) and prefix caching on, pages
+        fully covered by the PREFILL positions (0..length-2) reuse
+        pages whose token chain matches — their KV content is identical
+        by construction, so the prefill's idempotent rewrite of shared
+        pages is harmless. False = nothing allocated.
+
+        Page i is shareable iff fully inside the prefill range: the
+        decode write at length-1 (and everything after) must land on
+        private pages."""
+        need = self.pages_for(length)
+        if self._plan(length, tokens) > self.free_pages:
             return False
         row = self.tables[slot]
         assert (row < 0).all(), f"slot {slot} admitted while still holding pages"
+        ps = self.page_size
+        shareable = self._shareable(length, tokens)
+        fresh = self._fresh_keys.setdefault(slot, set())
         for i in range(need):
-            row[i] = self._free.pop()
+            page = None
+            if i < shareable:
+                key = tuple(tokens[:(i + 1) * ps])
+                hit = self._by_key.get(key)
+                if hit is not None:
+                    page = hit
+                    if page in self._cached:
+                        self._take(page)
+                    self.prefix_hits += 1
+                else:
+                    page = self._alloc_one()
+                    if page is not None:
+                        self._by_key[key] = page
+                        self._key_of[page] = key
+                        fresh.add(page)  # key valid only after prefill
+                        self.prefix_misses += 1
+            else:
+                page = self._alloc_one()
+            if page is None:
+                # _plan said this fits, so this branch is belt-and-
+                # braces against accounting drift: roll back cleanly
+                # rather than corrupt the row.
+                self.release(slot, invalidate_prefix=True)
+                return False
+            row[i] = page
+            self._ref[page] += 1
         return True
 
     def ensure(self, slot: int, pos: int) -> bool:
@@ -70,16 +167,50 @@ class PagePool:
             return False
         if self.tables[slot, idx] >= 0:
             return True
-        if not self._free:
+        page = self._alloc_one()
+        if page is None:
             return False
-        self.tables[slot, idx] = self._free.pop()
+        self.tables[slot, idx] = page
+        self._ref[page] += 1
         return True
 
-    def release(self, slot: int) -> None:
+    def release(self, slot: int, invalidate_prefix: bool = False) -> None:
+        """Drop the slot's references. A page at refcount 0 returns to
+        the free list — unless it is a prefix page, which stays
+        resident (LRU) so the next identical prompt hits it.
+
+        ``invalidate_prefix``: the slot's admission failed before its
+        prefill wrote the pages — only the keys THIS slot freshly
+        registered are dropped; pages it merely hit carry content from
+        completed prefills and stay shareable."""
         row = self.tables[slot]
+        fresh = self._fresh_keys.pop(slot, set())
         for idx in np.flatnonzero(row >= 0):
-            self._free.append(int(row[idx]))
+            page = int(row[idx])
+            self._ref[page] -= 1
+            if self._ref[page] <= 0:
+                self._ref[page] = 0
+                key = self._key_of.get(page)
+                if key is not None and invalidate_prefix and page in fresh:
+                    del self._key_of[page]
+                    self._by_key.pop(key, None)
+                    key = None
+                if key is not None:
+                    self._cached.pop(page, None)
+                    self._cached[page] = True  # to LRU tail
+                else:
+                    self._free.append(page)
         row[:] = -1
+
+    def invalidate_prefix_cache(self) -> None:
+        """Forget every resident prefix page (device cache rebuilt →
+        their content is gone). Pages still referenced by live rows
+        keep their allocation but lose their shareability."""
+        for page in list(self._cached):
+            del self._cached[page]
+            self._free.append(page)
+        self._by_key.clear()
+        self._key_of.clear()
 
     def padded_row(self, slot: int) -> np.ndarray:
         """The slot's block-table row (fixed [max_pages_per_row])."""

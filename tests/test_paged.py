@@ -299,3 +299,96 @@ class TestPagedKernel:
         np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
                                    atol=2e-4, rtol=2e-4)
         assert np.isfinite(np.asarray(got[1])).all()
+
+
+class TestPrefixCache:
+    def test_shared_prompt_pages_reused(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        tokens = list(range(10))  # prefill 0..8 → pages 0,1 shareable
+        assert pool.admit(0, 10, tokens)
+        free_after_first = pool.free_pages
+        assert pool.admit(1, 10, tokens)
+        assert pool.prefix_hits == 2
+        # Second identical prompt costs only its private decode page.
+        assert free_after_first - pool.free_pages == 1
+        # The shared pages appear in both tables; privates differ.
+        assert (pool.tables[0][:2] == pool.tables[1][:2]).all()
+        assert pool.tables[0][2] != pool.tables[1][2]
+
+    def test_resident_pages_survive_release_and_rehit(self):
+        pool = PagePool(slots=1, max_len=32, page_size=4, n_pages=9)
+        tokens = list(range(10))
+        assert pool.admit(0, 10, tokens)
+        pool.release(0)
+        assert pool.free_pages == 8  # resident pages still allocatable
+        assert pool.admit(0, 10, tokens)
+        assert pool.prefix_hits == 2  # prompt KV reused across requests
+
+    def test_distinct_prompts_do_not_cross_hit(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        assert pool.admit(0, 10, list(range(10)))
+        assert pool.admit(1, 10, list(range(100, 110)))
+        assert pool.prefix_hits == 0
+        # Common-prefix prompts share exactly the common full pages.
+        pool.release(0)
+        pool.release(1)
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        b = [1, 2, 3, 4, 5, 6, 7, 8, 77, 88]  # diverges in page 2
+        pool2 = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        assert pool2.admit(0, 10, a)
+        assert pool2.admit(1, 10, b)
+        assert pool2.prefix_hits == 2  # pages 0,1 shared; page 2 private
+
+    def test_eviction_under_pressure(self):
+        pool = PagePool(slots=1, max_len=32, page_size=4, n_pages=4)
+        assert pool.admit(0, 10, list(range(10)))  # 3 pages (2 prefix)
+        pool.release(0)
+        # A distinct prompt needs 3 pages; only 1 truly free → evicts
+        # LRU resident prefix pages.
+        assert pool.admit(0, 10, list(range(50, 60)))
+        assert pool.free_pages == 0
+
+    def test_failed_admission_invalidates_unwritten_keys(self):
+        pool = PagePool(slots=1, max_len=32, page_size=4, n_pages=9)
+        assert pool.admit(0, 10, list(range(10)))
+        pool.release(0, invalidate_prefix=True)  # prefill never ran
+        assert pool.admit(0, 10, list(range(10)))
+        assert pool.prefix_hits == 0  # keys did not survive
+
+    def test_engine_prefix_reuse_matches_dense(self):
+        """Sequential identical prompts: the second hits the prefix
+        cache AND produces exactly the dense engine's tokens (the
+        resident pages hold the right content)."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]  # 2 full prefix pages
+        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=1, max_len=32)
+        try:
+            want = dense.generate([prompt], max_new_tokens=5, timeout=300)
+        finally:
+            dense.stop()
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          kv="paged", page_size=4)
+        try:
+            first = engine.generate([prompt], max_new_tokens=5, timeout=300)
+            second = engine.generate([prompt], max_new_tokens=5, timeout=300)
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        assert first == want and second == want
+        assert stats["kv_prefix_hits"] >= 2  # second request reused KV
+
+    def test_live_shared_pages_cost_nothing_at_admission(self):
+        """A prompt whose prefix pages are LIVE in another slot only
+        pays for its private pages — the hot-system-prompt workload
+        must not be refused under pressure it doesn't create."""
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=5)
+        tokens = list(range(10))  # 3 pages, 2 shareable
+        assert pool.admit(0, 10, tokens)
+        assert pool.free_pages == 1  # pages_for(10)=3 would not fit...
+        assert pool.can_admit(10, tokens)  # ...but 2 are live shares
+        assert pool.admit(1, 10, tokens)
+        assert pool.free_pages == 0
+        assert pool.prefix_hits == 2
