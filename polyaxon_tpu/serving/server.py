@@ -254,6 +254,97 @@ class _Engine:
         }
 
 
+# Operator stats page at GET / — live tiles over /v1/stats (same
+# design tokens as the runs dashboard, api/ui.py: status never color
+# alone, ink/muted text roles, light+dark).
+STATS_PAGE = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>polyaxon_tpu — serving</title>
+<style>
+  :root {
+    color-scheme: light dark;
+    --page: #f9f9f7; --surface: #fcfcfb;
+    --ink: #0b0b0b; --ink-2: #52514e; --muted: #898781;
+    --ring: rgba(11,11,11,0.10); --good: #0ca30c; --bad: #d03b3b;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root { --page: #0d0d0d; --surface: #1a1a19; --ink: #fff;
+            --ink-2: #c3c2b7; --ring: rgba(255,255,255,0.10); }
+  }
+  body { margin: 0; background: var(--page); color: var(--ink);
+         font: 14px/1.45 system-ui, sans-serif; }
+  header { padding: 14px 20px; border-bottom: 1px solid var(--ring);
+           display: flex; gap: 10px; align-items: baseline; }
+  h1 { font-size: 16px; margin: 0; font-weight: 650; }
+  #state { color: var(--ink-2); font-size: 12px; }
+  main { padding: 16px 20px; max-width: 900px; margin: 0 auto;
+         display: flex; gap: 12px; flex-wrap: wrap; }
+  .tile { background: var(--surface); border: 1px solid var(--ring);
+          border-radius: 8px; padding: 10px 16px; min-width: 130px; }
+  .tile .v { font-size: 22px; font-weight: 650;
+             font-variant-numeric: tabular-nums; }
+  .tile .k { color: var(--ink-2); font-size: 12px; }
+</style>
+</head>
+<body>
+<header><h1>polyaxon_tpu serving</h1><span id="state">…</span></header>
+<main id="tiles"></main>
+<script>
+"use strict";
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+function tile(k, v) {
+  return `<div class="tile"><div class="v">${esc(v)}</div>` +
+         `<div class="k">${esc(k)}</div></div>`;
+}
+let lastTokens = null, lastT = null;
+async function refresh() {
+  let s;
+  try { s = await (await fetch("/v1/stats")).json(); }
+  catch (e) {
+    document.getElementById("state").textContent = "unreachable";
+    return;
+  }
+  const now = performance.now();
+  let rate = "";
+  if (lastTokens != null && s.tokens_generated >= lastTokens && now > lastT) {
+    rate = ((s.tokens_generated - lastTokens) / ((now - lastT) / 1000))
+      .toFixed(1);
+  }
+  lastTokens = s.tokens_generated; lastT = now;
+  document.getElementById("state").textContent =
+    `engine ${s.engine}` + (s.kv ? ` · kv ${s.kv}` : "") +
+    (s.stopped ? " · ✕ stopped" : " · ✓ live");
+  const tiles = [
+    tile("requests served", s.requests_served),
+    tile("tokens generated", s.tokens_generated),
+    rate !== "" ? tile("tokens/sec (page-window)", rate) : "",
+    s.slots != null ? tile("slots active", `${s.active} / ${s.slots}`) : "",
+    s.avg_occupancy != null ? tile("avg occupancy", s.avg_occupancy) : "",
+    s.queued != null ? tile("queued", s.queued) : "",
+    s.decode_steps != null ? tile("decode steps", s.decode_steps) : "",
+    s.step_failures ? tile("step failures", s.step_failures) : "",
+    s.kv_pages_total != null
+      ? tile("kv pages free", `${s.kv_pages_free} / ${s.kv_pages_total}`) : "",
+    s.kv_prefix_hits != null
+      ? tile("prefix hit rate", (s.kv_prefix_hits + s.kv_prefix_misses)
+          ? (s.kv_prefix_hits / (s.kv_prefix_hits + s.kv_prefix_misses))
+              .toFixed(2)
+          : "–") : "",
+  ];
+  document.getElementById("tiles").innerHTML = tiles.join("");
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     engine: _Engine
     protocol_version = "HTTP/1.1"
@@ -276,6 +367,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"models": [self.engine.model]})
         if self.path == "/v1/stats":
             return self._json(self.engine.stats())
+        if self.path in ("/", "/ui"):
+            body = STATS_PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         return self._json({"error": f"no route {self.path}"}, status=404)
 
     def do_POST(self):  # noqa: N802

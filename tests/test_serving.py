@@ -901,3 +901,14 @@ class TestQuantize:
             assert got == want
         finally:
             engine.stop()
+
+
+class TestStatsPage:
+    def test_serving_dashboard_served(self, server):
+        import urllib.request
+
+        for path in ("/", "/ui"):
+            with urllib.request.urlopen(server.url + path, timeout=10) as r:
+                page = r.read().decode()
+                assert r.headers["Content-Type"].startswith("text/html")
+        assert "/v1/stats" in page and "tokens generated" in page
