@@ -567,8 +567,17 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
                    "kv_pages_total in /v1/stats); default = the dense-"
                    "equivalent reservation, lower = deliberate "
                    "oversubscription with admission backpressure")
+@click.option("--draft-model", default=None,
+              help="speculative decoding draft (static engine, greedy "
+                   "requests): lossless — output is the target's own "
+                   "greedy sequence, the draft buys back decode steps")
+@click.option("--draft-checkpoint", default=None,
+              help="orbax checkpoint for the draft model")
+@click.option("--spec-k", default=4,
+              help="draft tokens proposed per verify round")
 def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
-              quantize, kv, kv_page_size, kv_pages):
+              quantize, kv, kv_page_size, kv_pages, draft_model,
+              draft_checkpoint, spec_k):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
@@ -583,7 +592,9 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
     server = ServingServer(model, checkpoint, host=host, port=port, seed=seed,
                            batching=batching, slots=slots,
                            mesh_axes=mesh_axes, quantize=quantize,
-                           kv=kv, page_size=kv_page_size, kv_pages=kv_pages)
+                           kv=kv, page_size=kv_page_size, kv_pages=kv_pages,
+                           draft_model=draft_model,
+                           draft_checkpoint=draft_checkpoint, spec_k=spec_k)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread

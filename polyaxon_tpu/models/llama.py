@@ -564,6 +564,71 @@ def insert_cache_row(cache: dict, row: dict, b) -> dict:
     }
 
 
+# ------------------------------------------------- speculative decoding
+def decode_chunk(
+    cfg: LlamaConfig,
+    params: dict,
+    cache: dict,  # full-length cache: slot == position (C == max_len)
+    tokens: jax.Array,  # [B, c] int32 — c tokens per row
+    pos0: jax.Array,  # [B] int32 — position of tokens[:, 0] per row
+) -> tuple[jax.Array, dict]:
+    """Cached forward over a SHORT chunk of c tokens per row (the
+    speculative-decoding verify step): writes their KV at positions
+    pos0..pos0+c-1 and returns logits [B, c, V] — logits[:, i] predicts
+    position pos0+i+1. Requires a full-length cache (slot == position;
+    no ring wrap, no sliding window), which is what makes acceptance
+    rollback-free: stale entries beyond the accepted prefix sit at
+    positions the next chunk rewrites before anything attends them."""
+    if cfg.sliding_window is not None:
+        raise ValueError("speculative decode_chunk requires a full-length "
+                         "cache (no sliding_window)")
+    from polyaxon_tpu.ops.attention import repeat_kv
+
+    dt = cfg.dtype
+    B, c = tokens.shape
+    C = cache["k"].shape[2]
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = H // KV
+    rows = jnp.arange(B)
+    positions = pos0[:, None] + jnp.arange(c)[None, :]  # [B, c]
+    x = params["embed"].astype(dt)[tokens]  # [B, c, D]
+
+    cols = jnp.arange(C)[None, None, :]  # [1, 1, C]
+    # Column j visible to the query at position p iff j <= p: unwritten
+    # slots sit at positions > p by the slot==position invariant.
+    valid = (cols <= positions[:, :, None])[:, None]  # [B, 1, c, C]
+
+    def layer_step(x, inputs):
+        layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(dt)).reshape(B, c, H, Hd)
+        k = (h @ layer["wk"].astype(dt)).reshape(B, c, KV, Hd)
+        v = (h @ layer["wv"].astype(dt)).reshape(B, c, KV, Hd)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        k_cache = k_cache.at[rows[:, None], positions].set(k)
+        v_cache = v_cache.at[rows[:, None], positions].set(v)
+        keys = repeat_kv(k_cache, n_rep)
+        vals = repeat_kv(v_cache, n_rep)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+        s = s * (Hd ** -0.5)
+        s = jnp.where(valid, s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+        x = x + attn.reshape(B, c, H * Hd) @ layer["wo"].astype(dt)
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+        up = h @ layer["w_up"].astype(dt)
+        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 # ------------------------------------------------- paged KV decode surface
 # vLLM-style paged attention, TPU-first: the KV cache is a shared pool
 # of fixed-size pages ([L, P, page, KV, Hd]) addressed through per-row

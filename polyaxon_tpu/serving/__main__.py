@@ -32,6 +32,11 @@ def main() -> int:
                              "shared page pool + block tables")
     parser.add_argument("--kv-page-size", type=int, default=16)
     parser.add_argument("--kv-pages", type=int, default=None)
+    parser.add_argument("--draft-model", default=None,
+                        help="speculative-decoding draft (static engine; "
+                             "lossless for greedy requests)")
+    parser.add_argument("--draft-checkpoint", default=None)
+    parser.add_argument("--spec-k", type=int, default=4)
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -50,7 +55,10 @@ def main() -> int:
                        batching=args.batching, slots=args.slots,
                        mesh_axes=mesh_axes, quantize=args.quantize,
                        kv=args.kv, page_size=args.kv_page_size,
-                       kv_pages=args.kv_pages) as s:
+                       kv_pages=args.kv_pages,
+                       draft_model=args.draft_model,
+                       draft_checkpoint=args.draft_checkpoint,
+                       spec_k=args.spec_k) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

@@ -146,12 +146,21 @@ def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0,
 
 
 class _Engine:
-    """Bucketed, jitted generation around the family's generate()."""
+    """Bucketed, jitted generation around the family's generate().
 
-    def __init__(self, model: str, cfg, params):
+    ``draft``: optional ``(draft_model, draft_cfg, draft_params, k)``
+    enables speculative decoding for GREEDY requests — lossless (the
+    output is the target's own greedy sequence), the draft just buys
+    back sequential decode steps. Sampled requests and requests without
+    cache headroom for the k+1 verify window fall back to the plain
+    path silently.
+    """
+
+    def __init__(self, model: str, cfg, params, draft=None):
         self.model = model
         self.cfg = cfg
         self.params = params
+        self.draft = draft
         self._served = 0
         self._tokens_out = 0
         self._lock = threading.Lock()  # one TPU program at a time
@@ -159,10 +168,27 @@ class _Engine:
         # seq2seq families decode into their own cache; the prompt is
         # the encoder input, so prompt and budget are bounded separately.
         self.seq2seq = bool(getattr(family, "SEQ2SEQ", False))
+        if draft is not None:
+            if not hasattr(family, "decode_chunk"):
+                raise ValueError(
+                    f"speculative decoding needs the target family to "
+                    f"expose decode_chunk; `{model}` does not — serve "
+                    "without --draft-model")
+            if getattr(cfg, "sliding_window", None) is not None:
+                raise ValueError(
+                    "speculative decoding requires a full-length cache "
+                    "(no sliding_window)")
+            draft_family = _family(draft[0])
+            missing = [name for name in ("prefill", "decode_step_ragged")
+                       if not hasattr(draft_family, name)]
+            if missing:
+                raise ValueError(
+                    f"draft `{draft[0]}` cannot speculate: its family "
+                    f"lacks {missing}")
 
         @functools.lru_cache(maxsize=16)
         def compiled(prompt_len: int, max_new: int, sampling: bool,
-                     filtered: bool):
+                     filtered: bool, spec: bool = False):
             # Temperature/top_p/top_k are traced scalars, NOT part of
             # the compile key — only the greedy/sampling/filtered mode
             # switches programs, so a client sweeping knobs reuses one
@@ -170,6 +196,29 @@ class _Engine:
             # the historical categorical draw (bit-stable seeds); only
             # requests that actually set top_p/top_k pay the sorted
             # nucleus path.
+            if spec:
+                from polyaxon_tpu.serving.speculative import (
+                    generate_speculative,
+                )
+
+                draft_name, draft_cfg, _, spec_k = self.draft
+
+                # Draft params are a traced ARGUMENT (passed at the
+                # call site), not a closure capture: captured weights
+                # would be baked as constants into every compiled
+                # (plen, budget) executable — constant-folding the
+                # int8 dequant back to full precision and duplicating
+                # the draft per program.
+                def run_spec(params, draft_params, prompt):
+                    return generate_speculative(
+                        self.cfg, dequantize_tree(params),
+                        draft_cfg, dequantize_tree(draft_params),
+                        prompt, max_new_tokens=max_new, k=spec_k,
+                        family=family,
+                        draft_family=_family(draft_name))
+
+                return jax.jit(run_spec)
+
             def run(params, prompt, rng, temperature, top_p, top_k):
                 # Identity for plain trees; int8 weights dequantize
                 # here, inside jit, so the multiply fuses into the
@@ -187,6 +236,14 @@ class _Engine:
             return jax.jit(run)
 
         self._compiled = compiled
+
+    def _spec_usable(self, plen: int, n_bucket: int) -> bool:
+        if self.draft is None:
+            return False
+        _, draft_cfg, _, spec_k = self.draft
+        need = plen + n_bucket + spec_k + 1
+        return (need <= self.cfg.max_seq_len
+                and need <= draft_cfg.max_seq_len)
 
     def _validate(self, tokens: list[int], max_new_tokens: int) -> None:
         """Request-level checks, shared with the streaming handler so a
@@ -231,13 +288,18 @@ class _Engine:
         results: list[Optional[list[int]]] = [None] * len(token_rows)
         for plen, idxs in groups.items():
             batch = np.asarray([token_rows[i] for i in idxs], np.int32)
-            fn = self._compiled(plen, n_bucket, sampling, filtered)
+            spec = not sampling and self._spec_usable(plen, n_bucket)
+            fn = self._compiled(plen, n_bucket, sampling, filtered, spec)
             with self._lock:
-                out = np.asarray(fn(self.params, jnp.asarray(batch),
-                                    jax.random.key(seed),
-                                    jnp.float32(temperature),
-                                    jnp.float32(top_p),
-                                    jnp.int32(top_k)))
+                if spec:
+                    out = np.asarray(fn(self.params, self.draft[2],
+                                        jnp.asarray(batch)))
+                else:
+                    out = np.asarray(fn(self.params, jnp.asarray(batch),
+                                        jax.random.key(seed),
+                                        jnp.float32(temperature),
+                                        jnp.float32(top_p),
+                                        jnp.int32(top_k)))
             for j, i in enumerate(idxs):
                 results[i] = out[j, :max_new_tokens].tolist()
         with self._lock:  # ThreadingHTTPServer: += on ints is not atomic
@@ -499,7 +561,9 @@ class ServingServer:
                  batching: str = "static", slots: int = 4,
                  mesh_axes: Optional[dict] = None,
                  quantize: Optional[str] = None, kv: str = "dense",
-                 page_size: int = 16, kv_pages: Optional[int] = None):
+                 page_size: int = 16, kv_pages: Optional[int] = None,
+                 draft_model: Optional[str] = None,
+                 draft_checkpoint: Optional[str] = None, spec_k: int = 4):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -522,6 +586,22 @@ class ServingServer:
             logger.info("quantized %s weights %s: %.1f MiB -> %.1f MiB",
                         model, quantize, full / 2**20,
                         tree_bytes(params) / 2**20)
+        draft = None
+        if draft_model is not None:
+            if batching != "static":
+                raise ValueError(
+                    "speculative decoding (--draft-model) runs on the "
+                    "static engine; the slot-pool's ragged per-row "
+                    "acceptance is future work")
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            draft_cfg, draft_params = load_params(
+                draft_model, draft_checkpoint, seed=seed)
+            if quantize:
+                draft_params = quantize_tree(draft_params, mode=quantize)
+            draft = (draft_model, draft_cfg, draft_params, spec_k)
+            logger.info("speculative decoding: draft=%s k=%d",
+                        draft_model, spec_k)
         if batching == "continuous":
             from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
 
@@ -534,7 +614,7 @@ class ServingServer:
                     "kv='paged' requires --batching continuous (the "
                     "static engine compiles whole generations, not "
                     "pooled steps)")
-            self.engine = _Engine(model, cfg, params)
+            self.engine = _Engine(model, cfg, params, draft=draft)
         else:
             raise ValueError(
                 f"unknown batching mode `{batching}` "

@@ -1,0 +1,131 @@
+"""Speculative decoding: draft-model proposals verified by the target
+in one chunked forward — lossless for greedy decoding (the output is
+PROVABLY the target's own greedy sequence; tests assert token
+equality), with the target's sequential decode steps replaced by one
+``decode_chunk`` per accepted run.
+
+TPU-first mechanics:
+- the whole draft→verify→accept loop runs inside ONE ``lax.while_loop``
+  under jit — no host round-trips between rounds;
+- full-length caches (slot == position) make acceptance rollback-free:
+  entries written for rejected candidates sit at positions the next
+  round rewrites before anything attends them (``decode_chunk``
+  docstring has the invariant);
+- per-row positions/acceptance are vectors, so a batch of rows at
+  different depths shares the compiled program (same ragged philosophy
+  as the continuous engine).
+
+The reference orchestrator has no serving math at all (SURVEY.md §2);
+the algorithm is the standard greedy speculative scheme (Leviathan et
+al. / Chen et al., public), implemented against this repo's own cache
+contracts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def generate_speculative(
+    cfg,
+    params,
+    draft_cfg,
+    draft_params,
+    prompt: jax.Array,  # [B, P] int32
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    family=None,
+    draft_family=None,
+    return_rounds: bool = False,
+):
+    """Greedy generation of ``max_new_tokens`` per row, draft-accelerated.
+
+    Returns [B, max_new_tokens] int32 — bit-identical to
+    ``family.generate(..., temperature=0)``. ``k`` = draft tokens per
+    round; each round emits between 1 (no proposals accepted: the
+    target's own token) and k+1 (all accepted + bonus) tokens.
+    ``return_rounds``: also return the number of verify rounds (the
+    efficiency observable — self-draft at high acceptance needs
+    ~max_new/(k+1) rounds).
+
+    Rows that finish early still ride along until the deepest row is
+    done — the same cost shape as the plain path's fixed-length
+    ``lax.scan``, not an added inefficiency.
+    """
+    from polyaxon_tpu.models import llama
+
+    family = family or llama
+    draft_family = draft_family or llama
+    B, P = prompt.shape
+    max_new = int(max_new_tokens)
+    # Full-length caches with verify headroom: positions reach at most
+    # P + max_new + k.
+    max_len = P + max_new + k + 1
+    if max_len > cfg.max_seq_len or max_len > draft_cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {P} + max_new {max_new} + draft window {k}+1 "
+            f"exceeds max_seq_len (target {cfg.max_seq_len}, draft "
+            f"{draft_cfg.max_seq_len})")
+
+    logits_t, cache_t = family.prefill(cfg, params, prompt, max_len)
+    t0 = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # token @ pos P
+    _, cache_d = draft_family.prefill(draft_cfg, draft_params, prompt,
+                                      max_len)
+
+    rows = jnp.arange(B)
+    width = max_new + k + 2  # + trash column for masked writes
+    trash = width - 1
+    out = jnp.zeros((B, width), jnp.int32).at[:, 0].set(t0)
+    n0 = jnp.ones((B,), jnp.int32)  # t0 already emitted
+    pos0 = jnp.full((B,), P, jnp.int32)  # cur sits at position P
+
+    def cond(state):
+        return jnp.any(state[1] < max_new)
+
+    def body(state):
+        out, n, cur, pos, cache_t, cache_d, rounds = state
+        live = n < max_new
+
+        def draft_step(carry, _):
+            cache_d, tok, p = carry
+            lg, cache_d = draft_family.decode_step_ragged(
+                draft_cfg, draft_params, cache_d, tok, p)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (cache_d, nxt, p + 1), nxt
+
+        # k+1 steps for k proposals: the extra step writes the LAST
+        # proposal's draft KV (position pos+k). Without it, a fully-
+        # accepted round leaves a permanent zero-KV hole there that
+        # every later draft query attends — output stays lossless (the
+        # target verifies) but acceptance silently collapses.
+        (cache_d, _, _), d = jax.lax.scan(
+            draft_step, (cache_d, cur, pos), None, length=k + 1)
+        d = d.T[:, :k]  # [B, k] proposals for positions pos+1..pos+k
+
+        chunk = jnp.concatenate([cur[:, None], d], axis=1)  # [B, k+1]
+        logits, cache_t = family.decode_chunk(cfg, params, cache_t,
+                                              chunk, pos)
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        # Leading proposals the target agrees with; emit those plus the
+        # target's own token at the first disagreement (the "bonus").
+        match = (d == t[:, :k]).astype(jnp.int32)
+        a = jnp.cumprod(match, axis=1).sum(axis=1)  # [B] in 0..k
+        emit = jnp.minimum(a + 1, max_new - n)  # capped at the budget
+        emit = jnp.where(live, emit, 0)
+
+        idx = jnp.arange(k + 1)[None, :]
+        col = jnp.where(idx < emit[:, None], n[:, None] + idx, trash)
+        out = out.at[rows[:, None], col].set(t)
+        cur = jnp.where(live, t[rows, jnp.maximum(emit - 1, 0)], cur)
+        n = n + emit
+        pos = pos + emit
+        return out, n, cur, pos, cache_t, cache_d, rounds + 1
+
+    out, _, _, _, _, _, rounds = jax.lax.while_loop(
+        cond, body,
+        (out, n0, t0, pos0, cache_t, cache_d, jnp.int32(0)))
+    if return_rounds:
+        return out[:, :max_new], rounds
+    return out[:, :max_new]

@@ -1,0 +1,123 @@
+"""Speculative decoding: lossless-greedy guarantee (output == the
+target's own greedy sequence, token for token), draft quality only
+affecting speed; engine/HTTP integration with silent fallbacks."""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import llama
+from polyaxon_tpu.serving import ServingServer
+from polyaxon_tpu.serving.speculative import generate_speculative
+
+
+def _cfg():
+    return dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                               dtype=jnp.float32)
+
+
+class TestSpeculative:
+    def test_lossless_vs_plain_greedy(self):
+        """Self-draft (full acceptance) AND an independent random draft
+        (low acceptance) both reproduce plain greedy exactly — the
+        defining property of the scheme."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        indep = llama.init(cfg, jax.random.key(7))["params"]
+        prompt = jax.random.randint(jax.random.key(1), (2, 9), 0,
+                                    cfg.vocab_size)
+        want = np.asarray(llama.generate(cfg, params, prompt,
+                                         max_new_tokens=12))
+        for draft_params, label in ((params, "self"), (indep, "indep")):
+            got = np.asarray(generate_speculative(
+                cfg, params, cfg, draft_params, prompt,
+                max_new_tokens=12, k=4))
+            np.testing.assert_array_equal(got, want, err_msg=label)
+
+    @pytest.mark.parametrize("k", [1, 3, 7])
+    def test_k_never_changes_output(self, k):
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        draft = llama.init(cfg, jax.random.key(3))["params"]
+        prompt = jnp.asarray([[5, 6, 7, 8, 9]], jnp.int32)
+        want = np.asarray(llama.generate(cfg, params, prompt,
+                                         max_new_tokens=10))
+        got = np.asarray(generate_speculative(
+            cfg, params, cfg, draft, prompt, max_new_tokens=10, k=k))
+        np.testing.assert_array_equal(got, want)
+
+    def test_self_draft_accepts_everything_every_round(self):
+        """A self-draft must sustain FULL acceptance across rounds:
+        exactly ceil((max_new-1)/(k+1)) verify rounds. This is the
+        regression guard for the draft-KV bonus-position hole — output
+        stays lossless with the hole, but acceptance collapses and
+        rounds balloon."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompt = jnp.asarray([[5, 6, 7, 8, 9]], jnp.int32)
+        k, max_new = 4, 16
+        out, rounds = generate_speculative(
+            cfg, params, cfg, params, prompt, max_new_tokens=max_new,
+            k=k, return_rounds=True)
+        want = np.asarray(llama.generate(cfg, params, prompt,
+                                         max_new_tokens=max_new))
+        np.testing.assert_array_equal(np.asarray(out), want)
+        assert int(rounds) == -(-(max_new - 1) // (k + 1)), int(rounds)
+
+    def test_headroom_validated(self):
+        cfg = _cfg()  # max_seq_len 128
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompt = jnp.zeros((1, 100), jnp.int32)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate_speculative(cfg, params, cfg, params, prompt,
+                                 max_new_tokens=30, k=4)
+
+    def test_sliding_window_refused_in_chunk(self):
+        cfg = dataclasses.replace(_cfg(), sliding_window=8)
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        cache = {"k": jnp.zeros((2, 1, 32, 2, 16)),
+                 "v": jnp.zeros((2, 1, 32, 2, 16))}
+        with pytest.raises(ValueError, match="sliding_window"):
+            llama.decode_chunk(cfg, params, cache,
+                               jnp.zeros((1, 3), jnp.int32),
+                               jnp.zeros((1,), jnp.int32))
+
+
+class TestSpeculativeServing:
+    def test_http_greedy_matches_undrafted_server(self):
+        """plx serve --draft-model end-to-end: greedy responses equal a
+        draft-less server's; sampled requests fall back and still work."""
+        def gen(url, payload):
+            req = urllib.request.Request(
+                url + "/v1/generate", method="POST",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.load(urllib.request.urlopen(req, timeout=300))
+
+        greedy = {"tokens": [[5, 6, 7], [1, 2, 3]], "max_new_tokens": 8}
+        sampled = {"tokens": [[5, 6, 7]], "max_new_tokens": 8,
+                   "temperature": 0.9, "seed": 3}
+        with ServingServer("llama_tiny", seed=0) as plain:
+            want = gen(plain.url, greedy)
+            want_sampled = gen(plain.url, sampled)
+        with ServingServer("llama_tiny", seed=0, draft_model="llama_tiny",
+                           spec_k=3) as spec:
+            got = gen(spec.url, greedy)
+            got_sampled = gen(spec.url, sampled)
+        assert got["tokens"] == want["tokens"]
+        # Sampled path bypasses speculation but stays bit-stable.
+        assert got_sampled["tokens"] == want_sampled["tokens"]
+
+    def test_draft_requires_static_engine(self):
+        with pytest.raises(ValueError, match="static"):
+            ServingServer("llama_tiny", batching="continuous",
+                          draft_model="llama_tiny")
+
+    def test_t5_target_refused(self):
+        with pytest.raises(ValueError, match="decode_chunk"):
+            ServingServer("t5_tiny", draft_model="t5_tiny")
