@@ -57,6 +57,9 @@ SMOKE_NODES = (
     "test_serving.py::TestServing::test_health_and_models",
     "test_serving.py::TestServing::test_generate_shapes_and_determinism",
     "test_serving.py::TestQuantize::test_static_serving_end_to_end_int8",
+    "test_paged.py::TestPagedEngine::test_matches_dense_engine_greedy",
+    "test_paged.py::TestPrefixCache::test_shared_prompt_pages_reused",
+    "test_speculative.py::TestSpeculative::test_lossless_vs_plain_greedy",
     "test_moe_pp.py::TestMoE::test_ragged_matches_dense_no_drop_single_shard",
     "test_tune.py::TestOneShotManagers",
     "test_tune.py::TestHyperband::test_rung_shapes_paper_table",
