@@ -582,14 +582,9 @@ def decode_chunk(
     if cfg.sliding_window is not None:
         raise ValueError("speculative decode_chunk requires a full-length "
                          "cache (no sliding_window)")
-    from polyaxon_tpu.ops.attention import repeat_kv
-
     dt = cfg.dtype
     B, c = tokens.shape
     C = cache["k"].shape[2]
-    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    n_rep = H // KV
-    rows = jnp.arange(B)
     positions = pos0[:, None] + jnp.arange(c)[None, :]  # [B, c]
     x = params["embed"].astype(dt)[tokens]  # [B, c, D]
 
@@ -600,22 +595,8 @@ def decode_chunk(
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, c, H, Hd)
-        k = (h @ layer["wk"].astype(dt)).reshape(B, c, KV, Hd)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, c, KV, Hd)
-        q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
-        k_cache = k_cache.at[rows[:, None], positions].set(k)
-        v_cache = v_cache.at[rows[:, None], positions].set(v)
-        keys = repeat_kv(k_cache, n_rep)
-        vals = repeat_kv(v_cache, n_rep)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
-        s = s * (Hd ** -0.5)
-        s = jnp.where(valid, s, -1e30)
-        probs = jax.nn.softmax(s, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
-        x = x + attn.reshape(B, c, H * Hd) @ layer["wo"].astype(dt)
+        x, k_cache, v_cache = chunk_attn_step(
+            cfg, layer, x, k_cache, v_cache, positions, valid)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
         up = h @ layer["w_up"].astype(dt)
@@ -627,6 +608,41 @@ def decode_chunk(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
+
+
+def chunk_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
+                    v_cache: jax.Array, positions: jax.Array,
+                    valid: jax.Array):
+    """One cached-attention sublayer for a c-token chunk (the
+    speculative-verify analogue of ``cached_attn_step``) — shared by
+    both decoder families' ``decode_chunk``. ``positions`` [B, c],
+    ``valid`` [B, 1, c, C]; writes slot == position."""
+    from polyaxon_tpu.ops.attention import repeat_kv
+
+    dt = cfg.dtype
+    B, c = positions.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = H // KV
+    rows = jnp.arange(B)
+    scaling = getattr(cfg, "rope_scaling", None)
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dt)).reshape(B, c, H, Hd)
+    k = (h @ layer["wk"].astype(dt)).reshape(B, c, KV, Hd)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, c, KV, Hd)
+    q = _rope(q, positions, cfg.rope_theta, scaling)
+    k = _rope(k, positions, cfg.rope_theta, scaling)
+    k_cache = k_cache.at[rows[:, None], positions].set(k)
+    v_cache = v_cache.at[rows[:, None], positions].set(v)
+    keys = repeat_kv(k_cache, n_rep)
+    vals = repeat_kv(v_cache, n_rep)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+    s = s * (Hd ** -0.5)
+    s = jnp.where(valid, s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+    return x + attn.reshape(B, c, H * Hd) @ layer["wo"].astype(dt), \
+        k_cache, v_cache
 
 
 # ------------------------------------------------- paged KV decode surface

@@ -623,6 +623,46 @@ def decode_step(
         jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,)))
 
 
+def decode_chunk(
+    cfg: MoEConfig,
+    params: dict,
+    cache: dict,  # full-length cache: slot == position
+    tokens: jax.Array,  # [B, c] int32
+    pos0: jax.Array,  # [B] int32
+) -> tuple[jax.Array, dict]:
+    """Speculative-verify chunk for MoE targets: llama's
+    ``chunk_attn_step`` with the expert FFN in the MLP slot. Routing
+    sees the B·c chunk tokens as its dispatch group with no-drop
+    capacity (same rule as ``decode_step_ragged``). MoE configs carry
+    no sliding window, so the slot==position invariant holds."""
+    from polyaxon_tpu.models.llama import chunk_attn_step
+
+    _check_decodable(cfg)
+    dt = cfg.dtype
+    B, c = tokens.shape
+    C = cache["k"].shape[2]
+    positions = pos0[:, None] + jnp.arange(c)[None, :]
+    x = params["embed"].astype(dt)[tokens]
+    cols = jnp.arange(C)[None, None, :]
+    valid = (cols <= positions[:, :, None])[:, None]  # [B, 1, c, C]
+
+    def layer_step(x, inputs):
+        layer, k_cache, v_cache = inputs
+        x, k_cache, v_cache = chunk_attn_step(
+            cfg, layer, x, k_cache, v_cache, positions, valid)
+        h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
+        moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
+                               layer["w_up"], layer["w_down"],
+                               min_capacity=B * c)
+        return x + moe_out, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def decode_step_paged(
     cfg: MoEConfig,
     params: dict,

@@ -121,3 +121,48 @@ class TestSpeculativeServing:
     def test_t5_target_refused(self):
         with pytest.raises(ValueError, match="decode_chunk"):
             ServingServer("t5_tiny", draft_model="t5_tiny")
+
+
+class TestMoESpeculative:
+    def test_moe_target_lossless(self):
+        """Mixtral-style target: per-token top-k routing with no-drop
+        capacity makes the chunked verify group-size-independent, so
+        speculation stays lossless for MoE targets too — with a dense
+        llama draft (the realistic pairing) and a self-draft."""
+        from polyaxon_tpu.models import moe
+
+        cfg = dataclasses.replace(moe.CONFIGS["moe_tiny"],
+                                  dtype=jnp.float32)
+        params = moe.init(cfg, jax.random.key(0))["params"]
+        lcfg = _cfg()
+        lparams = llama.init(lcfg, jax.random.key(5))["params"]
+        prompt = jax.random.randint(jax.random.key(1), (2, 7), 0,
+                                    min(cfg.vocab_size, lcfg.vocab_size))
+        want = np.asarray(moe.generate(cfg, params, prompt,
+                                       max_new_tokens=10))
+        got_self = np.asarray(generate_speculative(
+            cfg, params, cfg, params, prompt, max_new_tokens=10, k=3,
+            family=moe, draft_family=moe))
+        np.testing.assert_array_equal(got_self, want)
+        got_llama_draft = np.asarray(generate_speculative(
+            cfg, params, lcfg, lparams, prompt, max_new_tokens=10, k=3,
+            family=moe, draft_family=llama))
+        np.testing.assert_array_equal(got_llama_draft, want)
+
+    def test_moe_serving_with_draft(self):
+        with ServingServer("moe_tiny", seed=0, draft_model="llama_tiny",
+                           spec_k=2) as s:
+            req = urllib.request.Request(
+                s.url + "/v1/generate", method="POST",
+                data=json.dumps({"tokens": [[5, 6, 7]],
+                                 "max_new_tokens": 6}).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.load(urllib.request.urlopen(req, timeout=300))
+        with ServingServer("moe_tiny", seed=0) as plain:
+            req = urllib.request.Request(
+                plain.url + "/v1/generate", method="POST",
+                data=json.dumps({"tokens": [[5, 6, 7]],
+                                 "max_new_tokens": 6}).encode(),
+                headers={"Content-Type": "application/json"})
+            want = json.load(urllib.request.urlopen(req, timeout=300))
+        assert out["tokens"] == want["tokens"]
