@@ -113,6 +113,13 @@ CONFIGS: dict[str, LlamaConfig] = {
         vocab_size=32_000, dim=1024, n_layers=12, n_heads=16, n_kv_heads=8,
         ffn_dim=2816, max_seq_len=2048, rope_theta=10_000.0,
     ),
+    # Llama-3-vocab small model: the speculative DRAFT for llama3_*
+    # targets (drafting requires an identical token space; the other
+    # small configs carry the 32k vocab).
+    "llama3_draft_200m": LlamaConfig(
+        vocab_size=128_256, dim=768, n_layers=10, n_heads=12, n_kv_heads=4,
+        ffn_dim=2048, max_seq_len=8192,
+    ),
     "llama_tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
         ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,

@@ -63,8 +63,9 @@ def _warn_einsum_fallback(s_loc: int) -> None:
     warnings.warn(
         f"ring_attention: local sequence length {s_loc} is odd — falling "
         f"back to the contiguous masked-einsum ring (~2x the attention "
-        f"FLOPs of the zigzag path, no flash kernel). Pad the sequence "
-        f"so seq/cp is even to get the fast path.",
+        f"FLOPs of the zigzag path, no flash kernel). The global "
+        f"ring_attention entry pads this away automatically; inside "
+        f"shard_map, pad the sequence so seq/cp is even.",
         RuntimeWarning, stacklevel=3)
 
 
@@ -319,6 +320,21 @@ def ring_attention(
             "shard_map, pass mesh=, or enter `with mesh:` (the runtime "
             "loop does) with a cp axis in the mesh"
         )
+    # Odd local length cannot split into zigzag halves. From the global
+    # entry we can fix that instead of falling back to the ~2x masked-
+    # einsum path: pad the sequence TAIL by cp rows (shards stay equal
+    # at S_loc+1 — now even — and the pads sit at the highest global
+    # positions, which causal attention guarantees no real query ever
+    # attends), run zigzag, slice the pads back off. Only direct
+    # in-shard_map callers still hit the warned fallback.
+    S = q.shape[1]
+    cp = mesh.shape[axis_name]
+    pad = cp if causal and (S // cp) % 2 else 0
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
     spec = P(None, axis_name, None, None)  # seq dim sharded over cp
     fn = jax.shard_map(
         functools.partial(
@@ -330,4 +346,5 @@ def ring_attention(
         axis_names={axis_name},
         check_vma=False,
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :S] if pad else out

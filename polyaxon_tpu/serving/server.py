@@ -597,6 +597,12 @@ class ServingServer:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
             draft_cfg, draft_params = load_params(
                 draft_model, draft_checkpoint, seed=seed)
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft `{draft_model}` (vocab {draft_cfg.vocab_size}) "
+                    f"and target `{model}` (vocab {cfg.vocab_size}) must "
+                    "share a token space — mismatched drafts propose "
+                    "garbage and silently collapse acceptance")
             if quantize:
                 draft_params = quantize_tree(draft_params, mode=quantize)
             draft = (draft_model, draft_cfg, draft_params, spec_k)

@@ -276,19 +276,55 @@ class TestRing:
         with pytest.raises(ValueError, match="mesh axis"):
             ring_attention(q, k, v, axis_name="nonexistent")
 
-    def test_odd_local_seq_falls_back_and_matches(self, cp_mesh):
-        """s_loc = 63 cannot split into zigzag halves → contiguous
-        masked fallback, still exact vs the reference — and loud about
-        the ~2x cost (VERDICT r2 weak #6: no silent slow mode)."""
+    def test_odd_local_seq_pads_to_zigzag_and_matches(self, cp_mesh):
+        """s_loc = 63 cannot split into zigzag halves; the global entry
+        pads the tail by cp rows (causality keeps the pads unattended),
+        runs the FAST zigzag path — no warning, no ~2x einsum fallback
+        — and still matches the reference exactly. Gradients flow
+        through the pad/slice unchanged."""
+        import warnings
+
         from polyaxon_tpu.ops import ring
 
         q, k, v = _qkv(b=2, s=252, h=4, kv=2)
         ref = xla_attention(q, k, v, causal=True)
         ring._warned_einsum_fallback = False
         with cp_mesh:
-            with pytest.warns(RuntimeWarning, match="masked-einsum ring"):
+            with warnings.catch_warnings():
+                # Only the guarded fallback warning fails the test —
+                # unrelated Deprecation/FutureWarnings must not.
+                warnings.simplefilter("error", RuntimeWarning)
                 out = jax.jit(
                     lambda q, k, v: ring_attention(q, k, v))(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+        gr = jax.grad(lambda q: jnp.sum(xla_attention(q, k, v) ** 2))(q)
+        with cp_mesh:
+            gg = jax.jit(
+                jax.grad(lambda q: jnp.sum(ring_attention(q, k, v) ** 2))
+            )(q)
+        np.testing.assert_allclose(gg, gr, atol=5e-4, rtol=5e-4)
+
+    def test_odd_local_seq_inside_shard_map_still_warns(self, cp_mesh):
+        """Direct in-shard_map callers can't be re-padded from outside:
+        the loud masked-einsum fallback remains (no silent slow mode)."""
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from polyaxon_tpu.ops import ring
+
+        q, k, v = _qkv(b=2, s=252, h=4, kv=2)
+        ref = xla_attention(q, k, v, causal=True)
+        ring._warned_einsum_fallback = False
+        spec = P(None, "cp", None, None)
+        fn = jax.shard_map(
+            functools.partial(ring._ring_attention_sharded, causal=True,
+                              scale=q.shape[-1] ** -0.5, axis_name="cp"),
+            mesh=cp_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={"cp"}, check_vma=False)
+        with pytest.warns(RuntimeWarning, match="masked-einsum ring"):
+            out = jax.jit(fn)(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
     @pytest.mark.perf

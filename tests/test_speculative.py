@@ -166,3 +166,11 @@ class TestMoESpeculative:
                 headers={"Content-Type": "application/json"})
             want = json.load(urllib.request.urlopen(req, timeout=300))
         assert out["tokens"] == want["tokens"]
+
+
+class TestDraftVocab:
+    def test_vocab_mismatch_refused_at_startup(self):
+        # llama3_draft_200m carries the 128k llama-3 vocab; llama_tiny
+        # is 256 — serving must refuse the pairing loudly.
+        with pytest.raises(ValueError, match="token space"):
+            ServingServer("llama_tiny", draft_model="llama3_draft_200m")
