@@ -1,0 +1,174 @@
+"""LoRA fine-tuning as config (net-new surface — the reference
+orchestrates containers and owns no training math, SURVEY.md §2b).
+
+Design: a :class:`ModelDef` wrapper, so the train step, checkpointing,
+sharding, and loop machinery stay untouched. The wrapped state is
+``{"params": {"base": <frozen full tree>, "lora": {path: {"a","b"}}}}``:
+
+- ``apply`` merges ``W_eff = stop_gradient(W) + (alpha/rank)·A@B``
+  inside the jitted step — ``stop_gradient`` lets XLA dead-code the
+  base weight-gradient GEMMs, so backward cost tracks the adapters,
+  not the full model;
+- the optimizer is wrapped in ``optax.masked`` over the lora subtree,
+  so moment/velocity state exists ONLY for adapters — the memory that
+  makes fine-tuning an 8B on small slices possible;
+- adapter shardings derive from the base leaf's logical axes
+  (``W: (row, col)`` → ``A: (row, None)``, ``B: (None, col)``), so
+  FSDP/TP layouts carry over to A/B unchanged.
+
+Init follows the public LoRA recipe: A ~ N(0, 1/rank), B = 0 — the
+adapted model starts exactly at the base model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from polyaxon_tpu.models.common import ModelDef
+
+# Matmul weights adapted by default: attention + MLP projections of
+# the decoder families (embeddings/norms/lm_head stay frozen).
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _match(path: tuple, targets) -> bool:
+    leaf_name = str(path[-1])
+    return any(re.fullmatch(t, leaf_name) for t in targets)
+
+
+def init_lora(params: Any, rank: int, targets, key: jax.Array) -> dict:
+    """A/B adapters for every eligible leaf (ndim >= 2, name matches
+    ``targets``). Keyed by '/'-joined path so the lora tree is a flat
+    dict that checkpoints/shards like any other params tree."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    lora: dict[str, dict] = {}
+    for path, leaf in flat:
+        p = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        if leaf.ndim < 2 or not _match(p, targets):
+            continue
+        key, sub = jax.random.split(key)
+        *stack, d_in, d_out = leaf.shape
+        a = jax.random.normal(sub, (*stack, d_in, rank),
+                              jnp.float32) * (rank ** -0.5)
+        b = jnp.zeros((*stack, rank, d_out), jnp.float32)
+        lora["/".join(p)] = {"a": a.astype(leaf.dtype),
+                             "b": b.astype(leaf.dtype)}
+    if not lora:
+        raise ValueError(
+            f"no params matched lora targets {tuple(targets)} — check "
+            "the target names against the model's param tree")
+    return lora
+
+
+def merge(base: Any, lora: dict, alpha: float, rank: int) -> Any:
+    """``W_eff = stop_gradient(W) + (alpha/rank)·A@B`` for adapted
+    leaves; plain ``stop_gradient`` for the rest (backward never
+    touches base weights)."""
+    scale = alpha / rank
+
+    def rebuild(path, leaf):
+        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+        leaf = jax.lax.stop_gradient(leaf)
+        ab = lora.get(p)
+        if ab is None:
+            return leaf
+        delta = jnp.einsum("...ir,...ro->...io", ab["a"].astype(jnp.float32),
+                           ab["b"].astype(jnp.float32))
+        return leaf + (scale * delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(rebuild, base)
+
+
+def merge_saved(base: Any, lora: dict, alpha: float,
+                rank: Optional[int] = None) -> Any:
+    """Fold saved adapters into dense weights (serving a fine-tune:
+    load the base checkpoint, merge, serve — zero runtime overhead)."""
+    if rank is None:
+        rank = int(next(iter(lora.values()))["a"].shape[-1])
+    return merge(base, lora, alpha, rank)
+
+
+def _lora_logical_axes(base_logical: Any, lora_shapes: dict) -> dict:
+    """Adapter shardings from the base leaf's logical axes: A keeps the
+    row axis, B keeps the col axis, the rank axis is unsharded."""
+    flat = {
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in path): axes
+        for path, axes in jax.tree_util.tree_flatten_with_path(
+            base_logical, is_leaf=lambda x: isinstance(x, tuple))[0]
+    }
+    out = {}
+    for name, ab in lora_shapes.items():
+        axes = flat.get(name)
+        if isinstance(axes, tuple) and len(axes) >= 2:
+            *stack, row, col = axes
+            out[name] = {"a": tuple(stack) + (row, None),
+                         "b": tuple(stack) + (None, col)}
+        else:  # replicated adapters for leaves with unknown layout
+            out[name] = {"a": (None,) * ab["a"].ndim,
+                         "b": (None,) * ab["b"].ndim}
+    return out
+
+
+def lora_model_def(model_def: ModelDef, rank: int, alpha: float,
+                   targets: Optional[tuple] = None) -> ModelDef:
+    """Wrap a ModelDef for LoRA: same train-step/loop/checkpoint
+    machinery, state = {base (frozen), lora (trained)}."""
+    targets = tuple(targets or DEFAULT_TARGETS)
+
+    def init(rng: jax.Array):
+        variables = model_def.init(rng)
+        base = variables["params"]
+        lora = init_lora(base, rank, targets, jax.random.fold_in(rng, 51))
+        out = dict(variables)
+        out["params"] = {"base": base, "lora": lora}
+        return out
+
+    def apply(variables, batch, train=True, rng=None):
+        p = variables["params"]
+        merged = merge(p["base"], p["lora"], alpha, rank)
+        inner = dict(variables)
+        inner["params"] = merged
+        return model_def.apply(inner, batch, train, rng)
+
+    def logical_axes():
+        logical = model_def.logical_axes()
+        base_logical = logical["params"]
+        # The lora tree's axes need the lora STRUCTURE, which needs an
+        # init — derive lazily from a shape-only eval.
+        shapes = jax.eval_shape(lambda k: init(k)["params"]["lora"],
+                                jax.random.key(0))
+        out = dict(logical)
+        out["params"] = {"base": base_logical,
+                         "lora": _lora_logical_axes(base_logical, shapes)}
+        return out
+
+    return dataclasses.replace(
+        model_def, name=f"{model_def.name}+lora{rank}",
+        init=init, apply=apply, logical_axes=logical_axes)
+
+
+def lora_optimizer_mask(params: dict) -> dict:
+    """optax.masked mask: True (train) for the lora subtree, False
+    (frozen, no optimizer state) for base."""
+    return {
+        "base": jax.tree.map(lambda _: False, params["base"]),
+        "lora": jax.tree.map(lambda _: True, params["lora"]),
+    }
+
+
+def wrap_optimizer(optimizer: optax.GradientTransformation
+                   ) -> optax.GradientTransformation:
+    """Moment/velocity state only for adapters; base updates are
+    structurally zero."""
+    return optax.masked(
+        optimizer,
+        lambda params: lora_optimizer_mask(params),
+    )

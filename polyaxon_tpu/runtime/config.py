@@ -5,7 +5,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
-from pydantic import BaseModel, ConfigDict
+from pydantic import BaseModel, ConfigDict, Field
 
 
 class RuntimeConfig(BaseModel):
@@ -37,6 +37,12 @@ class RuntimeConfig(BaseModel):
     # Attention/remat knobs forwarded to the model config when supported.
     remat: Optional[str] = None
     attention_impl: Optional[str] = None
+    # LoRA fine-tuning (models/lora.py): rank > 0 freezes the base and
+    # trains low-rank adapters on `lora_targets` (default: attention +
+    # MLP projections); optimizer state exists only for the adapters.
+    lora_rank: int = Field(default=0, ge=0)  # 0 = LoRA off
+    lora_alpha: float = Field(default=16.0, gt=0)
+    lora_targets: Optional[list[str]] = None
     # Profiling: capture a jax.profiler trace for these steps.
     profile_steps: Optional[list[int]] = None
 

@@ -106,6 +106,15 @@ def run_jaxjob(
     ds_kwargs = _dataset_kwargs(cfg, model_cfg, per_host_batch)
 
     optimizer = build_optimizer(cfg)
+    if cfg.lora_rank:
+        from polyaxon_tpu.models.lora import lora_model_def, wrap_optimizer
+
+        model_def = lora_model_def(model_def, cfg.lora_rank,
+                                   cfg.lora_alpha,
+                                   cfg.lora_targets)
+        optimizer = wrap_optimizer(optimizer)
+        logger.info("lora: rank=%d alpha=%s targets=%s", cfg.lora_rank,
+                    cfg.lora_alpha, cfg.lora_targets or "default")
 
     with mesh:
         init_fn = build_init(model_def, optimizer, mesh, rules)

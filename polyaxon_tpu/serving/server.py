@@ -595,14 +595,18 @@ class ServingServer:
                     "acceptance is future work")
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
-            draft_cfg, draft_params = load_params(
-                draft_model, draft_checkpoint, seed=seed)
-            if draft_cfg.vocab_size != cfg.vocab_size:
+            # Validate the pairing from the CONFIG before materializing
+            # a single draft weight (a mispaired real-size draft would
+            # otherwise load GBs just to be refused).
+            draft_vocab = _family(draft_model).CONFIGS[draft_model].vocab_size
+            if draft_vocab != cfg.vocab_size:
                 raise ValueError(
-                    f"draft `{draft_model}` (vocab {draft_cfg.vocab_size}) "
+                    f"draft `{draft_model}` (vocab {draft_vocab}) "
                     f"and target `{model}` (vocab {cfg.vocab_size}) must "
                     "share a token space — mismatched drafts propose "
                     "garbage and silently collapse acceptance")
+            draft_cfg, draft_params = load_params(
+                draft_model, draft_checkpoint, seed=seed)
             if quantize:
                 draft_params = quantize_tree(draft_params, mode=quantize)
             draft = (draft_model, draft_cfg, draft_params, spec_k)

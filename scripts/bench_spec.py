@@ -11,7 +11,8 @@ decode steps, so speedup ~= mean_accepted+1 divided by the relative
 cost of draft steps + chunk. Writes bench_spec_results.json.
 
 Usage: python scripts/bench_spec.py [--model llama3_1b]
-       [--draft llama_200m] [--max-new 128] [--k 4] [--prompt-len 64]
+       [--draft llama3_draft_200m] [--max-new 128] [--k 4]
+       [--prompt-len 64]
 CPU smoke: JAX_PLATFORMS=cpu ... --model llama_tiny --draft llama_tiny --quick
 """
 

@@ -1,0 +1,123 @@
+"""LoRA fine-tuning: frozen base + low-rank adapters as a ModelDef
+wrapper — init is exactly the base model, training moves ONLY the
+adapters, optimizer state exists only for them, and merged weights
+reproduce the adapted model densely."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import get_model, lora
+from polyaxon_tpu.polyflow.runs import V1JAXJob
+from polyaxon_tpu.runtime.loop import run_jaxjob
+
+
+def _tiny_def():
+    return get_model("llama_tiny", dtype=jnp.float32, max_seq_len=64)
+
+
+class TestLoraWrapper:
+    def test_init_is_exactly_the_base_model(self):
+        """B = 0 at init: the wrapped apply equals the base apply on
+        the same weights (fine-tuning starts at the base model)."""
+        base_def = _tiny_def()
+        wrapped = lora.lora_model_def(base_def, rank=4, alpha=16.0)
+        rng = jax.random.key(0)
+        base_vars = base_def.init(rng)
+        wrapped_vars = wrapped.init(rng)
+        batch = {"tokens": jax.random.randint(jax.random.key(1), (2, 16),
+                                              0, 256)}
+        base_loss, _, _ = base_def.apply(base_vars, batch, True,
+                                         jax.random.key(2))
+        lora_loss, _, _ = wrapped.apply(wrapped_vars, batch, True,
+                                        jax.random.key(2))
+        np.testing.assert_allclose(float(lora_loss), float(base_loss),
+                                   rtol=1e-6)
+
+    def test_targets_cover_attention_and_mlp(self):
+        wrapped = lora.lora_model_def(_tiny_def(), rank=2, alpha=4.0)
+        tree = wrapped.init(jax.random.key(0))["params"]["lora"]
+        names = {name.rsplit("/", 1)[-1] for name in tree}
+        assert names == set(lora.DEFAULT_TARGETS)
+
+    def test_unknown_targets_fail_loudly(self):
+        with pytest.raises(ValueError, match="no params matched"):
+            lora.lora_model_def(_tiny_def(), rank=2, alpha=4.0,
+                                targets=("nonexistent",)).init(
+                jax.random.key(0))
+
+    def test_training_moves_only_adapters(self):
+        """5 optimizer steps: loss decreases, base weights are
+        bit-identical to init, optimizer state exists only for the
+        adapters (the masked wrapper's memory contract)."""
+        import optax
+
+        from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
+        from polyaxon_tpu.runtime.step import build_init, build_train_step
+
+        model_def = lora.lora_model_def(_tiny_def(), rank=4, alpha=16.0)
+        optimizer = lora.wrap_optimizer(optax.adam(1e-2))
+        mesh = build_mesh(axes={"dp": len(jax.devices())})
+        rules = rules_for_mesh(mesh)
+        with mesh:
+            state = build_init(model_def, optimizer, mesh, rules)(
+                jax.random.key(0))
+            step = build_train_step(model_def, optimizer, mesh, rules)
+            base0 = jax.tree.map(np.asarray, state["params"]["base"])
+            batch = {"tokens": jax.random.randint(jax.random.key(1),
+                                                  (8, 16), 0, 256)}
+            losses = []
+            for i in range(5):
+                state, metrics = step(state, batch, jax.random.key(i))
+                losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+            base0, state["params"]["base"])
+        # Adapters moved; moment state covers only the lora leaves.
+        moved = jax.tree.leaves(jax.tree.map(
+            lambda x: float(jnp.abs(x).sum()),
+            state["params"]["lora"]))
+        assert any(v > 0 for v in moved)
+        n_lora = len(jax.tree.leaves(state["params"]["lora"]))
+        n_all = len(jax.tree.leaves(state["params"]))
+        moments = [leaf for leaf in jax.tree.leaves(state["opt_state"])
+                   if hasattr(leaf, "ndim") and leaf.ndim >= 2]
+        assert len(moments) == 2 * n_lora  # adam mu+nu, adapters only
+        assert n_all > n_lora  # base really is in the tree, stateless
+
+    def test_merge_saved_reproduces_adapted_model(self):
+        base_def = _tiny_def()
+        wrapped = lora.lora_model_def(base_def, rank=4, alpha=16.0)
+        variables = wrapped.init(jax.random.key(0))
+        # Give the adapters non-zero values (as if trained).
+        variables["params"]["lora"] = jax.tree.map(
+            lambda x: x + 0.01, variables["params"]["lora"])
+        batch = {"tokens": jax.random.randint(jax.random.key(1), (2, 16),
+                                              0, 256)}
+        want, _, _ = wrapped.apply(variables, batch, False, None)
+        dense = lora.merge_saved(variables["params"]["base"],
+                                 variables["params"]["lora"], alpha=16.0)
+        got, _, _ = base_def.apply(
+            {"params": dense, "state": {}}, batch, False, None)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+
+class TestLoraRuntime:
+    def test_jaxjob_lora_trains_sharded(self):
+        """LoRA as config through the real runtime on the dp2xfsdp4
+        mesh: adapter shardings derive from the base logical axes, the
+        loop/checkpoint machinery needs zero changes."""
+        job = V1JAXJob.from_dict({
+            "kind": "jaxjob",
+            "mesh": {"axes": {"dp": 2, "fsdp": 4}},
+            "runtime": {"model": "llama_tiny", "dataset": "lm_synthetic",
+                        "steps": 4, "seq_len": 32,
+                        "global_batch_size": 8, "log_every": 1,
+                        "learning_rate": 1e-2,
+                        "lora_rank": 4, "lora_alpha": 16.0},
+        })
+        result = run_jaxjob(job)
+        assert result.steps == 4
+        assert np.isfinite(result.final_metrics["loss"])
