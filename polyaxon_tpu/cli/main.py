@@ -575,9 +575,13 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
               help="orbax checkpoint for the draft model")
 @click.option("--spec-k", default=4,
               help="draft tokens proposed per verify round")
+@click.option("--lora-alpha", default=16.0,
+              help="alpha used when --checkpoint is a LoRA fine-tune "
+                   "(adapters fold into dense weights at load; must "
+                   "match training)")
 def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
               quantize, kv, kv_page_size, kv_pages, draft_model,
-              draft_checkpoint, spec_k):
+              draft_checkpoint, spec_k, lora_alpha):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
@@ -594,7 +598,8 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
                            mesh_axes=mesh_axes, quantize=quantize,
                            kv=kv, page_size=kv_page_size, kv_pages=kv_pages,
                            draft_model=draft_model,
-                           draft_checkpoint=draft_checkpoint, spec_k=spec_k)
+                           draft_checkpoint=draft_checkpoint, spec_k=spec_k,
+                           lora_alpha=lora_alpha)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread

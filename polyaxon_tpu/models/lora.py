@@ -28,6 +28,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from polyaxon_tpu.models.common import ModelDef
@@ -66,6 +67,21 @@ def init_lora(params: Any, rank: int, targets, key: jax.Array) -> dict:
     return lora
 
 
+def with_meta(lora: dict, rank: int, alpha: float) -> dict:
+    """Persist the merge hyperparameters INSIDE the lora tree (scalar
+    leaves, masked from the optimizer) so a checkpoint is
+    self-describing — serving must never have to guess alpha."""
+    # Both as f32: these leaves ride through value_and_grad (zero
+    # gradient, masked from updates), and grad refuses integer inputs.
+    return {**lora, "_meta": {"alpha": jnp.float32(alpha),
+                              "rank": jnp.float32(rank)}}
+
+
+def split_meta(lora: dict) -> tuple[dict, Optional[dict]]:
+    adapters = {k: v for k, v in lora.items() if k != "_meta"}
+    return adapters, lora.get("_meta")
+
+
 def merge(base: Any, lora: dict, alpha: float, rank: int) -> Any:
     """``W_eff = stop_gradient(W) + (alpha/rank)·A@B`` for adapted
     leaves; plain ``stop_gradient`` for the rest (backward never
@@ -86,13 +102,41 @@ def merge(base: Any, lora: dict, alpha: float, rank: int) -> Any:
     return jax.tree_util.tree_map_with_path(rebuild, base)
 
 
-def merge_saved(base: Any, lora: dict, alpha: float,
-                rank: Optional[int] = None) -> Any:
+def merge_saved(base: Any, lora: dict, alpha: Optional[float] = None,
+                rank: Optional[int] = None, host: bool = False) -> Any:
     """Fold saved adapters into dense weights (serving a fine-tune:
-    load the base checkpoint, merge, serve — zero runtime overhead)."""
+    load the checkpoint, merge, serve — zero runtime overhead). Alpha
+    and rank come from the checkpoint's own ``_meta`` when present;
+    the arguments are fallbacks for pre-meta checkpoints. ``host=True``
+    merges with numpy (no device materialization — an 8B's stacked
+    leaves would otherwise land unsharded on device 0)."""
+    lora, meta = split_meta(lora)
+    if meta is not None:
+        alpha = float(np.asarray(meta["alpha"]))
+        rank = int(np.asarray(meta["rank"]))
+    if alpha is None:
+        raise ValueError("checkpoint has no lora _meta; pass alpha= "
+                         "explicitly (must match training)")
     if rank is None:
         rank = int(next(iter(lora.values()))["a"].shape[-1])
-    return merge(base, lora, alpha, rank)
+    if not host:
+        return merge(base, lora, alpha, rank)
+
+    scale = alpha / rank
+
+    def rebuild(path, leaf):
+        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+        ab = lora.get(p)
+        if ab is None:
+            return leaf
+        leaf = np.asarray(leaf)
+        delta = np.einsum("...ir,...ro->...io",
+                          np.asarray(ab["a"], np.float32),
+                          np.asarray(ab["b"], np.float32))
+        return leaf + (scale * delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(rebuild, base)
 
 
 def _lora_logical_axes(base_logical: Any, lora_shapes: dict) -> dict:
@@ -106,6 +150,9 @@ def _lora_logical_axes(base_logical: Any, lora_shapes: dict) -> dict:
     }
     out = {}
     for name, ab in lora_shapes.items():
+        if name == "_meta":
+            out[name] = {"alpha": (), "rank": ()}  # replicated scalars
+            continue
         axes = flat.get(name)
         if isinstance(axes, tuple) and len(axes) >= 2:
             *stack, row, col = axes
@@ -126,14 +173,17 @@ def lora_model_def(model_def: ModelDef, rank: int, alpha: float,
     def init(rng: jax.Array):
         variables = model_def.init(rng)
         base = variables["params"]
-        lora = init_lora(base, rank, targets, jax.random.fold_in(rng, 51))
+        lora = with_meta(
+            init_lora(base, rank, targets, jax.random.fold_in(rng, 51)),
+            rank, alpha)
         out = dict(variables)
         out["params"] = {"base": base, "lora": lora}
         return out
 
     def apply(variables, batch, train=True, rng=None):
         p = variables["params"]
-        merged = merge(p["base"], p["lora"], alpha, rank)
+        adapters, _ = split_meta(p["lora"])
+        merged = merge(p["base"], adapters, alpha, rank)
         inner = dict(variables)
         inner["params"] = merged
         return model_def.apply(inner, batch, train, rng)
@@ -156,11 +206,12 @@ def lora_model_def(model_def: ModelDef, rank: int, alpha: float,
 
 
 def lora_optimizer_mask(params: dict) -> dict:
-    """optax.masked mask: True (train) for the lora subtree, False
-    (frozen, no optimizer state) for base."""
+    """optax.masked mask: True (train) for the adapters, False (frozen,
+    no optimizer state) for base and the ``_meta`` scalars."""
     return {
         "base": jax.tree.map(lambda _: False, params["base"]),
-        "lora": jax.tree.map(lambda _: True, params["lora"]),
+        "lora": {k: jax.tree.map(lambda _: k != "_meta", v)
+                 for k, v in params["lora"].items()},
     }
 
 
@@ -168,7 +219,4 @@ def wrap_optimizer(optimizer: optax.GradientTransformation
                    ) -> optax.GradientTransformation:
     """Moment/velocity state only for adapters; base updates are
     structurally zero."""
-    return optax.masked(
-        optimizer,
-        lambda params: lora_optimizer_mask(params),
-    )
+    return optax.masked(optimizer, lora_optimizer_mask)

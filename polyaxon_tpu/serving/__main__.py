@@ -37,6 +37,8 @@ def main() -> int:
                              "lossless for greedy requests)")
     parser.add_argument("--draft-checkpoint", default=None)
     parser.add_argument("--spec-k", type=int, default=4)
+    parser.add_argument("--lora-alpha", type=float, default=16.0,
+                        help="alpha when --checkpoint is a LoRA fine-tune")
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -58,7 +60,7 @@ def main() -> int:
                        kv_pages=args.kv_pages,
                        draft_model=args.draft_model,
                        draft_checkpoint=args.draft_checkpoint,
-                       spec_k=args.spec_k) as s:
+                       spec_k=args.spec_k, lora_alpha=args.lora_alpha) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

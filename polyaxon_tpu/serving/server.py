@@ -76,7 +76,7 @@ def _family(model: str):
 
 
 def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0,
-                mesh=None):
+                mesh=None, lora_alpha: float = 16.0):
     """Model params: latest step of an Orbax checkpoint dir (a saved
     JAXJob train state or a bare params tree), else random init.
 
@@ -118,6 +118,18 @@ def load_params(model: str, checkpoint: Optional[str] = None, seed: int = 0,
             # validate against the model before serving.
             restored = mgr.restore(step, args=ocp.args.StandardRestore())
             loaded = restored.get("params", restored)
+            if isinstance(loaded, dict) and set(loaded) == {"base", "lora"}:
+                # A LoRA fine-tune's train state: fold the adapters
+                # into dense weights at load — zero serving overhead.
+                # Alpha/rank come from the checkpoint's own _meta
+                # (--lora-alpha is only a fallback for pre-meta saves);
+                # the merge runs on the HOST so an 8B's stacked leaves
+                # never materialize unsharded on one device.
+                from polyaxon_tpu.models.lora import merge_saved
+
+                loaded = merge_saved(loaded["base"], loaded["lora"],
+                                     alpha=lora_alpha, host=True)
+                logger.info("merged LoRA adapters into %s", model)
             if jax.tree.structure(template) != jax.tree.structure(loaded):
                 raise ValueError(
                     f"checkpoint {checkpoint} step {step} does not match "
@@ -563,7 +575,8 @@ class ServingServer:
                  quantize: Optional[str] = None, kv: str = "dense",
                  page_size: int = 16, kv_pages: Optional[int] = None,
                  draft_model: Optional[str] = None,
-                 draft_checkpoint: Optional[str] = None, spec_k: int = 4):
+                 draft_checkpoint: Optional[str] = None, spec_k: int = 4,
+                 lora_alpha: float = 16.0):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -579,7 +592,7 @@ class ServingServer:
             self.mesh = build_mesh(V1MeshSpec(axes=mesh_axes),
                                    devices=devices)
         cfg, params = load_params(model, checkpoint, seed=seed,
-                                  mesh=self.mesh)
+                                  mesh=self.mesh, lora_alpha=lora_alpha)
         if quantize:
             full = tree_bytes(params)
             params = quantize_tree(params, mode=quantize)
