@@ -32,7 +32,8 @@ class SliceManager:
         self.pool = SlicePool()
         self.heartbeat_timeout = heartbeat_timeout
         self._gangs: dict[str, int] = {}  # run_uuid -> gang id
-        for name, topology, preemptible in slices or []:
+        self._slices: list[tuple[str, str, bool]] = list(slices or [])
+        for name, topology, preemptible in self._slices:
             self.pool.add_slice(name, topology, preemptible=preemptible)
 
     def close(self) -> None:
@@ -86,6 +87,38 @@ class SliceManager:
                 self.pool.release_gang(gang_id)
             except SlicedError:
                 pass
+
+    def stats(self) -> dict:
+        """Pool state for the API/dashboard: per-slice capacity and the
+        gangs currently placed (the operator view of the C++ pool)."""
+        def chips_of(topology: str) -> int:
+            n = 1
+            for d in topology.lower().split("x"):
+                n *= int(d)
+            return n
+
+        slices = []
+        for name, topology, preemptible in self._slices:
+            total = chips_of(topology)
+            try:
+                free = self.pool.free_chips(name)
+            except SlicedError:  # removed from the pool since init
+                continue
+            slices.append({"name": name, "topology": topology,
+                           "preemptible": preemptible,
+                           "free_chips": free, "total_chips": total})
+        gangs = []
+        # Snapshot: API handler threads poll this while the agent
+        # thread mutates placements.
+        for run_uuid, gang_id in list(self._gangs.items()):
+            try:
+                g = self.pool.gang(gang_id)
+            except SlicedError:
+                continue
+            gangs.append({"run_uuid": run_uuid, "state": g.state,
+                          "slice": g.slice, "topology": g.topology,
+                          "chips": len(g.chips), "restarts": g.restarts})
+        return {"slices": slices, "gangs": gangs}
 
     # -------------------------------------------------------------- signals
     def heartbeat(self, run_uuid: str, *, proc: int = 0,

@@ -123,6 +123,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"version": __version__})
             if rest == ["projects"]:
                 return self._json(self.plane.store.list_projects())
+            if rest == ["agent", "slices"]:
+                # The C++ slice pool's operator view (empty when this
+                # server runs without a slice-managing agent).
+                manager = getattr(self, "slice_manager", None)
+                return self._json(manager.stats() if manager is not None
+                                  else {"slices": [], "gangs": []})
             # /{owner}/{project}/runs...
             if len(rest) >= 3 and rest[2] == "runs":
                 return self._runs(method, rest[1], rest[3:], query)
@@ -338,10 +344,12 @@ class _Handler(BaseHTTPRequestHandler):
 class ApiServer:
     """Owns the HTTP server thread; ``with ApiServer(plane) as s: s.port``."""
 
-    def __init__(self, plane: ControlPlane, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, plane: ControlPlane, host: str = "127.0.0.1",
+                 port: int = 0, slice_manager=None):
         import time
 
-        handler = type("BoundHandler", (_Handler,), {"plane": plane})
+        handler = type("BoundHandler", (_Handler,),
+                       {"plane": plane, "slice_manager": slice_manager})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.started_at = time.time()
         self.host = host

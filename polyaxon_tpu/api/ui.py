@@ -140,6 +140,7 @@ DASHBOARD_HTML = r"""<!doctype html>
 </header>
 <main>
   <div class="tiles" id="tiles"></div>
+  <div id="slicesPanel"></div>
   <table id="runs">
     <thead><tr>
       <th class="cmp" aria-label="compare"></th>
@@ -193,6 +194,7 @@ async function loadRuns() {
   $("#tiles").innerHTML =
     tile("total", rows.length) +
     ["running", "succeeded", "failed"].map(s => tile(s, counts[s] || 0)).join("");
+  renderSlices();
   $("#runs tbody").innerHTML = rows.map(r => `
     <tr class="run" data-uuid="${esc(r.uuid)}">
       <td class="cmp"><input type="checkbox" class="cmpBox"
@@ -518,6 +520,38 @@ async function sweepView(run) {
     <h3>sweep${metricName ? ` · ${maximize ? "maximizing" : "minimizing"} ${esc(metricName)}` : ""}</h3>
     ${rows}
   </div>`;
+}
+
+async function renderSlices() {
+  // The C++ slice pool's operator view: per-slice chip occupancy and
+  // placed gangs. Hidden entirely when no agent manages slices.
+  const data = await api("/api/v1/agent/slices").catch(() => null);
+  const el = $("#slicesPanel");
+  if (!data || !data.slices || !data.slices.length) { el.innerHTML = ""; return; }
+  const byslice = {};
+  for (const g of data.gangs || [])
+    (byslice[g.slice] = byslice[g.slice] || []).push(g);
+  el.innerHTML = `<div class="bracket"><h3>TPU slice pool</h3>` +
+    data.slices.map(s => {
+      const used = s.total_chips - s.free_chips;
+      const gangs = (byslice[s.name] || []).map(g =>
+        `<span class="chip" data-uuid="${esc(g.run_uuid)}" role="button"
+           tabindex="0">${pill(g.state)} ${esc(String(g.run_uuid).slice(0, 8))}
+           · ${esc(g.topology)}${g.restarts ? ` · ↻${g.restarts}` : ""}</span>`
+      ).join("");
+      return `<div class="rung"><span class="rname">${esc(s.name)}
+          · ${esc(s.topology)}${s.preemptible ? " · spot" : ""}</span>
+        <span class="val">${used}/${s.total_chips} chips</span>${gangs}</div>`;
+    }).join("") + "</div>";
+  for (const chip of el.querySelectorAll(".chip[data-uuid]")) {
+    chip.onclick = () => showRun(chip.dataset.uuid);
+    chip.onkeydown = (ev) => {  // role=button: Enter/Space activate
+      if (ev.key === "Enter" || ev.key === " ") {
+        ev.preventDefault();
+        showRun(chip.dataset.uuid);
+      }
+    };
+  }
 }
 
 async function dagView(run) {

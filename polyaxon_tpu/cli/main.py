@@ -512,16 +512,16 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
     from polyaxon_tpu.api import ApiServer
 
     plane = get_plane()
-    server = ApiServer(plane, host, port)
+    manager = None
+    if with_agent and slices:
+        from polyaxon_tpu.agent import SliceManager
+
+        manager = SliceManager(_parse_slices(slices),
+                               heartbeat_timeout=heartbeat_timeout)
+    server = ApiServer(plane, host, port, slice_manager=manager)
     if with_agent:
         from polyaxon_tpu.agent import Agent
 
-        manager = None
-        if slices:
-            from polyaxon_tpu.agent import SliceManager
-
-            manager = SliceManager(_parse_slices(slices),
-                                   heartbeat_timeout=heartbeat_timeout)
         agent = Agent(plane, slice_manager=manager,
                       max_concurrent=max_concurrent)
         threading.Thread(target=agent.serve_forever, daemon=True).start()

@@ -386,3 +386,53 @@ class TestApi:
         assert "my report.txt" in run.list_artifacts()
         dest = run.download_artifact("my report.txt", str(tmp_path / "r.txt"))
         assert open(dest).read() == "spaced"
+
+
+class TestSlicePoolApi:
+    def test_agent_slices_endpoint_and_panel(self, tmp_path):
+        """The C++ pool's operator view over the API: slice capacity
+        drops while a gang is placed, recovers on release; the
+        dashboard ships the panel; servers without a manager answer
+        empty instead of 404."""
+        import json as _json
+        import urllib.request
+
+        from polyaxon_tpu.agent import SliceManager
+
+        plane = ControlPlane(str(tmp_path / "home"))
+        manager = SliceManager([("pool0", "2x4", False),
+                                ("spot0", "2x2", True)])
+        try:
+            with ApiServer(plane, slice_manager=manager) as server:
+                state = manager.ensure_placed("run-a", "2x2")
+                assert state == "running"
+                with urllib.request.urlopen(
+                        server.url + "/api/v1/agent/slices", timeout=10) as r:
+                    data = _json.load(r)
+                names = {s["name"]: s for s in data["slices"]}
+                assert names["pool0"]["total_chips"] == 8
+                assert names["spot0"]["preemptible"] is True
+                placed_free = sum(s["free_chips"] for s in data["slices"])
+                assert placed_free == 8 + 4 - 4
+                gangs = {g["run_uuid"]: g for g in data["gangs"]}
+                assert gangs["run-a"]["state"] == "running"
+                assert gangs["run-a"]["chips"] == 4
+
+                manager.release("run-a")
+                with urllib.request.urlopen(
+                        server.url + "/api/v1/agent/slices", timeout=10) as r:
+                    after = _json.load(r)
+                assert sum(s["free_chips"] for s in after["slices"]) == 12
+
+                with urllib.request.urlopen(server.url + "/ui",
+                                            timeout=10) as r:
+                    page = r.read().decode()
+                assert "slicesPanel" in page and "agent/slices" in page
+        finally:
+            manager.close()
+
+        # No manager: the route answers empty, not 404.
+        with ApiServer(plane) as server:
+            with urllib.request.urlopen(
+                    server.url + "/api/v1/agent/slices", timeout=10) as r:
+                assert _json.load(r) == {"slices": [], "gangs": []}
