@@ -17,6 +17,7 @@ only its shard (multi-host correct, single-host trivial).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Iterator, Optional
 
 import jax
@@ -71,6 +72,74 @@ def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
         rng = np.random.default_rng((seed, i))
         starts = rng.integers(0, n, size=(batch_size,))
         yield {"tokens": np.stack([tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
+        i += 1
+
+
+def _tokenize_text_file(path: str, tokenizer: str) -> np.ndarray:
+    """Raw text → int32 token ids, cached next to the source as
+    ``<path>.<slug>.tokens.npy`` (stale caches — source newer than
+    cache — are rebuilt). ``tokenizer='bytes'`` is the dependency-free
+    path: utf-8 bytes as ids (vocab 256); anything else is passed to
+    ``transformers.AutoTokenizer.from_pretrained`` — in this zero-
+    egress environment that means a LOCAL tokenizer directory."""
+    import hashlib
+    import re as _re
+
+    # Slug carries a hash of the raw tokenizer string (two strings must
+    # never share a cache through sanitization collisions).
+    digest = hashlib.sha256(tokenizer.encode()).hexdigest()[:8]
+    slug = _re.sub(r"[^A-Za-z0-9_.-]+", "-", tokenizer).strip("-")[:40]
+    cache = f"{path}.{slug}.{digest}.tokens.npy"
+    # Freshness covers the corpus AND the tokenizer assets: swapping
+    # tokenizer.json inside the same dir must invalidate the cache.
+    source_mtime = os.path.getmtime(path)
+    if os.path.isdir(tokenizer):
+        source_mtime = max(
+            [source_mtime] + [os.path.getmtime(os.path.join(tokenizer, f))
+                              for f in os.listdir(tokenizer)])
+    if os.path.exists(cache) and os.path.getmtime(cache) >= source_mtime:
+        return np.load(cache, mmap_mode="r")
+    if tokenizer == "bytes":
+        with open(path, "rb") as fh:
+            ids = np.frombuffer(fh.read(), dtype=np.uint8).astype(np.int32)
+    else:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(tokenizer)
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        ids = np.asarray(tok(text)["input_ids"], np.int32)
+    # Atomic publish: a killed run (or a concurrent host on a shared
+    # corpus) must never leave a truncated cache that mtime-wins over
+    # the source forever.
+    tmp = f"{cache}.{os.getpid()}.tmp.npy"  # .npy suffix: np.save keeps it
+    np.save(tmp, ids)
+    os.replace(tmp, cache)
+    return np.load(cache, mmap_mode="r")
+
+
+def lm_text(batch_size: int, seq_len: int = 2048, path: str = "",
+            tokenizer: str = "bytes", seed: int = 0, start_batch: int = 0,
+            **_) -> Iterator[dict[str, np.ndarray]]:
+    """Real-text LM stream: tokenize ``path`` once (cached), then
+    resume-exact random crops like ``lm_file``. The practical input for
+    LoRA fine-tunes: point ``dataset: lm_text`` at a corpus file and a
+    local tokenizer dir (or ``bytes`` for tokenizer-free runs)."""
+    if not path:
+        raise ValueError("lm_text dataset requires `path`")
+    tokens = _tokenize_text_file(path, tokenizer)
+    n = tokens.shape[0] - seq_len - 1
+    if n <= 0:
+        raise ValueError(
+            f"text file {path!r} tokenizes to {tokens.shape[0]} ids — "
+            f"shorter than seq_len {seq_len}; lower seq_len or grow "
+            "the corpus")
+    i = start_batch
+    while True:
+        rng = np.random.default_rng((seed, i))
+        starts = rng.integers(0, n, size=(batch_size,))
+        yield {"tokens": np.stack(
+            [tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
         i += 1
 
 
@@ -154,6 +223,7 @@ def mnist_synthetic(batch_size: int, seed: int = 0, start_batch: int = 0,
 DATASETS: dict[str, Callable[..., Iterator[dict[str, np.ndarray]]]] = {
     "lm_synthetic": lm_synthetic,
     "lm_file": lm_file,
+    "lm_text": lm_text,
     "lm_packed_synthetic": lm_packed_synthetic,
     "seq2seq_synthetic": seq2seq_synthetic,
     "mlm_synthetic": mlm_synthetic,

@@ -52,7 +52,8 @@ def _model_config_cls(model_name: str):
 def _dataset_kwargs(cfg: RuntimeConfig, model_cfg, per_host_batch: int) -> dict:
     kwargs: dict[str, Any] = {"batch_size": per_host_batch, "seed": cfg.seed}
     extras = dict(cfg.__pydantic_extra__ or {})
-    for key in ("path", "image_size", "num_classes", "mask_rate"):
+    for key in ("path", "tokenizer", "image_size", "num_classes",
+                "mask_rate"):
         if key in extras:
             kwargs[key] = extras[key]
     if cfg.seq_len:
