@@ -616,25 +616,35 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
 @cli.command("convert")
 @click.option("--model", required=True,
               help="target model zoo name, e.g. llama3_8b")
-@click.option("--from-hf", "hf_path", required=True,
-              help="HF checkpoint: a .safetensors/.bin file or a model "
-                   "dir containing them")
+@click.option("--from-hf", "hf_path", default=None,
+              help="import: HF checkpoint (.safetensors/.bin file or a "
+                   "model dir) → Orbax at --out")
+@click.option("--from-orbax", "orbax_path", default=None,
+              help="export: Orbax checkpoint dir (a train state, incl. "
+                   "LoRA fine-tunes — adapters merge) → HF safetensors "
+                   "+ config.json at --out")
 @click.option("--out", "out_dir", required=True,
-              help="output Orbax checkpoint dir (servable via "
-                   "plx serve --checkpoint)")
-def convert_cmd(model, hf_path, out_dir):
-    """Convert a HuggingFace Llama checkpoint into a servable Orbax
-    checkpoint (models/convert.py::from_hf_llama)."""
+              help="output dir (Orbax when importing, HF when exporting)")
+def convert_cmd(model, hf_path, orbax_path, out_dir):
+    """Convert between HuggingFace and Orbax llama checkpoints, either
+    direction (models/convert.py::from_hf_llama / to_hf_llama)."""
     from polyaxon_tpu.models import llama
     from polyaxon_tpu.models.convert import from_hf_llama
     from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
     from polyaxon_tpu.runtime.checkpoint import CheckpointManager
 
+    if (hf_path is None) == (orbax_path is None):
+        raise click.UsageError(
+            "pass exactly one of --from-hf (import) or --from-orbax "
+            "(export)")
     if model not in llama.CONFIGS:
         raise click.BadParameter(
             f"`{model}` is not a llama-family model "
             f"(choices: {sorted(llama.CONFIGS)})")
     cfg = llama.CONFIGS[model]
+
+    if orbax_path is not None:
+        return _export_to_hf(model, cfg, orbax_path, out_dir)
 
     def load_state_dict(path):
         if os.path.isdir(path):
@@ -689,6 +699,70 @@ def convert_cmd(model, hf_path, out_dir):
 
     n_params = sum(int(p.size) for p in jax.tree.leaves(variables["params"]))
     click.echo(f"converted {model}: {n_params:,} params → {out_dir}")
+
+
+def _export_to_hf(model: str, cfg, orbax_path: str, out_dir: str) -> None:
+    """Orbax train state (plain or LoRA) → HF-loadable dir:
+    model.safetensors + config.json."""
+    import json as _json
+
+    import orbax.checkpoint as ocp
+    from safetensors.numpy import save_file
+
+    from polyaxon_tpu.models.convert import to_hf_llama
+
+    if cfg.sliding_window is not None:
+        raise click.ClickException(
+            f"`{model}` uses sliding-window attention, which HF's llama "
+            "architecture does not express — an export would silently "
+            "attend past the window; not supported")
+    if os.path.exists(os.path.join(out_dir, "model.safetensors")):
+        raise click.ClickException(
+            f"{out_dir} already contains model.safetensors; choose a new "
+            "--out or delete it first")
+    with ocp.CheckpointManager(orbax_path) as mgr:
+        step = mgr.latest_step()
+        if step is None:
+            raise click.ClickException(f"no checkpoint under {orbax_path}")
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
+    params = restored.get("params", restored)
+    if isinstance(params, dict) and set(params) == {"base", "lora"}:
+        from polyaxon_tpu.models.lora import merge_saved
+
+        params = merge_saved(params["base"], params["lora"], host=True)
+        click.echo("merged LoRA adapters into dense weights")
+    state_dict = to_hf_llama(params, cfg)
+    os.makedirs(out_dir, exist_ok=True)
+    # metadata format=pt: transformers' loader checks it before
+    # trusting the file.
+    save_file(state_dict, os.path.join(out_dir, "model.safetensors"),
+              metadata={"format": "pt"})
+    config = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "hidden_size": cfg.dim,
+        "intermediate_size": cfg.ffn_dim,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_seq_len,
+        "rms_norm_eps": cfg.norm_eps,
+        "rope_theta": cfg.rope_theta,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "torch_dtype": "float32",
+    }
+    if cfg.rope_scaling is not None:
+        # Ours carries the public llama3 rule's fields; HF wants the
+        # same dict plus its rope_type tag. Dropping this would export
+        # llama31_* with silently unscaled RoPE.
+        config["rope_scaling"] = {"rope_type": "llama3",
+                                  **dict(cfg.rope_scaling)}
+    with open(os.path.join(out_dir, "config.json"), "w") as fh:
+        _json.dump(config, fh, indent=2)
+    n_params = sum(int(v.size) for v in state_dict.values())
+    click.echo(f"exported {model} step {step}: {n_params:,} params → "
+               f"{out_dir} (model.safetensors + config.json)")
 
 
 # -------------------------------------------------------------------- agent

@@ -104,7 +104,12 @@ def to_hf_llama(params: Mapping[str, Any], cfg: LlamaConfig) -> dict[str, np.nda
     for i in range(cfg.n_layers):
         for hf_name, ours, transpose in mapping:
             mat = np.asarray(layers[ours][i], np.float32)
-            out[f"model.layers.{i}.{hf_name}"] = mat.T if transpose else mat
+            # ascontiguousarray: .T is a view, and safetensors writers
+            # serialize the underlying buffer — a non-contiguous
+            # transpose would round-trip as the UNtransposed matrix.
+            out[f"model.layers.{i}.{hf_name}"] = (
+                np.ascontiguousarray(mat.T) if transpose else mat)
     if "lm_head" in params:
-        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+        out["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"], np.float32).T)
     return out

@@ -59,6 +59,26 @@ def lm_synthetic(batch_size: int, seq_len: int = 2048, vocab_size: int = 32_000,
         i += 1
 
 
+def _crop_stream(tokens: np.ndarray, batch_size: int, seq_len: int,
+                 seed: int, start_batch: int,
+                 source: str) -> Iterator[dict[str, np.ndarray]]:
+    """Resume-exact random crops over a flat token array — the one
+    place the (seed, i) keying and crop bound live (lm_file + lm_text)."""
+    n = tokens.shape[0] - seq_len - 1
+    if n <= 0:
+        raise ValueError(
+            f"{source} holds {tokens.shape[0]} token ids — needs more "
+            f"than seq_len + 1 = {seq_len + 1}; lower seq_len or grow "
+            "the corpus")
+    i = start_batch
+    while True:
+        rng = np.random.default_rng((seed, i))
+        starts = rng.integers(0, n, size=(batch_size,))
+        yield {"tokens": np.stack(
+            [tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
+        i += 1
+
+
 def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
             start_batch: int = 0, **_) -> Iterator[dict[str, np.ndarray]]:
     """Memory-mapped token file: flat int32/int16 .npy of token ids.
@@ -66,13 +86,8 @@ def lm_file(batch_size: int, seq_len: int = 2048, path: str = "", seed: int = 0,
     if not path:
         raise ValueError("lm_file dataset requires `path`")
     tokens = np.load(path, mmap_mode="r")
-    n = tokens.shape[0] - seq_len - 1
-    i = start_batch
-    while True:
-        rng = np.random.default_rng((seed, i))
-        starts = rng.integers(0, n, size=(batch_size,))
-        yield {"tokens": np.stack([tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
-        i += 1
+    return _crop_stream(tokens, batch_size, seq_len, seed, start_batch,
+                        source=f"token file {path!r}")
 
 
 def _tokenize_text_file(path: str, tokenizer: str) -> np.ndarray:
@@ -120,6 +135,7 @@ def _tokenize_text_file(path: str, tokenizer: str) -> np.ndarray:
 
 def lm_text(batch_size: int, seq_len: int = 2048, path: str = "",
             tokenizer: str = "bytes", seed: int = 0, start_batch: int = 0,
+            vocab_size: Optional[int] = None,
             **_) -> Iterator[dict[str, np.ndarray]]:
     """Real-text LM stream: tokenize ``path`` once (cached), then
     resume-exact random crops like ``lm_file``. The practical input for
@@ -128,19 +144,19 @@ def lm_text(batch_size: int, seq_len: int = 2048, path: str = "",
     if not path:
         raise ValueError("lm_text dataset requires `path`")
     tokens = _tokenize_text_file(path, tokenizer)
-    n = tokens.shape[0] - seq_len - 1
-    if n <= 0:
-        raise ValueError(
-            f"text file {path!r} tokenizes to {tokens.shape[0]} ids — "
-            f"shorter than seq_len {seq_len}; lower seq_len or grow "
-            "the corpus")
-    i = start_batch
-    while True:
-        rng = np.random.default_rng((seed, i))
-        starts = rng.integers(0, n, size=(batch_size,))
-        yield {"tokens": np.stack(
-            [tokens[s:s + seq_len] for s in starts]).astype(np.int32)}
-        i += 1
+    # The runtime forwards the model's vocab here: an oversized
+    # tokenizer would otherwise flow out-of-range ids into the embed
+    # gather, which JAX silently CLAMPS — a garbage fine-tune with no
+    # diagnostic.
+    if vocab_size is not None and tokens.size:
+        top = int(tokens.max())
+        if top >= vocab_size:
+            raise ValueError(
+                f"tokenizer {tokenizer!r} produced id {top} but the "
+                f"model's vocab_size is {vocab_size} — the tokenizer "
+                "and model do not share a token space")
+    return _crop_stream(tokens, batch_size, seq_len, seed, start_batch,
+                        source=f"text file {path!r} ({tokenizer})")
 
 
 def lm_packed_synthetic(batch_size: int, seq_len: int = 2048,

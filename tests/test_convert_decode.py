@@ -118,3 +118,55 @@ class TestConvertGuards:
         small = dataclasses.replace(cfg, n_layers=1)
         with pytest.raises(ValueError, match="more than 1 layers"):
             from_hf_llama(hf.state_dict(), small)
+
+
+class TestExportToHF:
+    def test_export_cli_roundtrips_through_transformers(self, tiny,
+                                                        tmp_path):
+        """plx convert --from-orbax: a saved train state exports to an
+        HF dir that transformers loads, with logit parity against the
+        native forward — the full interop circle (import is tested
+        above)."""
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+        from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+        transformers = pytest.importorskip("transformers")
+        cfg, _, torch = tiny
+        params = llama.init(cfg, jax.random.key(3))["params"]
+        ckpt_dir = str(tmp_path / "ckpt")
+        mgr = CheckpointManager(ckpt_dir, V1JaxCheckpointing(
+            enabled=True, interval_steps=1, async_save=False))
+        try:
+            mgr.save(0, {"params": params}, force=True)
+        finally:
+            mgr.close()
+
+        out = str(tmp_path / "hf")
+        result = CliRunner().invoke(cli, [
+            "convert", "--model", "llama_tiny", "--from-orbax", ckpt_dir,
+            "--out", out])
+        assert result.exit_code == 0, result.output
+        assert "exported" in result.output
+
+        hf = transformers.LlamaForCausalLM.from_pretrained(out).eval()
+        tokens = np.random.RandomState(1).randint(0, cfg.vocab_size,
+                                                  (2, 12))
+        with torch.no_grad():
+            hf_logits = hf(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(cfg, params, jnp.asarray(tokens))
+        np.testing.assert_allclose(np.asarray(ours), hf_logits,
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_export_requires_exactly_one_source(self, tmp_path):
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        result = CliRunner().invoke(cli, [
+            "convert", "--model", "llama_tiny",
+            "--out", str(tmp_path / "x")])
+        assert result.exit_code != 0
+        assert "exactly one" in result.output

@@ -340,7 +340,7 @@ class TestLmText:
 
         corpus = tmp_path / "tiny.txt"
         corpus.write_text("short")
-        with pytest.raises(ValueError, match="shorter than seq_len"):
+        with pytest.raises(ValueError, match="needs more than seq_len"):
             next(data_lib.get_dataset("lm_text", batch_size=1,
                                       seq_len=128, path=str(corpus)))
 
