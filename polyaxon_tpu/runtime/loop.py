@@ -181,7 +181,34 @@ def run_jaxjob(
                 param_count=int(n_params),
                 restored_from_step=restored_from,
             )
+        # Periodic held-out evaluation: a FIXED batch set drawn from the
+        # same dataset family at a disjoint seed (or from `eval_path`
+        # when given — e.g. a separate validation corpus for lm_text),
+        # so every eval scores the same data and curves are comparable.
+        eval_step = run_eval = None
+        if cfg.eval_every:
+            eval_step = build_eval_step(model_def)
+            eval_kwargs = dict(ds_kwargs)
+            extras = dict(cfg.__pydantic_extra__ or {})
+            if extras.get("eval_path"):
+                eval_kwargs["path"] = extras["eval_path"]
+            eval_kwargs["seed"] = cfg.seed + 104_729  # disjoint stream
+            eval_kwargs["start_batch"] = 0
+            n_eval = max(cfg.eval_steps, 1)
+
+            def run_eval(state) -> dict[str, float]:
+                eval_iter = data_lib.shard_batches(
+                    data_lib.get_dataset(dataset_name, **eval_kwargs),
+                    mesh, rules)
+                sums: dict[str, float] = {}
+                for _ in range(n_eval):
+                    for k, v in eval_step(state, next(eval_iter)).items():
+                        sums[k] = sums.get(k, 0.0) + float(v)
+                return {f"eval_{k}": v / n_eval for k, v in sums.items()}
+
         final_metrics: dict[str, float] = {}
+        last_eval: dict[str, float] = {}
+        evaled_at = -1  # state["step"] value the last eval scored
         step_rng = jax.random.key(cfg.seed + 17)
         # Warm up compile outside the timed window.
         first_batch = next(batches)
@@ -203,6 +230,7 @@ def run_jaxjob(
 
         t0 = time.perf_counter()
         timed_steps = 0
+        off_clock = 0.0  # eval + sync-checkpoint seconds, excluded
         for step in range(start_step + 1, cfg.steps):
             if should_stop is not None and should_stop():
                 logger.info("stop requested at step %d", step)
@@ -237,16 +265,44 @@ def run_jaxjob(
                 # Stamp AFTER the callback: tracking I/O must not
                 # deflate the next window's reported throughput.
                 t_emit = time.perf_counter()
+            if eval_step is not None and step % cfg.eval_every == 0:
+                # Drain queued train dispatches BEFORE stamping the
+                # exclusion window, or their device time would be
+                # charged to eval and inflate reported throughput/MFU.
+                jax.block_until_ready(metrics["loss"])
+                t_eval = time.perf_counter()
+                last_eval = run_eval(state)
+                evaled_at = int(state["step"])
+                if on_metrics:
+                    on_metrics(step, last_eval)
+                # Off the training clock, like checkpoint saves — for
+                # both the per-emission window AND the run-level wall.
+                dt_eval = time.perf_counter() - t_eval
+                t_emit += dt_eval
+                off_clock += dt_eval
             if ckpt and ckpt.should_save(step):
                 t_save = time.perf_counter()
                 ckpt.save(step, state)
                 # Exclude (synchronous) checkpoint time too — an MFU
                 # dip every save interval would make real regressions
                 # indistinguishable from checkpoint cadence.
-                t_emit += time.perf_counter() - t_save
+                dt_save = time.perf_counter() - t_save
+                t_emit += dt_save
+                off_clock += dt_save
         jax.block_until_ready(state["params"])
-        wall = time.perf_counter() - t0
+        # Run-level throughput matches the emitted stream: eval and
+        # sync-save time are off the training clock in both.
+        wall = time.perf_counter() - t0 - off_clock
         final_metrics = {k: float(v) for k, v in metrics.items()}
+        if eval_step is not None:
+            # Outputs always carry an eval of the FINISHED params; skip
+            # the extra pass (and the duplicate metric point) when the
+            # cadence already scored them.
+            if evaled_at != int(state["step"]):
+                last_eval = run_eval(state)
+                if on_metrics:
+                    on_metrics(max(int(state["step"]) - 1, 0), last_eval)
+            final_metrics.update(last_eval)
         final_step = int(state["step"])
 
         if ckpt:
