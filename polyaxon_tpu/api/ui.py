@@ -174,6 +174,21 @@ const api = (p) => fetch(p).then(r => { if (!r.ok) throw new Error(r.status); re
 const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
   c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
 
+function wireRunChips(root) {
+  // role=button chips navigate on click AND Enter/Space — one wiring
+  // for the sweep/bracket chips, DAG nodes, and slice-pool gangs.
+  for (const chip of root.querySelectorAll(
+      ".chip[data-uuid], .dagnode[data-uuid]")) {
+    chip.onclick = () => showRun(chip.dataset.uuid);
+    chip.onkeydown = (ev) => {
+      if (ev.key === "Enter" || ev.key === " ") {
+        ev.preventDefault();
+        showRun(chip.dataset.uuid);
+      }
+    };
+  }
+}
+
 function pill(status) {
   const [color, glyph] = STATUS[status] || ["var(--muted)", "•"];
   return `<span class="pill"><span class="dot" style="background:${color}"></span>${glyph} ${esc(status)}</span>`;
@@ -543,15 +558,7 @@ async function renderSlices() {
           · ${esc(s.topology)}${s.preemptible ? " · spot" : ""}</span>
         <span class="val">${used}/${s.total_chips} chips</span>${gangs}</div>`;
     }).join("") + "</div>";
-  for (const chip of el.querySelectorAll(".chip[data-uuid]")) {
-    chip.onclick = () => showRun(chip.dataset.uuid);
-    chip.onkeydown = (ev) => {  // role=button: Enter/Space activate
-      if (ev.key === "Enter" || ev.key === " ") {
-        ev.preventDefault();
-        showRun(chip.dataset.uuid);
-      }
-    };
-  }
+  wireRunChips(el);
 }
 
 async function dagView(run) {
@@ -675,15 +682,7 @@ async function showRun(uuid, opts) {
                      Array.isArray(files) ? files : [])}
     <div id="logs" aria-label="run logs"${isPipeline ? " hidden" : ""}></div>`;
   for (const el of detail.querySelectorAll(".chart")) wireChart(el);
-  for (const chip of detail.querySelectorAll(".chip, .dagnode[data-uuid]")) {
-    chip.onclick = () => showRun(chip.dataset.uuid);
-    chip.onkeydown = (ev) => {  // role=button: Enter/Space activate
-      if (ev.key === "Enter" || ev.key === " ") {
-        ev.preventDefault();
-        showRun(chip.dataset.uuid);
-      }
-    };
-  }
+  wireRunChips(detail);
   if (!isPipeline) {
     const logs = $("#logs");
     logSource = new EventSource(`/streams/v1/default/default/runs/${uuid}/logs?follow=true`);

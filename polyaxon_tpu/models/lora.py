@@ -38,6 +38,13 @@ from polyaxon_tpu.models.common import ModelDef
 DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def _path_str(path) -> str:
+    """'/'-joined pytree key path (DictKey/SequenceKey agnostic) — the
+    stable leaf address the lora tree is keyed by."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
 def _match(path: tuple, targets) -> bool:
     leaf_name = str(path[-1])
     return any(re.fullmatch(t, leaf_name) for t in targets)
@@ -50,7 +57,8 @@ def init_lora(params: Any, rank: int, targets, key: jax.Array) -> dict:
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     lora: dict[str, dict] = {}
     for path, leaf in flat:
-        p = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        p = tuple(str(getattr(k, "key", getattr(k, "idx", k)))
+                  for k in path)
         if leaf.ndim < 2 or not _match(p, targets):
             continue
         key, sub = jax.random.split(key)
@@ -89,10 +97,8 @@ def merge(base: Any, lora: dict, alpha: float, rank: int) -> Any:
     scale = alpha / rank
 
     def rebuild(path, leaf):
-        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                     for k in path)
         leaf = jax.lax.stop_gradient(leaf)
-        ab = lora.get(p)
+        ab = lora.get(_path_str(path))
         if ab is None:
             return leaf
         delta = jnp.einsum("...ir,...ro->...io", ab["a"].astype(jnp.float32),
@@ -125,9 +131,7 @@ def merge_saved(base: Any, lora: dict, alpha: Optional[float] = None,
     scale = alpha / rank
 
     def rebuild(path, leaf):
-        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                     for k in path)
-        ab = lora.get(p)
+        ab = lora.get(_path_str(path))
         if ab is None:
             return leaf
         leaf = np.asarray(leaf)
@@ -143,8 +147,7 @@ def _lora_logical_axes(base_logical: Any, lora_shapes: dict) -> dict:
     """Adapter shardings from the base leaf's logical axes: A keeps the
     row axis, B keeps the col axis, the rank axis is unsharded."""
     flat = {
-        "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                 for k in path): axes
+        _path_str(path): axes
         for path, axes in jax.tree_util.tree_flatten_with_path(
             base_logical, is_leaf=lambda x: isinstance(x, tuple))[0]
     }

@@ -195,14 +195,20 @@ def run_jaxjob(
             eval_kwargs["seed"] = cfg.seed + 104_729  # disjoint stream
             eval_kwargs["start_batch"] = 0
             n_eval = max(cfg.eval_steps, 1)
+            # Materialize the fixed batch set ONCE: rebuilding the
+            # dataset pipeline per eval would re-pay its construction
+            # cost (e.g. lm_text's corpus mmap + vocab scan) at every
+            # cadence point.
+            _eval_iter = data_lib.shard_batches(
+                data_lib.get_dataset(dataset_name, **eval_kwargs),
+                mesh, rules)
+            eval_batches = [next(_eval_iter) for _ in range(n_eval)]
+            del _eval_iter
 
             def run_eval(state) -> dict[str, float]:
-                eval_iter = data_lib.shard_batches(
-                    data_lib.get_dataset(dataset_name, **eval_kwargs),
-                    mesh, rules)
                 sums: dict[str, float] = {}
-                for _ in range(n_eval):
-                    for k, v in eval_step(state, next(eval_iter)).items():
+                for batch in eval_batches:
+                    for k, v in eval_step(state, batch).items():
                         sums[k] = sums.get(k, 0.0) + float(v)
                 return {f"eval_{k}": v / n_eval for k, v in sums.items()}
 

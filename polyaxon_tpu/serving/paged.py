@@ -106,10 +106,6 @@ class PagePool:
             return page
         return None
 
-    def _take(self, page: int) -> None:
-        """Claim a specific resident page out of the retired cache."""
-        del self._cached[page]
-
     def admit(self, slot: int, length: int,
               tokens: Optional[list] = None) -> bool:
         """Allocate pages covering positions 0..length-1 for ``slot``.
@@ -138,7 +134,7 @@ class PagePool:
                 if hit is not None:
                     page = hit
                     if page in self._cached:
-                        self._take(page)
+                        del self._cached[page]  # claim the resident page
                     self.prefix_hits += 1
                 else:
                     page = self._alloc_one()
