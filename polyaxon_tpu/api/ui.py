@@ -54,11 +54,12 @@ DASHBOARD_HTML = r"""<!doctype html>
            padding: 14px 20px; border-bottom: 1px solid var(--ring); }
   header h1 { font-size: 16px; margin: 0; font-weight: 650; }
   header .spacer { flex: 1; }
-  select, button {
+  select, button, input[type="search"] {
     font: inherit; color: var(--ink); background: var(--surface);
     border: 1px solid var(--ring); border-radius: 6px; padding: 4px 10px;
-    cursor: pointer;
   }
+  select, button { cursor: pointer; }
+  input[type="search"] { min-width: 180px; }
   main { padding: 16px 20px; max-width: 1100px; margin: 0 auto; }
   .tiles { display: flex; gap: 12px; flex-wrap: wrap; margin-bottom: 16px; }
   .tile { background: var(--surface); border: 1px solid var(--ring);
@@ -128,6 +129,11 @@ DASHBOARD_HTML = r"""<!doctype html>
 <header>
   <h1>polyaxon_tpu</h1>
   <span class="spacer"></span>
+  <input id="searchBox" type="search" placeholder="filter runs…"
+         aria-label="filter runs by name, kind, uuid, or tag">
+  <select id="projectFilter" aria-label="project filter">
+    <option>default</option>
+  </select>
   <select id="statusFilter" aria-label="status filter">
     <option value="">all statuses</option>
     <option>running</option><option>succeeded</option>
@@ -198,18 +204,55 @@ function tile(k, v) {
   return `<div class="tile"><div class="v">${v}</div><div class="k">${k}</div></div>`;
 }
 
+let lastRows = [];      // last successful fetch — search filters this
+let lastProjects = "";  // rendered option set, rebuilt only on change
+
 async function loadRuns() {
-  const keep = new Set(selectedRuns().map(r => r.uuid));  // survive refresh
   const status = $("#statusFilter").value;
   const q = status ? `?status=${encodeURIComponent(status)}` : "";
-  const data = await api(`/api/v1/default/default/runs${q}`);
-  const rows = data.results || [];
+  // The list route is project-scoped; the dropdown picks which one
+  // (run DETAIL stays uuid-addressed, so everything else is unchanged).
+  const projSel = $("#projectFilter");
+  let projects;
+  try { projects = (await api("/api/v1/projects")).map(p => p.name).sort(); }
+  catch (e) { projects = null; }  // transient failure: keep the old list
+  if (projects && projects.length && projects.join("\n") !== lastProjects) {
+    // Rebuild only on a real change — an unconditional rebuild every
+    // poll would close the dropdown under the user's cursor.
+    const prev = projSel.value;
+    const current = projects.includes(prev) ? prev : projects[0];
+    projSel.innerHTML = projects.map(p =>
+      `<option${p === current ? " selected" : ""}>${esc(p)}</option>`
+    ).join("");
+    lastProjects = projects.join("\n");
+  }
+  const project = projSel.value || "default";
+  try {
+    const data = await api(
+      `/api/v1/default/${encodeURIComponent(project)}/runs${q}`);
+    lastRows = data.results || [];
+  } catch (e) {
+    return;  // transient failure: keep the last good table on screen
+  }
+  renderRuns();
+  renderSlices();
+}
+
+function renderRuns() {
+  const keep = new Set(selectedRuns().map(r => r.uuid));  // survive refresh
+  let rows = lastRows;
+  // Free-text filter over name/kind/uuid/tags — purely client-side,
+  // so keystrokes never trigger network I/O.
+  const needle = $("#searchBox").value.trim().toLowerCase();
+  if (needle)
+    rows = rows.filter(r =>
+      [r.name, r.kind, r.uuid, ...(r.tags || [])].some(
+        v => String(v ?? "").toLowerCase().includes(needle)));
   const counts = {};
   for (const r of rows) counts[r.status] = (counts[r.status] || 0) + 1;
   $("#tiles").innerHTML =
     tile("total", rows.length) +
     ["running", "succeeded", "failed"].map(s => tile(s, counts[s] || 0)).join("");
-  renderSlices();
   $("#runs tbody").innerHTML = rows.map(r => `
     <tr class="run" data-uuid="${esc(r.uuid)}">
       <td class="cmp"><input type="checkbox" class="cmpBox"
@@ -697,6 +740,11 @@ async function showRun(uuid, opts) {
 
 $("#refresh").onclick = loadRuns;
 $("#statusFilter").onchange = loadRuns;
+$("#projectFilter").onchange = loadRuns;
+$("#searchBox").oninput = () => {  // debounced; no network round-trip
+  clearTimeout(window._searchTimer);
+  window._searchTimer = setTimeout(renderRuns, 150);
+};
 $("#compareBtn").onclick = compareRuns;
 $("#themeToggle").onclick = () => {
   const root = document.documentElement;

@@ -436,3 +436,33 @@ class TestSlicePoolApi:
             with urllib.request.urlopen(
                     server.url + "/api/v1/agent/slices", timeout=10) as r:
                 assert _json.load(r) == {"slices": [], "gangs": []}
+
+
+class TestRunFilters:
+    def test_project_scoped_lists_and_search_surface(self, stack):
+        """The dashboard's project dropdown + search box: projects
+        endpoint lists every project, the list route scopes by its
+        path project, and the page ships both controls."""
+        import json as _json
+        import urllib.request
+
+        plane, server = stack
+        plane.submit(TRIAL, params={"lr": 0.1})
+        plane.submit(TRIAL, params={"lr": 0.2}, project="research")
+
+        with urllib.request.urlopen(server.url + "/api/v1/projects",
+                                    timeout=10) as r:
+            names = {p["name"] for p in _json.load(r)}
+        assert {"default", "research"} <= names
+
+        for project, expected in (("default", 1), ("research", 1)):
+            with urllib.request.urlopen(
+                    f"{server.url}/api/v1/default/{project}/runs",
+                    timeout=10) as r:
+                listed = _json.load(r)["results"]
+            assert len(listed) == expected
+            assert all(item["project"] == project for item in listed)
+
+        with urllib.request.urlopen(server.url + "/ui", timeout=10) as r:
+            page = r.read().decode()
+        assert "searchBox" in page and "projectFilter" in page
