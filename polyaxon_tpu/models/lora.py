@@ -36,6 +36,9 @@ from polyaxon_tpu.models.common import ModelDef
 # Matmul weights adapted by default: attention + MLP projections of
 # the decoder families (embeddings/norms/lm_head stay frozen).
 DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# T5 adds fused encoder QKV and the cross-attention projections; pass
+# as ``lora_targets`` when fine-tuning the seq2seq family.
+T5_TARGETS = DEFAULT_TARGETS + ("wqkv", "xq", "xkv", "xo")
 
 
 def _path_str(path) -> str:

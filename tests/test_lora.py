@@ -191,3 +191,17 @@ class TestLoraRuntime:
         moved = sum(float(jnp.abs(jnp.asarray(x)).sum())
                     for x in jax.tree.leaves(adapters))
         assert moved > 0
+
+    def test_t5_lora_with_documented_targets(self):
+        """The seq2seq family fine-tunes with lora.T5_TARGETS (fused
+        encoder QKV + cross-attention projections included)."""
+        job = V1JAXJob.from_dict({
+            "kind": "jaxjob",
+            "runtime": {"model": "t5_tiny", "dataset": "seq2seq_synthetic",
+                        "steps": 3, "seq_len": 32,
+                        "global_batch_size": 8, "log_every": 1,
+                        "learning_rate": 1e-2, "lora_rank": 4,
+                        "lora_targets": list(lora.T5_TARGETS)}})
+        result = run_jaxjob(job)
+        assert result.steps == 3
+        assert np.isfinite(result.final_metrics["loss"])
