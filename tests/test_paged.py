@@ -392,3 +392,27 @@ class TestPrefixCache:
         assert pool.admit(1, 10, tokens)
         assert pool.free_pages == 0
         assert pool.prefix_hits == 2
+
+
+class TestPagedEdges:
+    def test_page_size_one(self):
+        """Degenerate page size 1 (a page per position): allocator and
+        engine still token-match the dense engine."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=2, max_len=16)
+        try:
+            want = dense.generate([[5, 6, 7]], max_new_tokens=4,
+                                  timeout=300)
+        finally:
+            dense.stop()
+        paged = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=2, max_len=16,
+                                         kv="paged", page_size=1)
+        try:
+            got = paged.generate([[5, 6, 7]], max_new_tokens=4,
+                                 timeout=300)
+        finally:
+            paged.stop()
+        assert got == want

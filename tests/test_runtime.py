@@ -335,6 +335,15 @@ class TestLmText:
         fresh = next(it3)["tokens"]
         assert (fresh == ord("!")).all(), fresh
 
+    def test_lm_text_empty_file_rejected(self, tmp_path):
+        from polyaxon_tpu.runtime import data as data_lib
+
+        corpus = tmp_path / "empty.txt"
+        corpus.write_text("")
+        with pytest.raises(ValueError, match="needs more than"):
+            next(data_lib.get_dataset("lm_text", batch_size=1,
+                                      seq_len=8, path=str(corpus)))
+
     def test_too_short_corpus_rejected(self, tmp_path):
         from polyaxon_tpu.runtime import data as data_lib
 

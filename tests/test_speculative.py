@@ -174,3 +174,17 @@ class TestDraftVocab:
         # is 256 — serving must refuse the pairing loudly.
         with pytest.raises(ValueError, match="token space"):
             ServingServer("llama_tiny", draft_model="llama3_draft_200m")
+
+
+class TestSpeculativeEdges:
+    def test_max_new_one(self):
+        """Budget of 1: the prefill's own argmax is the whole output —
+        the while_loop body must never need to run."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+        want = np.asarray(llama.generate(cfg, params, prompt,
+                                         max_new_tokens=1))
+        got = np.asarray(generate_speculative(
+            cfg, params, cfg, params, prompt, max_new_tokens=1, k=4))
+        np.testing.assert_array_equal(got, want)
