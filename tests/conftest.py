@@ -91,9 +91,18 @@ def pytest_collection_modifyitems(config, items):
         if fname == "test_multiprocess_gang.py":
             item.add_marker(pytest.mark.gang)
     # A stale entry (renamed/deleted test) must fail collection loudly,
-    # not silently shrink the default CI tier.
-    if len(items) > 100:  # skip for targeted runs that collect subsets
-        stale = set(SMOKE_NODES) - matched
+    # not silently shrink the default CI tier. Checked PER ENTRY: an
+    # entry is stale only if its FILE was fully collected yet the node
+    # didn't match — file/dir subsets stay runnable and renames in any
+    # collected file are still caught. Explicit `::` node selections
+    # and -k filters narrow WITHIN files, so the guard stands down for
+    # those (a class-scoped run must not trip on its siblings).
+    narrowed = (any("::" in str(arg) for arg in config.args)
+                or bool(getattr(config.option, "keyword", "")))
+    if not narrowed:
+        collected = {os.path.basename(str(item.fspath)) for item in items}
+        stale = {entry for entry in set(SMOKE_NODES) - matched
+                 if entry.split("::", 1)[0] in collected}
         assert not stale, f"SMOKE_NODES entries match no test: {stale}"
 
 

@@ -106,11 +106,13 @@ class TestPagedEngine:
     def _params(self, cfg):
         return llama.init(cfg, jax.random.key(0))["params"]
 
-    def test_matches_dense_engine_greedy(self):
+    @pytest.mark.parametrize("page_size", [1, 4])
+    def test_matches_dense_engine_greedy(self, page_size):
         """Paged and dense engines share every step above the cache
         layout, so greedy decode must agree token-for-token — mixed
         prompt lengths, more requests than slots (retire→admit reuses
-        freed pages)."""
+        freed pages). page_size=1 is the degenerate page-per-position
+        case."""
         cfg = _cfg()
         params = self._params(cfg)
         rows = [[5, 6, 7], [1, 2, 3, 4], [9, 8], [3, 1, 4, 1, 5], [2, 7]]
@@ -122,7 +124,7 @@ class TestPagedEngine:
             dense.stop()
         paged = ContinuousBatchingEngine("llama_tiny", cfg, params,
                                          slots=2, max_len=32,
-                                         kv="paged", page_size=4)
+                                         kv="paged", page_size=page_size)
         try:
             got = paged.generate(rows, max_new_tokens=6, timeout=300)
             stats = paged.stats()
@@ -394,25 +396,3 @@ class TestPrefixCache:
         assert pool.prefix_hits == 2
 
 
-class TestPagedEdges:
-    def test_page_size_one(self):
-        """Degenerate page size 1 (a page per position): allocator and
-        engine still token-match the dense engine."""
-        cfg = _cfg()
-        params = llama.init(cfg, jax.random.key(0))["params"]
-        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
-                                         slots=2, max_len=16)
-        try:
-            want = dense.generate([[5, 6, 7]], max_new_tokens=4,
-                                  timeout=300)
-        finally:
-            dense.stop()
-        paged = ContinuousBatchingEngine("llama_tiny", cfg, params,
-                                         slots=2, max_len=16,
-                                         kv="paged", page_size=1)
-        try:
-            got = paged.generate([[5, 6, 7]], max_new_tokens=4,
-                                 timeout=300)
-        finally:
-            paged.stop()
-        assert got == want
