@@ -24,6 +24,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 # FLOPs accounting + peak tables live in the package so the runtime
 # loop self-reports the same MFU numbers (runtime/flops.py).
@@ -39,8 +40,10 @@ import jax
 p = os.environ.get("JAX_PLATFORMS")
 if p:
     jax.config.update("jax_platforms", p)
+cfg = jax.config.jax_platforms or ""
 d = jax.devices()
 print(json.dumps({"n": len(d), "platform": d[0].platform,
+                  "cfg_platforms": cfg,
                   "kind": getattr(d[0], "device_kind", "unknown")}))
 """
 
@@ -71,10 +74,115 @@ def _probe_backend(timeout_s: float = 90.0):
         return None, f"{kind}: {tail}"
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
-            return json.loads(line), None
+            probe = json.loads(line)
         except json.JSONDecodeError:
             continue
+        if probe.get("platform") == "cpu":
+            # The probe only runs when the tpu/axon backend is expected
+            # (callers that pin cpu skip it), so a cpu platform here is
+            # never a success: benching llama_200m on a host CPU takes
+            # hours and produces a garbage number. Whether it is a
+            # RETRYABLE outage depends on whether a TPU plugin is even
+            # configured: on the axon host (sitecustomize pins
+            # "axon,cpu") a fallback means the tunnel dropped the
+            # connection — transient; with no tpu platform configured
+            # at all, no amount of retrying will conjure one.
+            cfg = probe.get("cfg_platforms", "")
+            if "axon" in cfg or "tpu" in cfg:
+                return None, "tpu_unavailable: backend fell back to cpu"
+            return None, ("no_tpu_backend: only cpu available "
+                          f"(jax_platforms={cfg!r})")
+        return probe, None
     return None, "probe_no_output"
+
+
+def _probe_backend_with_retry(budget_s: float, probe_timeout: float = 90.0,
+                              interval_s: float = 240.0):
+    """Probe the backend repeatedly across a retry window instead of
+    giving up on the first hang.
+
+    The axon tunnel's observed failure mode is a ~23-minute outage/
+    recovery cycle (perf_sweep_log.txt, rounds 1-3): a single 90 s
+    probe sampled inside an outage guarantees a 0.0 benchmark even
+    though the chip comes back minutes later. So: probe, and on a
+    recognizable outage sleep and re-probe until ``budget_s`` is
+    spent (default 45 min ≈ two recovery cycles). Non-outage errors
+    (broken jax install, spawn failure) fail fast — retrying cannot
+    fix those. Progress goes to stderr; stdout stays one JSON line.
+    """
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        probe, err = _probe_backend(probe_timeout)
+        if probe is not None:
+            if attempt > 1:
+                print(f"# backend recovered on probe attempt {attempt}",
+                      file=sys.stderr)
+            return probe, None
+        if not err.startswith("tpu_unavailable"):
+            return None, err  # environment breakage: retries won't help
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None, (f"{err} (after {attempt} probes over "
+                          f"{budget_s / 60:.0f} min retry window)")
+        sleep_s = min(interval_s, remaining)
+        print(f"# probe {attempt}: {err}; retrying in {sleep_s:.0f}s "
+              f"({remaining / 60:.1f} min of retry window left)",
+              file=sys.stderr)
+        time.sleep(sleep_s)
+
+
+def _baseline_tpu_record():
+    """``(record, mfu)`` from ``bench_baseline.json`` when it holds a
+    real-TPU measurement, else ``(None, None)``. The single reader of
+    the baseline schema — the outage fallback and the roofline
+    estimate both derive their MFU here, so a schema change has one
+    place to land."""
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    try:
+        with open(baseline_path) as fh:
+            prior = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    tps = prior.get("tokens_per_sec_per_chip")
+    if not tps or prior.get("backend") != "tpu":
+        return None, None
+    mfu = None
+    try:
+        flops_tok = _flops_per_token(
+            prior["model"], prior["seq"], prior["params"])
+        # MFU must be computed against the peak of the chip the
+        # baseline was MEASURED on (which may not be a v5e).
+        peak = _peak_flops(prior.get("device_kind", ""))
+        if flops_tok and peak:
+            mfu = tps * flops_tok / peak
+    except Exception:  # noqa: BLE001 — MFU is diagnostic enrichment
+        pass
+    return prior, mfu
+
+
+def _cached_real_chip():
+    """Last-known-good on-chip measurement from ``bench_baseline.json``,
+    or None. Attached (clearly labeled) to the outage JSON so a tunnel
+    outage at sample time still leaves the driver evidence that the
+    framework has run on silicon — the live error stays alongside it."""
+    prior, mfu = _baseline_tpu_record()
+    if prior is None:
+        return None
+    return {
+        "note": "NOT a live measurement: last-known-good real-chip "
+                "result recorded by a prior successful run of this "
+                "same benchmark (bench_baseline.json); attached "
+                "because the live attempt hit a TPU-tunnel outage",
+        "model": prior.get("model"),
+        "seq": prior.get("seq"),
+        "tokens_per_sec_per_chip": round(
+            prior["tokens_per_sec_per_chip"], 2),
+        "device_kind": prior.get("device_kind"),
+        **({"mfu": round(mfu, 4)} if mfu else {}),
+    }
 
 
 def _peak_flops(device_kind: str):
@@ -89,7 +197,7 @@ def _flops_per_token(model: str, seq: int, param_count: int):
     return train_flops_per_token(model, seq, param_count)
 
 
-def _emit_error(error: str, rc: int = 1) -> int:
+def _emit_error(error: str, rc: int = 1, extra: dict | None = None) -> int:
     """One parseable JSON line, never a bare traceback (round-1 BENCH
     was rc=1/parsed:null on tunnel outage). Metric/unit come from
     ``_ACTIVE`` so failures land on the series that was running. rc 0
@@ -101,6 +209,7 @@ def _emit_error(error: str, rc: int = 1) -> int:
         "unit": _ACTIVE[1],
         "vs_baseline": 0.0,
         "error": error,
+        **(extra or {}),
     }))
     return rc
 
@@ -198,27 +307,12 @@ def estimate_bench(model: str, seq: int, per_chip_batch: int,
             pass
         return n_params, mem
 
-    baseline_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
-    measured_mfu = None
+    prior, measured_mfu = _baseline_tpu_record()
     measured_ref = None
-    try:
-        with open(baseline_path) as fh:
-            prior = json.load(fh)
-        measured = prior.get("tokens_per_sec_per_chip")
-        if measured and prior.get("backend") == "tpu":
-            ref_flops = train_flops_per_token(
-                prior["model"], prior["seq"], prior["params"])
-            # MFU must be computed against the peak of the chip the
-            # baseline was MEASURED on (which may not be a v5e).
-            ref_peak = _peak_flops(prior.get("device_kind", ""))
-            if ref_flops and ref_peak:
-                measured_mfu = measured * ref_flops / ref_peak
-                measured_ref = (f"{prior['model']} seq{prior['seq']} "
-                                f"{measured:.0f} tok/s/chip on "
-                                f"{prior.get('device_kind')}")
-    except (OSError, json.JSONDecodeError, KeyError):
-        pass
+    if prior is not None and measured_mfu:
+        measured_ref = (f"{prior['model']} seq{prior['seq']} "
+                        f"{prior['tokens_per_sec_per_chip']:.0f} "
+                        f"tok/s/chip on {prior.get('device_kind')}")
 
     n_params, mem = compile_check(model, seq, per_chip_batch)
     flops_tok = train_flops_per_token(model, seq, n_params)
@@ -452,25 +546,54 @@ def main() -> int:
     # skip the probe rather than paying backend init twice.
     pinned = os.environ.get("JAX_PLATFORMS", "")
     if not pinned or "axon" in pinned or "tpu" in pinned:
-        probe, probe_err = _probe_backend()
-        # A probe that "succeeds" on the cpu platform means jax silently
-        # fell back from the dead axon backend — report outage rather
-        # than benching llama_200m on a host CPU (hours, garbage number).
-        if probe is not None and probe.get("platform") == "cpu":
-            probe, probe_err = None, (
-                "tpu_unavailable: backend fell back to cpu")
-        if probe is None:
-            if args.smoke:
-                # The smoke config is a cheap correctness gate that is
-                # meaningful on any backend — run it on the CPU instead
-                # of refusing.
+        if args.smoke:
+            # The smoke config is a cheap correctness gate meaningful on
+            # any backend — one quick probe, fall back to CPU, no retry.
+            probe, _ = _probe_backend()
+            if probe is None:
                 os.environ["JAX_PLATFORMS"] = "cpu"
                 apply_jax_platforms_override()
-            else:
+        else:
+            # The measurement path gets the full retry window: the axon
+            # tunnel recovers on a ~23-min cycle, so one 90 s probe
+            # sampled mid-outage must not decide the round's number.
+            try:
+                budget = float(os.environ.get(
+                    "POLYAXON_TPU_BENCH_RETRY_S", "2700"))
+            except ValueError:
+                print("# ignoring non-numeric POLYAXON_TPU_BENCH_RETRY_S"
+                      f"={os.environ['POLYAXON_TPU_BENCH_RETRY_S']!r}; "
+                      "using default 2700", file=sys.stderr)
+                budget = 2700.0
+
+            # A driver/harness timeout shorter than the retry window
+            # must not reproduce the round-1 failure (killed with
+            # nothing on stdout): on SIGTERM mid-retry, emit the
+            # outage JSON (with the cached real-chip record) and exit.
+            import signal
+
+            def _on_term(signum, frame):
+                cached = _cached_real_chip()
+                _emit_error(
+                    "tpu_unavailable: SIGTERM during probe-retry window",
+                    extra={"cached_real_chip": cached} if cached else None)
+                sys.exit(0)
+
+            prev_term = signal.signal(signal.SIGTERM, _on_term)
+            try:
+                probe, probe_err = _probe_backend_with_retry(budget)
+            finally:
+                signal.signal(signal.SIGTERM, prev_term)
+            if probe is None:
                 # Environmental outage → rc 0 (not a bench defect); real
-                # breakage keeps rc 1 so CI trips.
-                rc = 0 if probe_err.startswith("tpu_unavailable") else 1
-                return _emit_error(probe_err, rc=rc)
+                # breakage keeps rc 1 so CI trips. On an outage, attach
+                # the last-known-good real-chip record so the driver
+                # still sees on-silicon evidence (clearly labeled).
+                outage = probe_err.startswith("tpu_unavailable")
+                cached = _cached_real_chip() if outage else None
+                return _emit_error(
+                    probe_err, rc=0 if outage else 1,
+                    extra={"cached_real_chip": cached} if cached else None)
 
     if args.tuner:
         return tuner_bench(smoke=args.smoke)

@@ -38,6 +38,12 @@ def bench_args(**kw) -> list[str]:
 def run_point(name: str, timeout_s: float = 1200, **kw):
     cmd = [sys.executable, os.path.join(REPO, "bench.py")] + bench_args(**kw)
     t0 = time.time()
+    # The sweep is its own retry layer (--resume + the hourly probe
+    # cycle), so disable bench.py's internal 45-min probe-retry window:
+    # otherwise an outage makes every point sit in bench's retry loop
+    # until this 1200 s timeout SIGTERMs it, replacing the structured
+    # tpu_unavailable JSON with an unstructured timeout error.
+    env = {**os.environ, "POLYAXON_TPU_BENCH_RETRY_S": "0"}
     # Popen + SIGTERM-then-SIGKILL, not subprocess.run(timeout=...):
     # run() SIGKILLs on timeout, and a bench killed mid-TPU-program can
     # wedge the tunnel for every later client (observed 2026-07-31:
@@ -45,7 +51,7 @@ def run_point(name: str, timeout_s: float = 1200, **kw):
     # lets the PJRT client unwind its device lease first.
     with subprocess.Popen(cmd, stdout=subprocess.PIPE,
                           stderr=subprocess.PIPE, text=True,
-                          cwd=REPO) as popen:
+                          cwd=REPO, env=env) as popen:
         try:
             stdout, stderr = popen.communicate(timeout=timeout_s)
             proc = subprocess.CompletedProcess(cmd, popen.returncode,
