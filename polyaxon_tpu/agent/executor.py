@@ -250,6 +250,9 @@ class LocalExecutor:
             tracking.log_outputs(
                 steps=result.steps, throughput=result.throughput,
                 wall_time=result.wall_time, param_count=result.param_count,
+                # Same resume-audit field as the subprocess entrypoint
+                # (runtime/launch.py): None means cold start.
+                restored_from_step=result.restored_from_step,
                 **{f"final_{k}": v for k, v in result.final_metrics.items()},
             )
             if gang.stop_event.is_set():

@@ -15,12 +15,19 @@ embedded ``ControlPlane`` with stdlib ``ThreadingHTTPServer``:
     GET  /streams/v1/{owner}/{project}/runs/{uuid}/logs[?follow=true]  (SSE)
     GET  /healthz | /api/v1/version | /api/v1/projects
 
-The ``owner`` segment is accepted for upstream URL compatibility; the
-embedded plane is single-tenant and ignores it.
+Authentication (SURVEY.md §2 "API server": haupt's owner/user model,
+scaled to haupt-CE scope): ``ApiServer(auth_token=...)`` turns on
+bearer-token auth — the shared secret grants admin access to every
+owner; ``owner_tokens={"alice": "tk"}`` adds per-owner tokens that can
+only read/mutate runs under their own ``{owner}`` path segment (and
+only runs stamped with that owner at submit). Without either, the
+server stays open (embedded single-user default; the ``owner`` path
+segment is then accepted for upstream URL compatibility and ignored).
 """
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import urllib.parse
@@ -59,7 +66,64 @@ class ApiError(Exception):
 
 class _Handler(BaseHTTPRequestHandler):
     plane: ControlPlane  # injected by ApiServer via class attribute
+    auth_token: Optional[str] = None  # admin shared secret (None = open)
+    owner_tokens: dict[str, str] = {}  # owner -> per-owner token
     protocol_version = "HTTP/1.1"
+
+    # -- auth --------------------------------------------------------------
+    @property
+    def _auth_enabled(self) -> bool:
+        return bool(self.auth_token or self.owner_tokens)
+
+    def _caller(self) -> Optional[str]:
+        """``"*"`` for the admin secret, the owner name for a per-owner
+        token, ``None`` for no credentials. Unknown tokens are 401 —
+        constant-time compares so the check can't leak secret prefixes.
+        """
+        if not self._auth_enabled:
+            return "*"  # open server: any credentials are ignored
+        header = self.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            return None
+        # Compare as bytes: compare_digest raises TypeError on
+        # non-ASCII str (http.server decodes headers latin-1), which
+        # would turn attacker-controlled input into a 500, not a 401.
+        token = header[len("Bearer "):].strip().encode("utf-8", "replace")
+        if self.auth_token and hmac.compare_digest(
+                token, self.auth_token.encode("utf-8", "replace")):
+            return "*"
+        for owner, expected in self.owner_tokens.items():
+            if hmac.compare_digest(token, expected.encode("utf-8", "replace")):
+                return owner
+        raise ApiError(401, "invalid token")
+
+    def _require(self, caller: Optional[str], owner: Optional[str] = None,
+                 admin: bool = False) -> None:
+        """401 without credentials; 403 when the token's scope does not
+        cover ``owner`` (or ``admin`` is required). No-op when auth is
+        off."""
+        if not self._auth_enabled:
+            return
+        if caller is None:
+            raise ApiError(401, "missing bearer token")
+        if caller == "*":
+            return
+        if admin:
+            raise ApiError(403, "admin token required")
+        if owner is not None and caller != owner:
+            raise ApiError(
+                403, f"token for owner `{caller}` cannot access "
+                     f"owner `{owner}`")
+
+    def _require_run(self, caller: Optional[str], record: RunRecord) -> None:
+        """Record-level isolation: a scoped token only touches runs
+        stamped with its owner at submit — path spoofing (A's run uuid
+        under B's path) and pre-auth legacy runs both fall to admin."""
+        if not self._auth_enabled or caller in (None, "*"):
+            return
+        if (record.meta or {}).get("owner") != caller:
+            raise ApiError(
+                403, f"run {record.uuid} is not owned by `{caller}`")
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, *args):  # quiet; the agent log is the log
@@ -111,32 +175,40 @@ class _Handler(BaseHTTPRequestHandler):
             raise ApiError(404, f"run {uuid} not found") from exc
 
     def _dispatch(self, method: str, parts: list[str], query: dict) -> None:
+        # Open routes: liveness, scrape, the dashboard page itself, and
+        # version. Everything that exposes run DATA authenticates.
         if parts == ["healthz"]:
             return self._json({"status": "ok"})
         if parts == ["metrics"]:
             return self._prometheus()
         if parts in ([], ["ui"]):
             return self._dashboard()
+        caller = self._caller()  # may raise 401 on a bad token
         if parts[:2] == ["api", "v1"]:
             rest = parts[2:]
             if rest == ["version"]:
                 return self._json({"version": __version__})
             if rest == ["projects"]:
+                self._require(caller, admin=True)
                 return self._json(self.plane.store.list_projects())
             if rest == ["agent", "slices"]:
                 # The C++ slice pool's operator view (empty when this
                 # server runs without a slice-managing agent).
+                self._require(caller, admin=True)
                 manager = getattr(self, "slice_manager", None)
                 return self._json(manager.stats() if manager is not None
                                   else {"slices": [], "gangs": []})
             # /{owner}/{project}/runs...
             if len(rest) >= 3 and rest[2] == "runs":
-                return self._runs(method, rest[1], rest[3:], query)
+                self._require(caller, owner=rest[0])
+                return self._runs(method, caller, rest[0], rest[1],
+                                  rest[3:], query)
         if parts[:2] == ["streams", "v1"]:
             rest = parts[2:]
             # /{owner}/{project}/runs/{uuid}/logs
             if len(rest) >= 5 and rest[2] == "runs" and rest[4] == "logs":
-                return self._logs(rest[3], query)
+                self._require(caller, owner=rest[0])
+                return self._logs(caller, rest[3], query)
         raise ApiError(404, f"no route for {method} {'/'.join(parts)}")
 
     def _dashboard(self) -> None:
@@ -184,7 +256,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     # -- runs --------------------------------------------------------------
-    def _runs(self, method: str, project: str, rest: list[str], query: dict) -> None:
+    def _runs(self, method: str, caller: Optional[str], owner: str,
+              project: str, rest: list[str], query: dict) -> None:
         plane = self.plane
         if not rest:
             if method == "POST":
@@ -197,7 +270,12 @@ class _Handler(BaseHTTPRequestHandler):
                         presets=body.get("presets"),
                         name=body.get("name"),
                         tags=body.get("tags"),
+                        # Stamped from the authenticated PATH (not the
+                        # body): record-level isolation keys off it.
+                        meta={"owner": owner},
                     )
+                except ApiError:
+                    raise
                 except Exception as exc:
                     raise ApiError(400, f"submit failed: {exc}") from exc
                 return self._json(_record_json(record), status=201)
@@ -212,11 +290,17 @@ class _Handler(BaseHTTPRequestHandler):
             if "pipeline" in query:
                 kwargs["pipeline_uuid"] = query["pipeline"][0]
             records = plane.list_runs(**kwargs)
+            if self._auth_enabled and caller != "*":
+                # Per-owner isolation on list: scoped tokens only see
+                # runs stamped with their owner.
+                records = [r for r in records
+                           if (r.meta or {}).get("owner") == caller]
             return self._json({"count": len(records),
                                "results": [_record_json(r) for r in records]})
 
         uuid = rest[0]
         record = self._get_run(uuid)
+        self._require_run(caller, record)
         action = rest[1] if len(rest) > 1 else None
         if action is None:
             if method == "POST":
@@ -292,10 +376,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(chunk)
 
     # -- streams -----------------------------------------------------------
-    def _logs(self, uuid: str, query: dict) -> None:
+    def _logs(self, caller: Optional[str], uuid: str, query: dict) -> None:
         import time
 
         record = self._get_run(uuid)
+        self._require_run(caller, record)
         follow = query.get("follow", ["false"])[0].lower() == "true"
         streams = self.plane.streams
         if not follow:
@@ -345,11 +430,15 @@ class ApiServer:
     """Owns the HTTP server thread; ``with ApiServer(plane) as s: s.port``."""
 
     def __init__(self, plane: ControlPlane, host: str = "127.0.0.1",
-                 port: int = 0, slice_manager=None):
+                 port: int = 0, slice_manager=None,
+                 auth_token: Optional[str] = None,
+                 owner_tokens: Optional[dict[str, str]] = None):
         import time
 
         handler = type("BoundHandler", (_Handler,),
-                       {"plane": plane, "slice_manager": slice_manager})
+                       {"plane": plane, "slice_manager": slice_manager,
+                        "auth_token": auth_token,
+                        "owner_tokens": owner_tokens or {}})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.started_at = time.time()
         self.host = host

@@ -146,6 +146,7 @@ DASHBOARD_HTML = r"""<!doctype html>
 </header>
 <main>
   <div class="tiles" id="tiles"></div>
+  <div id="projectPanel"></div>
   <div id="slicesPanel"></div>
   <table id="runs">
     <thead><tr>
@@ -250,9 +251,20 @@ function renderRuns() {
         v => String(v ?? "").toLowerCase().includes(needle)));
   const counts = {};
   for (const r of rows) counts[r.status] = (counts[r.status] || 0) + 1;
+  // Project-level health tiles: success rate over terminal runs and
+  // median wall time of succeeded runs, alongside the status counts.
+  const terminal = rows.filter(r => r.finished_at);
+  const ok = terminal.filter(r => r.status === "succeeded");
+  const rate = terminal.length
+    ? Math.round(100 * ok.length / terminal.length) + "%" : "–";
+  const walls = ok.map(r => r.finished_at - r.created_at)
+    .filter(w => w >= 0).sort((a, b) => a - b);
+  const med = walls.length ? fmtDur(walls[walls.length >> 1]) : "–";
   $("#tiles").innerHTML =
     tile("total", rows.length) +
-    ["running", "succeeded", "failed"].map(s => tile(s, counts[s] || 0)).join("");
+    ["running", "succeeded", "failed"].map(s => tile(s, counts[s] || 0)).join("") +
+    tile("success rate", rate) + tile("median wall", med);
+  $("#projectPanel").innerHTML = projectPanel(rows);
   $("#runs tbody").innerHTML = rows.map(r => `
     <tr class="run" data-uuid="${esc(r.uuid)}">
       <td class="cmp"><input type="checkbox" class="cmpBox"
@@ -273,6 +285,59 @@ function renderRuns() {
     box.onchange = updateCompareBtn;
   }
   updateCompareBtn();
+}
+
+function fmtDur(s) {
+  if (s < 90) return Math.round(s) + "s";
+  if (s < 5400) return (s / 60).toPrecision(2) + "m";
+  return (s / 3600).toPrecision(2) + "h";
+}
+
+function projectPanel(rows) {
+  // Project activity: runs created per day over the last 14 days,
+  // stacked by outcome (succeeded / failed / other). Pure client-side
+  // over the already-fetched list — no extra API round trips.
+  const DAYS = 14, DAY = 86400;
+  const today = Math.floor(Date.now() / 1000 / DAY);
+  const buckets = Array.from({length: DAYS}, () => ({ok: 0, bad: 0, other: 0}));
+  let seen = 0;
+  for (const r of rows) {
+    if (!r.created_at) continue;
+    const age = today - Math.floor(r.created_at / DAY);
+    if (age < 0 || age >= DAYS) continue;
+    seen++;
+    const b = buckets[DAYS - 1 - age];
+    if (r.status === "succeeded") b.ok++;
+    else if (r.status === "failed" || r.status === "upstream_failed") b.bad++;
+    else b.other++;
+  }
+  if (!seen) return "";
+  const W = 980, H = 88, P = {l: 30, r: 6, t: 6, b: 16};
+  const max = Math.max(...buckets.map(b => b.ok + b.bad + b.other), 1);
+  const bw = (W - P.l - P.r) / DAYS;
+  const sy = n => (H - P.t - P.b) * n / max;
+  const bars = buckets.map((b, i) => {
+    const x = P.l + i * bw + 2, w = Math.max(bw - 4, 2);
+    let y = H - P.b;
+    const seg = (n, color) => {
+      if (!n) return "";
+      const h = sy(n); y -= h;
+      return `<rect x="${x}" y="${y}" width="${w}" height="${h}" fill="${color}" rx="1"/>`;
+    };
+    const day = new Date((today - (DAYS - 1 - i)) * DAY * 1000);
+    const lbl = (i % 2 === 0)
+      ? `<text x="${x + w / 2}" y="${H - 3}" text-anchor="middle" font-size="9" fill="var(--muted)">${day.getMonth() + 1}/${day.getDate()}</text>`
+      : "";
+    return seg(b.ok, "var(--status-good)") + seg(b.bad, "var(--status-critical)")
+      + seg(b.other, "var(--muted)") + lbl;
+  }).join("");
+  const axis = `<text x="2" y="${P.t + 9}" font-size="9" fill="var(--muted)">${max}</text>
+    <line x1="${P.l}" y1="${H - P.b}" x2="${W - P.r}" y2="${H - P.b}" stroke="var(--axis)" stroke-width="1"/>`;
+  return `<div class="bracket">
+    <h3>project activity · last ${DAYS} days · ${seen} runs</h3>
+    <svg viewBox="0 0 ${W} ${H}" width="100%" height="${H}" role="img"
+         aria-label="runs per day stacked by outcome">${axis}${bars}</svg>
+  </div>`;
 }
 
 function selectedRuns() {

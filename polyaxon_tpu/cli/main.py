@@ -77,16 +77,22 @@ def _read_json_or_empty(path: str) -> dict:
 
 @config_group.command("set")
 @click.option("--host", default=None, help="API host, e.g. http://plx:8000")
+@click.option("--token", default=None,
+              help="bearer token for an auth-enabled server "
+                   "(plx server --auth-token/--owner-token)")
 @click.argument("pairs", nargs=-1)
-def config_set(host, pairs):
-    """Set client host (--host) and/or home config key=value PAIRS."""
+def config_set(host, token, pairs):
+    """Set client host/token and/or home config key=value PAIRS."""
     from polyaxon_tpu.client.client import CONFIG_DIR, CONFIG_FILE
 
     out = {}
-    if host:
+    if host or token:
         os.makedirs(CONFIG_DIR, exist_ok=True)
         data = _read_json_or_empty(CONFIG_FILE)
-        data["host"] = host
+        if host:
+            data["host"] = host
+        if token:
+            data["token"] = token
         with open(CONFIG_FILE, "w") as fh:
             json.dump(data, fh, indent=2)
         out["client"] = data
@@ -505,12 +511,25 @@ def admin_teardown(config_file):
               help="(with --with-agent) slice-pool heartbeat timeout seconds")
 @click.option("--slice", "slices", multiple=True,
               help="(with --with-agent) register a TPU slice NAME:TOPOLOGY[:spot]")
-def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices):
+@click.option("--auth-token", default=None, envvar="POLYAXON_TPU_AUTH_TOKEN",
+              help="admin bearer token; enables auth (default: open server)")
+@click.option("--owner-token", "owner_tokens", multiple=True,
+              help="OWNER=TOKEN per-owner scoped credential (repeatable); "
+                   "implies auth")
+def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout,
+               slices, auth_token, owner_tokens):
     """Serve the REST API (control plane + streams) in the foreground."""
     import threading
 
     from polyaxon_tpu.api import ApiServer
 
+    scoped = {}
+    for item in owner_tokens:
+        owner, sep, token = item.partition("=")
+        if not sep or not owner or not token:
+            raise click.BadParameter(
+                f"--owner-token needs OWNER=TOKEN, got {item!r}")
+        scoped[owner] = token
     plane = get_plane()
     manager = None
     if with_agent and slices:
@@ -518,7 +537,8 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout, slices
 
         manager = SliceManager(_parse_slices(slices),
                                heartbeat_timeout=heartbeat_timeout)
-    server = ApiServer(plane, host, port, slice_manager=manager)
+    server = ApiServer(plane, host, port, slice_manager=manager,
+                       auth_token=auth_token, owner_tokens=scoped)
     if with_agent:
         from polyaxon_tpu.agent import Agent
 

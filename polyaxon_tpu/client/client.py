@@ -50,23 +50,55 @@ def resolve_host(host: Optional[str] = None) -> str:
     return DEFAULT_HOST
 
 
+def resolve_token(token: Optional[str] = None,
+                  host: Optional[str] = None) -> Optional[str]:
+    """(explicit arg) → ``POLYAXON_TPU_TOKEN`` → config-file ``token``
+    (``plx config set --token``) → None (open server).
+
+    The config-file credential is PAIRED with the config-file host: it
+    is only attached when ``host`` is the host that config names (or
+    the default, when config names none) — pointing the client at some
+    other server must not disclose the saved secret to it. Explicit and
+    env tokens are deliberate per-call/per-session choices and attach
+    unconditionally."""
+    if token:
+        return token
+    env = os.environ.get("POLYAXON_TPU_TOKEN")
+    if env:
+        return env
+    if os.path.exists(CONFIG_FILE):
+        try:
+            with open(CONFIG_FILE) as fh:
+                data = json.load(fh)
+            configured = data.get("token")
+            cfg_host = str(data.get("host") or DEFAULT_HOST).rstrip("/")
+            if configured and (host is None or host == cfg_host):
+                return str(configured)
+        except (OSError, json.JSONDecodeError):
+            pass
+    return None
+
+
 class PolyaxonClient:
     """Thin JSON-over-HTTP transport with typed errors."""
 
     def __init__(self, host: Optional[str] = None, *, owner: str = "default",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, token: Optional[str] = None):
         self.host = resolve_host(host)
         self.owner = owner
         self.timeout = timeout
+        self.token = resolve_token(token, host=self.host)
 
     # ------------------------------------------------------------ transport
     def request(self, method: str, path: str, *,
                 body: Optional[dict] = None, raw: bool = False) -> Any:
         url = f"{self.host}{path}"
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            url, data=data, method=method, headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -189,7 +221,9 @@ class RunClient:
         """SSE tail: yields log lines until the run finishes."""
         url = (f"{self.client.host}/streams/v1/{self.client.owner}/"
                f"{self.project}/runs/{self.run_uuid}/logs?follow=true")
-        req = urllib.request.Request(url)
+        headers = ({"Authorization": f"Bearer {self.client.token}"}
+                   if self.client.token else {})
+        req = urllib.request.Request(url, headers=headers)
         with urllib.request.urlopen(req, timeout=None) as resp:
             for raw in resp:
                 line = raw.decode()

@@ -74,6 +74,15 @@ class ControlPlane:
             kind = V1RunKind.DAG
         else:
             kind = op.component.run_kind if op.component else "hub"
+        if parent_uuid and not (meta or {}).get("owner"):
+            # Child runs (matrix trials, DAG nodes, schedule fires)
+            # inherit the submitting owner's stamp: API-level isolation
+            # keys off meta["owner"], and a sweep's trials must stay
+            # visible to the owner who submitted the sweep.
+            parent_owner = (self.store.get_run(parent_uuid).meta
+                            or {}).get("owner")
+            if parent_owner:
+                meta = {**(meta or {}), "owner": parent_owner}
         record = self.store.create_run(
             project=project,
             spec=op.to_dict(),

@@ -63,9 +63,11 @@ def _warn_einsum_fallback(s_loc: int) -> None:
     warnings.warn(
         f"ring_attention: local sequence length {s_loc} is odd — falling "
         f"back to the contiguous masked-einsum ring (~2x the attention "
-        f"FLOPs of the zigzag path, no flash kernel). The global "
-        f"ring_attention entry pads this away automatically; inside "
-        f"shard_map, pad the sequence so seq/cp is even.",
+        f"FLOPs of the zigzag path, no flash kernel). For CAUSAL "
+        f"attention the global ring_attention entry pads this away "
+        f"automatically (the pad relies on the causal mask, so it does "
+        f"not apply non-causal); inside shard_map, pad the sequence so "
+        f"seq/cp is even.",
         RuntimeWarning, stacklevel=3)
 
 

@@ -109,9 +109,17 @@ def _tokenize_text_file(path: str, tokenizer: str) -> np.ndarray:
     # tokenizer.json inside the same dir must invalidate the cache.
     source_mtime = os.path.getmtime(path)
     if os.path.isdir(tokenizer):
-        source_mtime = max(
-            [source_mtime] + [os.path.getmtime(os.path.join(tokenizer, f))
-                              for f in os.listdir(tokenizer)])
+        # Recursive walk, directories included: HF tokenizer dirs can
+        # nest assets, and a swap inside a subdirectory must invalidate
+        # the cache too. Entries that vanish mid-walk are skipped —
+        # missing files can't be what the cache was built from.
+        for root, dirs, files in os.walk(tokenizer):
+            for name in dirs + files:
+                try:
+                    source_mtime = max(source_mtime, os.path.getmtime(
+                        os.path.join(root, name)))
+                except OSError:
+                    continue
     if os.path.exists(cache) and os.path.getmtime(cache) >= source_mtime:
         return np.load(cache, mmap_mode="r")
     if tokenizer == "bytes":

@@ -71,6 +71,12 @@ def main() -> int:
                 throughput_unit=f"{result.unit}/sec",
                 wall_time=result.wall_time,
                 param_count=result.param_count,
+                # Preemption-requeue proof: a requeued attempt reports
+                # where its checkpoint restore landed (None → cold
+                # start), so the plane can audit that resume actually
+                # resumed instead of silently burning the budget from
+                # step 0 (SURVEY §5.4).
+                restored_from_step=result.restored_from_step,
                 **{f"final_{k}": v for k, v in result.final_metrics.items()},
             )
             tracking.log_succeeded()

@@ -618,8 +618,11 @@ class ServingServer:
                     f"and target `{model}` (vocab {cfg.vocab_size}) must "
                     "share a token space — mismatched drafts propose "
                     "garbage and silently collapse acceptance")
+            # mesh= so the draft shards like the target: left off, an
+            # unsharded real-size draft sits whole on device 0 (OOM
+            # risk) or gets replicated by GSPMD on every call.
             draft_cfg, draft_params = load_params(
-                draft_model, draft_checkpoint, seed=seed)
+                draft_model, draft_checkpoint, seed=seed, mesh=self.mesh)
             if quantize:
                 draft_params = quantize_tree(draft_params, mode=quantize)
             draft = (draft_model, draft_cfg, draft_params, spec_k)

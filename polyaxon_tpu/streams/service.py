@@ -32,6 +32,10 @@ class StreamsService:
         self._walk_cache: dict[Any, tuple[float, Any]] = {}
         self._walk_cache_lock = threading.Lock()
         self._walk_inflight: dict[Any, threading.Event] = {}
+        # Per-key insert generation: a walker that was degraded-past
+        # (waiters gave up on it and cached their own fresher walk)
+        # must not overwrite that newer entry when it finally finishes.
+        self._walk_gen: dict[Any, int] = {}
 
     def _cached_walk(self, key: Any, compute, ttl: float = 10.0):
         with self._walk_cache_lock:
@@ -44,6 +48,7 @@ class StreamsService:
             waiting = self._walk_inflight.get(key)
             if waiting is None:
                 self._walk_inflight[key] = threading.Event()
+                gen0 = self._walk_gen.get(key, 0)
         if waiting is not None:
             waiting.wait(timeout=30)
             with self._walk_cache_lock:
@@ -57,11 +62,17 @@ class StreamsService:
                 # waiting (or recursing) behind it forever — and CACHE
                 # the result so pollers arriving during the hang get a
                 # hit instead of each launching another walk against
-                # the already-slow store.
+                # the already-slow store. Same generation discipline as
+                # the walker path: anything inserted while THIS compute
+                # ran started later (so is fresher) — don't clobber it.
+                with self._walk_cache_lock:
+                    my_gen = self._walk_gen.get(key, 0)
                 value = compute()
                 done = time.monotonic()
                 with self._walk_cache_lock:
-                    self._walk_cache[key] = (done + ttl, value)
+                    if self._walk_gen.get(key, 0) == my_gen:
+                        self._walk_cache[key] = (done + ttl, value)
+                        self._walk_gen[key] = my_gen + 1
                 return value
             # Walker finished-with-failure or died: re-enter ONCE —
             # the inflight entry is gone, so one waiter becomes the
@@ -75,7 +86,18 @@ class StreamsService:
                 for k in [k for k, (exp, _) in self._walk_cache.items()
                           if exp <= done]:
                     del self._walk_cache[k]
-                self._walk_cache[key] = (done + ttl, value)
+                # Generations only matter while a walk is inflight for
+                # the key; drop the rest so deleted runs don't pin them.
+                for k in [k for k in self._walk_gen
+                          if k not in self._walk_cache
+                          and k not in self._walk_inflight]:
+                    del self._walk_gen[k]
+                if self._walk_gen.get(key, 0) == gen0:
+                    # No degraded waiter inserted while this walk ran;
+                    # otherwise their walk STARTED later (after the 30s
+                    # wait) — keep the newer result, drop this one.
+                    self._walk_cache[key] = (done + ttl, value)
+                    self._walk_gen[key] = gen0 + 1
             return value
         finally:
             # Cache insert happens BEFORE the event fires (walker

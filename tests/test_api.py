@@ -33,6 +33,19 @@ TRIAL = {
 }
 
 
+@pytest.fixture(autouse=True)
+def _no_ambient_credentials(tmp_path, monkeypatch):
+    """Hermetic clients: PolyaxonClient resolves tokens from the env
+    and ~/.polyaxon_tpu/config.json — a developer's real credentials
+    must never leak into (or break) these assertions."""
+    monkeypatch.delenv("POLYAXON_TPU_TOKEN", raising=False)
+    monkeypatch.delenv("POLYAXON_TPU_HOST", raising=False)
+    import polyaxon_tpu.client.client as client_mod
+
+    monkeypatch.setattr(client_mod, "CONFIG_FILE",
+                        str(tmp_path / "no-such-config.json"))
+
+
 @pytest.fixture()
 def stack(tmp_path):
     """plane + HTTP server + background agent thread."""
@@ -75,7 +88,9 @@ class TestApi:
                            "histChart", "imageCard", "EventSource",
                            # r2: multi-run overlay + hyperband brackets
                            "compareBtn", "overlayChart", "sweepView",
-                           "cmpBox", "trial_params"):
+                           "cmpBox", "trial_params",
+                           # r4: project-level dashboard
+                           "projectPanel", "success rate"):
                 assert marker in html, marker
 
     def test_run_detail_includes_spec(self, stack):
@@ -466,3 +481,158 @@ class TestRunFilters:
         with urllib.request.urlopen(server.url + "/ui", timeout=10) as r:
             page = r.read().decode()
         assert "searchBox" in page and "projectFilter" in page
+
+
+@pytest.fixture()
+def auth_stack(tmp_path):
+    """plane + auth-enabled server + background agent (VERDICT r3 #6:
+    shared-secret admin token + per-owner scoped tokens)."""
+    plane = ControlPlane(str(tmp_path / "home"))
+    agent = Agent(plane, max_concurrent=4)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            agent.reconcile_once()
+            time.sleep(0.05)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    with ApiServer(plane, auth_token="admin-secret",
+                   owner_tokens={"alice": "tk-alice",
+                                 "bob": "tk-bob"}) as server:
+        yield plane, server
+    stop.set()
+    thread.join(timeout=5)
+
+
+class TestAuth:
+    """Bearer-token auth + per-owner isolation (haupt-CE scope)."""
+
+    def test_anonymous_401_on_data_routes(self, auth_stack):
+        _, server = auth_stack
+        client = PolyaxonClient(server.url, owner="alice")
+        assert client.token is None
+        with pytest.raises(ApiClientError) as exc:
+            client.list_runs()
+        assert exc.value.status == 401
+        with pytest.raises(ApiClientError) as exc:
+            client.post(f"/api/v1/alice/default/runs", body={"content": TRIAL})
+        assert exc.value.status == 401
+
+    def test_open_routes_stay_open(self, auth_stack):
+        _, server = auth_stack
+        client = PolyaxonClient(server.url)
+        assert client.healthy()
+        assert client.version()
+
+    def test_invalid_token_401(self, auth_stack):
+        _, server = auth_stack
+        client = PolyaxonClient(server.url, owner="alice", token="wrong")
+        with pytest.raises(ApiClientError) as exc:
+            client.list_runs()
+        assert exc.value.status == 401
+
+    def test_admin_token_full_access(self, auth_stack):
+        _, server = auth_stack
+        admin = PolyaxonClient(server.url, owner="anyone",
+                               token="admin-secret")
+        created = admin.post("/api/v1/anyone/default/runs",
+                             body={"content": TRIAL,
+                                   "params": {"lr": 0.1}})
+        assert created["uuid"]
+        assert admin.list_runs()
+        assert admin.list_projects()
+
+    def test_owner_scoping_on_list_and_mutate(self, auth_stack):
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        bob = PolyaxonClient(server.url, owner="bob", token="tk-bob")
+
+        mine = alice.post("/api/v1/alice/default/runs",
+                          body={"content": TRIAL, "params": {"lr": 0.1}})
+        # Path scoping: bob's token cannot touch alice's path at all.
+        with pytest.raises(ApiClientError) as exc:
+            bob.get("/api/v1/alice/default/runs")
+        assert exc.value.status == 403
+        # Record scoping: alice's run uuid under bob's OWN path is
+        # still refused (path spoofing).
+        with pytest.raises(ApiClientError) as exc:
+            bob.get(f"/api/v1/bob/default/runs/{mine['uuid']}")
+        assert exc.value.status == 403
+        with pytest.raises(ApiClientError) as exc:
+            bob.post(f"/api/v1/bob/default/runs/{mine['uuid']}/stop", body={})
+        assert exc.value.status == 403
+        # List isolation: bob sees none of alice's runs.
+        assert bob.list_runs() == []
+        assert [r["uuid"] for r in alice.list_runs()] == [mine["uuid"]]
+        # The owner can read and mutate their own run.
+        assert alice.get(
+            f"/api/v1/alice/default/runs/{mine['uuid']}")["uuid"] == mine["uuid"]
+        alice.post(f"/api/v1/alice/default/runs/{mine['uuid']}/stop", body={})
+
+    def test_scoped_token_cannot_list_projects(self, auth_stack):
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        with pytest.raises(ApiClientError) as exc:
+            alice.list_projects()
+        assert exc.value.status == 403
+
+    def test_sweep_children_inherit_owner(self, auth_stack):
+        """Matrix trials spawned by the scheduler stay visible to the
+        owner who submitted the sweep (meta.owner inheritance)."""
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        sweep = {
+            "kind": "operation",
+            "name": "sweep",
+            "matrix": {
+                "kind": "grid",
+                "concurrency": 2,
+                "params": {"lr": {"kind": "choice", "value": [0.1, 0.2]}},
+            },
+            "component": TRIAL,
+        }
+        parent = alice.post("/api/v1/alice/default/runs",
+                            body={"content": sweep})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            children = alice.get(
+                f"/api/v1/alice/default/runs?pipeline={parent['uuid']}"
+            )["results"]
+            if len(children) == 2:
+                break
+            time.sleep(0.2)
+        assert len(children) == 2, "sweep children not visible to owner"
+        bob = PolyaxonClient(server.url, owner="bob", token="tk-bob")
+        assert bob.list_runs() == []
+
+    def test_logs_route_scoped(self, auth_stack):
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        mine = alice.post("/api/v1/alice/default/runs",
+                          body={"content": TRIAL, "params": {"lr": 0.1}})
+        with pytest.raises(ApiClientError) as exc:
+            PolyaxonClient(server.url, token="tk-bob").get(
+                f"/streams/v1/bob/default/runs/{mine['uuid']}/logs")
+        assert exc.value.status == 403
+        # Owner reads own logs (may be empty while queued).
+        alice.get(f"/streams/v1/alice/default/runs/{mine['uuid']}/logs")
+
+    def test_config_token_paired_with_config_host(self, tmp_path, monkeypatch):
+        """A config-file credential must not be disclosed to a server
+        the config does not name (review: credential-leak guard)."""
+        import json as _json
+
+        import polyaxon_tpu.client.client as client_mod
+
+        cfg = tmp_path / "config.json"
+        cfg.write_text(_json.dumps(
+            {"host": "http://trusted:8000", "token": "secret"}))
+        monkeypatch.setattr(client_mod, "CONFIG_FILE", str(cfg))
+        assert PolyaxonClient("http://trusted:8000").token == "secret"
+        assert PolyaxonClient("http://other:9000").token is None
+        # Explicit + env tokens stay unconditional (deliberate choice).
+        assert PolyaxonClient("http://other:9000", token="t2").token == "t2"
+        monkeypatch.setenv("POLYAXON_TPU_TOKEN", "t3")
+        assert PolyaxonClient("http://other:9000").token == "t3"

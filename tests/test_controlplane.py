@@ -735,3 +735,74 @@ class TestGitInit:
             time.sleep(0.05)
         plane.stop(record.uuid)
         agent.reconcile_once()
+
+
+class TestHyperbandPreemptionAccounting:
+    """VERDICT r3 #5 (tuner half): a preempted hyperband trial re-enters
+    its rung IN PLACE — same run uuid, same params, same budget — and
+    the rung charges it once (no duplicate spawn, no failure score)."""
+
+    def test_preempted_trial_charged_once(self, plane, agent):
+        import time as _time
+
+        slow_trial = {
+            **TRIAL_COMPONENT,
+            "run": {
+                "kind": "job",
+                "container": {"command": [
+                    "python", "-c",
+                    # Same score contract as TRIAL_SCRIPT, after a sleep
+                    # wide enough to preempt into.
+                    "import time; time.sleep(3)\n" + TRIAL_SCRIPT,
+                ]},
+            },
+        }
+        record = plane.submit(
+            {
+                "kind": "operation",
+                "matrix": {
+                    "kind": "hyperband",
+                    "maxIterations": 4,
+                    "eta": 2,
+                    "seed": 11,
+                    "resource": {"name": "epochs", "type": "int"},
+                    "metric": {"name": "score", "optimization": "minimize"},
+                    "params": {"lr": {"kind": "uniform",
+                                      "value": {"low": 0.0, "high": 1.0}}},
+                },
+                "component": slow_trial,
+            }
+        )
+        # Catch a live trial gang and evict it.
+        victim = None
+        deadline = _time.monotonic() + 60
+        while victim is None:
+            assert _time.monotonic() < deadline, "no trial went live"
+            agent.reconcile_once()
+            children = plane.list_runs(pipeline_uuid=record.uuid)
+            for child in children:
+                if child.uuid in agent.executor.active_runs:
+                    if agent.executor.preempt(child.uuid):
+                        victim = child
+                        break
+            _time.sleep(0.05)
+
+        status = agent.run_until_done(record.uuid, timeout=300)
+        assert status == V1Statuses.SUCCEEDED
+
+        children = plane.list_runs(pipeline_uuid=record.uuid)
+        revived = plane.get_run(victim.uuid)
+        # Requeued in place: the SAME run finished the trial...
+        assert revived.status == V1Statuses.SUCCEEDED
+        conditions = [c["type"] for c in plane.get_statuses(victim.uuid)]
+        assert "preempted" in conditions and "retrying" in conditions
+        # ...with the same params/budget, charged once: no other child
+        # occupies its (bracket, rung, trial_index) slot.
+        key = tuple((revived.meta or {}).get(k)
+                    for k in ("bracket", "rung", "trial_index"))
+        slot = [c for c in children
+                if tuple((c.meta or {}).get(k)
+                         for k in ("bracket", "rung", "trial_index")) == key]
+        assert [c.uuid for c in slot] == [victim.uuid]
+        # Preemption never consumed the retry budget.
+        assert revived.retries == 0

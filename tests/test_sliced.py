@@ -303,3 +303,102 @@ class TestRaceDetection:
             capture_output=True, text=True, timeout=120)
         assert result.returncode == 0, result.stderr[-2000:]
         assert "stress ok" in result.stdout
+
+
+class TestPreemptResumeE2E:
+    """VERDICT r3 #5: the COMPOSED preempt→resume path. A checkpointing
+    JAXJob gang is preempted mid-run at the slice layer; the scheduler
+    requeues it in place (same uuid, same artifacts dir); the second
+    attempt must restore from the checkpoint — `restored_from_step > 0`
+    in the run outputs — not silently restart at step 0."""
+
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        from polyaxon_tpu.controlplane import ControlPlane
+
+        return ControlPlane(str(tmp_path / "home"))
+
+    def test_preempted_jaxjob_resumes_from_checkpoint(
+            self, plane, monkeypatch):
+        import os
+        import time as _time
+
+        from polyaxon_tpu.agent import Agent, SliceManager
+        from polyaxon_tpu.lifecycle import V1Statuses
+
+        # Gang subprocesses contribute their own devices (gang tests'
+        # convention): drop the test process's 8-device host flag.
+        monkeypatch.setenv("XLA_FLAGS", "")
+        manager = SliceManager([("spot", "2x2", True)])
+        agent = Agent(plane, slice_manager=manager)
+        record = plane.submit({
+            "kind": "component",
+            "name": "ckpt-preempt",
+            "run": {
+                "kind": "jaxjob",
+                "environment": {
+                    "tpu": {"accelerator": "v5e", "topology": "2x2",
+                            "preemptible": True},
+                },
+                "checkpointing": {"enabled": True, "intervalSteps": 50,
+                                  "asyncSave": False},
+                "runtime": {"model": "llama_tiny",
+                            "dataset": "lm_synthetic",
+                            "steps": 4000, "seq_len": 64,
+                            "global_batch_size": 4,
+                            "log_every": 10**9},
+            },
+        })
+        try:
+            # Preempt only after a checkpoint is COMMITTED on disk
+            # (async_save off → a clean numeric step dir is committed;
+            # orbax keeps uncommitted work under *-tmp-* names).
+            ckpt_dir = os.path.join(
+                plane.run_artifacts_dir(record.uuid), "checkpoints")
+
+            def committed_steps():
+                if not os.path.isdir(ckpt_dir):
+                    return []
+                return [d for d in os.listdir(ckpt_dir)
+                        if d.isdigit()
+                        and os.path.isdir(os.path.join(ckpt_dir, d))]
+
+            deadline = _time.monotonic() + 300
+            while not committed_steps():
+                assert _time.monotonic() < deadline, \
+                    "no checkpoint appeared before deadline"
+                run = plane.get_run(record.uuid)
+                assert run.status not in (
+                    V1Statuses.FAILED, V1Statuses.SUCCEEDED), (
+                    f"run reached {run.status} before preemption; "
+                    "raise steps to widen the window")
+                agent.reconcile_once()
+                _time.sleep(0.1)
+
+            manager.preempt_slice("spot")
+            # The agent observes the eviction, the scheduler requeues
+            # in place, a second gang attempt runs to completion.
+            deadline = _time.monotonic() + 60
+            while True:
+                agent.reconcile_once()
+                conditions = [c["type"]
+                              for c in plane.get_statuses(record.uuid)]
+                if "preempted" in conditions and "retrying" in conditions:
+                    break
+                assert _time.monotonic() < deadline, conditions
+                _time.sleep(0.05)
+            status = agent.run_until_done(record.uuid, timeout=600)
+            assert status == V1Statuses.SUCCEEDED
+
+            outputs = plane.streams.get_outputs(record.uuid)
+            # The composed assertion: attempt 2 resumed from the
+            # checkpoint, completed the FULL budget, under the SAME run.
+            assert outputs.get("restored_from_step") is not None, outputs
+            assert outputs["restored_from_step"] >= 50
+            assert outputs["steps"] == 4000
+            # TPU-native accounting: preemption is not a failure —
+            # the retry budget is untouched (preemptionCountsAsRetry
+            # defaults off), so a tuner charges the trial once.
+            assert plane.get_run(record.uuid).retries == 0
+        finally:
+            manager.close()
