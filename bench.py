@@ -467,6 +467,11 @@ def main() -> int:
                         help="flash backward impl (default: pallas on TPU)")
     parser.add_argument("--loss-chunk", type=int, default=None,
                         help="chunked lm-head loss slab length (sweepable)")
+    parser.add_argument("--profile", action="store_true",
+                        help="capture a jax.profiler trace of one "
+                             "mid-run step into profiles/<config>/ "
+                             "(the per-point trace VERDICT r3 #2 asks "
+                             "for; adds one traced step of overhead)")
     parser.add_argument("--tuner", action="store_true",
                         help="measure Polytune throughput instead: a "
                              "Hyperband LR sweep of JAXJob trials, "
@@ -627,9 +632,21 @@ def main() -> int:
                if args.loss_chunk is not None else {}),
         },
     }
+    profile_dir = None
+    if args.profile:
+        # Trace one late step (warmed-up, compiled); the trace lands in
+        # <profile_dir>/profile as a perfetto/tensorboard-loadable dump.
+        tag = f"{model}-seq{seq}-b{batch}" + (
+            f"-{args.attention}" if args.attention != "auto" else "")
+        profile_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "profiles", tag)
+        os.makedirs(profile_dir, exist_ok=True)
+        spec["runtime"]["profile_steps"] = [max(steps - 2, 1)]
+        print(f"# profiler trace -> {profile_dir}/profile", file=sys.stderr)
     fallback = None
     try:
-        result = run_jaxjob(V1JAXJob.from_dict(spec))
+        result = run_jaxjob(V1JAXJob.from_dict(spec),
+                            artifacts_dir=profile_dir)
     except Exception as exc:  # noqa: BLE001 — degrade, don't erase
         # The Pallas backward is the newest kernel on the hot path; if
         # the failure is identifiably Pallas/Mosaic, retry once with
@@ -644,7 +661,8 @@ def main() -> int:
                        f"{type(exc).__name__}: {exc}"[:300]
             print(f"# {fallback}", file=sys.stderr)
             spec["runtime"]["flash_bwd_impl"] = "xla"
-            result = run_jaxjob(V1JAXJob.from_dict(spec))
+            result = run_jaxjob(V1JAXJob.from_dict(spec),
+                                artifacts_dir=profile_dir)
         else:
             raise
     tokens_per_sec_per_chip = result.throughput / max(n_chips, 1)

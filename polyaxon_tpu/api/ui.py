@@ -257,7 +257,7 @@ function renderRuns() {
   const ok = terminal.filter(r => r.status === "succeeded");
   const rate = terminal.length
     ? Math.round(100 * ok.length / terminal.length) + "%" : "–";
-  const walls = ok.map(r => r.finished_at - r.created_at)
+  const walls = ok.map(r => toEpoch(r.finished_at) - toEpoch(r.created_at))
     .filter(w => w >= 0).sort((a, b) => a - b);
   const med = walls.length ? fmtDur(walls[walls.length >> 1]) : "–";
   $("#tiles").innerHTML =
@@ -273,7 +273,7 @@ function renderRuns() {
       <td><a class="uuid">${esc(String(r.uuid).slice(0, 12))}</a></td>
       <td>${esc(r.name)}</td><td>${esc(r.kind)}</td><td>${esc(r.project)}</td>
       <td>${pill(r.status)}</td>
-      <td class="num">${r.created_at ? new Date(r.created_at * 1000).toLocaleString() : ""}</td>
+      <td class="num">${isFinite(toEpoch(r.created_at)) ? new Date(toEpoch(r.created_at) * 1000).toLocaleString() : ""}</td>
     </tr>`).join("");
   for (const tr of document.querySelectorAll("tr.run"))
     tr.onclick = (ev) => {
@@ -285,6 +285,14 @@ function renderRuns() {
     box.onchange = updateCompareBtn;
   }
   updateCompareBtn();
+}
+
+function toEpoch(v) {
+  // Records serialize timestamps as ISO-8601 strings (store.py
+  // isoformat); accept epoch numbers too. NaN for absent/unparsable.
+  if (v == null) return NaN;
+  if (typeof v === "number") return v;
+  return Date.parse(v) / 1000;
 }
 
 function fmtDur(s) {
@@ -302,8 +310,9 @@ function projectPanel(rows) {
   const buckets = Array.from({length: DAYS}, () => ({ok: 0, bad: 0, other: 0}));
   let seen = 0;
   for (const r of rows) {
-    if (!r.created_at) continue;
-    const age = today - Math.floor(r.created_at / DAY);
+    const created = toEpoch(r.created_at);
+    if (!isFinite(created)) continue;
+    const age = today - Math.floor(created / DAY);
     if (age < 0 || age >= DAYS) continue;
     seen++;
     const b = buckets[DAYS - 1 - age];

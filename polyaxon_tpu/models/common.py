@@ -118,6 +118,32 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-
     return (normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
 
 
+def _w(w, dt):
+    """Weight read at the point of CONSUMPTION (shared by every model
+    family). Plain arrays cast to the compute dtype; int8
+    ``QuantizedTensor`` leaves (duck-typed via ``.dequantize`` —
+    serving/quantize.py, no serving import here) dequantize HERE,
+    inside whatever scan body is executing, so XLA fuses int8-read →
+    convert → matmul and per-step HBM traffic stays int8. Dequantizing
+    a whole tree BEFORE a decode scan instead gets hoisted out of the
+    loop by XLA, materializing a bf16 copy that every step then
+    re-reads — the round-3 0.88x int8 anomaly (VERDICT r3 #3)."""
+    if hasattr(w, "dequantize"):
+        return w.dequantize().astype(dt)
+    return w.astype(dt)
+
+
+def _embed_rows(embed, tokens, dt):
+    """Embedding gather that keeps int8 reads int8: gather the int8
+    rows first, then dequantize only the gathered rows — never the
+    whole [V, D] table (llama3-scale tables are the largest single
+    weight; a per-step full-table dequant would swamp the decode)."""
+    if hasattr(embed, "dequantize"):
+        rows = embed.q[tokens].astype(jnp.float32) * embed.scale
+        return rows.astype(dt)
+    return embed.astype(dt)[tokens]
+
+
 def cross_entropy_loss(
     logits: jax.Array,  # [..., vocab] any float dtype; upcast internally
     labels: jax.Array,  # [...] int32

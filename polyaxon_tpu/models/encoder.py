@@ -14,7 +14,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from polyaxon_tpu.models.common import layer_norm, scaled_init
+from polyaxon_tpu.models.common import _w, layer_norm, scaled_init
 from polyaxon_tpu.ops.attention import dot_product_attention
 
 
@@ -72,17 +72,17 @@ def _layer(cfg: EncoderConfig, x: jax.Array, layer: dict) -> jax.Array:
     dt = cfg.dtype
 
     h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], cfg.norm_eps)
-    qkv = h @ layer["wqkv"].astype(dt)
+    qkv = h @ _w(layer["wqkv"], dt)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, Hd)
     k = k.reshape(B, S, H, Hd)
     v = v.reshape(B, S, H, Hd)
     attn = dot_product_attention(q, k, v, causal=False, impl=cfg.attention_impl)
-    x = x + attn.reshape(B, S, D) @ layer["wo"].astype(dt)
+    x = x + attn.reshape(B, S, D) @ _w(layer["wo"], dt)
 
     h = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], cfg.norm_eps)
-    h = jax.nn.gelu(h @ layer["w_up"].astype(dt) + layer["b_up"].astype(dt))
-    x = x + (h @ layer["w_down"].astype(dt) + layer["b_down"].astype(dt))
+    h = jax.nn.gelu(h @ _w(layer["w_up"], dt) + layer["b_up"].astype(dt))
+    x = x + (h @ _w(layer["w_down"], dt) + layer["b_down"].astype(dt))
     return x
 
 

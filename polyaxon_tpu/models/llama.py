@@ -24,6 +24,8 @@ import jax.numpy as jnp
 
 from polyaxon_tpu.models.common import (
     Batch,
+    _embed_rows,
+    _w,
     ModelDef,
     Variables,
     chunked_lm_loss,
@@ -182,9 +184,9 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
     dt = cfg.dtype
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, Hd)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, Hd)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
+    q = (h @ _w(layer["wq"], dt)).reshape(B, S, H, Hd)
+    k = (h @ _w(layer["wk"], dt)).reshape(B, S, KV, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, S, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     # dot_product_attention owns the impl support matrix (xla and flash
@@ -196,12 +198,12 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
                                  block_q=cfg.flash_block_q,
                                  block_k=cfg.flash_block_k,
                                  bwd_impl=cfg.flash_bwd_impl)
-    x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
+    x = x + attn.reshape(B, S, H * Hd) @ _w(layer["wo"], dt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-    up = h @ layer["w_up"].astype(dt)
-    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
+    up = h @ _w(layer["w_up"], dt)
+    x = x + (gate * up) @ _w(layer["w_down"], dt)
     return x
 
 
@@ -291,7 +293,7 @@ def hidden_states(
         else:
             positions = jnp.broadcast_to(
                 jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    x = params["embed"].astype(dt)[tokens]
+    x = _embed_rows(params["embed"], tokens, dt)
 
     body = _layer_body(cfg)
 
@@ -306,7 +308,13 @@ def hidden_states(
 
 
 def lm_head(cfg: LlamaConfig, params: dict) -> jax.Array:
-    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    if hasattr(w, "dequantize"):
+        # Unwrap at consumption (same contract as _w): callers sit
+        # inside jit, so the convert+scale fuses into the logits
+        # matmul's operand read and int8 stays the HBM format.
+        w = w.dequantize()
+    return w.T if cfg.tie_embeddings else w
 
 
 def forward(
@@ -396,9 +404,9 @@ def cached_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
     rows = jnp.arange(B)
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
+    q = (h @ _w(layer["wq"], dt)).reshape(B, 1, H, Hd)
+    k = (h @ _w(layer["wk"], dt)).reshape(B, 1, KV, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, 1, KV, Hd)
     scaling = getattr(cfg, "rope_scaling", None)
     q = _rope(q, positions, cfg.rope_theta, scaling)
     k = _rope(k, positions, cfg.rope_theta, scaling)
@@ -412,7 +420,7 @@ def cached_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
     logits = jnp.where(valid, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(dt)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
-    return x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt), \
+    return x + attn.reshape(B, 1, H * Hd) @ _w(layer["wo"], dt), \
         k_cache, v_cache
 
 
@@ -434,16 +442,16 @@ def decode_step_ragged(
     dt = cfg.dtype
     C = cache["k"].shape[2]
     positions, slot, valid = ragged_cache_coords(pos, C)
-    x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
+    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]  # [B, 1, D]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
         x, k_cache, v_cache = cached_attn_step(
             cfg, layer, x, k_cache, v_cache, positions, slot, valid)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
+        up = h @ _w(layer["w_up"], dt)
+        x = x + (gate * up) @ _w(layer["w_down"], dt)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -463,13 +471,13 @@ def _prompt_pass(cfg: LlamaConfig, params: dict, prompt: jax.Array):
     B, P = prompt.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None], (B, P))
-    x = params["embed"].astype(dt)[prompt]
+    x = _embed_rows(params["embed"], prompt, dt)
 
     def layer_step(x, layer):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, P, H, Hd)
-        k = (h @ layer["wk"].astype(dt)).reshape(B, P, KV, Hd)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, P, KV, Hd)
+        q = (h @ _w(layer["wq"], dt)).reshape(B, P, H, Hd)
+        k = (h @ _w(layer["wk"], dt)).reshape(B, P, KV, Hd)
+        v = (h @ _w(layer["wv"], dt)).reshape(B, P, KV, Hd)
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = dot_product_attention(q, k, v, causal=True,
@@ -478,11 +486,11 @@ def _prompt_pass(cfg: LlamaConfig, params: dict, prompt: jax.Array):
                                      block_q=cfg.flash_block_q,
                                      block_k=cfg.flash_block_k,
                                      bwd_impl=cfg.flash_bwd_impl)
-        x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
+        x = x + attn.reshape(B, P, H * Hd) @ _w(layer["wo"], dt)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
+        up = h @ _w(layer["w_up"], dt)
+        x = x + (gate * up) @ _w(layer["w_down"], dt)
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
@@ -593,7 +601,7 @@ def decode_chunk(
     B, c = tokens.shape
     C = cache["k"].shape[2]
     positions = pos0[:, None] + jnp.arange(c)[None, :]  # [B, c]
-    x = params["embed"].astype(dt)[tokens]  # [B, c, D]
+    x = _embed_rows(params["embed"], tokens, dt)  # [B, c, D]
 
     cols = jnp.arange(C)[None, None, :]  # [1, 1, C]
     # Column j visible to the query at position p iff j <= p: unwritten
@@ -605,9 +613,9 @@ def decode_chunk(
         x, k_cache, v_cache = chunk_attn_step(
             cfg, layer, x, k_cache, v_cache, positions, valid)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
+        up = h @ _w(layer["w_up"], dt)
+        x = x + (gate * up) @ _w(layer["w_down"], dt)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -634,9 +642,9 @@ def chunk_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
     scaling = getattr(cfg, "rope_scaling", None)
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(B, c, H, Hd)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, c, KV, Hd)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, c, KV, Hd)
+    q = (h @ _w(layer["wq"], dt)).reshape(B, c, H, Hd)
+    k = (h @ _w(layer["wk"], dt)).reshape(B, c, KV, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, c, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta, scaling)
     k = _rope(k, positions, cfg.rope_theta, scaling)
     k_cache = k_cache.at[rows[:, None], positions].set(k)
@@ -648,7 +656,7 @@ def chunk_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
     s = jnp.where(valid, s, -1e30)
     probs = jax.nn.softmax(s, axis=-1).astype(dt)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
-    return x + attn.reshape(B, c, H * Hd) @ layer["wo"].astype(dt), \
+    return x + attn.reshape(B, c, H * Hd) @ _w(layer["wo"], dt), \
         k_cache, v_cache
 
 
@@ -688,9 +696,9 @@ def paged_attn_step(cfg, layer: dict, x: jax.Array, k_pages: jax.Array,
     page = k_pages.shape[2]
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, 1, KV, Hd)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, 1, KV, Hd)
+    q = (h @ _w(layer["wq"], dt)).reshape(B, 1, H, Hd)
+    k = (h @ _w(layer["wk"], dt)).reshape(B, 1, KV, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, 1, KV, Hd)
     scaling = getattr(cfg, "rope_scaling", None)
     q = _rope(q, positions, cfg.rope_theta, scaling)
     k = _rope(k, positions, cfg.rope_theta, scaling)
@@ -723,7 +731,7 @@ def paged_attn_step(cfg, layer: dict, x: jax.Array, k_pages: jax.Array,
         logits = jnp.where(valid, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(dt)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
-    return x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt), \
+    return x + attn.reshape(B, 1, H * Hd) @ _w(layer["wo"], dt), \
         k_pages, v_pages
 
 
@@ -761,7 +769,7 @@ def decode_step_paged(
     dt = cfg.dtype
     page = cache["k"].shape[2]
     positions, write_page, write_off, valid = paged_coords(pos, tables, page)
-    x = params["embed"].astype(dt)[tokens][:, None, :]
+    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]
 
     def layer_step(x, inputs):
         layer, k_pages, v_pages = inputs
@@ -769,9 +777,9 @@ def decode_step_paged(
             cfg, layer, x, k_pages, v_pages, positions,
             write_page, write_off, tables, valid)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
+        up = h @ _w(layer["w_up"], dt)
+        x = x + (gate * up) @ _w(layer["w_down"], dt)
         return x, (k_pages, v_pages)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -57,6 +57,7 @@ from polyaxon_tpu.models.common import (
     shift_right,
     truncated_normal_init,
 )
+from polyaxon_tpu.models.common import _embed_rows, _w
 from polyaxon_tpu.models.llama import _rope
 from polyaxon_tpu.ops.attention import dot_product_attention
 
@@ -227,7 +228,7 @@ def _moe_ragged_sharded(cfg: MoEConfig, x, router_w, w_gate, w_up, w_down,
                               * cfg.send_capacity_margin / ep)), K)
     s_cap = min(s_cap, T_loc * K)
 
-    logits = (x @ router_w.astype(dt)).astype(jnp.float32)  # [T_loc, E]
+    logits = (x @ _w(router_w, dt)).astype(jnp.float32)  # [T_loc, E]
     probs = jax.nn.softmax(logits, axis=-1)
     top_probs, top_idx = jax.lax.top_k(probs, K)  # [T_loc, K]
     top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
@@ -271,9 +272,9 @@ def _moe_ragged_sharded(cfg: MoEConfig, x, router_w, w_gate, w_up, w_down,
         jnp.where(keep_e, eid, E_loc), slot_e].set(rx, mode="drop")
 
     gate = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dt)))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dt))
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down.astype(dt))
+        jnp.einsum("ecd,edf->ecf", expert_in, _w(w_gate, dt)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, _w(w_up, dt))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, _w(w_down, dt))
 
     out_rows = jnp.where(
         keep_e[:, None],
@@ -377,7 +378,7 @@ def moe_block(
         return _moe_ragged(cfg, x, router_w, w_gate, w_up, w_down)
 
     tokens = x.reshape(T, D)
-    logits = (tokens @ router_w.astype(dt)).astype(jnp.float32)  # [T, E]
+    logits = (tokens @ _w(router_w, dt)).astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
 
     if cfg.router == "expert_choice":
@@ -387,9 +388,9 @@ def moe_block(
         g, idx = jax.lax.top_k(probs.T, min(capacity, T))  # [E, C]
         expert_in = tokens[idx]  # [E, C, D]
         gate = jax.nn.silu(
-            jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dt)))
-        up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dt))
-        expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down.astype(dt))
+            jnp.einsum("ecd,edf->ecf", expert_in, _w(w_gate, dt)))
+        up = jnp.einsum("ecd,edf->ecf", expert_in, _w(w_up, dt))
+        expert_out = jnp.einsum("ecf,efd->ecd", gate * up, _w(w_down, dt))
         weighted = (g[..., None].astype(dt) * expert_out).reshape(-1, D)
         out = jnp.zeros((T, D), dt).at[idx.reshape(-1)].add(weighted)
         return out.reshape(B, S, D), jnp.zeros((), jnp.float32)
@@ -420,9 +421,9 @@ def moe_block(
         top_probs.T * keep.astype(jnp.float32))
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), tokens)  # [E,C,D]
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dt)))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dt))
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, w_down.astype(dt))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, _w(w_gate, dt)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, _w(w_up, dt))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, _w(w_down, dt))
     out = jnp.einsum("tec,ecd->td", combine.astype(dt), expert_out)
 
     aux = _router_aux_loss(cfg, jnp.mean(onehot[:, 0, :], axis=0),
@@ -437,13 +438,13 @@ def _layer(cfg: MoEConfig, carry, layer: dict, positions: jax.Array):
     dt = cfg.dtype
 
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, Hd)
-    k = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, Hd)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, Hd)
+    q = (h @ _w(layer["wq"], dt)).reshape(B, S, H, Hd)
+    k = (h @ _w(layer["wk"], dt)).reshape(B, S, KV, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, S, KV, Hd)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
-    x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
+    x = x + attn.reshape(B, S, H * Hd) @ _w(layer["wo"], dt)
 
     h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
     moe_out, aux = moe_block(
@@ -462,7 +463,7 @@ def hidden_states(
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    x = params["embed"].astype(dt)[tokens]
+    x = _embed_rows(params["embed"], tokens, dt)
 
     body = functools.partial(_layer, cfg)
     if cfg.remat == "full":
@@ -487,7 +488,7 @@ def forward(
 ) -> tuple[jax.Array, jax.Array]:
     """Token ids → (logits [B,S,vocab] fp32, mean router aux loss)."""
     x, aux = hidden_states(cfg, params, tokens, positions)
-    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
     return logits, aux
 
 
@@ -509,18 +510,18 @@ def _prompt_pass(cfg: MoEConfig, params: dict, prompt: jax.Array):
     B, P = prompt.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None], (B, P))
-    x = params["embed"].astype(dt)[prompt]
+    x = _embed_rows(params["embed"], prompt, dt)
 
     def layer_step(x, layer):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(dt)).reshape(B, P, H, Hd)
-        k = (h @ layer["wk"].astype(dt)).reshape(B, P, KV, Hd)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, P, KV, Hd)
+        q = (h @ _w(layer["wq"], dt)).reshape(B, P, H, Hd)
+        k = (h @ _w(layer["wk"], dt)).reshape(B, P, KV, Hd)
+        v = (h @ _w(layer["wv"], dt)).reshape(B, P, KV, Hd)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         attn = dot_product_attention(q, k, v, causal=True,
                                      impl=cfg.attention_impl)
-        x = x + attn.reshape(B, P, H * Hd) @ layer["wo"].astype(dt)
+        x = x + attn.reshape(B, P, H * Hd) @ _w(layer["wo"], dt)
         h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
         moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
                                layer["w_up"], layer["w_down"])
@@ -543,7 +544,7 @@ def prefill(
     B = prompt.shape[0]
     x, k_all, v_all = _prompt_pass(cfg, params, prompt)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, -1] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, -1] @ _w(params["lm_head"], dt)).astype(jnp.float32)
     cache = init_cache(cfg, B, max_len)
     cache = {
         "k": jax.lax.dynamic_update_slice(
@@ -588,7 +589,7 @@ def decode_step_ragged(
     dt = cfg.dtype
     C = cache["k"].shape[2]
     positions, slot, valid = ragged_cache_coords(pos, C)
-    x = params["embed"].astype(dt)[tokens][:, None, :]  # [B, 1, D]
+    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]  # [B, 1, D]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
@@ -603,7 +604,7 @@ def decode_step_ragged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -642,7 +643,7 @@ def decode_chunk(
     B, c = tokens.shape
     C = cache["k"].shape[2]
     positions = pos0[:, None] + jnp.arange(c)[None, :]
-    x = params["embed"].astype(dt)[tokens]
+    x = _embed_rows(params["embed"], tokens, dt)
     cols = jnp.arange(C)[None, None, :]
     valid = (cols <= positions[:, :, None])[:, None]  # [B, 1, c, C]
 
@@ -659,7 +660,7 @@ def decode_chunk(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -680,7 +681,7 @@ def decode_step_paged(
     dt = cfg.dtype
     page = cache["k"].shape[2]
     positions, write_page, write_off, valid = paged_coords(pos, tables, page)
-    x = params["embed"].astype(dt)[tokens][:, None, :]
+    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]
 
     def layer_step(x, inputs):
         layer, k_pages, v_pages = inputs
@@ -696,7 +697,7 @@ def decode_step_paged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 

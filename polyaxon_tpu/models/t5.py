@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from polyaxon_tpu.models import encoder
+from polyaxon_tpu.models.common import _embed_rows, _w
 from polyaxon_tpu.models.common import (
     Batch,
     ModelDef,
@@ -163,30 +164,30 @@ def _decoder_layer(cfg: T5Config, x: jax.Array, enc_out: jax.Array,
 
     # Causal self-attention with RoPE.
     h = rms_norm(x, layer["self_norm"], cfg.norm_eps)
-    q = _rope((h @ layer["wq"].astype(dt)).reshape(B, S, H, Hd),
+    q = _rope((h @ _w(layer["wq"], dt)).reshape(B, S, H, Hd),
               positions, cfg.rope_theta)
-    k = _rope((h @ layer["wk"].astype(dt)).reshape(B, S, H, Hd),
+    k = _rope((h @ _w(layer["wk"], dt)).reshape(B, S, H, Hd),
               positions, cfg.rope_theta)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, S, H, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, S, H, Hd)
     attn = dot_product_attention(q, k, v, causal=True, impl=cfg.attention_impl)
-    x = x + attn.reshape(B, S, H * Hd) @ layer["wo"].astype(dt)
+    x = x + attn.reshape(B, S, H * Hd) @ _w(layer["wo"], dt)
 
     # Cross-attention over the encoder output (bidirectional, no RoPE —
     # encoder positions carry no causal structure for the decoder).
     h = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
-    q = (h @ layer["xq"].astype(dt)).reshape(B, S, H, Hd)
-    kv = enc_out @ layer["xkv"].astype(dt)
+    q = (h @ _w(layer["xq"], dt)).reshape(B, S, H, Hd)
+    kv = enc_out @ _w(layer["xkv"], dt)
     k, v = jnp.split(kv, 2, axis=-1)
     k = k.reshape(B, Se, H, Hd)
     v = v.reshape(B, Se, H, Hd)
     attn = dot_product_attention(q, k, v, causal=False, impl="xla")
-    x = x + attn.reshape(B, S, H * Hd) @ layer["xo"].astype(dt)
+    x = x + attn.reshape(B, S, H * Hd) @ _w(layer["xo"], dt)
 
     # Gated-GELU FFN (T5.1.1 style).
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.gelu(h @ layer["w_gate"].astype(dt))
-    up = h @ layer["w_up"].astype(dt)
-    x = x + (gate * up) @ layer["w_down"].astype(dt)
+    gate = jax.nn.gelu(h @ _w(layer["w_gate"], dt))
+    up = h @ _w(layer["w_up"], dt)
+    x = x + (gate * up) @ _w(layer["w_down"], dt)
     return x
 
 
@@ -194,7 +195,7 @@ def encode(cfg: T5Config, params: dict, inputs: jax.Array) -> jax.Array:
     """Input token ids [B, Se] → encoder states [B, Se, D]."""
     dt = cfg.dtype
     Se = inputs.shape[1]
-    x = params["embed"].astype(dt)[inputs] + params["enc_pos"].astype(dt)[None, :Se]
+    x = _embed_rows(params["embed"], inputs, dt) + _w(params["enc_pos"], dt)[None, :Se]
     x = encoder.encode(cfg.encoder_config(), params["enc_layers"], x)
     return rms_norm(x, params["enc_norm"], cfg.norm_eps)
 
@@ -205,7 +206,7 @@ def decode_hidden(cfg: T5Config, params: dict, enc_out: jax.Array,
     dt = cfg.dtype
     B, S = targets_in.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    x = params["embed"].astype(dt)[targets_in]
+    x = _embed_rows(params["embed"], targets_in, dt)
 
     body = functools.partial(_decoder_layer, cfg)
     if cfg.remat == "full":
@@ -226,7 +227,7 @@ def forward(cfg: T5Config, params: dict, inputs: jax.Array,
     """(input ids, decoder-input ids) → logits [B, Sd, vocab] fp32."""
     enc_out = encode(cfg, params, inputs)
     x = decode_hidden(cfg, params, enc_out, targets_in)
-    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return (x @ _w(params["lm_head"], cfg.dtype)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------- decode
@@ -238,7 +239,7 @@ def precompute_cross_kv(cfg: T5Config, params: dict,
     H, Hd = cfg.n_heads, cfg.head_dim
 
     def layer_kv(_, layer):
-        kv = enc_out @ layer["xkv"].astype(cfg.dtype)
+        kv = enc_out @ _w(layer["xkv"], cfg.dtype)
         k, v = jnp.split(kv, 2, axis=-1)
         return None, (k.reshape(B, Se, H, Hd), v.reshape(B, Se, H, Hd))
 
@@ -425,38 +426,38 @@ def decode_step_ragged(
     valid = ((jnp.arange(C)[None, :] <= pos_safe[:, None])
              & live)[:, None, None, :]
     xvalid = (cache["xmask"] & live)[:, None, None, :]
-    x = params["embed"].astype(dt)[tokens][:, None, :]
+    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache, xk, xv = inputs
         # Causal self-attention over the per-row cache.
         h = rms_norm(x, layer["self_norm"], cfg.norm_eps)
-        q = rope((h @ layer["wq"].astype(dt)).reshape(B, 1, H, Hd),
+        q = rope((h @ _w(layer["wq"], dt)).reshape(B, 1, H, Hd),
                  positions, cfg.rope_theta)
-        k = rope((h @ layer["wk"].astype(dt)).reshape(B, 1, H, Hd),
+        k = rope((h @ _w(layer["wk"], dt)).reshape(B, 1, H, Hd),
                  positions, cfg.rope_theta)
-        v = (h @ layer["wv"].astype(dt)).reshape(B, 1, H, Hd)
+        v = (h @ _w(layer["wv"], dt)).reshape(B, 1, H, Hd)
         k_cache = k_cache.at[rows, pos_safe].set(k[:, 0])
         v_cache = v_cache.at[rows, pos_safe].set(v[:, 0])
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
         s = jnp.where(valid, s * (Hd ** -0.5), -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(dt)
         attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
-        x = x + attn.reshape(B, 1, H * Hd) @ layer["wo"].astype(dt)
+        x = x + attn.reshape(B, 1, H * Hd) @ _w(layer["wo"], dt)
 
         # Cross-attention over the slot's padded encoder K/V.
         h = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
-        q = (h @ layer["xq"].astype(dt)).reshape(B, 1, H, Hd)
+        q = (h @ _w(layer["xq"], dt)).reshape(B, 1, H, Hd)
         s = jnp.einsum("bqhd,bkhd->bhqk", q, xk).astype(jnp.float32)
         s = jnp.where(xvalid, s * (Hd ** -0.5), -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(dt)
         attn = jnp.einsum("bhqk,bkhd->bqhd", p, xv)
-        x = x + attn.reshape(B, 1, H * Hd) @ layer["xo"].astype(dt)
+        x = x + attn.reshape(B, 1, H * Hd) @ _w(layer["xo"], dt)
 
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.gelu(h @ layer["w_gate"].astype(dt))
-        up = h @ layer["w_up"].astype(dt)
-        x = x + (gate * up) @ layer["w_down"].astype(dt)
+        gate = jax.nn.gelu(h @ _w(layer["w_gate"], dt))
+        up = h @ _w(layer["w_up"], dt)
+        x = x + (gate * up) @ _w(layer["w_down"], dt)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -464,7 +465,7 @@ def decode_step_ragged(
         (params["dec_layers"], cache["k"], cache["v"],
          cache["xk"], cache["xv"]))
     x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
     return logits, {**cache, "k": new_k, "v": new_v}
 
 

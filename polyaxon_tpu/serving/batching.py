@@ -159,9 +159,10 @@ class ContinuousBatchingEngine:
         def step(params, cache, tokens, pos, keys, temps, top_ps, top_ks,
                  tables, *, filtered: bool):
             from polyaxon_tpu.models.common import sample_row
-            from polyaxon_tpu.serving.quantize import dequantize_tree
 
-            params = dequantize_tree(params)  # identity for plain trees
+            # Quantized trees pass through whole — weights unwrap at
+            # consumption inside the model (models/llama.py _w), so
+            # int8 stays the HBM format in the per-step program.
             if tables is None:
                 logits, cache = family.decode_step_ragged(
                     cfg, params, cache, tokens, pos)
@@ -199,21 +200,19 @@ class ContinuousBatchingEngine:
         # an unbounded compile cache over prompt-length diversity).
         @lru_cache(maxsize=16)
         def compiled_prefill(plen: int):
-            from polyaxon_tpu.serving.quantize import dequantize_tree
-
             if self.kv == "paged":
                 ps = page_size
 
                 def run(params, prompt, cache, page_ids):
                     k_all, v_all = family.paged_prefill_kv(
-                        cfg, dequantize_tree(params), prompt)
+                        cfg, params, prompt)
                     return family.paged_insert_prefill(
                         cache, k_all, v_all, page_ids, ps)
 
                 return jax.jit(run, donate_argnums=(2,))
 
             def run(params, prompt):
-                return family.cb_prefill(cfg, dequantize_tree(params),
+                return family.cb_prefill(cfg, params,
                                          prompt, self.max_len)
 
             return jax.jit(run)

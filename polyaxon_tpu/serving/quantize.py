@@ -13,13 +13,18 @@ stacked-layer weights ``[L, in, out]`` keep per-layer per-out-channel
 scales, and the dequant ``q * scale`` broadcast is always elementwise-
 valid whatever the rank.
 
-Integration contract: engines call :func:`dequantize_tree` on their
-params INSIDE their jitted programs. For unquantized trees it is an
-identity (zero cost); for quantized leaves XLA fuses the
-convert+multiply into the consuming matmul's operand read, so int8
-stays the HBM-resident format and the bf16 weights exist only in VMEM
-tiles. 1-D leaves (norm gains, biases) stay full precision — they are
-a rounding error of the footprint and the quality-sensitive part.
+Integration contract: engines pass quantized trees through WHOLE; each
+model unwraps every weight at its consumption site (``models/llama.py
+_w`` / ``_embed_rows``, shared by moe/t5), duck-typed on
+``.dequantize``. The placement matters: inside a ``lax.scan`` decode
+loop a tree-level dequant is loop-invariant, so XLA hoists it and
+materializes a bf16 copy that every step re-reads — int8 then saves
+nothing. Per-consumption unwrapping keeps the convert+multiply fused
+into each matmul's operand read, so int8 stays the HBM-resident format
+and bf16 weights exist only in VMEM tiles (embedding rows are gathered
+int8-first, never the whole table). 1-D leaves (norm gains, biases)
+stay full precision — they are a rounding error of the footprint and
+the quality-sensitive part.
 """
 
 from __future__ import annotations
@@ -74,7 +79,27 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def _eligible(leaf: Any) -> bool:
+# Leaf-name fragments that mark NON-matmul per-layer vectors (norm
+# gains/biases, layer-norm scale/bias pairs, additive biases). These
+# are excluded BY NAME, not just rank: stacked per-layer vectors are
+# 2-D ([L, D] — a rank rule can't tell them from embed/lm_head), they
+# are the quality-sensitive part, and their reduced scale ([1, D],
+# leading axis 1) cannot ride a lax.scan over the layer stack the way
+# real stacked weights' [L, 1, out] scales can.
+_SKIP_FRAGMENTS = ("norm", "bias", "scale", "ln1", "ln2", "router")
+# "router": MoE router weights are a rounding error of the footprint
+# ([L, D, E]) but feed an argmax/top-k — a discrete, discontinuous
+# choice where quantization noise flips expert assignment outright
+# rather than nudging logits. Standard practice keeps routers in full
+# precision; the bytes saved would be unmeasurable.
+
+
+def _eligible(path, leaf: Any) -> bool:
+    segments = [str(getattr(k, "key", k)).lower() for k in path]
+    if any(frag in seg for seg in segments for frag in _SKIP_FRAGMENTS):
+        return False
+    if segments and segments[-1].startswith("b_"):
+        return False
     return (hasattr(leaf, "ndim") and leaf.ndim >= 2
             and jnp.issubdtype(leaf.dtype, jnp.floating))
 
@@ -98,13 +123,16 @@ def quantize_tree(params: Any, *, mode: str = "int8") -> Any:
     if mode != "int8":
         raise ValueError(f"unknown quantization mode {mode!r} "
                          "(supported: 'int8')")
-    return jax.tree.map(
-        lambda w: _jit_quantize_leaf(w) if _eligible(w) else w, params)
+    return jax.tree_util.tree_map_with_path(
+        lambda p, w: _jit_quantize_leaf(w) if _eligible(p, w) else w,
+        params)
 
 
 def dequantize_tree(params: Any) -> Any:
     """Identity on plain trees; materializes bf16/f32 views of quantized
-    leaves. Call inside jit so the dequant fuses into consumers."""
+    leaves. NOT used on the serving hot path anymore (models unwrap at
+    consumption — see module docstring); kept for tests and interop
+    (e.g. exporting a quantized checkpoint back to full precision)."""
     return jax.tree.map(
         lambda leaf: leaf.dequantize() if isinstance(leaf, QuantizedTensor)
         else leaf,

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from polyaxon_tpu.serving.batching import validate_sampling
-from polyaxon_tpu.serving.quantize import dequantize_tree, quantize_tree, tree_bytes
+from polyaxon_tpu.serving.quantize import quantize_tree, tree_bytes
 
 logger = logging.getLogger(__name__)
 
@@ -222,9 +222,15 @@ class _Engine:
                 # int8 dequant back to full precision and duplicating
                 # the draft per program.
                 def run_spec(params, draft_params, prompt):
+                    # Quantized trees pass through WHOLE: the model
+                    # unwraps each weight at its consumption site
+                    # (models/llama.py _w), inside the decode scan —
+                    # a tree-level dequant here would be hoisted out
+                    # of the loop, materializing a bf16 copy that
+                    # every step re-reads.
                     return generate_speculative(
-                        self.cfg, dequantize_tree(params),
-                        draft_cfg, dequantize_tree(draft_params),
+                        self.cfg, params,
+                        draft_cfg, draft_params,
                         prompt, max_new_tokens=max_new, k=spec_k,
                         family=family,
                         draft_family=_family(draft_name))
@@ -232,10 +238,13 @@ class _Engine:
                 return jax.jit(run_spec)
 
             def run(params, prompt, rng, temperature, top_p, top_k):
-                # Identity for plain trees; int8 weights dequantize
-                # here, inside jit, so the multiply fuses into the
-                # consuming matmuls (serving/quantize.py contract).
-                params = dequantize_tree(params)
+                # Quantized trees pass through whole; weights unwrap at
+                # their consumption sites INSIDE the decode scan
+                # (models/llama.py _w) so int8 stays the HBM-resident
+                # format per step. A dequantize_tree here is loop-
+                # invariant — XLA hoists it, and decode then re-reads a
+                # materialized bf16 copy every step (the round-3 0.88x
+                # int8 anomaly).
                 # llama: prompt continues; t5: prompt is the encoder
                 # input and generation starts from BOS.
                 return family.generate(

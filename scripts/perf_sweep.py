@@ -32,6 +32,10 @@ def bench_args(**kw) -> list[str]:
                       ("--loss-chunk", "loss_chunk"), ("--model", "model")):
         if kw.get(key) is not None:
             args += [flag, str(kw[key])]
+    if kw.get("profile"):
+        # One jax.profiler trace of a late step per point
+        # (VERDICT r3 #2); dumps land under profiles/<config>/.
+        args += ["--profile"]
     return args
 
 
@@ -172,6 +176,10 @@ def main() -> int:
                         choices=("cpu", "tpu"),
                         help="--moe backend: cpu = 8-device virtual mesh "
                              "(dp2xep4), tpu = the real chip (ep=1)")
+    parser.add_argument("--profile", action="store_true",
+                        help="capture a jax.profiler trace of one late "
+                             "step per point (profiles/<config>/; "
+                             "VERDICT r3 #2's per-point trace)")
     parser.add_argument("--resume", action="store_true",
                         help="rerun only the points that errored in the "
                              "existing perf_sweep_results.json (tunnel "
@@ -182,7 +190,8 @@ def main() -> int:
         return moe_dispatch_sweep(args.moe_platform,
                                   steps=min(args.steps, 15))
 
-    base = dict(model=args.model, steps=args.steps, seq=args.seq)
+    base = dict(model=args.model, steps=args.steps, seq=args.seq,
+                profile=args.profile or None)
     points = [
         ("baseline-b8-dots-flash", dict(base, batch=8, remat="dots",
                                         attention="flash")),
@@ -219,11 +228,11 @@ def main() -> int:
             # Bigger proxy: dim-2048 matmuls fill the MXU better than
             # the 200M's dim-1024; reconciles the --estimate projection
             # against a measured point one step closer to the 8B star.
-            ("1b-b4-dots-flash", dict(model="llama3_1b", steps=args.steps,
-                                      seq=args.seq, batch=4, remat="dots",
+            ("1b-b4-dots-flash", dict(base, model="llama3_1b",
+                                      batch=4, remat="dots",
                                       attention="flash")),
-            ("1b-b8-dots-flash", dict(model="llama3_1b", steps=args.steps,
-                                      seq=args.seq, batch=8, remat="dots",
+            ("1b-b8-dots-flash", dict(base, model="llama3_1b",
+                                      batch=8, remat="dots",
                                       attention="flash")),
         ]
 

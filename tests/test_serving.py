@@ -912,3 +912,76 @@ class TestStatsPage:
                 page = r.read().decode()
                 assert r.headers["Content-Type"].startswith("text/html")
         assert "/v1/stats" in page and "tokens generated" in page
+
+
+class TestQuantizeInLoop:
+    """VERDICT r3 #3: int8 must stay the HBM-resident format through
+    the decode scan — the model unwraps each weight at its consumption
+    site, so the compiled loop body consumes s8 operands instead of a
+    hoisted bf16 copy of the tree."""
+
+    def test_norm_gains_never_quantized(self):
+        import jax
+
+        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.serving.quantize import QuantizedTensor, quantize_tree
+
+        cfg = llama.CONFIGS["llama_tiny"]
+        q = quantize_tree(llama.init(cfg, jax.random.key(0))["params"])
+        assert not isinstance(q["layers"]["attn_norm"], QuantizedTensor)
+        assert not isinstance(q["final_norm"], QuantizedTensor)
+        assert isinstance(q["layers"]["wq"], QuantizedTensor)
+        assert isinstance(q["embed"], QuantizedTensor)
+
+    def test_quantized_tree_flows_through_decode_scan(self):
+        """Greedy parity with the plain tree, AND the compiled program
+        keeps int8 live: s8 buffers present, and no full-table bf16
+        embed ([V, D]) is materialized (rows are gathered int8-first)."""
+        import jax
+        import jax.numpy as jnp
+
+        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.serving.quantize import quantize_tree
+
+        cfg = llama.CONFIGS["llama_tiny"]
+        plain = llama.init(cfg, jax.random.key(0))["params"]
+        quant = quantize_tree(plain)
+        prompt = jnp.zeros((2, 8), jnp.int32)
+
+        def run(params, prompt):
+            return llama.generate(cfg, params, prompt, max_new_tokens=12)
+
+        out_q = jax.jit(run)(quant, prompt)
+        out_p = jax.jit(run)(plain, prompt)
+        assert (out_q == out_p).all(), "int8 greedy decode diverged"
+
+        hlo = jax.jit(run).lower(quant, prompt).compile().as_text()
+        assert "s8[" in hlo, "quantized weights vanished from the program"
+        V, D = cfg.vocab_size, cfg.dim
+        assert f"bf16[{V},{D}]" not in hlo, (
+            "full embed table dequantized to bf16 — the int8-first "
+            "row gather regressed")
+
+    def test_families_serve_int8(self):
+        """int8 must work for EVERY servable family end-to-end (review
+        regression: the t5 encoder stack missed the unwrap-at-
+        consumption conversion and only llama was tested). t5 holds
+        exact greedy parity; moe does NOT get a parity assert — int8
+        error through the top-k router is a discrete re-route, so
+        tiny random-init models legitimately diverge mid-sequence —
+        but must serve, deterministically."""
+        for model, parity in (("t5_tiny", True), ("moe_tiny", False)):
+            with ServingServer(model, seed=0) as plain:
+                ref = _post(plain.url,
+                            {"tokens": [[5, 6, 7, 8]], "max_new_tokens": 5})
+            with ServingServer(model, seed=0, quantize="int8") as q:
+                out = _post(q.url,
+                            {"tokens": [[5, 6, 7, 8]], "max_new_tokens": 5})
+                again = _post(q.url,
+                              {"tokens": [[5, 6, 7, 8]], "max_new_tokens": 5})
+            assert len(out["tokens"][0]) == 5, f"{model} int8 failed"
+            assert out["tokens"] == again["tokens"], (
+                f"{model} int8 nondeterministic")
+            if parity:
+                assert out["tokens"] == ref["tokens"], (
+                    f"{model} int8 diverged")
