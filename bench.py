@@ -636,8 +636,18 @@ def main() -> int:
     if args.profile:
         # Trace one late step (warmed-up, compiled); the trace lands in
         # <profile_dir>/profile as a perfetto/tensorboard-loadable dump.
-        tag = f"{model}-seq{seq}-b{batch}" + (
-            f"-{args.attention}" if args.attention != "auto" else "")
+        # Tag carries EVERY lever that distinguishes sweep points —
+        # the tile/chunk/remat variants are exactly the points the
+        # per-point traces exist to compare.
+        tag = f"{model}-seq{seq}-b{batch}" + "".join(
+            f"-{part}" for part in (
+                args.attention if args.attention != "auto" else None,
+                spec["runtime"]["remat"],
+                f"q{args.block_q}" if args.block_q else None,
+                f"k{args.block_k}" if args.block_k else None,
+                f"bwd{args.bwd}" if args.bwd else None,
+                f"chunk{args.loss_chunk}" if args.loss_chunk else None,
+            ) if part)
         profile_dir = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "profiles", tag)
         os.makedirs(profile_dir, exist_ok=True)

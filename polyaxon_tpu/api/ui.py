@@ -585,9 +585,45 @@ async function compareRuns() {
   )).join("");
   detail.innerHTML = `
     <h2 style="font-size:15px">comparing ${sel.length} runs</h2>
+    ${paramDiffTable(sel)}
     <div class="charts">${charts ||
       "<div class='sub' style='color:var(--muted)'>no shared metrics yet</div>"}</div>`;
   detail.scrollIntoView({behavior: "smooth"});
+}
+
+function paramDiffTable(sel) {
+  // The question a sweep comparison answers is "what was different?":
+  // one row per param whose value VARIES across the selected runs
+  // (op-level params + meta.trial_params), identical params omitted.
+  const uuids = new Set(sel.map(r => r.uuid));
+  const rows = lastRows.filter(r => uuids.has(r.uuid));
+  if (rows.length < 2) return "";
+  const valsOf = r => {
+    const out = {};
+    for (const [k, v] of Object.entries(r.params || {}))
+      out[k] = (v && typeof v === "object" && "value" in v) ? v.value : v;
+    Object.assign(out, (r.meta || {}).trial_params || {});
+    return out;
+  };
+  const perRun = rows.map(r => ({
+    label: r.name || String(r.uuid).slice(0, 8), vals: valsOf(r)}));
+  const keys = [...new Set(perRun.flatMap(p => Object.keys(p.vals)))].sort();
+  const differing = keys.filter(k => new Set(
+    perRun.map(p => JSON.stringify(p.vals[k]))).size > 1);
+  if (!differing.length) return "";
+  const fmt = v => v === undefined ? "–"
+    // Integers render EXACTLY (this table's one job is showing the
+    // difference; 16384 must not display as 16380); floats get
+    // 6 significant digits.
+    : typeof v === "number"
+      ? (Number.isInteger(v) ? String(v) : String(+v.toPrecision(6)))
+      : esc(String(v));
+  const head = `<tr><th>param</th>${perRun.map(p =>
+    `<th>${esc(p.label)}</th>`).join("")}</tr>`;
+  const body = differing.map(k => `<tr><td>${esc(k)}</td>${perRun.map(p =>
+    `<td class="num">${fmt(p.vals[k])}</td>`).join("")}</tr>`).join("");
+  return `<div class="bracket"><h3>differing params</h3>
+    <table><thead>${head}</thead><tbody>${body}</tbody></table></div>`;
 }
 
 function fmtParams(params) {

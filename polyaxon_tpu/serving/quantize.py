@@ -86,12 +86,15 @@ jax.tree_util.register_pytree_node(
 # are the quality-sensitive part, and their reduced scale ([1, D],
 # leading axis 1) cannot ride a lax.scan over the layer stack the way
 # real stacked weights' [L, 1, out] scales can.
-_SKIP_FRAGMENTS = ("norm", "bias", "scale", "ln1", "ln2", "router")
+_SKIP_FRAGMENTS = ("norm", "bias", "scale", "ln1", "ln2", "router", "pos")
 # "router": MoE router weights are a rounding error of the footprint
 # ([L, D, E]) but feed an argmax/top-k — a discrete, discontinuous
 # choice where quantization noise flips expert assignment outright
 # rather than nudging logits. Standard practice keeps routers in full
 # precision; the bytes saved would be unmeasurable.
+# "pos": additive positional tables (t5 enc_pos) are 2-D but not
+# matmul weights — their dequant noise adds straight into every
+# activation, and they are footprint-negligible like the norms.
 
 
 def _eligible(path, leaf: Any) -> bool:

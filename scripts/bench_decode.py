@@ -48,11 +48,12 @@ def measure(model: str, quantize: bool, slots: int, steps: int,
 
     # The continuous engine's exact step program, driven synchronously:
     # one ragged decode step for the whole slot pool, greedy rows.
-    from polyaxon_tpu.serving.quantize import dequantize_tree
-
+    # Quantized trees pass through whole — weights unwrap at their
+    # consumption sites inside the model (models/common.py _w), the
+    # same contract the engines use.
     def step(params, cache, tokens, pos):
         logits, cache = family.decode_step_ragged(
-            cfg, dequantize_tree(params), cache, tokens, pos)
+            cfg, params, cache, tokens, pos)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
     step = jax.jit(step, donate_argnums=(1,))
@@ -61,7 +62,7 @@ def measure(model: str, quantize: bool, slots: int, steps: int,
     prompt = jax.random.randint(jax.random.key(1), (1, prompt_len), 0,
                                 cfg.vocab_size, jnp.int32)
     row = jax.jit(
-        lambda p, t: family.cb_prefill(cfg, dequantize_tree(p), t, max_len)
+        lambda p, t: family.cb_prefill(cfg, p, t, max_len)
     )(params, prompt)
     for b in range(slots):
         cache = family.insert_cache_row(cache, row, jnp.int32(b))
@@ -115,6 +116,19 @@ def main() -> int:
 
     bf16, int8 = rows
     agree = float((bf16.pop("tokens") == int8.pop("tokens")).mean())
+    # Bandwidth roofline context: each decode step re-reads the whole
+    # weight tree, so implied bandwidth = weight_bytes / step_time. On
+    # a v5e (~819 GB/s HBM) a bandwidth-bound step cannot beat
+    # weight_bytes/819e9 — if the bf16 step is near that bound, int8
+    # SHOULD approach 2x; if far below it, decode is latency/compute
+    # bound there and int8's ceiling shrinks accordingly.
+    V5E_HBM_GBPS = 819.0
+    for r in rows:
+        gb = r["weight_bytes"] / 1e9
+        # 3 SIGNIFICANT digits, not 3 decimals: tiny-model bounds are
+        # sub-microsecond and fixed rounding would record 0.0.
+        r["implied_gbps"] = float(f"{gb / (r['step_ms'] / 1e3):.3g}")
+        r["hbm_bound_step_ms_v5e"] = float(f"{gb / V5E_HBM_GBPS * 1e3:.3g}")
     out = {
         "backend": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),

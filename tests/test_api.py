@@ -89,8 +89,9 @@ class TestApi:
                            # r2: multi-run overlay + hyperband brackets
                            "compareBtn", "overlayChart", "sweepView",
                            "cmpBox", "trial_params",
-                           # r4: project-level dashboard
-                           "projectPanel", "success rate"):
+                           # r4: project-level dashboard + compare diff
+                           "projectPanel", "success rate",
+                           "paramDiffTable"):
                 assert marker in html, marker
 
     def test_run_detail_includes_spec(self, stack):
