@@ -157,22 +157,30 @@ def _ring_causal_zigzag(q, k, v, *, scale, axis_name):
 
     attn = functools.partial(_block_attn, scale=scale)
 
+    def rot4(k_lo, k_hi, v_lo, v_hi):
+        return tuple(jax.lax.ppermute(x, axis_name, rotate)
+                     for x in (k_lo, k_hi, v_lo, v_hi))
+
     # --- step 0: the diagonal chunks this device already holds ------
     # low = global chunk idx, high = global chunk 2cp-1-idx. The high
     # chunk always sees the low chunk fully (2cp-1-idx > idx).
+    # Rotation 1 is issued FIRST: it is independent of the diagonal
+    # attention, so the ICI hop hides under the compute (pipelined
+    # ring — SURVEY §7 hard-part 3; same shape as _ring_dense).
+    kv1 = rot4(k_lo, k_hi, v_lo, v_hi)
     acc_lo = attn(q_lo, k_lo, v_lo, causal=True)
     o_hh, l_hh = attn(q_hi, k_hi, v_hi, causal=True)
     o_hl, l_hl = attn(q_hi, k_lo, v_lo, causal=False)
     acc_hi = _merge(o_hh, l_hh, o_hl, l_hl)
 
-    # --- ring steps 1..cp-1: exactly two dense blocks per step ------
+    # --- ring steps 1..cp-1: exactly two dense blocks per step, the
+    # NEXT rotation in flight while the current blocks are attended
+    # (the final iteration's permute is unused: ~1/cp extra bandwidth,
+    # hidden under that step's compute) ---------------------------------
     def step(carry, s):
         (k_lo, k_hi, v_lo, v_hi), (acc_lo, acc_hi) = carry
-        k_lo = jax.lax.ppermute(k_lo, axis_name, rotate)
-        k_hi = jax.lax.ppermute(k_hi, axis_name, rotate)
-        v_lo = jax.lax.ppermute(v_lo, axis_name, rotate)
-        v_hi = jax.lax.ppermute(v_hi, axis_name, rotate)
-        src = (idx - s) % cp  # kv now holds chunks (src, 2cp-1-src)
+        kv_nxt = rot4(k_lo, k_hi, v_lo, v_hi)
+        src = (idx - s) % cp  # kv in hand holds chunks (src, 2cp-1-src)
 
         # Always visible: q chunk 2cp-1-idx vs kv chunk src (< cp).
         o1, l1 = attn(q_hi, k_lo, v_lo, causal=False)
@@ -193,10 +201,10 @@ def _ring_causal_zigzag(q, k, v, *, scale, axis_name):
                        for a, b in zip(lo_upd, acc_lo))
         acc_hi = tuple(jnp.where(take_low, b, a)
                        for a, b in zip(hi_upd, acc_hi))
-        return ((k_lo, k_hi, v_lo, v_hi), (acc_lo, acc_hi)), None
+        return (kv_nxt, (acc_lo, acc_hi)), None
 
     ((_, (acc_lo, acc_hi)), _) = jax.lax.scan(
-        step, ((k_lo, k_hi, v_lo, v_hi), (acc_lo, acc_hi)),
+        step, (kv1, (acc_lo, acc_hi)),
         jnp.arange(1, cp))
 
     # --- inverse zigzag: restore contiguous output layout -----------
@@ -212,22 +220,33 @@ def _ring_causal_zigzag(q, k, v, *, scale, axis_name):
 
 
 def _ring_dense(q, k, v, *, scale, axis_name):
-    """Non-causal ring: every block visible, one flash call per step."""
+    """Non-causal ring: every block visible, one flash call per step.
+
+    Pipelined (SURVEY §7 hard-part 3): each step attends to the block
+    IN HAND while the next block's ppermute is already in flight — the
+    two are data-independent, so XLA's async collective-permute
+    (start/done pair) hides the ICI hop under the attention compute.
+    The permute issued by the final iteration is unused (~1/cp extra
+    bandwidth, itself hidden under that step's compute).
+    """
     cp = jax.lax.axis_size(axis_name)
     rotate = [(i, (i + 1) % cp) for i in range(cp)]
     attn = functools.partial(_block_attn, scale=scale, causal=False)
 
+    # Rotation 1 flies while block 0 (the local block) is attended.
+    k1 = jax.lax.ppermute(k, axis_name, rotate)
+    v1 = jax.lax.ppermute(v, axis_name, rotate)
     acc = attn(q, k, v)
 
     def step(carry, _):
         (k_cur, v_cur), acc = carry
-        k_cur = jax.lax.ppermute(k_cur, axis_name, rotate)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, rotate)
-        o, lse = attn(q, k_cur, v_cur)
-        return ((k_cur, v_cur), _merge(*acc, o, lse)), None
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, rotate)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, rotate)
+        o, lse = attn(q, k_cur, v_cur)  # independent of the permutes
+        return ((k_nxt, v_nxt), _merge(*acc, o, lse)), None
 
     (((_, _), acc), _) = jax.lax.scan(
-        step, ((k, v), acc), jnp.arange(1, cp))
+        step, ((k1, v1), acc), jnp.arange(1, cp))
     return acc[0].astype(q.dtype)
 
 
