@@ -81,10 +81,35 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model: str, cfg, params, *, slots: int = 4,
                  max_len: Optional[int] = None, kv: str = "dense",
-                 page_size: int = 16, kv_pages: Optional[int] = None):
+                 page_size: int = 16, kv_pages: Optional[int] = None,
+                 draft=None):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
+        # Speculative decoding over the slot pool: ``draft`` =
+        # (draft_model, draft_cfg, draft_params, k). Each loop
+        # iteration becomes one draft→verify round — every live slot
+        # proposes k tokens with its own draft-cache row and accepts
+        # 1..k+1 of them raggedly (per-row acceptance counts, per-row
+        # budget caps). Greedy-only: acceptance compares the target's
+        # own argmax, so the pool serves temperature-0 requests while
+        # a draft is configured (submit refuses sampled requests
+        # loudly rather than silently starving speculation).
+        if draft is not None:
+            if kv != "dense":
+                raise ValueError(
+                    "speculative continuous batching requires kv='dense' "
+                    "(the verify chunk needs the slot==position cache)")
+            if getattr(cfg, "sliding_window", None) is not None:
+                raise ValueError(
+                    "speculative decoding requires a full-length cache "
+                    "(no sliding_window) — rollback-free acceptance "
+                    "depends on slot == position")
+            if not hasattr(family, "decode_chunk"):
+                raise ValueError(
+                    f"`{model}` ({family.__name__}) has no decode_chunk "
+                    "verify surface; speculative continuous batching "
+                    "supports llama/moe-family decoders")
         # Family-generic: any family exposing the continuous-batching
         # surface (llama dense decoders, moe expert-FFN decoders, t5
         # seq2seq with per-slot encoder state) batches continuously.
@@ -131,6 +156,38 @@ class ContinuousBatchingEngine:
                 cfg, self._pool.n_pages, page_size)
         else:
             self._cache = family.cb_init_cache(cfg, slots, self.max_len)
+        self.draft = draft
+        self._spec_rounds = 0
+        self._spec_tokens = 0
+        if draft is not None:
+            draft_model, draft_cfg, draft_params, spec_k = draft
+            if getattr(draft_cfg, "sliding_window", None) is not None:
+                raise ValueError(
+                    "draft model must not use sliding_window (its cache "
+                    "needs slot == position too)")
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self._draft_family = _family(draft_model)
+            if getattr(self._draft_family, "SEQ2SEQ", False):
+                raise ValueError(
+                    f"draft `{draft_model}` is seq2seq — a drafting "
+                    "decoder must continue the same token stream the "
+                    "target decodes (its proposals would be garbage "
+                    "and acceptance would silently collapse)")
+            draft_required = ("decode_step_ragged", "cb_init_cache",
+                              "cb_prefill", "insert_cache_row")
+            draft_missing = [name for name in draft_required
+                             if not hasattr(self._draft_family, name)]
+            if draft_missing:
+                raise ValueError(
+                    f"draft `{draft_model}` "
+                    f"({self._draft_family.__name__}) lacks the ragged "
+                    f"decode surface: {draft_missing}")
+            self._draft_cfg = draft_cfg
+            self._draft_params = draft_params
+            self.spec_k = int(spec_k)
+            self._draft_cache = self._draft_family.cb_init_cache(
+                draft_cfg, slots, self.max_len)
         self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
         self._cur = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
@@ -222,6 +279,64 @@ class ContinuousBatchingEngine:
                         jax.jit(family.insert_cache_row,
                                 donate_argnums=(0,)))
 
+        if draft is not None:
+            draft_family, draft_cfg = self._draft_family, self._draft_cfg
+            k_spec = self.spec_k
+
+            @lru_cache(maxsize=16)
+            def compiled_draft_prefill(plen: int):
+                def run(draft_params, prompt):
+                    return draft_family.cb_prefill(
+                        draft_cfg, draft_params, prompt, self.max_len)
+
+                return jax.jit(run)
+
+            self._compiled_draft_prefill = compiled_draft_prefill
+            self._draft_insert = jax.jit(draft_family.insert_cache_row,
+                                         donate_argnums=(0,))
+
+            def spec_round(params, draft_params, cache_t, cache_d,
+                           cur, pos, budget_left):
+                """One draft→verify round for the whole pool. Returns
+                (candidates [B, k+1], emit [B], next cur, caches).
+                Idle rows (pos < 0) run with clamped positions and
+                emit 0 — their cache rows are garbage the next
+                admission's insert replaces wholesale."""
+                B = cur.shape[0]
+                rows = jnp.arange(B)
+                live = pos >= 0
+                p0 = jnp.maximum(pos, 0)
+
+                def draft_step(carry, _):
+                    cache_d, tok, p = carry
+                    lg, cache_d = draft_family.decode_step_ragged(
+                        draft_cfg, draft_params, cache_d, tok, p)
+                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    return (cache_d, nxt, p + 1), nxt
+
+                # k+1 draft steps for k proposals: the extra step
+                # writes the LAST proposal's draft KV (same hole-free
+                # invariant as speculative.py).
+                (cache_d, _, _), d = jax.lax.scan(
+                    draft_step, (cache_d, cur, p0), None,
+                    length=k_spec + 1)
+                d = d.T[:, :k_spec]  # [B, k]
+
+                chunk = jnp.concatenate([cur[:, None], d], axis=1)
+                logits, cache_t = family.decode_chunk(
+                    cfg, params, cache_t, chunk, p0)
+                t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                match = (d == t[:, :k_spec]).astype(jnp.int32)
+                accepted = jnp.cumprod(match, axis=1).sum(axis=1)
+                emit = jnp.minimum(accepted + 1, budget_left)
+                emit = jnp.where(live, emit, 0)
+                cur_nxt = jnp.where(
+                    emit > 0, t[rows, jnp.maximum(emit - 1, 0)], cur)
+                return t, emit, cur_nxt, cache_t, cache_d
+
+            self._spec_round = jax.jit(spec_round,
+                                       donate_argnums=(2, 3))
+
         self._thread = threading.Thread(
             target=self._loop, name="plx-serving-batcher", daemon=True)
         self._thread.start()
@@ -238,6 +353,18 @@ class ContinuousBatchingEngine:
         # encoder prompt and decode budget separately.
         self._family_mod.cb_validate(self.cfg, len(tokens), max_new_tokens,
                                      self.max_len)
+        if self.draft is not None:
+            # Verify rounds write KV up to k positions past the budget
+            # (a nearly-done row still runs a full draft window): the
+            # full-length cache must hold that headroom or the ring
+            # wrap would scribble over the prompt start.
+            need = len(tokens) + max_new_tokens + self.spec_k + 1
+            if need > self.max_len:
+                raise ValueError(
+                    f"prompt {len(tokens)} + max_new {max_new_tokens} + "
+                    f"draft window {self.spec_k}+1 exceeds the cache "
+                    f"length {self.max_len} (speculative rounds need "
+                    "the headroom)")
         if self._pool is not None:
             # A request that cannot fit the pool even when it is the
             # only tenant would wait at the FIFO head forever (and
@@ -256,6 +383,12 @@ class ContinuousBatchingEngine:
                top_p: float = 1.0, top_k: int = 0) -> _Request:
         self._validate(tokens, max_new_tokens)
         validate_sampling(top_p, top_k)
+        if self.draft is not None and temperature > 0:
+            raise ValueError(
+                "this engine speculates with a draft model, which is "
+                "greedy-only (acceptance compares the target's own "
+                "argmax); send temperature=0 or serve without "
+                "--draft-model for sampling")
         req = _Request(list(tokens), max_new_tokens, float(temperature),
                        int(seed), float(top_p), int(top_k))
         with self._cv:
@@ -393,6 +526,14 @@ class ContinuousBatchingEngine:
                         row_cache = fn(self.params, row)
                         self._cache = self._insert(
                             self._cache, row_cache, jnp.int32(b))
+                    if self.draft is not None:
+                        # The draft's cache row prefills the same
+                        # prompt prefix; its first query (cur at pos)
+                        # writes position pos inside the round.
+                        draft_row = self._compiled_draft_prefill(
+                            len(prefill_tokens))(self._draft_params, row)
+                        self._draft_cache = self._draft_insert(
+                            self._draft_cache, draft_row, jnp.int32(b))
                 self._slot_req[b] = req
                 self._pos[b] = pos0
                 self._cur[b] = tok0
@@ -448,6 +589,17 @@ class ContinuousBatchingEngine:
             "step_failures": self._step_failures,
             "stopped": self._stopped,
             "kv": self.kv,
+            **({"draft_model": self.draft[0],
+                "spec_k": self.spec_k,
+                "spec_rounds": self._spec_rounds,
+                # Mean tokens emitted per verify round (1..k+1): THE
+                # speculation-efficiency number — near 1 means the
+                # draft buys nothing, near k+1 means near-full
+                # acceptance.
+                "spec_tokens_per_round": (
+                    round(self._spec_tokens / self._spec_rounds, 3)
+                    if self._spec_rounds else None)}
+               if self.draft is not None else {}),
             **({"kv_pages_total": self._pool.n_pages - 1,
                 "kv_pages_free": self._pool.free_pages,
                 "kv_page_size": self._pool.page_size,
@@ -455,6 +607,80 @@ class ContinuousBatchingEngine:
                 "kv_prefix_misses": self._pool.prefix_misses}
                if self._pool is not None else {}),
         }
+
+    def _handle_step_failure(self, exc: Exception, what: str) -> bool:
+        """Shared device-failure recovery for the plain step AND the
+        speculative round: fail every live request with the error,
+        count toward the fail-fast budget, and rebuild the donated
+        cache(s) so a transient failure doesn't kill the engine.
+        Returns False when fail-fast stopped the engine. Must be
+        called from an ``except`` block (logger.exception)."""
+        logger.exception("%s failed", what)
+        self._step_failures += 1
+        self._consec_step_failures += 1
+        err = f"{type(exc).__name__}: {exc}"
+        for b in range(self.slots):
+            if self._slot_req[b] is not None:
+                self._slot_req[b].error = err
+                self._retire(b)
+        if self._consec_step_failures >= self.max_step_failures:
+            self._fail_fast(err)
+            return False
+        # The old cache was donated to the failed program — its buffer
+        # is gone (or poisoned). Rebuild. (Every live row was retired
+        # above, so a paged pool is fully free.)
+        if self._pool is not None:
+            self._cache = self._family_mod.paged_init_cache(
+                self.cfg, self._pool.n_pages, self._pool.page_size)
+            # The rebuilt cache is zeros: resident prefix pages no
+            # longer hold the content their keys promise.
+            self._pool.invalidate_prefix_cache()
+        else:
+            self._cache = self._family_mod.cb_init_cache(
+                self.cfg, self.slots, self.max_len)
+        if self.draft is not None:
+            self._draft_cache = self._draft_family.cb_init_cache(
+                self._draft_cfg, self.slots, self.max_len)
+        return True
+
+    def _spec_iteration(self) -> bool:
+        """One draft→verify round for the pool: every live slot emits
+        1..k+1 tokens (ragged acceptance, per-row budget caps). Returns
+        False when a persistent failure stopped the engine. Mirrors the
+        plain step's failure semantics, rebuilding BOTH caches on a
+        transient device error (they were donated to the failed round).
+        """
+        budget = np.zeros(self.slots, np.int32)
+        for b in range(self.slots):
+            req = self._slot_req[b]
+            if req is not None:
+                budget[b] = req.max_new - len(req.out)
+        try:
+            t, emit, cur_nxt, self._cache, self._draft_cache = (
+                self._spec_round(
+                    self.params, self._draft_params,
+                    self._cache, self._draft_cache,
+                    jnp.asarray(self._cur), jnp.asarray(self._pos),
+                    jnp.asarray(budget)))
+            t = np.asarray(t)
+            emit = np.asarray(emit)
+            cur_nxt = np.asarray(cur_nxt)
+        except Exception as exc:  # noqa: BLE001 — fail live requests
+            return self._handle_step_failure(exc, "speculative round")
+        self._consec_step_failures = 0
+        self._spec_rounds += 1
+        for b in range(self.slots):
+            req = self._slot_req[b]
+            if req is None:
+                continue
+            n = int(emit[b])
+            self._spec_tokens += n
+            req.out.extend(int(tok) for tok in t[b, :n])
+            self._pos[b] += n
+            self._cur[b] = int(cur_nxt[b])
+            if len(req.out) >= req.max_new:
+                self._retire(b)
+        return True
 
     def _retire(self, b: int) -> None:
         req = self._slot_req[b]
@@ -495,6 +721,10 @@ class ContinuousBatchingEngine:
                 continue
             self._steps_total += 1
             self._live_slot_steps += live
+            if self.draft is not None:
+                if not self._spec_iteration():
+                    return  # fail-fast stopped the engine
+                continue
             try:
                 keys = jnp.stack([
                     jax.random.fold_in(self._keys[b],
@@ -516,30 +746,8 @@ class ContinuousBatchingEngine:
                     tables)
                 nxt = np.asarray(nxt)
             except Exception as exc:  # noqa: BLE001 — fail live requests
-                logger.exception("decode step failed")
-                self._step_failures += 1
-                self._consec_step_failures += 1
-                err = f"{type(exc).__name__}: {exc}"
-                for b in range(self.slots):
-                    if self._slot_req[b] is not None:
-                        self._slot_req[b].error = err
-                        self._retire(b)
-                if self._consec_step_failures >= self.max_step_failures:
-                    self._fail_fast(err)
+                if not self._handle_step_failure(exc, "decode step"):
                     return
-                # The old cache was donated to the failed step — its
-                # buffer is gone (or poisoned). Rebuild so the engine
-                # survives a transient step failure. (Every live row
-                # was retired above, so a paged pool is fully free.)
-                if self._pool is not None:
-                    self._cache = self._family_mod.paged_init_cache(
-                        self.cfg, self._pool.n_pages, self._pool.page_size)
-                    # The rebuilt cache is zeros: resident prefix pages
-                    # no longer hold the content their keys promise.
-                    self._pool.invalidate_prefix_cache()
-                else:
-                    self._cache = self._family_mod.cb_init_cache(
-                        self.cfg, self.slots, self.max_len)
                 continue
             self._consec_step_failures = 0
             for b in range(self.slots):

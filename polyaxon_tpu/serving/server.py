@@ -610,11 +610,6 @@ class ServingServer:
                         tree_bytes(params) / 2**20)
         draft = None
         if draft_model is not None:
-            if batching != "static":
-                raise ValueError(
-                    "speculative decoding (--draft-model) runs on the "
-                    "static engine; the slot-pool's ragged per-row "
-                    "acceptance is future work")
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
             # Validate the pairing from the CONFIG before materializing
@@ -642,7 +637,7 @@ class ServingServer:
 
             self.engine = ContinuousBatchingEngine(
                 model, cfg, params, slots=slots, kv=kv,
-                page_size=page_size, kv_pages=kv_pages)
+                page_size=page_size, kv_pages=kv_pages, draft=draft)
         elif batching == "static":
             if kv != "dense":
                 raise ValueError(

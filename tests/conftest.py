@@ -61,6 +61,8 @@ SMOKE_NODES = (
     "test_paged.py::TestPagedEngine::test_matches_dense_engine_greedy",
     "test_paged.py::TestPrefixCache::test_shared_prompt_pages_reused",
     "test_speculative.py::TestSpeculative::test_lossless_vs_plain_greedy",
+    "test_speculative.py::TestContinuousSpeculative::"
+    "test_lossless_and_ragged_budgets",
     "test_lora.py::TestLoraWrapper::test_init_is_exactly_the_base_model",
     "test_moe_pp.py::TestMoE::test_ragged_matches_dense_no_drop_single_shard",
     "test_tune.py::TestOneShotManagers",
