@@ -33,8 +33,15 @@ def main() -> int:
     parser.add_argument("--kv-page-size", type=int, default=16)
     parser.add_argument("--kv-pages", type=int, default=None)
     parser.add_argument("--draft-model", default=None,
-                        help="speculative-decoding draft (static engine; "
-                             "lossless for greedy requests)")
+                        help="speculative-decoding draft (both engines; "
+                             "lossless for greedy requests; the "
+                             "continuous pool becomes greedy-only)")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="(continuous, dense kv) stream long prompts "
+                             "into the pool this many tokens per loop "
+                             "iteration instead of one blocking prefill; "
+                             "each in-flight reservation holds its own "
+                             "full-length row cache until it inserts")
     parser.add_argument("--draft-checkpoint", default=None)
     parser.add_argument("--spec-k", type=int, default=4)
     parser.add_argument("--lora-alpha", type=float, default=16.0,
@@ -60,7 +67,8 @@ def main() -> int:
                        kv_pages=args.kv_pages,
                        draft_model=args.draft_model,
                        draft_checkpoint=args.draft_checkpoint,
-                       spec_k=args.spec_k, lora_alpha=args.lora_alpha) as s:
+                       spec_k=args.spec_k, lora_alpha=args.lora_alpha,
+                       prefill_chunk=args.prefill_chunk) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

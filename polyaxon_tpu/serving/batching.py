@@ -82,10 +82,38 @@ class ContinuousBatchingEngine:
     def __init__(self, model: str, cfg, params, *, slots: int = 4,
                  max_len: Optional[int] = None, kv: str = "dense",
                  page_size: int = 16, kv_pages: Optional[int] = None,
-                 draft=None):
+                 draft=None, prefill_chunk: Optional[int] = None):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
+        # Chunked prefill (vLLM-style): a long prompt's admission no
+        # longer blocks the pool for one monolithic prefill — the
+        # prompt streams into a standalone row cache `prefill_chunk`
+        # tokens per loop iteration (one fixed-shape decode_chunk
+        # program, reused for EVERY prompt length — no per-length
+        # compile cache), interleaved with the live slots' decode
+        # steps; the finished row then inserts like any admission.
+        # Rollback-free by the same slot==position argument as
+        # speculative verify: the padded tail chunk's junk writes sit
+        # at positions decode rewrites before anything attends them.
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            if kv != "dense":
+                raise ValueError(
+                    "chunked prefill requires kv='dense' (the chunk "
+                    "writer needs the slot==position row cache)")
+            if not hasattr(family, "decode_chunk"):
+                raise ValueError(
+                    f"`{model}` ({family.__name__}) has no decode_chunk "
+                    "surface; chunked prefill supports llama/moe-family "
+                    "decoders")
+            if getattr(cfg, "sliding_window", None) is not None:
+                raise ValueError(
+                    "chunked prefill requires a full-length cache "
+                    "(no sliding_window): the padded tail chunk's "
+                    "junk writes rely on slot == position")
         # Speculative decoding over the slot pool: ``draft`` =
         # (draft_model, draft_cfg, draft_params, k). Each loop
         # iteration becomes one draft→verify round — every live slot
@@ -188,6 +216,15 @@ class ContinuousBatchingEngine:
             self.spec_k = int(spec_k)
             self._draft_cache = self._draft_family.cb_init_cache(
                 draft_cfg, slots, self.max_len)
+        self.prefill_chunk = prefill_chunk
+        # Per-slot chunked-prefill state: [request, prompt tokens to
+        # write, progress, target row cache, draft row cache or None,
+        # pos0, tok0]. A slot in this dict is RESERVED but not yet
+        # live; dict insertion order IS the admission FIFO. Each
+        # reservation holds a standalone full-length row cache (plus
+        # the draft's when speculating) on top of the pool cache —
+        # peak KV memory grows accordingly (documented at the flag).
+        self._prefilling: dict[int, list] = {}
         self._pos = np.full(slots, -1, np.int32)  # -1 = free slot
         self._cur = np.zeros(slots, np.int32)
         self._temps = np.zeros(slots, np.float32)
@@ -337,6 +374,34 @@ class ContinuousBatchingEngine:
             self._spec_round = jax.jit(spec_round,
                                        donate_argnums=(2, 3))
 
+        if prefill_chunk is not None:
+            if draft is not None and not hasattr(self._draft_family,
+                                                 "decode_chunk"):
+                raise ValueError(
+                    "chunked prefill with a draft needs the draft "
+                    "family's decode_chunk too")
+
+            def chunk_write(params, row_cache, tokens, pos0):
+                """Write one [1, c] chunk of prompt KV into a
+                standalone row cache; logits discarded. The padded
+                tail's junk writes land at positions decode rewrites
+                before anything attends them (slot == position)."""
+                _, row_cache = family.decode_chunk(
+                    cfg, params, row_cache, tokens, pos0)
+                return row_cache
+
+            self._chunk_write = jax.jit(chunk_write, donate_argnums=(1,))
+            if draft is not None:
+                def draft_chunk_write(draft_params, row_cache, tokens,
+                                      pos0):
+                    _, row_cache = self._draft_family.decode_chunk(
+                        self._draft_cfg, draft_params, row_cache,
+                        tokens, pos0)
+                    return row_cache
+
+                self._draft_chunk_write = jax.jit(
+                    draft_chunk_write, donate_argnums=(1,))
+
         self._thread = threading.Thread(
             target=self._loop, name="plx-serving-batcher", daemon=True)
         self._thread.start()
@@ -439,7 +504,8 @@ class ContinuousBatchingEngine:
         loop's own done.set() calls."""
         self._thread.join()
         with self._cv:
-            for req in list(self._queue) + self._slot_req:
+            pending = [state[0] for state in self._prefilling.values()]
+            for req in list(self._queue) + self._slot_req + pending:
                 if req is not None and not req.done.is_set():
                     req.error = "engine stopped"
                     req.done.set()
@@ -477,6 +543,12 @@ class ContinuousBatchingEngine:
             if self._slot_req[b] is not None:
                 self._slot_req[b].error = f"engine failed: {err}"
                 self._retire(b)
+        for b, state in list(self._prefilling.items()):
+            req = state[0]
+            del self._prefilling[b]
+            if not req.done.is_set():
+                req.error = f"engine failed: {err}"
+                req.done.set()
         with self._cv:
             self._stopped = True
             while self._queue:
@@ -487,7 +559,7 @@ class ContinuousBatchingEngine:
 
     def _admit(self) -> None:
         for b in range(self.slots):
-            if self._slot_req[b] is not None:
+            if self._slot_req[b] is not None or b in self._prefilling:
                 continue
             # Pop under the lock: cancel() mutates the queue from HTTP
             # threads, and an unsynchronized popleft can race it empty.
@@ -515,6 +587,20 @@ class ContinuousBatchingEngine:
             try:
                 pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
                     req.tokens)
+                if (prefill_tokens and self.prefill_chunk is not None
+                        and len(prefill_tokens) > self.prefill_chunk):
+                    # Long prompt: reserve the slot and stream the
+                    # prompt in chunks across loop iterations instead
+                    # of blocking the pool on one monolithic prefill.
+                    row_t = self._family_mod.cb_init_cache(
+                        self.cfg, 1, self.max_len)
+                    row_d = (self._draft_family.cb_init_cache(
+                        self._draft_cfg, 1, self.max_len)
+                        if self.draft is not None else None)
+                    self._prefilling[b] = [
+                        req, np.asarray(prefill_tokens, np.int32), 0,
+                        row_t, row_d, pos0, tok0]
+                    continue
                 if prefill_tokens:
                     row = jnp.asarray([prefill_tokens], jnp.int32)
                     fn = self._compiled_prefill(len(prefill_tokens))
@@ -534,13 +620,7 @@ class ContinuousBatchingEngine:
                             len(prefill_tokens))(self._draft_params, row)
                         self._draft_cache = self._draft_insert(
                             self._draft_cache, draft_row, jnp.int32(b))
-                self._slot_req[b] = req
-                self._pos[b] = pos0
-                self._cur[b] = tok0
-                self._temps[b] = req.temperature
-                self._top_ps[b] = req.top_p
-                self._top_ks[b] = req.top_k
-                self._keys[b] = jax.random.key(req.seed)
+                self._go_live(b, req, pos0, tok0)
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 if self._pool is not None:
                     # Failed admission frees pages AND forgets any
@@ -552,21 +632,10 @@ class ContinuousBatchingEngine:
                 # Persistent device breakage surfaces in the admission
                 # prefill just as readily as in the decode step — count
                 # it toward the same fail-fast budget so a broken
-                # device doesn't burn one prefill per queued request.
-                # Only RuntimeErrors count (XLA device errors subclass
-                # it): a ValueError from a family's cb_admission is a
-                # bad REQUEST, and three of those in a row must not
-                # stop a healthy engine for everyone else. (And only a
-                # successful STEP resets the counter: resetting on
-                # admission would let fail-step/re-admit cycles
-                # alternate forever below the threshold.)
-                if isinstance(exc, RuntimeError):
-                    self._step_failures += 1
-                    self._consec_step_failures += 1
-                    if (self._consec_step_failures
-                            >= self.max_step_failures):
-                        self._fail_fast(f"{type(exc).__name__}: {exc}")
-                        return
+                # device doesn't burn one prefill per queued request
+                # (_count_request_failure has the counting rules).
+                if not self._count_request_failure(exc):
+                    return
 
     def stats(self) -> dict:
         """Live engine counters + occupancy gauges for /v1/stats."""
@@ -574,6 +643,7 @@ class ContinuousBatchingEngine:
             "engine": "continuous",
             "slots": self.slots,
             "active": sum(1 for r in self._slot_req if r is not None),
+            "prefilling": len(self._prefilling),
             "queued": len(self._queue),
             "queue_depth_peak": self._queue_depth_peak,
             "decode_steps": self._steps_total,
@@ -607,6 +677,87 @@ class ContinuousBatchingEngine:
                 "kv_prefix_misses": self._pool.prefix_misses}
                if self._pool is not None else {}),
         }
+
+    def _go_live(self, b: int, req: _Request, pos0: int, tok0: int) -> None:
+        """Mark a slot live for decode — the ONE place slot state is
+        initialized (monolithic admission and chunked-prefill
+        completion both land here)."""
+        self._slot_req[b] = req
+        self._pos[b] = pos0
+        self._cur[b] = tok0
+        self._temps[b] = req.temperature
+        self._top_ps[b] = req.top_p
+        self._top_ks[b] = req.top_k
+        self._keys[b] = jax.random.key(req.seed)
+
+    def _count_request_failure(self, exc: Exception) -> bool:
+        """Request-scoped device-failure accounting, shared by the
+        admission prefill and the chunk writer: only RuntimeErrors
+        (XLA device errors) count toward fail-fast — a ValueError is a
+        bad REQUEST, and bad requests must not stop a healthy engine —
+        and only a successful step resets the counter. Returns False
+        when fail-fast stopped the engine."""
+        if isinstance(exc, RuntimeError):
+            self._step_failures += 1
+            self._consec_step_failures += 1
+            if self._consec_step_failures >= self.max_step_failures:
+                self._fail_fast(f"{type(exc).__name__}: {exc}")
+                return False
+        return True
+
+    def _advance_prefill(self, all_slots: bool = False) -> bool:
+        """Advance prefilling slots by one chunk each: the OLDEST
+        reservation only while live rows are decoding (bounded added
+        latency per decode step, strict admission FIFO — dict
+        insertion order), or every reservation when the pool is
+        otherwise idle (``all_slots`` — serializing a cold-start burst
+        behind one-slot-at-a-time would beat monolithic prefill at
+        nothing). Returns False when fail-fast stopped the engine."""
+        c = self.prefill_chunk
+        advanced = False
+        for b in list(self._prefilling):
+            state = self._prefilling[b]
+            req = state[0]
+            if req.cancelled:
+                del self._prefilling[b]
+                if not req.done.is_set():
+                    req.error = "cancelled"
+                    req.done.set()
+                continue
+            if advanced and not all_slots:
+                break
+            req, pending, i, row_t, row_d, pos0, tok0 = state
+            chunk = pending[i:i + c]
+            if len(chunk) < c:  # padded tail: junk writes land at
+                chunk = np.concatenate(  # positions decode rewrites 1st
+                    [chunk, np.zeros(c - len(chunk), np.int32)])
+            tokens = jnp.asarray(chunk[None, :], jnp.int32)
+            p0 = jnp.asarray([i], jnp.int32)
+            try:
+                state[3] = row_t = self._chunk_write(
+                    self.params, row_t, tokens, p0)
+                if row_d is not None:
+                    state[4] = row_d = self._draft_chunk_write(
+                        self._draft_params, row_d, tokens, p0)
+            except Exception as exc:  # noqa: BLE001 — request-scoped
+                del self._prefilling[b]
+                req.error = f"{type(exc).__name__}: {exc}"
+                req.done.set()
+                if not self._count_request_failure(exc):
+                    return False
+                continue
+            advanced = True
+            state[2] = i + c
+            if state[2] >= len(pending):
+                # Caught up: insert the finished row(s) and go live.
+                del self._prefilling[b]
+                self._cache = self._insert(self._cache, row_t,
+                                           jnp.int32(b))
+                if row_d is not None:
+                    self._draft_cache = self._draft_insert(
+                        self._draft_cache, row_d, jnp.int32(b))
+                self._go_live(b, req, pos0, tok0)
+        return True
 
     def _handle_step_failure(self, exc: Exception, what: str) -> bool:
         """Shared device-failure recovery for the plain step AND the
@@ -703,6 +854,7 @@ class ContinuousBatchingEngine:
         while True:
             with self._cv:
                 while (not self._stopped and not self._queue
+                       and not self._prefilling
                        and all(r is None for r in self._slot_req)):
                     self._cv.wait()
                 if self._stopped:
@@ -717,6 +869,12 @@ class ContinuousBatchingEngine:
             self._queue_depth_peak = max(self._queue_depth_peak,
                                          len(self._queue))
             live = sum(1 for r in self._slot_req if r is not None)
+            if self._prefilling:
+                # Idle pool → advance every reservation (a cold-start
+                # burst must not serialize one slot at a time).
+                if not self._advance_prefill(all_slots=(live == 0)):
+                    return  # fail-fast stopped the engine
+                live = sum(1 for r in self._slot_req if r is not None)
             if live == 0:
                 continue
             self._steps_total += 1

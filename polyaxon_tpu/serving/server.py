@@ -585,7 +585,8 @@ class ServingServer:
                  page_size: int = 16, kv_pages: Optional[int] = None,
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None, spec_k: int = 4,
-                 lora_alpha: float = 16.0):
+                 lora_alpha: float = 16.0,
+                 prefill_chunk: Optional[int] = None):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -637,8 +638,13 @@ class ServingServer:
 
             self.engine = ContinuousBatchingEngine(
                 model, cfg, params, slots=slots, kv=kv,
-                page_size=page_size, kv_pages=kv_pages, draft=draft)
+                page_size=page_size, kv_pages=kv_pages, draft=draft,
+                prefill_chunk=prefill_chunk)
         elif batching == "static":
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "--prefill-chunk requires --batching continuous "
+                    "(the static engine compiles whole generations)")
             if kv != "dense":
                 raise ValueError(
                     "kv='paged' requires --batching continuous (the "

@@ -109,6 +109,8 @@ def run_config(name: str, model: str, prompts, max_new, clients,
                 - (before["avg_occupancy"] or 0) * before["decode_steps"])
         occupancy = round(live / dsteps, 4)
     row = {"name": name, **result, "avg_occupancy": occupancy}
+    if after.get("spec_rounds") is not None:
+        row["spec_tokens_per_round"] = after.get("spec_tokens_per_round")
     if after.get("kv_prefix_hits") is not None:
         row["kv_prefix_hits"] = (after["kv_prefix_hits"]
                                  - before["kv_prefix_hits"])
@@ -128,6 +130,10 @@ def main() -> int:
     parser.add_argument("--max-new", type=int, default=64)
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--prompt-len", type=int, default=48)
+    parser.add_argument("--draft", default=None,
+                        help="also bench continuous speculative with "
+                             "this draft model (vocab must match)")
+    parser.add_argument("--spec-k", type=int, default=4)
     parser.add_argument("--quick", action="store_true",
                         help="tiny load (CPU smoke of the harness)")
     args = parser.parse_args()
@@ -155,6 +161,12 @@ def main() -> int:
         ("paged-int8", dict(slots=args.slots, kv="paged",
                             quantize="int8")),
     ]
+    if args.draft:
+        # Continuous speculative (r4): ragged per-row acceptance over
+        # the slot pool. Greedy-only engine; the drive() load is
+        # already greedy (no temperature), so the same workload runs.
+        configs.append(("dense-spec", dict(
+            slots=args.slots, draft_model=args.draft, spec_k=args.spec_k)))
     results = [run_config(name, args.model, prompts, args.max_new,
                           args.clients, **kw)
                for name, kw in configs]
