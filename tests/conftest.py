@@ -53,6 +53,8 @@ SMOKE_NODES = (
     "test_parallel.py::TestRules",
     "test_parallel.py::TestBootstrap::test_env_contract",
     "test_runtime.py::TestData",
+    "test_runtime.py::TestLmTextPacked::"
+    "test_segments_follow_document_boundaries",
     "test_runtime.py::TestTrainLoop::test_loss_decreases",
     "test_serving.py::TestServing::test_health_and_models",
     "test_serving.py::TestServing::test_generate_shapes_and_determinism",
