@@ -75,20 +75,26 @@ class _Handler(BaseHTTPRequestHandler):
     def _auth_enabled(self) -> bool:
         return bool(self.auth_token or self.owner_tokens)
 
-    def _caller(self) -> Optional[str]:
+    def _caller(self, query_token: Optional[str] = None) -> Optional[str]:
         """``"*"`` for the admin secret, the owner name for a per-owner
         token, ``None`` for no credentials. Unknown tokens are 401 —
         constant-time compares so the check can't leak secret prefixes.
+        ``query_token``: header-less fallback used by the SSE log route
+        ONLY — the browser EventSource API cannot set headers.
         """
         if not self._auth_enabled:
             return "*"  # open server: any credentials are ignored
         header = self.headers.get("Authorization", "")
         if not header.startswith("Bearer "):
-            return None
+            if not query_token:
+                return None
+            raw = query_token
+        else:
+            raw = header[len("Bearer "):]
         # Compare as bytes: compare_digest raises TypeError on
         # non-ASCII str (http.server decodes headers latin-1), which
         # would turn attacker-controlled input into a 500, not a 401.
-        token = header[len("Bearer "):].strip().encode("utf-8", "replace")
+        token = raw.strip().encode("utf-8", "replace")
         if self.auth_token and hmac.compare_digest(
                 token, self.auth_token.encode("utf-8", "replace")):
             return "*"
@@ -200,6 +206,12 @@ class _Handler(BaseHTTPRequestHandler):
                                   else {"slices": [], "gangs": []})
             # /{owner}/{project}/runs...
             if len(rest) >= 3 and rest[2] == "runs":
+                if (caller is None and "token" in query and method == "GET"
+                        and len(rest) >= 6 and rest[4] == "artifacts"):
+                    # <img src>/<a href> loads cannot set headers (same
+                    # constraint as EventSource): artifact-FILE reads
+                    # (only) accept ?token= as the credential.
+                    caller = self._caller(query_token=query["token"][0])
                 self._require(caller, owner=rest[0])
                 return self._runs(method, caller, rest[0], rest[1],
                                   rest[3:], query)
@@ -207,6 +219,10 @@ class _Handler(BaseHTTPRequestHandler):
             rest = parts[2:]
             # /{owner}/{project}/runs/{uuid}/logs
             if len(rest) >= 5 and rest[2] == "runs" and rest[4] == "logs":
+                if caller is None and "token" in query:
+                    # EventSource cannot set headers: the SSE route
+                    # (only) accepts ?token= as the credential.
+                    caller = self._caller(query_token=query["token"][0])
                 self._require(caller, owner=rest[0])
                 return self._logs(caller, rest[3], query)
         raise ApiError(404, f"no route for {method} {'/'.join(parts)}")

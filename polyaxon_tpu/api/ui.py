@@ -129,6 +129,8 @@ DASHBOARD_HTML = r"""<!doctype html>
 <header>
   <h1>polyaxon_tpu</h1>
   <span class="spacer"></span>
+  <input id="tokenBox" type="password" placeholder="API token" hidden
+         aria-label="bearer token for an auth-enabled server">
   <input id="searchBox" type="search" placeholder="filter runs…"
          aria-label="filter runs by name, kind, uuid, or tag">
   <select id="projectFilter" aria-label="project filter">
@@ -175,7 +177,27 @@ const STATUS = {
   failed:    ["var(--status-critical)", "✕"],
 };
 const $ = (sel, el) => (el || document).querySelector(sel);
-const api = (p) => fetch(p).then(r => { if (!r.ok) throw new Error(r.status); return r.json(); });
+// Auth-enabled servers (plx server --auth-token/--owner-token): the
+// token lives in localStorage and rides every fetch; a 401 reveals
+// the header's token box so the dashboard is usable without curl.
+const getToken = () => localStorage.getItem("plx_token") || "";
+const OWNER = localStorage.getItem("plx_owner") || "default";
+const base = (project) => `/api/v1/${encodeURIComponent(OWNER)}/${encodeURIComponent(project || "default")}`;
+// Header-less browser loads (img/a/EventSource) carry the credential
+// as ?token= — the server accepts it on the artifacts + SSE routes.
+const tokenQS = (sep) => getToken()
+  ? `${sep}token=${encodeURIComponent(getToken())}` : "";
+const api = (p) => fetch(p, getToken()
+    ? {headers: {Authorization: `Bearer ${getToken()}`}} : {})
+  .then(r => {
+    if (r.status === 401) {
+      const box = $("#tokenBox");
+      if (box) box.hidden = false;
+      throw new Error("401 (set the API token, top right)");
+    }
+    if (!r.ok) throw new Error(r.status);
+    return r.json();
+  });
 // All user-controlled strings (run names, projects, metric names) go
 // through esc() before any innerHTML interpolation — stored XSS guard.
 const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
@@ -230,10 +252,18 @@ async function loadRuns() {
   const project = projSel.value || "default";
   try {
     const data = await api(
-      `/api/v1/default/${encodeURIComponent(project)}/runs${q}`);
+      `${base(project)}/runs${q}`);
     lastRows = data.results || [];
   } catch (e) {
-    return;  // transient failure: keep the last good table on screen
+    // 401 already revealed the token box; a 403 means the credential
+    // does not cover this owner path — surface it instead of showing
+    // a silently empty table ("owner:token" scopes the dashboard).
+    if (String(e.message).startsWith("403")) {
+      const box = $("#tokenBox");
+      box.hidden = false;
+      box.placeholder = "owner:token (403 for this owner)";
+    }
+    return;  // otherwise transient: keep the last good table on screen
   }
   renderRuns();
   renderSlices();
@@ -476,7 +506,7 @@ function imageCard(uuid, name, ev) {
   // URL-encode each path segment (names may carry spaces/#/%), then
   // HTML-escape for the attribute context.
   const rel = String(ev.path).split("/").map(encodeURIComponent).join("/");
-  const src = esc(`/api/v1/default/default/runs/${encodeURIComponent(uuid)}/artifacts/${rel}`);
+  const src = esc(`${base()}/runs/${encodeURIComponent(uuid)}/artifacts/${rel}${tokenQS('?')}`);
   return `<div class="chart">
     <h3>${esc(name)}</h3>
     <div class="sub">image${ev.step != null ? ` · step ${ev.step}` : ""}</div>
@@ -494,7 +524,7 @@ function fmtSize(n) {
 
 function artUrl(uuid, rel) {
   const enc = String(rel).split("/").map(encodeURIComponent).join("/");
-  return `/api/v1/default/default/runs/${encodeURIComponent(uuid)}/artifacts/${enc}`;
+  return `${base()}/runs/${encodeURIComponent(uuid)}/artifacts/${enc}${tokenQS('?')}`;
 }
 
 function artifactsPanel(uuid, lineage, files) {
@@ -573,7 +603,7 @@ async function compareRuns() {
   stopDetailTimers();
   const fetched = await Promise.all(sel.map(async r => ({
     ...r,
-    metrics: await api(`/api/v1/default/default/runs/${r.uuid}/metrics`).catch(() => ({})),
+    metrics: await api(`${base()}/runs/${r.uuid}/metrics`).catch(() => ({})),
   })));
   if (gen !== renderGen) return;  // user navigated mid-fetch
   const names = [...new Set(fetched.flatMap(f => Object.keys(f.metrics)))].sort();
@@ -644,7 +674,7 @@ async function sweepView(run) {
   // Hyperband bracket / rung visualization: children grouped by
   // (bracket, rung) with live trial statuses and observed metric.
   const children = (await api(
-    `/api/v1/default/default/runs?pipeline=${encodeURIComponent(run.uuid)}`
+    `${base()}/runs?pipeline=${encodeURIComponent(run.uuid)}`
   ).catch(() => ({results: []}))).results || [];
   if (!children.length) return "";
   const metricName = run.spec?.matrix?.metric?.name;
@@ -652,7 +682,7 @@ async function sweepView(run) {
   const outputs = await Promise.all(children.map(async c => {
     if (outputsCache.has(c.uuid)) return outputsCache.get(c.uuid);
     const out = await api(
-      `/api/v1/default/default/runs/${c.uuid}/outputs`).catch(() => ({}));
+      `${base()}/runs/${c.uuid}/outputs`).catch(() => ({}));
     if (TERMINAL.has(c.status)) outputsCache.set(c.uuid, out);
     return out;
   }));
@@ -721,7 +751,7 @@ async function dagView(run) {
   const ops = run.spec?.component?.run?.operations || [];
   if (!ops.length) return "";
   const children = (await api(
-    `/api/v1/default/default/runs?pipeline=${encodeURIComponent(run.uuid)}`
+    `${base()}/runs?pipeline=${encodeURIComponent(run.uuid)}`
   ).catch(() => ({results: []}))).results || [];
   const byName = new Map(children.map(c => [c.name, c]));
   // Longest-path layering (deps are validated acyclic at submit).
@@ -800,10 +830,10 @@ async function showRun(uuid, opts) {
   const gen = ++renderGen;
   stopDetailTimers();
   const [run, metrics, images, hists] = await Promise.all([
-    api(`/api/v1/default/default/runs/${uuid}`),
-    api(`/api/v1/default/default/runs/${uuid}/metrics`).catch(() => ({})),
-    api(`/api/v1/default/default/runs/${uuid}/events?kind=image`).catch(() => ({})),
-    api(`/api/v1/default/default/runs/${uuid}/events?kind=histogram`).catch(() => ({})),
+    api(`${base()}/runs/${uuid}`),
+    api(`${base()}/runs/${uuid}/metrics`).catch(() => ({})),
+    api(`${base()}/runs/${uuid}/events?kind=image`).catch(() => ({})),
+    api(`${base()}/runs/${uuid}/events?kind=histogram`).catch(() => ({})),
   ]);
   const isSweep = run.kind === "matrix";
   const isDag = run.kind === "dag";
@@ -812,8 +842,8 @@ async function showRun(uuid, opts) {
   // for pipelines (their artifacts live in child runs) so the 5 s live
   // rerender loop doesn't re-walk the tree forever.
   const [lineage, files] = isPipeline ? [[], []] : await Promise.all([
-    api(`/api/v1/default/default/runs/${uuid}/lineage`).catch(() => []),
-    api(`/api/v1/default/default/runs/${uuid}/artifacts?detail=1`).catch(() => []),
+    api(`${base()}/runs/${uuid}/lineage`).catch(() => []),
+    api(`${base()}/runs/${uuid}/artifacts?detail=1`).catch(() => []),
   ]);
   const sweep = isSweep ? await sweepView(run)
     : isDag ? await dagView(run) : "";
@@ -838,7 +868,9 @@ async function showRun(uuid, opts) {
   wireRunChips(detail);
   if (!isPipeline) {
     const logs = $("#logs");
-    logSource = new EventSource(`/streams/v1/default/default/runs/${uuid}/logs?follow=true`);
+    // EventSource cannot set headers; the SSE route accepts ?token=.
+    const tok = getToken() ? `&token=${encodeURIComponent(getToken())}` : "";
+    logSource = new EventSource(`/streams/v1/${encodeURIComponent(OWNER)}/default/runs/${uuid}/logs?follow=true${tok}`);
     logSource.onmessage = (ev) => { logs.textContent += ev.data + "\n"; logs.scrollTop = logs.scrollHeight; };
     logSource.addEventListener("done", () => { logSource.close(); logSource = null; });
   } else if (!TERMINAL.has(run.status)) {
@@ -849,6 +881,23 @@ async function showRun(uuid, opts) {
 }
 
 $("#refresh").onclick = loadRuns;
+$("#tokenBox").onchange = () => {
+  const v = $("#tokenBox").value.trim();
+  // "owner:token" scopes the dashboard to that owner's paths (scoped
+  // credentials are path-isolated); a bare value is the admin token.
+  const sep = v.indexOf(":");
+  if (sep > 0) {
+    localStorage.setItem("plx_owner", v.slice(0, sep));
+    localStorage.setItem("plx_token", v.slice(sep + 1));
+  } else if (v) {
+    localStorage.removeItem("plx_owner");
+    localStorage.setItem("plx_token", v);
+  } else {
+    localStorage.removeItem("plx_owner");
+    localStorage.removeItem("plx_token");
+  }
+  location.reload();  // reinitialize every surface (incl. SSE streams)
+};
 $("#statusFilter").onchange = loadRuns;
 $("#projectFilter").onchange = loadRuns;
 $("#searchBox").oninput = () => {  // debounced; no network round-trip

@@ -608,6 +608,69 @@ class TestAuth:
         bob = PolyaxonClient(server.url, owner="bob", token="tk-bob")
         assert bob.list_runs() == []
 
+    def test_sse_query_token_on_logs_route_only(self, auth_stack):
+        """EventSource cannot set headers, so the SSE log route (and
+        only it) accepts ?token=; every other route ignores the query
+        credential and still requires the header."""
+        import urllib.error
+        import urllib.request
+
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        mine = alice.post("/api/v1/alice/default/runs",
+                          body={"content": TRIAL, "params": {"lr": 0.1}})
+        base = (f"{server.url}/streams/v1/alice/default/runs/"
+                f"{mine['uuid']}/logs")
+        with urllib.request.urlopen(f"{base}?token=tk-alice",
+                                    timeout=10) as r:
+            assert r.status == 200
+        for url, code in (
+                (f"{base}?token=wrong", 401),
+                (f"{base}?token=tk-bob", 403),  # valid token, not alice
+                (f"{server.url}/api/v1/alice/default/runs?token=tk-alice",
+                 401),  # non-SSE routes never read the query credential
+        ):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=10)
+            assert err.value.code == code, url
+
+    def test_artifact_files_accept_query_token(self, auth_stack):
+        """<img src>/<a href> loads cannot set headers either: artifact
+        FILE reads accept ?token=; the artifacts LISTING (an api()
+        fetch) still requires the header."""
+        import urllib.error
+        import urllib.request
+
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        mine = alice.post("/api/v1/alice/default/runs",
+                          body={"content": TRIAL, "params": {"lr": 0.3}})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            arts = alice.get(
+                f"/api/v1/alice/default/runs/{mine['uuid']}/artifacts")
+            if any("score" in a for a in arts):
+                break
+            time.sleep(0.2)
+        rel = next(a for a in arts if "score" in a)
+        url = (f"{server.url}/api/v1/alice/default/runs/{mine['uuid']}"
+               f"/artifacts/{rel}")
+        with urllib.request.urlopen(f"{url}?token=tk-alice",
+                                    timeout=10) as r:
+            assert b"value" in r.read()
+        for bad, code in ((f"{url}?token=wrong", 401),
+                          (f"{url}?token=tk-bob", 403),
+                          (f"{url}", 401)):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(bad, timeout=10)
+            assert err.value.code == code, bad
+        # The listing route ignores the query credential.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"{server.url}/api/v1/alice/default/runs/{mine['uuid']}"
+                f"/artifacts?token=tk-alice", timeout=10)
+        assert err.value.code == 401
+
     def test_logs_route_scoped(self, auth_stack):
         _, server = auth_stack
         alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
