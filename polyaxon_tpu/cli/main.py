@@ -332,6 +332,60 @@ def ops_metrics(uid, names):
     click.echo(json.dumps(metrics, indent=2, default=str))
 
 
+@ops.command("compare")
+@click.argument("uids", nargs=-1, required=True)
+@click.option("--metric", "metric_names", multiple=True,
+              help="metric(s) to tabulate (default: the union across "
+                   "the runs; absent values print '-')")
+def ops_compare(uids, metric_names):
+    """Side-by-side comparison of N runs — the CLI twin of the
+    dashboard's compare view: final value of each metric per run, plus
+    the params whose values DIFFER across the selection."""
+    if len(uids) < 2:
+        raise click.BadParameter("give at least two run uuids")
+    plane = get_plane()
+    records = [get_run_or_fail(plane, uid) for uid in uids]
+    labels = [r.name or r.uuid[:12] for r in records]
+
+    def vals_of(record):
+        out = {}
+        for key, value in (record.params or {}).items():
+            if isinstance(value, dict) and "value" in value:
+                value = value["value"]
+            out[key] = value
+        out.update((record.meta or {}).get("trial_params") or {})
+        return out
+
+    per_run = [vals_of(r) for r in records]
+    keys = sorted({k for vals in per_run for k in vals})
+    differing = [k for k in keys
+                 if len({json.dumps(v.get(k), sort_keys=True, default=str)
+                         for v in per_run}) > 1]
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        return f"{v:.6g}" if isinstance(v, float) else str(v)
+
+    width = max([len(x) for x in labels] + [12])
+    header = "  ".join(f"{name:>{width}}" for name in labels)
+    click.echo(f"  {'':>20s}  {header}")
+    if differing:
+        click.echo("differing params:")
+        for k in differing:
+            cells = "  ".join(f"{fmt(v.get(k)):>{width}}" for v in per_run)
+            click.echo(f"  {k:>20s}  {cells}")
+    all_metrics = metric_names or sorted(
+        set().union(*[plane.streams.metric_names(r.uuid) for r in records]))
+    if all_metrics:
+        click.echo("final metrics:")
+        for name in all_metrics:
+            row = [fmt(plane.streams.last_metric(r.uuid, name))
+                   for r in records]
+            cells = "  ".join(f"{v:>{width}}" for v in row)
+            click.echo(f"  {name:>20s}  {cells}")
+
+
 @ops.command("events")
 @click.option("-uid", "--uid", required=True)
 @click.option("--kind", default="metric",

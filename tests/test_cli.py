@@ -242,3 +242,55 @@ class TestConvert:
             "--from-hf", str(tmp_path), "--out", str(tmp_path / "o")])
         assert result.exit_code != 0
         assert "llama-family" in result.output
+
+
+class TestOpsCompare:
+    def test_compare_params_and_final_metrics(self, runner, tmp_path,
+                                              monkeypatch):
+        """`plx ops compare A B`: differing params + final metric per
+        run, side by side — the CLI twin of the dashboard compare."""
+        import textwrap
+
+        from polyaxon_tpu.agent import Agent
+        from polyaxon_tpu.cli.main import get_plane
+
+        script = textwrap.dedent(
+            """
+            import json, os
+            d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+            os.makedirs(d + "/events/metric", exist_ok=True)
+            score = (float(os.environ["LR"]) - 0.3) ** 2
+            with open(d + "/events/metric/score.jsonl", "a") as fh:
+                fh.write(json.dumps({"step": 1, "value": score}) + "\\n")
+            """
+        ).strip()
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        plane = get_plane()
+        component = {
+            "kind": "component", "name": "t",
+            "inputs": [{"name": "lr", "type": "float", "toEnv": "LR"},
+                       {"name": "fixed", "type": "int", "value": 7,
+                        "isOptional": True}],
+            "run": {"kind": "job",
+                    "container": {"command": ["python", "-c", script]}},
+        }
+        agent = Agent(plane)
+        a = plane.submit(component, params={"lr": 0.1}, name="run-a")
+        b = plane.submit(component, params={"lr": 0.5}, name="run-b")
+        agent.run_until_done(a.uuid, timeout=60)
+        agent.run_until_done(b.uuid, timeout=60)
+
+        result = runner.invoke(cli, ["ops", "compare", a.uuid, b.uuid])
+        assert result.exit_code == 0, result.output
+        out = result.output
+        # lr differs and is tabulated; `fixed` is identical -> omitted.
+        assert "lr" in out and "fixed" not in out
+        assert "0.1" in out and "0.5" in out
+        # Final metric values per run: (0.1-0.3)^2 and (0.5-0.3)^2.
+        assert "0.04" in out and "score" in out
+        assert "run-a" in out and "run-b" in out
+
+    def test_compare_needs_two_runs(self, runner, tmp_path, monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        result = runner.invoke(cli, ["ops", "compare", "deadbeef"])
+        assert result.exit_code != 0

@@ -184,6 +184,36 @@ class TestContinuousSpeculative:
         assert stats["spec_tokens_per_round"] > 1.5, stats
         assert stats["draft_model"] == "llama_tiny"
 
+    def test_moe_target_with_dense_draft(self):
+        """Mixtral-style continuous target speculated by a dense llama
+        draft (the realistic pairing): lossless vs the plain
+        continuous engine."""
+        import jax
+
+        from polyaxon_tpu.models import llama, moe
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        cfg = moe.CONFIGS["moe_tiny"]
+        params = moe.init(cfg, jax.random.key(0))["params"]
+        dcfg = llama.CONFIGS["llama_tiny"]
+        dparams = llama.init(dcfg, jax.random.key(1))["params"]
+        prompts = [[5, 6, 7], [1, 2, 3, 4]]
+
+        plain = ContinuousBatchingEngine("moe_tiny", cfg, params, slots=2)
+        try:
+            want = [plain.submit(p, 7).wait(timeout=300) for p in prompts]
+        finally:
+            plain.stop()
+        engine = ContinuousBatchingEngine(
+            "moe_tiny", cfg, params, slots=2,
+            draft=("llama_tiny", dcfg, dparams, 3))
+        try:
+            got = [r.wait(timeout=300)
+                   for r in [engine.submit(p, 7) for p in prompts]]
+        finally:
+            engine.stop()
+        assert got == want
+
     def test_sampled_request_refused(self):
         engine, _, _ = self._engine()
         try:
