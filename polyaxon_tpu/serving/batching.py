@@ -60,6 +60,11 @@ class _Request:
     seed: int
     top_p: float = 1.0
     top_k: int = 0
+    # Early stop: generation retires at the first of these token ids
+    # (the stop token IS included in the output — callers that want it
+    # dropped slice it off; including it keeps losslessness trivially
+    # comparable across engines).
+    eos: frozenset = frozenset()
     out: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None
@@ -445,9 +450,11 @@ class ContinuousBatchingEngine:
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
-               top_p: float = 1.0, top_k: int = 0) -> _Request:
+               top_p: float = 1.0, top_k: int = 0,
+               eos_tokens=None) -> _Request:
         self._validate(tokens, max_new_tokens)
         validate_sampling(top_p, top_k)
+        eos = frozenset(int(t) for t in (eos_tokens or ()))
         if self.draft is not None and temperature > 0:
             raise ValueError(
                 "this engine speculates with a draft model, which is "
@@ -455,7 +462,7 @@ class ContinuousBatchingEngine:
                 "argmax); send temperature=0 or serve without "
                 "--draft-model for sampling")
         req = _Request(list(tokens), max_new_tokens, float(temperature),
-                       int(seed), float(top_p), int(top_k))
+                       int(seed), float(top_p), int(top_k), eos)
         with self._cv:
             if self._stopped:
                 raise RuntimeError("engine stopped")
@@ -479,7 +486,8 @@ class ContinuousBatchingEngine:
     def generate(self, token_rows: list[list[int]], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
                  top_p: float = 1.0, top_k: int = 0,
-                 timeout: Optional[float] = None) -> list[list[int]]:
+                 timeout: Optional[float] = None,
+                 eos_tokens=None) -> list[list[int]]:
         if not token_rows:
             return []
         # Validate the whole batch before submitting ANY row — same
@@ -488,7 +496,7 @@ class ContinuousBatchingEngine:
         for row in token_rows:
             self._validate(row, max_new_tokens)
         reqs = [self.submit(row, max_new_tokens, temperature, seed + i,
-                            top_p, top_k)
+                            top_p, top_k, eos_tokens=eos_tokens)
                 for i, row in enumerate(token_rows)]
         try:
             return [r.wait(timeout=timeout) for r in reqs]
@@ -826,10 +834,20 @@ class ContinuousBatchingEngine:
                 continue
             n = int(emit[b])
             self._spec_tokens += n
-            req.out.extend(int(tok) for tok in t[b, :n])
+            fresh = [int(tok) for tok in t[b, :n]]
+            hit = next((j for j, tok in enumerate(fresh)
+                        if tok in req.eos), None)
+            if hit is not None:
+                # Stop at the eos (inclusive): the accepted tokens past
+                # it are the target's real greedy continuation, but the
+                # request asked to stop — drop them. Cache/pos state
+                # past the retire point is irrelevant (the row is
+                # replaced wholesale at the next admission).
+                fresh = fresh[:hit + 1]
+            req.out.extend(fresh)
             self._pos[b] += n
             self._cur[b] = int(cur_nxt[b])
-            if len(req.out) >= req.max_new:
+            if len(req.out) >= req.max_new or hit is not None:
                 self._retire(b)
         return True
 
@@ -915,7 +933,7 @@ class ContinuousBatchingEngine:
                 req.out.append(int(nxt[b]))
                 self._pos[b] += 1
                 self._cur[b] = int(nxt[b])
-                if len(req.out) >= req.max_new:
+                if len(req.out) >= req.max_new or int(nxt[b]) in req.eos:
                     self._retire(b)
                 elif (self._pool is not None
                       and not self._pool.ensure(b, int(self._pos[b]))):

@@ -287,9 +287,11 @@ class _Engine:
 
     def generate(self, token_rows: list[list[int]], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
-                 top_p: float = 1.0, top_k: int = 0) -> list[list[int]]:
+                 top_p: float = 1.0, top_k: int = 0,
+                 eos_tokens=None) -> list[list[int]]:
         if not token_rows:
             return []
+        eos = frozenset(int(t) for t in (eos_tokens or ()))
         # Validate every row before running any (no TPU work is spent
         # on a batch that will be rejected).
         for row in token_rows:
@@ -322,10 +324,20 @@ class _Engine:
                                         jnp.float32(top_p),
                                         jnp.int32(top_k)))
             for j, i in enumerate(idxs):
-                results[i] = out[j, :max_new_tokens].tolist()
+                row_out = out[j, :max_new_tokens].tolist()
+                if eos:
+                    # Whole-budget program, host truncation: stop at
+                    # the first eos (inclusive — same convention as the
+                    # continuous engine's early retire).
+                    hit = next((jj for jj, tok in enumerate(row_out)
+                                if tok in eos), None)
+                    if hit is not None:
+                        row_out = row_out[:hit + 1]
+                results[i] = row_out
         with self._lock:  # ThreadingHTTPServer: += on ints is not atomic
             self._served += len(token_rows)
-            self._tokens_out += max_new_tokens * len(token_rows)
+            self._tokens_out += sum(
+                len(r) for r in results if r is not None)
         return results  # type: ignore[return-value]
 
     def stats(self) -> dict:
@@ -477,13 +489,24 @@ class _Handler(BaseHTTPRequestHandler):
             top_p = float(req.get("top_p", 1.0))
             top_k = int(req.get("top_k", 0))
             validate_sampling(top_p, top_k)
+            eos_tokens = req.get("eos_tokens")
+            if eos_tokens is None and "eos_token" in req:
+                eos_tokens = [req["eos_token"]]
+            if eos_tokens is not None:
+                if (not isinstance(eos_tokens, list)
+                        or not all(isinstance(t, int)
+                                   and not isinstance(t, bool)
+                                   for t in eos_tokens)):
+                    raise ValueError(
+                        "`eos_tokens` must be a list of token ids")
             if req.get("stream"):
                 return self._stream_generate(tokens, max_new, temperature,
-                                             seed, top_p, top_k)
+                                             seed, top_p, top_k,
+                                             eos_tokens=eos_tokens)
             out = self.engine.generate(
                 tokens, max_new_tokens=max_new,
                 temperature=temperature, seed=seed,
-                top_p=top_p, top_k=top_k)
+                top_p=top_p, top_k=top_k, eos_tokens=eos_tokens)
             return self._json({"tokens": out})
         except (KeyError, ValueError, TypeError) as exc:
             return self._json({"error": str(exc)}, status=400)
@@ -501,7 +524,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_generate(self, token_rows, max_new: int, temperature: float,
                          seed: int, top_p: float = 1.0,
-                         top_k: int = 0) -> None:
+                         top_k: int = 0, eos_tokens=None) -> None:
         """SSE token streaming. With the continuous engine, per-token
         events flow as rows decode (the handler polls each request's
         growing output — appends are GIL-atomic); the static engine
@@ -524,7 +547,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if hasattr(self.engine, "submit"):
                 reqs = [self.engine.submit(row, max_new, temperature,
-                                           seed + i, top_p, top_k)
+                                           seed + i, top_p, top_k,
+                                           eos_tokens=eos_tokens)
                         for i, row in enumerate(token_rows)]
                 emitted = [0] * len(reqs)
                 while True:
@@ -548,7 +572,7 @@ class _Handler(BaseHTTPRequestHandler):
                 out = self.engine.generate(
                     token_rows, max_new_tokens=max_new,
                     temperature=temperature, seed=seed,
-                    top_p=top_p, top_k=top_k)
+                    top_p=top_p, top_k=top_k, eos_tokens=eos_tokens)
                 for i, row in enumerate(out):
                     for tok in row:
                         self._sse({"index": i, "token": tok})

@@ -1089,3 +1089,58 @@ class TestChunkedPrefill:
                                      kv="paged", prefill_chunk=8)
         with pytest.raises(ValueError, match="continuous"):
             ServingServer("llama_tiny", prefill_chunk=8)
+
+
+class TestEosStop:
+    """Per-request early stop: generation retires at the first of the
+    request's eos_tokens (inclusive), on every engine."""
+
+    def _expect(self, full, eos_set):
+        hit = next((i for i, t in enumerate(full) if t in eos_set), None)
+        return full if hit is None else full[:hit + 1]
+
+    def test_static_engine_truncates_at_eos(self, server):
+        full = _post(server.url,
+                     {"tokens": [[5, 6, 7]], "max_new_tokens": 9}
+                     )["tokens"][0]
+        eos = full[3]  # guaranteed to occur
+        got = _post(server.url, {"tokens": [[5, 6, 7]],
+                                 "max_new_tokens": 9,
+                                 "eos_token": eos})["tokens"][0]
+        assert got == self._expect(full, {eos})
+        assert len(got) < 9
+
+    def test_continuous_engines_truncate_at_eos(self):
+        import jax
+
+        from polyaxon_tpu.models import llama
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        cfg = llama.CONFIGS["llama_tiny"]
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompts = [[5, 6, 7], [1, 2, 3, 4]]
+        plain = ContinuousBatchingEngine("llama_tiny", cfg, params, slots=2)
+        try:
+            full = [plain.submit(p, 10).wait(timeout=300) for p in prompts]
+        finally:
+            plain.stop()
+        eos = full[0][2]
+        for draft in (None, ("llama_tiny", cfg, params, 3)):
+            engine = ContinuousBatchingEngine(
+                "llama_tiny", cfg, params, slots=2, draft=draft)
+            try:
+                got = [engine.submit(p, 10, eos_tokens=[eos])
+                       .wait(timeout=300) for p in prompts]
+            finally:
+                engine.stop()
+            label = "spec" if draft else "plain"
+            for g, f in zip(got, full):
+                assert g == self._expect(f, {eos}), (label, g, f)
+
+    def test_bad_eos_rejected(self, server):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server.url, {"tokens": [[1, 2]], "max_new_tokens": 4,
+                               "eos_tokens": ["nope"]})
+        assert err.value.code == 400
