@@ -132,6 +132,49 @@ class TestNorthStar:
         assert min(steps_by_rung[max(rungs)]) > min(steps_by_rung[0])
 
 
+
+    def test_sweep_best_trial_serves(self, plane, monkeypatch):
+        """The COMPOSED product loop the north star describes: tune →
+        pick the best trial by its metric → serve that trial's own
+        checkpoint. No GPU, no user code anywhere in the chain."""
+        import copy
+        import json as _json
+        import os
+        import urllib.request
+
+        from polyaxon_tpu.serving import ServingServer
+
+        sweep = copy.deepcopy(HYPERBAND_SWEEP)
+        runtime = sweep["component"]["run"]["runtime"]
+        runtime["log_every"] = 1
+        sweep["component"]["run"]["checkpointing"] = {
+            "enabled": True, "intervalSteps": 1, "asyncSave": False}
+        record = plane.submit(sweep)
+        agent = Agent(plane, max_concurrent=2, in_process=True)
+        assert agent.run_until_done(record.uuid,
+                                    timeout=600) == V1Statuses.SUCCEEDED
+
+        trials = plane.list_runs(pipeline_uuid=record.uuid)
+        scored = [(plane.get_metric(t.uuid, "loss"), t)
+                  for t in trials if t.status == V1Statuses.SUCCEEDED]
+        scored = [(v, t) for v, t in scored if v is not None]
+        assert scored, "no succeeded trial carries the sweep metric"
+        best = min(scored, key=lambda vt: vt[0])[1]
+
+        ckpt = os.path.join(plane.run_artifacts_dir(best.uuid),
+                            "checkpoints")
+        assert os.path.isdir(ckpt), "best trial left no checkpoint"
+        with ServingServer("llama_tiny", ckpt) as server:
+            req = urllib.request.Request(
+                server.url + "/v1/generate", method="POST",
+                data=_json.dumps({"tokens": [[5, 6, 7]],
+                                  "max_new_tokens": 5}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = _json.load(resp)
+        assert len(out["tokens"][0]) == 5
+
+
 class TestEstimate:
     def test_bench_estimate_contract(self):
         """bench.py --estimate: the roofline/MFU-transfer projection
