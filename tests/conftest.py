@@ -26,6 +26,14 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # collective rendezvous until the 40s watchdog hard-aborts the whole
 # pytest process). Reproduced deterministically on cache hits of the
 # dp2xfsdp4 checkpoint tests, 2026-07-30.
+#
+# NOTE 2: run the FULL suite via `scripts/ci.sh --full` (one pytest
+# process per module), not one `pytest tests/` process. Hour-long
+# single-process runs intermittently segfault inside XLA:CPU's native
+# compiler (backend_compile_and_load, faulthandler stack in the jax
+# compile path; observed 2026-07-31 twice, with 120+ GB free — flaky
+# and not correlated with any particular test; the same modules pass
+# in fresh processes). Per-module processes bound the blast radius.
 
 import pytest  # noqa: E402
 
