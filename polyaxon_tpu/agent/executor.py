@@ -72,7 +72,9 @@ class LocalExecutor:
         os.makedirs(plan.outputs_dir, exist_ok=True)
         os.makedirs(os.path.join(plan.artifacts_dir, "logs"), exist_ok=True)
         for phase in plan.init:
-            if phase.kind == "auth":
+            if phase.kind == "build":
+                self._init_build(plan, phase)
+            elif phase.kind == "auth":
                 with open(os.path.join(plan.artifacts_dir, ".auth"), "w") as fh:
                     json.dump({"run_uuid": plan.run_uuid, "mode": "local"}, fh)
             elif phase.kind == "artifacts":
@@ -122,6 +124,33 @@ class LocalExecutor:
                 with open(os.path.join(plan.artifacts_dir, "tpu-metadata.json"), "w") as fh:
                     json.dump({"coordinator": "127.0.0.1", "topology": "local"}, fh)
             # dockerfile needs docker: recorded, skipped locally.
+
+    def _init_build(self, plan: V1LaunchPlan, phase) -> None:
+        """Execute the compiled ``build:`` section (upstream gates the
+        main run on a separate build run; here the builder's command
+        runs as the FIRST init phase, so a build failure fails the run
+        with its log before any main process starts). Output lands in
+        ``logs/build.log`` next to the main-process logs."""
+        cmd = phase.config.get("command") or []
+        if not cmd:
+            raise RuntimeError("build init phase has no command")
+        env = dict(os.environ)
+        env.update(phase.config.get("env") or {})
+        log_path = os.path.join(plan.artifacts_dir, "logs", "build.log")
+        with open(log_path, "ab") as log_handle:
+            proc = subprocess.run(
+                [str(c) for c in cmd], env=env, cwd=plan.artifacts_dir,
+                stdout=log_handle, stderr=subprocess.STDOUT, timeout=3600)
+        if proc.returncode != 0:
+            tail = ""
+            try:
+                with open(log_path, "rb") as fh:
+                    tail = fh.read()[-400:].decode(errors="replace")
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"build `{phase.config.get('hubRef')}` failed "
+                f"rc={proc.returncode}: {tail}")
 
     def _init_git(self, plan: V1LaunchPlan, phase) -> None:
         """Git initializer (upstream init.git): clone url@revision into the

@@ -30,6 +30,7 @@ from __future__ import annotations
 import hmac
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -75,6 +76,45 @@ class _Handler(BaseHTTPRequestHandler):
     def _auth_enabled(self) -> bool:
         return bool(self.auth_token or self.owner_tokens)
 
+    # Derived stream tokens (ADVICE r4 #3): ?token= URLs land in
+    # reverse-proxy logs, browser history, and Referer headers, so the
+    # header-less routes (SSE/EventSource, <img> artifact loads) should
+    # never carry a long-lived primary secret. /api/v1/stream-token
+    # (header-auth) mints an HMAC-derived credential with a short TTL;
+    # the dashboard uses those in URLs and only ever sends the primary
+    # in an Authorization header. Primary tokens are still accepted in
+    # the query for curl-style use — the mint is the browser fix, not a
+    # protocol break.
+    STREAM_TOKEN_TTL = 300
+
+    def _stream_key(self, caller: str) -> Optional[str]:
+        return (self.auth_token if caller == "*"
+                else self.owner_tokens.get(caller))
+
+    def _mint_stream_token(self, caller: str) -> str:
+        key = self._stream_key(caller)
+        if not key:
+            raise ApiError(400, "no primary token to derive from")
+        exp = int(time.time()) + self.STREAM_TOKEN_TTL
+        msg = f"st:{caller}:{exp}"
+        sig = hmac.new(key.encode(), msg.encode(), "sha256").hexdigest()
+        return f"{msg}:{sig}"
+
+    def _verify_stream_token(self, raw: str) -> str:
+        parts = raw.split(":")
+        # st:{caller}:{exp}:{sig} — caller may itself contain ':'.
+        caller, exp_s, sig = ":".join(parts[1:-2]), parts[-2], parts[-1]
+        key = self._stream_key(caller)
+        if not key or not exp_s.isdigit():
+            raise ApiError(401, "invalid token")
+        msg = f"st:{caller}:{exp_s}"
+        want = hmac.new(key.encode(), msg.encode(), "sha256").hexdigest()
+        if not hmac.compare_digest(sig.encode(), want.encode()):
+            raise ApiError(401, "invalid token")
+        if int(exp_s) < time.time():
+            raise ApiError(401, "stream token expired")
+        return caller
+
     def _caller(self, query_token: Optional[str] = None) -> Optional[str]:
         """``"*"`` for the admin secret, the owner name for a per-owner
         token, ``None`` for no credentials. Unknown tokens are 401 —
@@ -88,6 +128,8 @@ class _Handler(BaseHTTPRequestHandler):
         if not header.startswith("Bearer "):
             if not query_token:
                 return None
+            if query_token.startswith("st:") and query_token.count(":") >= 3:
+                return self._verify_stream_token(query_token)
             raw = query_token
         else:
             raw = header[len("Bearer "):]
@@ -194,6 +236,13 @@ class _Handler(BaseHTTPRequestHandler):
             rest = parts[2:]
             if rest == ["version"]:
                 return self._json({"version": __version__})
+            if rest == ["stream-token"]:
+                # Header auth ONLY (a stream token cannot mint another).
+                self._require(caller)
+                return self._json({
+                    "token": self._mint_stream_token(caller),
+                    "expiresIn": self.STREAM_TOKEN_TTL,
+                })
             if rest == ["projects"]:
                 self._require(caller, admin=True)
                 return self._json(self.plane.store.list_projects())
@@ -347,6 +396,22 @@ class _Handler(BaseHTTPRequestHandler):
             names = query.get("names")
             return self._json(plane.streams.get_events(uuid, kind, names))
         if action == "lineage":
+            if rest[2:] == ["graph"]:
+                # Cross-run inputs → run → outputs graph. Scoped tokens:
+                # the node set is filtered to the caller's own runs so a
+                # graph cannot leak another owner's run names.
+                graph = plane.lineage_graph(uuid)
+                if caller not in (None, "*"):
+                    # Nodes carry their owner stamp — no per-node
+                    # store fetch needed to filter foreign runs out.
+                    visible = {n["uuid"] for n in graph["nodes"]
+                               if n.get("owner") == caller}
+                    graph["nodes"] = [n for n in graph["nodes"]
+                                      if n["uuid"] in visible]
+                    graph["edges"] = [e for e in graph["edges"]
+                                      if e["from"] in visible
+                                      and e["to"] in visible]
+                return self._json(graph)
             return self._json(plane.streams.get_lineage(uuid))
         if action == "outputs":
             return self._json(plane.streams.get_outputs(uuid))

@@ -185,8 +185,27 @@ const OWNER = localStorage.getItem("plx_owner") || "default";
 const base = (project) => `/api/v1/${encodeURIComponent(OWNER)}/${encodeURIComponent(project || "default")}`;
 // Header-less browser loads (img/a/EventSource) carry the credential
 // as ?token= — the server accepts it on the artifacts + SSE routes.
-const tokenQS = (sep) => getToken()
-  ? `${sep}token=${encodeURIComponent(getToken())}` : "";
+// URLs leak into proxy logs/history/Referer, so they get a SHORT-LIVED
+// derived stream token (minted over an authed header call, refreshed
+// before expiry), never the primary secret. Until the first mint
+// resolves, URLs fall back to the primary so nothing breaks.
+let streamTok = "", streamTokExp = 0, streamTokPending = null;
+function refreshStreamToken() {
+  if (!getToken()) return;
+  if (streamTok && Date.now() < streamTokExp - 30000) return;
+  if (streamTokPending) return;
+  streamTokPending = api("/api/v1/stream-token").then(d => {
+    streamTok = d.token;
+    streamTokExp = Date.now() + (d.expiresIn || 300) * 1000;
+  }).catch(() => {}).finally(() => { streamTokPending = null; });
+}
+const tokenQS = (sep) => {
+  if (!getToken()) return "";
+  refreshStreamToken();  // async refill for the NEXT url
+  const t = (streamTok && Date.now() < streamTokExp)
+    ? streamTok : getToken();
+  return `${sep}token=${encodeURIComponent(t)}`;
+};
 const api = (p) => fetch(p, getToken()
     ? {headers: {Authorization: `Bearer ${getToken()}`}} : {})
   .then(r => {
@@ -207,7 +226,8 @@ function wireRunChips(root) {
   // role=button chips navigate on click AND Enter/Space — one wiring
   // for the sweep/bracket chips, DAG nodes, and slice-pool gangs.
   for (const chip of root.querySelectorAll(
-      ".chip[data-uuid], .dagnode[data-uuid]")) {
+      ".chip[data-uuid], .dagnode[data-uuid], .lingnode[data-uuid]")) {
+    if (!chip.dataset.uuid) continue;  // unknown lineage node: inert
     chip.onclick = () => showRun(chip.dataset.uuid);
     chip.onkeydown = (ev) => {
       if (ev.key === "Enter" || ev.key === " ") {
@@ -550,7 +570,13 @@ function artifactsPanel(uuid, lineage, files) {
       preview = `<img src="${esc(artUrl(uuid, rel))}" alt="${label}"
                    style="max-height:72px;border-radius:4px">`;
     } else if (rel && isHtml(rel)) {
-      preview = `<a class="uuid" href="${esc(artUrl(uuid, rel))}" target="_blank">open</a>`;
+      // Inline render, sandboxed twice over: iframe sandbox attr here
+      // plus the server's CSP sandbox header on the artifact route —
+      // run-produced html draws but cannot script or reach the API.
+      preview = `<iframe src="${esc(artUrl(uuid, rel))}" sandbox
+          title="${label}" loading="lazy"
+          style="width:260px;height:120px;border:1px solid var(--axis);border-radius:4px;background:#fff"></iframe>
+        <a class="uuid" href="${esc(artUrl(uuid, rel))}" target="_blank">open</a>`;
     }
     return `<tr><td>${esc(r.kind || "artifact")}</td><td>${link}</td>
       <td class="num">${fmtSize(r.size_bytes)}</td><td>${preview}</td></tr>`;
@@ -568,6 +594,93 @@ function artifactsPanel(uuid, lineage, files) {
     ${fileRows ? `<div style="max-height:220px;overflow:auto;margin-top:8px">
       <table aria-label="artifact files"><tr><th>file</th><th>size</th></tr>${fileRows}</table></div>` : ""}
     ${files.length > MAX_FILES ? `<div class="sub">showing ${MAX_FILES} of ${files.length} files</div>` : ""}
+  </details>`;
+}
+
+function lineageGraphPanel(uuid, graph) {
+  // Cross-run lineage: inputs → run → outputs as a three-column SVG
+  // (upstream runs | this run + its artifact records | downstream
+  // runs). Edge kinds: param ref, dag dependency, join match, cache
+  // adoption. Run nodes navigate like every other chip.
+  if (!graph || !graph.edges) return "";
+  const ups = graph.edges.filter(e => e.to === uuid);
+  const downs = graph.edges.filter(e => e.from === uuid);
+  const arts = (graph.artifacts || []).slice(0, 8);
+  const outs = Object.keys(graph.outputs || {}).slice(0, 8);
+  if (!ups.length && !downs.length && !arts.length && !outs.length) return "";
+  const byId = {};
+  for (const n of graph.nodes || []) byId[n.uuid] = n;
+  const ROW = 34, W = 640, COLW = 200, TOP = 26;
+  // The right column stacks artifacts, outputs, AND downstream runs
+  // sequentially — size for their SUM or the tail clips off the SVG.
+  const rows = Math.max(
+    ups.length, arts.length + outs.length + downs.length, 1);
+  const H = TOP + rows * ROW + 10;
+  const nodeBox = (x, y, n, edge) => {
+    const name = esc((n && (n.name || n.uuid.slice(0, 8))) || "?");
+    const color = n ? (STATUS[n.status] || ["var(--muted)"])[0] : "var(--muted)";
+    const label = edge ? esc(edge.kind + (edge.label ? `:${edge.label}` : "")) : "";
+    return `<g class="lingnode" data-uuid="${esc(n ? n.uuid : "")}" style="cursor:pointer">
+      <rect x="${x}" y="${y}" width="${COLW - 24}" height="24" rx="5"
+        fill="var(--surface-2, rgba(128,128,128,.12))" stroke="${color}"/>
+      <text x="${x + 8}" y="${y + 16}" font-size="11" fill="currentColor">${name}</text>
+      ${label ? `<text x="${x + COLW - 28}" y="${y + 16}" font-size="9" text-anchor="end" fill="var(--muted)">${label}</text>` : ""}
+    </g>`;
+  };
+  const artBox = (x, y, label, kind) => `<g>
+      <rect x="${x}" y="${y}" width="${COLW - 24}" height="24" rx="12"
+        fill="none" stroke="var(--axis)" stroke-dasharray="3 2"/>
+      <text x="${x + 8}" y="${y + 16}" font-size="10" fill="var(--muted)">${esc(kind)}: ${esc(label)}</text>
+    </g>`;
+  const midX = COLW + 20, rightX = 2 * COLW + 40;
+  let svg = "";
+  const midY = TOP + 4;
+  // center: the run itself
+  svg += `<rect x="${midX}" y="${midY}" width="${COLW - 24}" height="24" rx="5"
+      fill="var(--series-1)" opacity="0.15"/>
+    <rect x="${midX}" y="${midY}" width="${COLW - 24}" height="24" rx="5"
+      fill="none" stroke="var(--series-1)"/>
+    <text x="${midX + 8}" y="${midY + 16}" font-size="11" font-weight="600"
+      fill="currentColor">${esc((byId[uuid] || {}).name || uuid.slice(0, 8))}</text>`;
+  ups.forEach((e, i) => {
+    const y = TOP + i * ROW;
+    svg += nodeBox(10, y, byId[e.from], e);
+    svg += `<line x1="${10 + COLW - 24}" y1="${y + 12}" x2="${midX}" y2="${midY + 12}"
+      stroke="var(--axis)" marker-end="url(#lgarrow)"/>`;
+  });
+  // right column: artifacts/outputs first, then downstream runs
+  let ri = 0;
+  arts.forEach((a) => {
+    const y = TOP + ri++ * ROW;
+    svg += artBox(rightX, y, a.name || a.rel_path || "", a.kind || "artifact");
+    svg += `<line x1="${midX + COLW - 24}" y1="${midY + 12}" x2="${rightX}" y2="${y + 12}"
+      stroke="var(--axis)" stroke-dasharray="3 2"/>`;
+  });
+  outs.forEach((k) => {
+    const y = TOP + ri++ * ROW;
+    svg += artBox(rightX, y, k, "output");
+    svg += `<line x1="${midX + COLW - 24}" y1="${midY + 12}" x2="${rightX}" y2="${y + 12}"
+      stroke="var(--axis)" stroke-dasharray="3 2"/>`;
+  });
+  downs.forEach((e) => {
+    const y = TOP + ri++ * ROW;
+    svg += nodeBox(rightX, y, byId[e.to], e);
+    svg += `<line x1="${midX + COLW - 24}" y1="${midY + 12}" x2="${rightX}" y2="${y + 12}"
+      stroke="var(--axis)" marker-end="url(#lgarrow)"/>`;
+  });
+  return `<details class="chart" style="margin-top:14px" open id="lineageGraph">
+    <summary style="cursor:pointer;font-weight:600;font-size:13px">lineage graph
+      <span class="sub">${ups.length} upstream · ${arts.length + outs.length} artifacts/outputs · ${downs.length} downstream</span></summary>
+    <svg viewBox="0 0 ${W} ${H}" role="img" aria-label="cross-run lineage graph"
+         style="max-width:100%">
+      <defs><marker id="lgarrow" viewBox="0 0 8 8" refX="7" refY="4"
+        markerWidth="6" markerHeight="6" orient="auto">
+        <path d="M0,0 L8,4 L0,8 z" fill="var(--axis)"/></marker></defs>
+      <text x="10" y="14" font-size="10" fill="var(--muted)">inputs</text>
+      <text x="${midX}" y="14" font-size="10" fill="var(--muted)">run</text>
+      <text x="${rightX}" y="14" font-size="10" fill="var(--muted)">outputs</text>
+      ${svg}
+    </svg>
   </details>`;
 }
 
@@ -841,9 +954,11 @@ async function showRun(uuid, opts) {
   // Artifact listing stats the whole run tree server-side — skip it
   // for pipelines (their artifacts live in child runs) so the 5 s live
   // rerender loop doesn't re-walk the tree forever.
-  const [lineage, files] = isPipeline ? [[], []] : await Promise.all([
+  const [lineage, files, lingraph] = isPipeline ? [[], [], null]
+    : await Promise.all([
     api(`${base()}/runs/${uuid}/lineage`).catch(() => []),
     api(`${base()}/runs/${uuid}/artifacts?detail=1`).catch(() => []),
+    api(`${base()}/runs/${uuid}/lineage/graph`).catch(() => null),
   ]);
   const sweep = isSweep ? await sweepView(run)
     : isDag ? await dagView(run) : "";
@@ -863,14 +978,15 @@ async function showRun(uuid, opts) {
     ${media ? `<div class="charts">${media}</div>` : ""}
     ${artifactsPanel(uuid, Array.isArray(lineage) ? lineage : [],
                      Array.isArray(files) ? files : [])}
+    ${lineageGraphPanel(uuid, lingraph)}
     <div id="logs" aria-label="run logs"${isPipeline ? " hidden" : ""}></div>`;
   for (const el of detail.querySelectorAll(".chart")) wireChart(el);
   wireRunChips(detail);
   if (!isPipeline) {
     const logs = $("#logs");
-    // EventSource cannot set headers; the SSE route accepts ?token=.
-    const tok = getToken() ? `&token=${encodeURIComponent(getToken())}` : "";
-    logSource = new EventSource(`/streams/v1/${encodeURIComponent(OWNER)}/default/runs/${uuid}/logs?follow=true${tok}`);
+    // EventSource cannot set headers; the SSE route accepts ?token=
+    // (a short-lived stream token when one is minted — see tokenQS).
+    logSource = new EventSource(`/streams/v1/${encodeURIComponent(OWNER)}/default/runs/${uuid}/logs?follow=true${tokenQS("&")}`);
     logSource.onmessage = (ev) => { logs.textContent += ev.data + "\n"; logs.scrollTop = logs.scrollHeight; };
     logSource.addEventListener("done", () => { logSource.close(); logSource = null; });
   } else if (!TERMINAL.has(run.status)) {
@@ -910,6 +1026,7 @@ $("#themeToggle").onclick = () => {
   const dark = getComputedStyle(document.body).colorScheme.includes("dark");
   root.dataset.theme = dark ? "light" : "dark";
 };
+refreshStreamToken();  // mint eagerly so first img/SSE urls use it
 loadRuns();
 setInterval(loadRuns, 10000);
 </script>

@@ -288,6 +288,88 @@ def _compile_job(run, plan_args, env_base, *, service: bool = False):
     return resources, processes
 
 
+def _build_phase(op: V1Operation, plan_args: dict[str, Any],
+                 hub_resolver) -> Optional[V1InitPhase]:
+    """Compile the operation's ``build:`` section into a pre-run init
+    phase (SURVEY §2 "Polyflow IR" — upstream spawns a separate build
+    run from the referenced builder component and gates the main run on
+    it, patching the main container's image with the built destination;
+    the embedded plane's equivalent is the same builder compiled INTO
+    the launch plan, executed by the agent before the gang starts, so a
+    build failure fails the run before any main process spawns).
+
+    The builder is resolved from the component hub, patched with the
+    section's ``runPatch``/presets, and rendered through the same
+    param/globals context as a normal operation — so ``{{ params.* }}``
+    in the builder's command resolves against the build params.
+    """
+    build = op.build
+    if build is None:
+        return None
+    if not build.hub_ref:
+        raise CompilerError(
+            "`build` requires hubRef naming the builder component")
+    if hub_resolver is None:
+        raise CompilerError(
+            f"cannot resolve build hubRef `{build.hub_ref}`: no component "
+            "hub available (submit through the control plane)")
+    try:
+        builder = hub_resolver(build.hub_ref)
+    except ValueError as exc:
+        raise CompilerError(str(exc)) from exc
+
+    from polyaxon_tpu.polyaxonfile import (
+        apply_presets,
+        resolve_operation_context,
+    )
+
+    build_op = V1Operation(
+        component=builder,
+        params=build.params,
+        run_patch=build.run_patch,
+        patch_strategy=build.patch_strategy,
+    )
+    if build.presets:
+        build_op = apply_presets(build_op, build.presets)
+    try:
+        resolved = resolve_operation_context(
+            build_op,
+            run_uuid=plan_args["run_uuid"],
+            run_name=plan_args.get("run_name") or "",
+            project_name=plan_args.get("project") or "",
+        )
+    except Exception as exc:
+        raise CompilerError(
+            f"build section failed to resolve: {exc}") from exc
+    run = resolved.component.run
+    container = getattr(run, "container", None)
+    command, args = _container_cmd(container)
+    if not command and not args:
+        raise CompilerError(
+            f"build component `{build.hub_ref}` has no container command")
+    env: dict[str, str] = {}
+    if container is not None and container.env:
+        env.update({e.name: str(e.value) for e in container.env
+                    if e.value is not None})
+    env.update(_io_env(resolved))
+    # Upstream convention: the builder's `destination` param names the
+    # image the build produces; the main processes run that image.
+    destination = None
+    dest_param = (resolved.params or {}).get("destination")
+    if dest_param is not None and isinstance(dest_param.value, str):
+        destination = dest_param.value
+    return V1InitPhase(
+        kind="build",
+        config={
+            "hubRef": build.hub_ref,
+            "command": command + args,
+            "env": env,
+            **({"destination": destination} if destination else {}),
+        },
+        connection=build.connection,
+    )
+
+
 def _referenced_connections(op: V1Operation, run) -> tuple[list[str], list[str]]:
     """(init connections — env injected into the gang,
     notifier/hook connections — validated only: their schemas can carry
@@ -313,6 +395,7 @@ def compile_operation(
     project: str = "default",
     store_dir: Optional[str] = None,
     catalog=None,  # connections.ConnectionCatalog
+    hub_resolver=None,  # name -> V1Component (build: sections need it)
 ) -> V1LaunchPlan:
     """Resolved operation (literal params — run through
     ``resolve_operation_context`` first) → launch plan."""
@@ -415,6 +498,17 @@ def compile_operation(
     if op.termination or component.termination:
         termination = (op.termination or component.termination).to_dict()
 
+    init = _init_phases(run, plugins, catalog)
+    build_phase = _build_phase(op, plan_args, hub_resolver)
+    if build_phase is not None:
+        # The build gates everything: first phase, before even auth —
+        # upstream's build run completes before the main run exists.
+        init.insert(0, build_phase)
+        destination = build_phase.config.get("destination")
+        if destination:
+            for proc in processes:
+                proc.image = destination
+
     return V1LaunchPlan(
         run_uuid=run_uuid,
         run_name=plan_args["run_name"],
@@ -425,7 +519,7 @@ def compile_operation(
         resources=resources,
         num_processes=len(processes),
         processes=processes,
-        init=_init_phases(run, plugins, catalog),
+        init=init,
         sidecars=_sidecars(run, plugins, artifacts_dir, store_dir),
         termination=termination,
         queue=op.queue or component.queue,

@@ -113,13 +113,18 @@ def _context_value(record: RunRecord, streams, ref: str) -> Any:
 
 
 def resolve_joins(store: Store, streams, joins: list[dict], *,
-                  project: str) -> dict[str, list]:
-    """Evaluate every join; returns {param_name: [value per matched run]}."""
+                  project: str,
+                  matched: Optional[list] = None) -> dict[str, list]:
+    """Evaluate every join; returns {param_name: [value per matched run]}.
+    ``matched`` (optional out-param): collects the matched runs' uuids —
+    the compile step stamps them as the run's upstream lineage edges."""
     out: dict[str, list] = {}
     for join in joins:
         records = find_runs(
             store, join["query"], project=project,
             sort=join.get("sort"), limit=join.get("limit"))
+        if matched is not None:
+            matched.extend(r.uuid for r in records)
         for name, param in (join.get("params") or {}).items():
             ref = param.get("value") if isinstance(param, dict) else param
             if not isinstance(ref, str):

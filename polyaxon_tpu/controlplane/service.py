@@ -147,10 +147,20 @@ class ControlPlane:
         if op.joins:
             from polyaxon_tpu.controlplane.joins import resolve_joins
 
+            matched: list[str] = []
             joined = resolve_joins(
                 self.store, self.streams,
-                [j.to_dict() for j in op.joins], project=record.project)
+                [j.to_dict() for j in op.joins], project=record.project,
+                matched=matched)
             trial_params.update(joined)
+            if matched:
+                # Join upstreams are lineage edges (inputs → this run);
+                # stamped here because the query result is not
+                # re-derivable after the upstream set changes.
+                meta = dict(record.meta or {})
+                meta["upstream_runs"] = sorted(set(matched))
+                self.store.update_run(run_uuid, meta=meta)
+                record = self.store.get_run(run_uuid)
         resolved = resolve_operation_context(
             op,
             params=trial_params,
@@ -166,6 +176,7 @@ class ControlPlane:
             artifacts_root=self.artifacts_root,
             project=record.project,
             catalog=self.connections,
+            hub_resolver=self.resolve_hub_ref,
         )
         self.store.update_run(
             run_uuid, resolved_spec=resolved.to_dict(), launch_plan=plan.to_dict()
@@ -289,3 +300,98 @@ class ControlPlane:
 
     def run_artifacts_dir(self, run_uuid: str) -> str:
         return os.path.join(self.artifacts_root, run_uuid)
+
+    # -- cross-run lineage -------------------------------------------------
+    def _upstream_edges(
+        self, record: RunRecord,
+        sibling_cache: Optional[dict] = None,
+    ) -> list[tuple[str, str, Optional[str]]]:
+        """(upstream_uuid, edge_kind, label) for every input edge the
+        data model records: ``runs.<uuid>``/``ops.<name>`` param refs,
+        DAG dependencies, join matches (meta.upstream_runs, stamped at
+        compile), and cache adoption. ``sibling_cache`` (pipeline_uuid
+        → {name: record}) is shared by the project-wide downstream scan
+        so sibling listings run once per pipeline, not once per run."""
+        out: list[tuple[str, str, Optional[str]]] = []
+        cache = sibling_cache if sibling_cache is not None else {}
+
+        def sibs() -> dict[str, RunRecord]:
+            key = record.pipeline_uuid
+            if not key:
+                return {}
+            if key not in cache:
+                cache[key] = {c.name: c for c in self.store.list_runs(
+                    pipeline_uuid=key)}
+            return cache[key]
+
+        # Param refs + DAG dependencies need only the raw spec dict —
+        # no pydantic re-validation per scanned run.
+        for name, param in (record.params or {}).items():
+            ref = param.get("ref") if isinstance(param, dict) else None
+            if not ref:
+                continue
+            if ref.startswith("runs."):
+                out.append((ref.split(".")[1], "param", name))
+            elif ref.startswith("ops."):
+                sib = sibs().get(ref.split(".")[1])
+                if sib is not None:
+                    out.append((sib.uuid, "param", name))
+        meta = record.meta or {}
+        for uuid in meta.get("upstream_runs") or []:
+            out.append((uuid, "join", None))
+        if meta.get("cache_hit_from"):
+            out.append((meta["cache_hit_from"], "cache", None))
+        deps = (record.spec or {}).get("dependencies") or []
+        if deps and record.pipeline_uuid:
+            for dep in deps:
+                sib = sibs().get(dep)
+                if sib is not None:
+                    out.append((sib.uuid, "dag", None))
+        return out
+
+    def lineage_graph(self, run_uuid: str) -> dict:
+        """Inputs → run → outputs across runs (SURVEY §2 "Tracking":
+        upstream's artifact-lineage graph view): upstream runs feeding
+        this one (param refs, DAG deps, joins, cache adoption),
+        downstream runs consuming it, and the run's own artifact
+        records + outputs as the terminal nodes."""
+        record = self.store.get_run(run_uuid)
+        nodes: dict[str, dict] = {}
+        edges: list[dict] = []
+
+        def node(r: RunRecord) -> None:
+            # "owner" rides along so the API's scoped-token filter can
+            # drop foreign nodes without an extra get_run per node.
+            nodes.setdefault(r.uuid, {
+                "uuid": r.uuid, "name": r.name, "kind": r.kind,
+                "status": r.status.value,
+                "owner": (r.meta or {}).get("owner"),
+            })
+
+        node(record)
+        sibling_cache: dict = {}
+        for uuid, kind, label in self._upstream_edges(record, sibling_cache):
+            try:
+                up = self.store.get_run(uuid)
+            except Exception:  # noqa: BLE001 — deleted upstream: drop edge
+                continue
+            node(up)
+            edges.append({"from": uuid, "to": run_uuid, "kind": kind,
+                          **({"label": label} if label else {})})
+        for other in self.store.list_runs(project=record.project):
+            if other.uuid == run_uuid:
+                continue
+            for uuid, kind, label in self._upstream_edges(
+                    other, sibling_cache):
+                if uuid == run_uuid:
+                    node(other)
+                    edges.append({
+                        "from": run_uuid, "to": other.uuid, "kind": kind,
+                        **({"label": label} if label else {})})
+        return {
+            "run": run_uuid,
+            "nodes": list(nodes.values()),
+            "edges": edges,
+            "artifacts": self.streams.get_lineage(run_uuid),
+            "outputs": self.streams.get_outputs(run_uuid),
+        }

@@ -29,6 +29,7 @@ from polyaxon_tpu.models.common import (
     ModelDef,
     Variables,
     chunked_lm_loss,
+    lm_logits,
     rms_norm,
     rope,
     sample_logits,
@@ -71,10 +72,11 @@ class LlamaConfig:
     # Flash-kernel tuning (runtime keys flow here via model_overrides):
     # fwd tile sizes and backward implementation ("pallas" | "xla").
     # None = the kernel's own defaults (512 fwd tiles; pallas bwd on
-    # real TPU). Sweepable per-run from bench.py; setting one with a
-    # non-flash attention_impl is an error.
-    flash_block_q: Optional[int] = None
-    flash_block_k: Optional[int] = None
+    # real TPU); "auto" = trace-time VMEM-budget pick (flash.auto_blocks).
+    # Sweepable per-run from bench.py; setting one with a non-flash
+    # attention_impl is an error.
+    flash_block_q: Optional[int | str] = None
+    flash_block_k: Optional[int | str] = None
     flash_bwd_impl: Optional[str] = None
     # Chunked lm-head loss slab length (peak HBM holds [B, chunk, V]
     # fp32); sweepable alongside the flash tiles.
@@ -308,6 +310,11 @@ def hidden_states(
 
 
 def lm_head(cfg: LlamaConfig, params: dict) -> jax.Array:
+    """Materialized head table — for OUT-OF-LOOP callers only (prefill,
+    training forward). Decode loops must go through ``decode_logits``:
+    a quantized table dequantized here is loop-invariant, so XLA
+    hoists the full-precision [D, V] table onto the loop carry
+    (ADVICE r4 #1; see common.lm_logits)."""
     w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
     if hasattr(w, "dequantize"):
         # Unwrap at consumption (same contract as _w): callers sit
@@ -315,6 +322,14 @@ def lm_head(cfg: LlamaConfig, params: dict) -> jax.Array:
         # matmul's operand read and int8 stays the HBM format.
         w = w.dequantize()
     return w.T if cfg.tie_embeddings else w
+
+
+def decode_logits(cfg: LlamaConfig, params: dict, x: jax.Array) -> jax.Array:
+    """Hidden states [..., D] → fp32 logits [..., V], safe inside
+    decode loops (common.lm_logits keeps a quantized head int8 on the
+    loop carry via chunked consumption)."""
+    w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    return lm_logits(x, w, cfg.dtype, transpose=cfg.tie_embeddings)
 
 
 def forward(
@@ -457,7 +472,7 @@ def decode_step_ragged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
+    logits = decode_logits(cfg, params, x[:, 0])
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -621,7 +636,7 @@ def decode_chunk(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
+    logits = decode_logits(cfg, params, x)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -785,7 +800,7 @@ def decode_step_paged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
+    logits = decode_logits(cfg, params, x[:, 0])
     return logits, {"k": new_k, "v": new_v}
 
 

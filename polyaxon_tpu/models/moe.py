@@ -57,7 +57,7 @@ from polyaxon_tpu.models.common import (
     shift_right,
     truncated_normal_init,
 )
-from polyaxon_tpu.models.common import _embed_rows, _w
+from polyaxon_tpu.models.common import _embed_rows, _w, lm_logits
 from polyaxon_tpu.models.llama import _rope
 from polyaxon_tpu.ops.attention import dot_product_attention
 
@@ -604,7 +604,7 @@ def decode_step_ragged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    logits = lm_logits(x[:, 0], params["lm_head"], dt)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -660,7 +660,7 @@ def decode_chunk(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    logits = lm_logits(x, params["lm_head"], dt)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -697,7 +697,7 @@ def decode_step_paged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    logits = lm_logits(x[:, 0], params["lm_head"], dt)
     return logits, {"k": new_k, "v": new_v}
 
 

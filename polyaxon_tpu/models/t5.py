@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from polyaxon_tpu.models import encoder
-from polyaxon_tpu.models.common import _embed_rows, _w
+from polyaxon_tpu.models.common import _embed_rows, _w, lm_logits
 from polyaxon_tpu.models.common import (
     Batch,
     ModelDef,
@@ -465,7 +465,7 @@ def decode_step_ragged(
         (params["dec_layers"], cache["k"], cache["v"],
          cache["xk"], cache["xv"]))
     x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ _w(params["lm_head"], dt)).astype(jnp.float32)
+    logits = lm_logits(x[:, 0], params["lm_head"], dt)
     return logits, {**cache, "k": new_k, "v": new_v}
 
 

@@ -60,6 +60,45 @@ def pick_block(seq: int, preferred: int) -> int:
 
 _pick_block = pick_block  # internal alias
 
+# Per-core VMEM is ~128 MiB on v5e/v4; the budget leaves headroom for
+# Mosaic's double-buffered input pipelining and the bwd kernels' extra
+# accumulators (dk/dv scratch ≈ the fwd footprint again).
+VMEM_BUDGET = 48 * 2**20
+
+
+def _tile_bytes(bq: int, bk: int, d: int) -> int:
+    """Estimated fwd-kernel VMEM residency for one grid cell: bf16 Q
+    tile + double-buffered bf16 K/V streams + f32 scores + f32 output
+    accumulator + lane-broadcast m/l scratch."""
+    return (bq * d * 2          # q tile (bf16)
+            + 2 * 2 * bk * d * 2  # k + v, double-buffered (bf16)
+            + bq * bk * 4       # scores (f32)
+            + bq * d * 4        # o accumulator (f32)
+            + 2 * bq * LANES * 4)  # m / l scratch (f32)
+
+
+def auto_blocks(seq_q: int, seq_k: int, head_dim: int,
+                *, vmem_budget: int = VMEM_BUDGET) -> tuple[int, int]:
+    """Trace-time (block_q, block_k) choice keyed on (seq, head_dim,
+    VMEM budget) — VERDICT r4 item 3's staged MFU lever. Larger tiles
+    amortize the online-softmax rescale and grid overhead (fewer
+    passes over the K/V stream per Q tile) but must leave VMEM room
+    for pipelining; the historical fixed 512x512 default is kept as
+    the FLOOR of preference order so auto never picks worse than the
+    measured r3/r4 configuration, and 1024-tiles are tried first where
+    the budget allows (small head_dim). Shapes that don't tile fall
+    back through ``pick_block`` exactly as explicit sizes do."""
+    for bq in (1024, 512, 256, 128):
+        for bk in (1024, 512, 256, 128):
+            if bk > bq * 2:
+                continue  # tall score tiles win nothing; skip extremes
+            if _tile_bytes(bq, bk, head_dim) <= vmem_budget:
+                got_q = _pick_block(seq_q, bq)
+                got_k = _pick_block(seq_k, bk)
+                if got_q == min(bq, seq_q) and got_k == min(bk, seq_k):
+                    return got_q, got_k
+    return _pick_block(seq_q, 512), _pick_block(seq_k, 512)
+
 
 def _block_visible(qi, ki, block_q: int, block_k: int, causal: bool,
                    window: int):
@@ -618,8 +657,8 @@ def flash_attention(
     *,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | str = 512,  # tile size, or "auto" (auto_blocks)
+    block_k: int | str = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
     segment_ids: Optional[jax.Array] = None,  # [B, S] packed-sequence ids
@@ -651,8 +690,8 @@ def flash_attention_with_lse(
     *,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | str = 512,  # tile size, or "auto" (auto_blocks)
+    block_k: int | str = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
     segment_ids: Optional[jax.Array] = None,
@@ -678,6 +717,12 @@ def flash_attention_with_lse(
         # Validate before the shape-based fallback so a typo can't ride
         # silently on non-tiling shapes.
         raise ValueError(f"unknown bwd_impl `{bwd_impl}`")
+    if block_q == "auto" or block_k == "auto":
+        # Trace-time auto-pick keyed on (seq, head_dim, VMEM budget) —
+        # sweepable against the fixed default (VERDICT r4 item 3).
+        abq, abk = auto_blocks(sq, sk, d)
+        block_q = abq if block_q == "auto" else block_q
+        block_k = abk if block_k == "auto" else block_k
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     if pltpu is None or bq < 128 or bk < 128 or (d % 128 and d != 64):

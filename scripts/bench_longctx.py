@@ -5,10 +5,12 @@ Two modes:
 
 ``--cpu-mesh``
     The multi-device half, runnable anywhere: ring attention (zigzag
-    causal) training at seq 16k on an 8-device virtual CPU mesh
-    (dp=1 x cp=8 → 2048 local rows per device). Proves the
-    sequence-parallel path compiles, executes, and is differentiable
-    at long context without chip access.
+    causal) AND ulysses (all-to-all head-parallel) training at seq 16k
+    on an 8-device virtual CPU mesh (dp=1 x cp=8 → 2048 local rows per
+    device). Proves both sequence-parallel schedules compile, execute,
+    and are differentiable at long context without chip access — and
+    that the two schedules' losses agree at real length, not just the
+    seq-64 dryrun (VERDICT r4 item 8).
 
 default (chip)
     Single-chip flash training at seq 8k and 16k (llama_200m, Pallas
@@ -117,15 +119,24 @@ def main() -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        entry = run_point(
-            "ring-cpu8-seq16k",
-            model=args.model or "llama_tiny", seq=16384, batch=2,
-            steps=args.steps or 2, mesh_axes={"dp": 1, "cp": 8},
-            attention="ring", remat="none")
-        ok = entry["loss"] == entry["loss"]  # finite (not NaN)
-        print(json.dumps({"summary": "ring@16k on 8-dev cp mesh",
-                          "ok": bool(ok)}))
-        return 0 if ok else 1
+        entries = []
+        for attention in ("ring", "ulysses"):
+            entries.append(run_point(
+                f"{attention}-cpu8-seq16k",
+                model=args.model or "llama_tiny", seq=16384, batch=2,
+                steps=args.steps or 2, mesh_axes={"dp": 1, "cp": 8},
+                attention=attention, remat="none"))
+        losses = [e["loss"] for e in entries]
+        finite = all(l == l for l in losses)
+        # Same data/init/steps: the two SP schedules compute the same
+        # math, so their losses must agree to float tolerance.
+        agree = finite and abs(losses[0] - losses[1]) < 5e-3
+        print(json.dumps({
+            "summary": "ring + ulysses @16k on 8-dev cp mesh",
+            "losses": {"ring": losses[0], "ulysses": losses[1]},
+            "ok": bool(agree),
+        }))
+        return 0 if agree else 1
 
     # Chip mode: flash at 8k then 16k; the O(S) claim is the ratio.
     from polyaxon_tpu.utils import apply_jax_platforms_override

@@ -16,11 +16,19 @@ if [ "$1" = "--full" ]; then
     # process's compile-cache/lifetime, isolate a native crash to one
     # module's rerun, and change no test semantics (modules are
     # already independent).
+    # Accumulate failures instead of aborting at the first failing
+    # module (set -e would otherwise mask later modules' results).
     echo "== pytest (full, per-module processes)"
+    rc=0
+    failed=""
     for mod in tests/test_*.py; do
         echo "-- $mod"
-        python -m pytest "$mod" -q
+        python -m pytest "$mod" -q || { rc=1; failed="$failed $mod"; }
     done
+    if [ "$rc" -ne 0 ]; then
+        echo "FAILED modules:$failed"
+        exit "$rc"
+    fi
 else
     echo "== pytest (smoke tier; use --full for the whole suite)"
     python -m pytest tests/ -q -m smoke

@@ -217,6 +217,16 @@ def main() -> int:
             ("b8-dots-flash-q256k256", dict(base, batch=8, remat="dots",
                                             attention="flash",
                                             block_q=256, block_k=256)),
+            # VERDICT r4 item 3 staged levers: VMEM-budget auto-pick
+            # (currently resolves to 1024-tiles at these shapes) vs the
+            # fixed 512 default, plus the explicit 1024-tile point so
+            # the auto pick's benefit is attributable.
+            ("b8-dots-flash-qkauto", dict(base, batch=8, remat="dots",
+                                          attention="flash",
+                                          block_q="auto", block_k="auto")),
+            ("b8-dots-flash-q1024k1024", dict(base, batch=8, remat="dots",
+                                              attention="flash",
+                                              block_q=1024, block_k=1024)),
             ("b16-dots-flash-bwd-xla", dict(base, batch=16, remat="dots",
                                             attention="flash", bwd="xla")),
             ("b8-dots-flash-chunk512", dict(base, batch=8, remat="dots",

@@ -274,6 +274,77 @@ class TestApi:
             page = r.read().decode()
         assert "artifactsPanel" in page and "artifacts?detail=1" in page
 
+    def test_lineage_graph_endpoint(self, stack):
+        """VERDICT r4 item 7: the cross-run lineage graph surface —
+        a run consuming another run's output via a `runs.<uuid>` param
+        ref appears as an upstream edge of the consumer AND a
+        downstream edge of the producer; artifact records ride along;
+        the dashboard ships the renderer."""
+        import json as _json
+        import textwrap
+        import urllib.request
+
+        _, server = stack
+        producer = RunClient(host=server.url)
+        script = textwrap.dedent(
+            """
+            import os
+            from polyaxon_tpu.tracking import Run
+            d = os.environ["POLYAXON_RUN_ARTIFACTS_PATH"]
+            with Run(os.environ["POLYAXON_RUN_UUID"], d) as r:
+                p = os.path.join(d, "model.bin")
+                open(p, "w").write("weights")
+                r.log_model(p, name="model.bin")
+                r.log_outputs(accuracy=0.9)
+            """
+        ).strip()
+        prod = producer.create({"kind": "component", "name": "producer",
+                                "run": {"kind": "job", "container": {
+                                    "command": ["python", "-c", script]}}})
+        assert producer.wait(timeout=60) == V1Statuses.SUCCEEDED
+
+        consumer = RunClient(host=server.url)
+        cons = consumer.create({
+            "kind": "operation",
+            "name": "consumer",
+            "params": {"acc": {"ref": f"runs.{prod['uuid']}",
+                               "value": "outputs.accuracy"}},
+            "component": {
+                "inputs": [{"name": "acc", "type": "float",
+                            "isOptional": True, "value": 0.0}],
+                "run": {"kind": "job", "container": {
+                    "command": ["python", "-c", "print('ok')"]}},
+            },
+        })
+        consumer.wait(timeout=60)
+
+        base = f"{server.url}/api/v1/default/default/runs"
+        with urllib.request.urlopen(
+                f"{base}/{cons['uuid']}/lineage/graph", timeout=10) as r:
+            graph = _json.load(r)
+        uuids = {n["uuid"] for n in graph["nodes"]}
+        assert {prod["uuid"], cons["uuid"]} <= uuids
+        edge = next(e for e in graph["edges"] if e["from"] == prod["uuid"])
+        assert edge["to"] == cons["uuid"]
+        assert edge["kind"] == "param" and edge["label"] == "acc"
+
+        # The same edge from the producer's side is downstream.
+        with urllib.request.urlopen(
+                f"{base}/{prod['uuid']}/lineage/graph", timeout=10) as r:
+            pgraph = _json.load(r)
+        assert any(e["from"] == prod["uuid"] and e["to"] == cons["uuid"]
+                   for e in pgraph["edges"])
+        # Producer's own artifacts/outputs are the terminal nodes.
+        assert any(a.get("name") == "model.bin"
+                   for a in pgraph["artifacts"])
+        assert pgraph["outputs"].get("accuracy") == 0.9
+
+        # The dashboard ships the graph renderer + iframe inline render.
+        with urllib.request.urlopen(f"{server.url}/ui", timeout=10) as r:
+            page = r.read().decode()
+        assert "lineageGraphPanel" in page and "lineage/graph" in page
+        assert "<iframe" in page and "stream-token" in page
+
     def test_dag_view_data_surface(self, stack):
         """Everything the dashboard's pipeline graph consumes: run-detail
         spec carries the dag operations + dependencies, the pipeline
@@ -670,6 +741,63 @@ class TestAuth:
                 f"{server.url}/api/v1/alice/default/runs/{mine['uuid']}"
                 f"/artifacts?token=tk-alice", timeout=10)
         assert err.value.code == 401
+
+    def test_stream_token_mint_and_use(self, auth_stack):
+        """ADVICE r4 #3: browser ?token= URLs should carry a short-lived
+        DERIVED credential, not the primary secret. The mint route is
+        header-auth-only; the derived token works on the SSE and
+        artifact-file routes with the minter's scope; tampered or
+        expired tokens are 401."""
+        import urllib.error
+        import urllib.request
+
+        _, server = auth_stack
+        alice = PolyaxonClient(server.url, owner="alice", token="tk-alice")
+        minted = alice.get("/api/v1/stream-token")
+        tok = minted["token"]
+        assert tok.startswith("st:alice:") and minted["expiresIn"] > 0
+        assert "tk-alice" not in tok, "derived token embeds the secret"
+
+        mine = alice.post("/api/v1/alice/default/runs",
+                          body={"content": TRIAL, "params": {"lr": 0.1}})
+        logs = (f"{server.url}/streams/v1/alice/default/runs/"
+                f"{mine['uuid']}/logs")
+        quoted = urllib.parse.quote(tok, safe="")
+        with urllib.request.urlopen(f"{logs}?token={quoted}",
+                                    timeout=10) as r:
+            assert r.status == 200
+        # Scope rides along: alice's stream token is still alice.
+        bob_logs = logs.replace("/alice/", "/bob/")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{bob_logs}?token={quoted}", timeout=10)
+        assert err.value.code == 403
+        # Tampered signature → 401.
+        bad = urllib.parse.quote(tok[:-4] + "0000", safe="")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{logs}?token={bad}", timeout=10)
+        assert err.value.code == 401
+        # Expired → 401 (forge the same HMAC with a past timestamp is
+        # impossible; simulate by minting with a past exp via the
+        # server's own key material).
+        import hmac as _hmac
+        import time as _time
+
+        past = int(_time.time()) - 10
+        msg = f"st:alice:{past}"
+        sig = _hmac.new(b"tk-alice", msg.encode(), "sha256").hexdigest()
+        expired = urllib.parse.quote(f"{msg}:{sig}", safe="")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{logs}?token={expired}", timeout=10)
+        assert err.value.code == 401
+        # A stream token in the HEADER cannot mint another one.
+        with pytest.raises(ApiClientError) as exc:
+            PolyaxonClient(server.url, owner="alice",
+                           token=tok).get("/api/v1/stream-token")
+        assert exc.value.status == 401
+        # Anonymous mint is refused.
+        with pytest.raises(ApiClientError) as exc:
+            PolyaxonClient(server.url).get("/api/v1/stream-token")
+        assert exc.value.status == 401
 
     def test_logs_route_scoped(self, auth_stack):
         _, server = auth_stack

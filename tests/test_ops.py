@@ -181,6 +181,35 @@ class TestFlash:
         ref = dot_product_attention(q, k, v, impl="xla")
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_auto_blocks_pick(self):
+        """The VMEM-budget auto-pick (VERDICT r4 item 3 staged lever):
+        tiles divide the seq, stay >= 128 where the seq allows, and a
+        tight budget forces smaller tiles than a loose one."""
+        from polyaxon_tpu.ops.flash import _tile_bytes, auto_blocks
+
+        bq, bk = auto_blocks(2048, 2048, 64)
+        assert 2048 % bq == 0 and 2048 % bk == 0
+        assert bq >= 128 and bk >= 128
+        assert _tile_bytes(bq, bk, 64) <= 48 * 2**20
+        # Tight budget → strictly smaller score tile than the default.
+        tq, tk = auto_blocks(2048, 2048, 64, vmem_budget=2**20)
+        assert tq * tk < bq * bk
+        # Non-power-of-two seq still yields a dividing tile.
+        oq, ok_ = auto_blocks(1536, 1536, 128)
+        assert 1536 % oq == 0 and 1536 % ok_ == 0
+
+    def test_auto_blocks_matches_reference(self):
+        q, k, v = _qkv()
+        ref = xla_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True,
+                              block_q="auto", block_k="auto")
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # And through the model-config path a training step compiles:
+        # "auto" rides cfg.flash_block_q like an int does.
+        out2 = dot_product_attention(q, k, v, impl="flash",
+                                     block_q="auto", block_k="auto")
+        np.testing.assert_allclose(out2, ref, atol=2e-5, rtol=2e-5)
+
 
 class TestFlashPallasBackward:
     """Grad parity of the Pallas bwd kernels (the real-TPU default,

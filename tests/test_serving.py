@@ -961,6 +961,25 @@ class TestQuantizeInLoop:
         assert f"bf16[{V},{D}]" not in hlo, (
             "full embed table dequantized to bf16 — the int8-first "
             "row gather regressed")
+        # ADVICE r4 #1/#2: the UNTIED lm_head is [D, V], so a hoisted
+        # dequant materializes the TRANSPOSED table — which the [V, D]
+        # assert above cannot see. The regression signature is a full-
+        # precision full-table buffer riding a while-loop carry (the
+        # hoisted table is re-read every decode step); scan every while
+        # op's carry-tuple shapes. In-body converts are fine — they
+        # fuse into the logits matmul's operand read.
+        import re
+
+        carried = []
+        for m in re.finditer(r"while\(", hlo):
+            line = hlo[hlo.rfind("\n", 0, m.start()) + 1:m.start()]
+            carried += re.findall(r"(?:bf16|f32)\[(\d+),(\d+)\]", line)
+        full_tables = [s for s in carried
+                       if {int(s[0]), int(s[1])} == {V, D}]
+        assert not full_tables, (
+            f"full-precision lm_head/embed table {full_tables} rides "
+            "the decode loop carry — the dequant was hoisted out of "
+            "the loop (pin_in_loop regressed)")
 
     def test_families_serve_int8(self):
         """int8 must work for EVERY servable family end-to-end (review
@@ -1144,3 +1163,61 @@ class TestEosStop:
             _post(server.url, {"tokens": [[1, 2]], "max_new_tokens": 4,
                                "eos_tokens": ["nope"]})
         assert err.value.code == 400
+
+class TestLmLogitsChunked:
+    """common.lm_logits — the chunked quantized head consumption that
+    keeps int8 on decode-loop carries (ADVICE r4 #1). The llama_tiny
+    e2e tests only exercise the exact-divide path; these cover padding
+    (V not a multiple of the chunk) and the tied/transposed layout."""
+
+    def _check(self, D, V, transpose, chunk):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from polyaxon_tpu.models.common import lm_logits
+        from polyaxon_tpu.serving.quantize import quantize_leaf
+
+        shape = (V, D) if transpose else (D, V)
+        w = jax.random.normal(jax.random.key(0), shape, jnp.float32) * 0.1
+        q = quantize_leaf(w)
+        x = jax.random.normal(jax.random.key(1), (3, D), jnp.bfloat16)
+        got = lm_logits(x, q, jnp.bfloat16, transpose=transpose,
+                        chunk=chunk)
+        tab = q.dequantize().astype(jnp.bfloat16)
+        want = (x @ (tab.T if transpose else tab)).astype(jnp.float32)
+        assert got.shape == (3, V)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-2, rtol=1e-2)
+
+    def test_pad_path(self):
+        # V=300: chunk 128 → 3 chunks with 84 pad columns sliced off.
+        self._check(D=32, V=300, transpose=False, chunk=128)
+
+    def test_tied_transpose_path(self):
+        self._check(D=32, V=300, transpose=True, chunk=128)
+
+    def test_tiny_vocab_falls_back(self):
+        # V too small to split: the one-dot fallback path.
+        self._check(D=16, V=3, transpose=False, chunk=128)
+        self._check(D=16, V=3, transpose=True, chunk=128)
+
+    def test_3d_hidden_states(self):
+        """decode_chunk passes [B, c, D] hidden states — the chunked
+        path must broadcast like the plain matmul does."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from polyaxon_tpu.models.common import lm_logits
+        from polyaxon_tpu.serving.quantize import quantize_leaf
+
+        D, V = 16, 256
+        w = jax.random.normal(jax.random.key(0), (D, V), jnp.float32) * 0.1
+        q = quantize_leaf(w)
+        x = jax.random.normal(jax.random.key(1), (2, 5, D), jnp.bfloat16)
+        got = lm_logits(x, q, jnp.bfloat16, chunk=64)
+        want = (x @ q.dequantize().astype(jnp.bfloat16)).astype(jnp.float32)
+        assert got.shape == (2, 5, V)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-2, rtol=1e-2)
