@@ -255,8 +255,9 @@ def estimate_bench(model: str, seq: int, per_chip_batch: int,
       kernel, so the memory diagnostic OVERSTATES activation temps at
       long seq (the S^2 score tensor never exists on the TPU path).
     """
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8")
+    from polyaxon_tpu.utils import cpu_mesh_xla_flags
+
+    cpu_mesh_xla_flags(8)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 

@@ -1,3 +1,6 @@
-from polyaxon_tpu.utils.env import apply_jax_platforms_override
+from polyaxon_tpu.utils.env import (
+    apply_jax_platforms_override,
+    cpu_mesh_xla_flags,
+)
 
-__all__ = ["apply_jax_platforms_override"]
+__all__ = ["apply_jax_platforms_override", "cpu_mesh_xla_flags"]

@@ -5,6 +5,38 @@ from __future__ import annotations
 import os
 
 
+def cpu_mesh_xla_flags(n_devices: int = 8, *,
+                       watchdog_timeout_s: int = 600) -> None:
+    """Point ``XLA_FLAGS`` at an ``n_devices`` virtual CPU mesh, with
+    the collective-rendezvous watchdog sized for an oversubscribed
+    host. Must run BEFORE any jax backend initializes (this module
+    imports no jax).
+
+    Two flags, both append-only and NEVER overriding an operator's
+    explicit setting (XLA's repeated-flag parsing is last-wins, so we
+    skip appending when the flag is already present):
+
+    - ``--xla_force_host_platform_device_count=N``: the virtual mesh.
+    - ``--xla_cpu_collective_call_terminate_timeout_seconds``: XLA:CPU
+      CHECK-aborts the whole process when any device thread misses a
+      collective rendezvous for 40 s; with N device threads sharing
+      one physical core a straggler starves past that easily
+      (reproduced standalone at seq 16k, 2026-08-01 — the former
+      "full-suite segfault", see tests/conftest.py). 600 s keeps the
+      watchdog as a deadlock backstop without killing slow-but-live
+      programs.
+    """
+    flags = os.environ.get("XLA_FLAGS", "").split()
+    if not any(f.startswith("--xla_force_host_platform_device_count")
+               for f in flags):
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    if not any(f.startswith("--xla_cpu_collective_call_terminate_timeout")
+               for f in flags):
+        flags.append("--xla_cpu_collective_call_terminate_timeout_seconds"
+                     f"={watchdog_timeout_s}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
 def apply_jax_platforms_override() -> None:
     """Honor ``JAX_PLATFORMS`` even where a sitecustomize hook (e.g. the
     axon TPU-emulator plugin) pinned ``jax_platforms`` before our code

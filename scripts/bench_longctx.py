@@ -5,12 +5,13 @@ Two modes:
 
 ``--cpu-mesh``
     The multi-device half, runnable anywhere: ring attention (zigzag
-    causal) AND ulysses (all-to-all head-parallel) training at seq 16k
-    on an 8-device virtual CPU mesh (dp=1 x cp=8 → 2048 local rows per
-    device). Proves both sequence-parallel schedules compile, execute,
-    and are differentiable at long context without chip access — and
-    that the two schedules' losses agree at real length, not just the
-    seq-64 dryrun (VERDICT r4 item 8).
+    causal, dp=1 x cp=8 → 2048 local rows per device) AND ulysses
+    (all-to-all head-parallel, dp=2 x cp=4 — the 4-head tiny model
+    caps the head-sharded axis at 4) training at seq 16k on an
+    8-device virtual CPU mesh. Proves both sequence-parallel schedules
+    compile, execute, and are differentiable at long context without
+    chip access — and that the two schedules' losses agree at real
+    length, not just the seq-64 dryrun (VERDICT r4 item 8).
 
 default (chip)
     Single-chip flash training at seq 8k and 16k (llama_200m, Pallas
@@ -112,19 +113,23 @@ def main() -> int:
     args = parser.parse_args()
 
     if args.cpu_mesh:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8")
+        from polyaxon_tpu.utils import cpu_mesh_xla_flags
+
+        cpu_mesh_xla_flags(8)
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
         entries = []
-        for attention in ("ring", "ulysses"):
+        # Ulysses shards HEADS over the cp axis (heads % axis == 0), so
+        # the 4-head tiny model takes cp=4 with dp=2 — same global
+        # batch/data/steps, so the losses stay directly comparable.
+        for attention, mesh_axes in (("ring", {"dp": 1, "cp": 8}),
+                                     ("ulysses", {"dp": 2, "cp": 4})):
             entries.append(run_point(
                 f"{attention}-cpu8-seq16k",
                 model=args.model or "llama_tiny", seq=16384, batch=2,
-                steps=args.steps or 2, mesh_axes={"dp": 1, "cp": 8},
+                steps=args.steps or 2, mesh_axes=mesh_axes,
                 attention=attention, remat="none"))
         losses = [e["loss"] for e in entries]
         finite = all(l == l for l in losses)

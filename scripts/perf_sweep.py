@@ -89,10 +89,11 @@ def moe_dispatch_sweep(platform: str, steps: int) -> int:
     dp2×ep4 mesh (8-device virtual CPU mesh by default; single-chip
     ep=1 on TPU still measures the einsum-elimination term, which
     dominates as E grows). Writes moe_dispatch_results.json."""
-    if platform == "cpu":
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8")
     sys.path.insert(0, REPO)
+    if platform == "cpu":
+        from polyaxon_tpu.utils import cpu_mesh_xla_flags
+
+        cpu_mesh_xla_flags(8)
     import dataclasses
 
     import jax
@@ -244,6 +245,10 @@ def main() -> int:
             ("1b-b8-dots-flash", dict(base, model="llama3_1b",
                                       batch=8, remat="dots",
                                       attention="flash")),
+            ("1b-b4-seq4096-dots-flash", dict(base, model="llama3_1b",
+                                              batch=4, seq=4096,
+                                              remat="dots",
+                                              attention="flash")),
         ]
 
     out_path = os.path.join(REPO, "perf_sweep_results.json")

@@ -8,10 +8,19 @@ devices exactly the way the driver's ``dryrun_multichip`` harness does.
 """
 
 import os
+import sys
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+# The package root, importable regardless of the invoking cwd (the
+# debug_fullsuite.sh harness runs pytest from /tmp so core dumps land
+# outside the repo).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 8-device virtual mesh + a collective watchdog sized for this
+# oversubscribed 1-core host (utils/env.py has the full story; the
+# helper never overrides operator-set flags).
+from polyaxon_tpu.utils import cpu_mesh_xla_flags  # noqa: E402
+
+cpu_mesh_xla_flags(8)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -29,11 +38,23 @@ jax.config.update("jax_default_matmul_precision", "highest")
 #
 # NOTE 2: run the FULL suite via `scripts/ci.sh --full` (one pytest
 # process per module), not one `pytest tests/` process. Hour-long
-# single-process runs intermittently segfault inside XLA:CPU's native
-# compiler (backend_compile_and_load, faulthandler stack in the jax
-# compile path; observed 2026-07-31 twice, with 120+ GB free — flaky
-# and not correlated with any particular test; the same modules pass
-# in fresh processes). Per-module processes bound the blast radius.
+# single-process runs intermittently died with what looked like a
+# segfault "inside backend_compile_and_load" (observed 2026-07-31
+# twice, with 120+ GB free — flaky, not test-correlated). Root cause
+# likely IDENTIFIED 2026-08-01: XLA:CPU's collective rendezvous
+# watchdog CHECK-aborts the process when any device thread misses a
+# rendezvous for 40 s (`InProcessCommunicator::AllReduce` →
+# `AwaitAndLogIfStuck` → "Termination timeout ... exceeded. Exiting to
+# ensure a consistent program state") — reproduced standalone running
+# a seq-16k sharded train step on this 1-core host, where 8 device
+# threads + compile threads contend for one core and a straggler can
+# easily starve >40 s. The SIGABRT's faulthandler dump shows the MAIN
+# thread's Python stack (often mid-compile), which is why it
+# masqueraded as a compiler segfault. Mitigation: the
+# --xla_cpu_collective_call_terminate_timeout_seconds=600 flag above;
+# per-module processes stay as defense in depth (scripts/
+# debug_fullsuite.sh re-tests the single-process run under
+# faulthandler + RSS sampling).
 
 import pytest  # noqa: E402
 
