@@ -15,13 +15,17 @@
 #   - an RSS/thread sampler (rules memory pressure in or out).
 #
 # Usage: scripts/debug_fullsuite.sh [extra pytest args]
-# Output: /tmp/fullsuite-debug/{pytest.log,rss.log,core*}
+# Output: /tmp/fullsuite-debug/{pytest.log,rss.log,core*} — cores drop
+# in the repo cwd first and are swept into the output dir at the end.
 set -u
 REPO=$(CDPATH= cd "$(dirname "$0")/.." && pwd)
 OUT=/tmp/fullsuite-debug
 mkdir -p "$OUT"
 ulimit -c unlimited 2>/dev/null || echo "# core dumps unavailable"
-cd "$OUT" || exit 1  # cores drop in cwd on most kernels
+# Run from the REPO (fixture paths are repo-relative); cores then drop
+# in the repo cwd on plain `core` core_patterns — the tail of this
+# script sweeps both locations.
+cd "$REPO" || exit 1
 
 JAX_PLATFORMS=cpu PYTHONFAULTHANDLER=1 PYTHONPATH="$REPO" \
 python -X faulthandler -m pytest "$REPO/tests/" -q "$@" \
@@ -41,5 +45,13 @@ wait "$PID"
 RC=$?
 echo "# pytest exited rc=$RC"
 tail -5 "$OUT/pytest.log"
-ls -la "$OUT"/core* 2>/dev/null || echo "# no core dumped"
+# Sweep any core out of the working tree (multi-GB at this suite's
+# RSS; must not dirty git or risk accidental staging).
+mv "$REPO"/core* "$OUT"/ 2>/dev/null
+CORES=$(find "$OUT" -maxdepth 1 -name 'core*' 2>/dev/null)
+if [ -n "$CORES" ]; then
+    ls -la $CORES
+else
+    echo "# no core dumped"
+fi
 exit "$RC"
