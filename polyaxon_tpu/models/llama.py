@@ -81,6 +81,11 @@ class LlamaConfig:
     # Chunked lm-head loss slab length (peak HBM holds [B, chunk, V]
     # fp32); sweepable alongside the flash tiles.
     loss_chunk: int = 256
+    # Vocab-chunk length for QUANTIZED decode logits (common.lm_logits:
+    # the scan structure that keeps int8 on decode-loop carries).
+    # Bigger chunks = fewer, larger matmuls per step; sweepable on chip
+    # via bench_decode --lm-chunk. Ignored for unquantized heads.
+    lm_logits_chunk: int = 4096
     # Pipeline parallelism over the `pp` mesh axis (parallel/pipeline.py):
     # >1 splits the layer stack into that many ppermute-chained stages.
     pipeline_stages: int = 1
@@ -329,7 +334,8 @@ def decode_logits(cfg: LlamaConfig, params: dict, x: jax.Array) -> jax.Array:
     decode loops (common.lm_logits keeps a quantized head int8 on the
     loop carry via chunked consumption)."""
     w = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    return lm_logits(x, w, cfg.dtype, transpose=cfg.tie_embeddings)
+    return lm_logits(x, w, cfg.dtype, transpose=cfg.tie_embeddings,
+                     chunk=cfg.lm_logits_chunk)
 
 
 def forward(

@@ -76,6 +76,8 @@ class MoEConfig:
     router_aux_coef: float = 0.01
     # Chunked lm-head loss slab length (see LlamaConfig.loss_chunk).
     loss_chunk: int = 256
+    # Vocab-chunk for quantized decode logits (see LlamaConfig.lm_logits_chunk).
+    lm_logits_chunk: int = 4096
     # "top_k": tokens choose experts (GShard; needs the aux loss for
     # balance). "expert_choice": experts choose their top-capacity
     # tokens (Zhou et al. 2022) — perfectly load-balanced by
@@ -604,7 +606,8 @@ def decode_step_ragged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = lm_logits(x[:, 0], params["lm_head"], dt)
+    logits = lm_logits(x[:, 0], params["lm_head"], dt,
+                       chunk=cfg.lm_logits_chunk)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -660,7 +663,8 @@ def decode_chunk(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = lm_logits(x, params["lm_head"], dt)
+    logits = lm_logits(x, params["lm_head"], dt,
+                       chunk=cfg.lm_logits_chunk)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -697,7 +701,8 @@ def decode_step_paged(
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = lm_logits(x[:, 0], params["lm_head"], dt)
+    logits = lm_logits(x[:, 0], params["lm_head"], dt,
+                       chunk=cfg.lm_logits_chunk)
     return logits, {"k": new_k, "v": new_v}
 
 

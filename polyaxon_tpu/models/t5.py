@@ -60,6 +60,8 @@ class T5Config:
     attention_impl: str = "xla"
     # Chunked lm-head loss slab length (see LlamaConfig.loss_chunk).
     loss_chunk: int = 256
+    # Vocab-chunk for quantized decode logits (see LlamaConfig.lm_logits_chunk).
+    lm_logits_chunk: int = 4096
 
     @property
     def head_dim(self) -> int:
@@ -465,7 +467,8 @@ def decode_step_ragged(
         (params["dec_layers"], cache["k"], cache["v"],
          cache["xk"], cache["xv"]))
     x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
-    logits = lm_logits(x[:, 0], params["lm_head"], dt)
+    logits = lm_logits(x[:, 0], params["lm_head"], dt,
+                       chunk=cfg.lm_logits_chunk)
     return logits, {**cache, "k": new_k, "v": new_v}
 
 

@@ -31,7 +31,8 @@ apply_jax_platforms_override()  # honor JAX_PLATFORMS=cpu despite sitecustomize
 
 
 def measure(model: str, quantize: bool, slots: int, steps: int,
-            prompt_len: int, seed: int = 0) -> dict:
+            prompt_len: int, seed: int = 0,
+            lm_chunk: int | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -41,6 +42,13 @@ def measure(model: str, quantize: bool, slots: int, steps: int,
 
     family = _family(model)
     cfg, params = load_params(model, seed=seed)
+    if lm_chunk is not None:
+        # Sweepable lever: the quantized decode-logits vocab chunk
+        # (models/common.py lm_logits) — fewer/larger matmuls per step
+        # at bigger chunks, with the int8-on-carry guarantee unchanged.
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, lm_logits_chunk=lm_chunk)
     full_bytes = tree_bytes(params)
     if quantize:
         params = quantize_tree(params)
@@ -101,6 +109,18 @@ def main() -> int:
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--steps", type=int, default=256)
     parser.add_argument("--prompt-len", type=int, default=32)
+    def _positive(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError(
+                "lm-chunk must be >= 1 (chunk<=0 would silently fall "
+                "back to the monolithic dequant this bench exists to "
+                "avoid)")
+        return v
+
+    parser.add_argument("--lm-chunk", type=_positive, default=None,
+                        help="quantized decode-logits vocab chunk "
+                             "(default: the model config's 4096)")
     args = parser.parse_args()
 
     import jax
@@ -108,7 +128,7 @@ def main() -> int:
     rows = []
     for quantize in (False, True):
         r = measure(args.model, quantize, args.slots, args.steps,
-                    args.prompt_len)
+                    args.prompt_len, lm_chunk=args.lm_chunk)
         print(f"{args.model} quantize={r['quantize']}: "
               f"{r['tokens_per_sec']} tok/s ({r['step_ms']} ms/step, "
               f"weights {r['weight_bytes'] / 2**20:.0f} MiB)", flush=True)
@@ -131,6 +151,8 @@ def main() -> int:
         r["hbm_bound_step_ms_v5e"] = float(f"{gb / V5E_HBM_GBPS * 1e3:.3g}")
     out = {
         "backend": jax.devices()[0].platform,
+        **({"lm_chunk": args.lm_chunk}
+           if args.lm_chunk is not None else {}),
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
         "results": rows,
         "int8_speedup": round(int8["tokens_per_sec"]
