@@ -90,9 +90,19 @@ def measure(model: str, quantize: bool, slots: int, steps: int,
     jax.block_until_ready(cur)
     dt = time.perf_counter() - t0
     tokens = np.asarray(jnp.stack(emitted))  # [steps, slots]
+    # The EFFECTIVE vocab chunk, not just the request: _lm_chunk_len
+    # floors to a power of two capped at V//2, so distinct --lm-chunk
+    # values can compile the SAME program — the sweep record must show
+    # that, or a no-op delta reads as a lever effect.
+    from polyaxon_tpu.models.common import _lm_chunk_len
+
+    effective_chunk = (_lm_chunk_len(cfg.vocab_size, cfg.lm_logits_chunk)
+                       if quantize else None)
     return {
         "model": model,
         "quantize": "int8" if quantize else None,
+        **({"lm_chunk_effective": effective_chunk}
+           if effective_chunk is not None else {}),
         "slots": slots,
         "decode_steps": steps,
         "weight_bytes": tree_bytes(params),
