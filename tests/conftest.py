@@ -91,6 +91,8 @@ SMOKE_NODES = (
     "test_serving.py::TestServing::test_generate_shapes_and_determinism",
     "test_serving.py::TestQuantize::test_static_serving_end_to_end_int8",
     "test_serving.py::TestQuantizeInLoop",
+    "test_serving.py::TestLmLogitsChunked::test_pad_path",
+    "test_ops.py::TestFlash::test_auto_blocks_pick",
     "test_paged.py::TestPagedEngine::test_matches_dense_engine_greedy",
     "test_paged.py::TestPrefixCache::test_shared_prompt_pages_reused",
     "test_speculative.py::TestSpeculative::test_lossless_vs_plain_greedy",
