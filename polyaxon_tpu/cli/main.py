@@ -399,8 +399,32 @@ def ops_events(uid, kind, names):
 
 @ops.command("lineage")
 @click.option("-uid", "--uid", required=True)
-def ops_lineage(uid):
+@click.option("--graph", is_flag=True,
+              help="cross-run inputs → run → outputs graph (param "
+                   "refs, DAG deps, joins, cache adoption) instead of "
+                   "this run's artifact records")
+def ops_lineage(uid, graph):
     plane = get_plane()
+    if graph:
+        get_run_or_fail(plane, uid)  # clean CLI error on unknown uid
+        data = plane.lineage_graph(uid)
+        by_uuid = {n["uuid"]: n for n in data["nodes"]}
+
+        def label(u):
+            n = by_uuid.get(u) or {}
+            return f"{n.get('name') or u[:8]} [{n.get('status', '?')}]"
+
+        for e in data["edges"]:
+            tag = e["kind"] + (f":{e['label']}" if e.get("label") else "")
+            click.echo(f"{label(e['from'])} --{tag}--> {label(e['to'])}")
+        for a in data["artifacts"]:
+            click.echo(f"{label(uid)} --artifact--> "
+                       f"{a.get('kind', 'artifact')}:{a.get('name')}")
+        for k in data["outputs"]:
+            click.echo(f"{label(uid)} --output--> {k}")
+        if not (data["edges"] or data["artifacts"] or data["outputs"]):
+            click.echo("(no lineage edges recorded)")
+        return
     click.echo(json.dumps(plane.streams.get_lineage(uid), indent=2,
                           default=str))
 

@@ -97,6 +97,43 @@ class TestRunAndOps:
         assert result.exit_code == 0, result.output
         assert (tmp_path / "newdir" / "outputs.json").exists()
 
+    def test_ops_lineage_graph(self, runner):
+        """`plx ops lineage --graph` prints cross-run edges (a consumer
+        whose param runs-refs this run) plus artifact/output edges."""
+        result = runner.invoke(cli, ["run", "-f", FIXTURE])
+        uid = result.output.split("Run created: ")[1].split()[0]
+        from polyaxon_tpu.cli.main import get_plane
+
+        plane = get_plane()
+        # Outputs recorded for the producer.
+        rd = plane.streams.run_dir(uid)
+        os.makedirs(rd, exist_ok=True)
+        with open(os.path.join(rd, "outputs.json"), "w") as fh:
+            fh.write('{"accuracy": 0.5}')
+        plane.submit({
+            "kind": "operation", "name": "grapher",
+            "params": {"acc": {"ref": f"runs.{uid}",
+                               "value": "outputs.accuracy"}},
+            "component": {
+                "inputs": [{"name": "acc", "type": "float",
+                            "isOptional": True, "value": 0.0}],
+                "run": {"kind": "job", "container": {
+                    "command": ["python", "-c", "print(1)"]}},
+            },
+        })
+        result = runner.invoke(cli, ["ops", "lineage", "-uid", uid,
+                                     "--graph"])
+        assert result.exit_code == 0, result.output
+        assert "--param:acc-->" in result.output
+        assert "grapher" in result.output
+        assert "--output--> accuracy" in result.output
+        # Unknown uid: clean CLI error, not a traceback.
+        result = runner.invoke(cli, ["ops", "lineage", "-uid", "ghost",
+                                     "--graph"])
+        assert result.exit_code != 0
+        assert result.exception is None or isinstance(
+            result.exception, SystemExit)
+
     def test_projects(self, runner):
         assert runner.invoke(cli, ["projects", "create", "--name", "p9"]).exit_code == 0
         result = runner.invoke(cli, ["projects", "ls"])
