@@ -55,8 +55,9 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # per-module processes stay as defense in depth (scripts/
 # debug_fullsuite.sh re-tests the single-process run under
 # faulthandler + RSS sampling). VALIDATED 2026-08-01: with the raised
-# watchdog the single-process suite ran green for the first time on
-# this host — 537 passed in 45:27, no crash, peak RSS 8.2 GB.
+# watchdog the single-process suite ran green TWICE consecutively on
+# this host (537 passed in 45:27, then 538 in 46:10) — it had never
+# completed before; no crash, no core, peak RSS ~8 GB both runs.
 
 import pytest  # noqa: E402
 
