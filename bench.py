@@ -534,8 +534,11 @@ def main() -> int:
     # would silently measure something else — refuse it instead.
     from polyaxon_tpu.ops.flash import pick_block
 
-    for flag, value in (("--block-q", args.block_q),
-                        ("--block-k", args.block_k)):
+    # Validate AND normalize in one pass: ints land back on args as
+    # ints (they flow into the runtime spec), "auto" rides through to
+    # the kernel's trace-time auto-pick.
+    for attr, flag in (("block_q", "--block-q"), ("block_k", "--block-k")):
+        value = getattr(args, attr)
         if value is None or value == "auto":
             continue
         try:
@@ -549,12 +552,7 @@ def main() -> int:
                 f"{flag} {value} cannot tile seq {seq} in the flash "
                 f"kernel (effective block {effective}, minimum 128): "
                 "this sweep point would fall back to einsum attention")
-    # Normalized: ints flow into the runtime spec as ints, "auto" rides
-    # through to the kernel's trace-time auto-pick.
-    args.block_q = (args.block_q if args.block_q in (None, "auto")
-                    else int(args.block_q))
-    args.block_k = (args.block_k if args.block_k in (None, "auto")
-                    else int(args.block_k))
+        setattr(args, attr, value)
     if args.loss_chunk is not None:
         effective = pick_block(seq, args.loss_chunk)
         if args.loss_chunk < 1 or effective != args.loss_chunk:
