@@ -73,6 +73,7 @@ SMOKE_MODULES = {
     "test_deploy.py", "test_connections.py", "test_fs.py", "test_cli.py",
     "test_api.py", "test_tracking.py", "test_schedules_cache.py",
     "test_joins_events.py", "test_sliced.py", "test_controlplane.py",
+    "test_utils_env.py",
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",
@@ -143,6 +144,14 @@ def pytest_collection_modifyitems(config, items):
         stale = {entry for entry in set(SMOKE_NODES) - matched
                  if entry.split("::", 1)[0] in collected}
         assert not stale, f"SMOKE_NODES entries match no test: {stale}"
+    # SMOKE_MODULES gets the same guard: a renamed/deleted module must
+    # fail loudly, not silently shrink the tier. Filesystem-based so it
+    # holds for ANY collection subset (unlike the node guard, which
+    # needs the file collected to judge).
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    ghost = {m for m in SMOKE_MODULES
+             if not os.path.exists(os.path.join(tests_dir, m))}
+    assert not ghost, f"SMOKE_MODULES name no file: {ghost}"
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,56 @@
+"""Environment helpers (utils/env.py): the CPU-mesh XLA flag contract
+every virtual-mesh entrypoint (conftest, bench, sweeps, dryrun) relies
+on."""
+
+import os
+from unittest import mock
+
+from polyaxon_tpu.utils import cpu_mesh_xla_flags
+
+
+class TestCpuMeshXlaFlags:
+    def _flags(self, initial=None, **kw):
+        env = {} if initial is None else {"XLA_FLAGS": initial}
+        with mock.patch.dict(os.environ, env, clear=False):
+            if initial is None:
+                # Start clean: drop the conftest-inherited XLA_FLAGS.
+                os.environ.pop("XLA_FLAGS", None)
+            cpu_mesh_xla_flags(**kw)
+            return os.environ["XLA_FLAGS"].split()
+
+    def test_defaults(self):
+        flags = self._flags()
+        assert "--xla_force_host_platform_device_count=8" in flags
+        assert ("--xla_cpu_collective_call_terminate_timeout_seconds=600"
+                in flags)
+
+    def test_device_count_param(self):
+        assert "--xla_force_host_platform_device_count=4" in self._flags(
+            n_devices=4)
+
+    def test_operator_flags_win(self):
+        """An operator-set value is NEVER overridden (XLA repeated-flag
+        parsing is last-wins, so appending would silently defeat it)."""
+        flags = self._flags(
+            "--xla_cpu_collective_call_terminate_timeout_seconds=1200")
+        timeouts = [f for f in flags
+                    if f.startswith("--xla_cpu_collective_call_terminate")]
+        assert timeouts == [
+            "--xla_cpu_collective_call_terminate_timeout_seconds=1200"]
+
+    def test_existing_device_count_kept(self):
+        flags = self._flags("--xla_force_host_platform_device_count=2")
+        counts = [f for f in flags
+                  if f.startswith("--xla_force_host_platform")]
+        assert counts == ["--xla_force_host_platform_device_count=2"]
+
+    def test_idempotent(self):
+        first = self._flags()
+        with mock.patch.dict(os.environ,
+                             {"XLA_FLAGS": " ".join(first)}):
+            cpu_mesh_xla_flags()
+            assert os.environ["XLA_FLAGS"].split() == first
+
+    def test_unrelated_flags_preserved(self):
+        flags = self._flags("--xla_dump_to=/tmp/d")
+        assert "--xla_dump_to=/tmp/d" in flags
