@@ -68,19 +68,63 @@ def run_point(name: str, timeout_s: float = 1200, **kw):
                 popen.kill()
                 popen.communicate()
             return {"name": name, "error": f"timeout>{timeout_s:.0f}s", **kw}
-    line = None
-    for ln in reversed(proc.stdout.strip().splitlines()):
-        try:
-            line = json.loads(ln)
-            break
-        except json.JSONDecodeError:
-            continue
+    line = _last_json_line(proc.stdout)
     if line is None:
         tail = " | ".join(proc.stderr.strip().splitlines()[-3:])[-300:]
         return {"name": name, "error": f"rc={proc.returncode}: {tail}", **kw}
     out = {"name": name, "wall_s": round(time.time() - t0, 1), **kw, **line}
     # OOM shows up as an error field from bench's catch-all.
+    if kw.get("profile") and "error" not in out:
+        out.update(_analyze_profile(proc.stderr))
     return out
+
+
+def _last_json_line(stdout: str):
+    """Last parseable JSON object on stdout, or None — the one-JSON-line
+    output contract shared by bench.py and analyze_trace.py."""
+    for ln in reversed(stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def _analyze_profile(bench_stderr: str) -> dict:
+    """Run scripts/analyze_trace.py on the trace the bench just wrote
+    (it announces '# profiler trace -> <dir>/profile' on stderr) and
+    attach the summary — so every profiled chip point carries its own
+    matmul-ceiling/top-sink analysis in perf_sweep_results.json instead
+    of needing a manual per-point analyzer pass in the tunnel window.
+    Analysis failure never fails the measurement (the number stands on
+    its own; the note says what went wrong)."""
+    marker = "# profiler trace -> "
+    trace_dir = None
+    for ln in bench_stderr.splitlines():
+        if ln.startswith(marker):
+            trace_dir = ln[len(marker):].strip()
+    if not trace_dir:
+        return {"profile_analysis": {"error": "no trace dir announced"}}
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "analyze_trace.py"), trace_dir],
+            capture_output=True, text=True, timeout=600, cwd=REPO)
+    except (subprocess.TimeoutExpired, OSError) as exc:
+        # The measurement stands on its own — a slow/broken analyzer
+        # must never cost a completed chip number or the rest of the
+        # sweep (the docstring's promise, enforced).
+        return {"profile_analysis": {
+            "error": f"analyzer failed: {type(exc).__name__}"}}
+    summary = _last_json_line(proc.stdout)
+    if summary is not None:
+        summary.pop("categories", None)  # keep the record compact
+        return {"profile_analysis": summary}
+    tail = " | ".join(proc.stderr.strip().splitlines()[-2:])[-200:]
+    return {"profile_analysis": {
+        "error": f"analyzer rc={proc.returncode}: {tail}"}}
 
 
 def moe_dispatch_sweep(platform: str, steps: int) -> int:
