@@ -133,6 +133,15 @@ CONFIGS: dict[str, LlamaConfig] = {
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
         ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,
     ),
+    # Tied-embeddings variant (Gemma/Qwen-small convention: lm_head IS
+    # embed.T): exercises the transposed head path everywhere —
+    # training loss, decode logits, and the quantized serving branch
+    # where the [V, D] table must stay int8 on decode-loop carries.
+    "llama_tiny_tied": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,
+        tie_embeddings=True,
+    ),
 }
 
 
