@@ -102,11 +102,15 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     return rotated.astype(x.dtype)
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
+             offset: float = 0.0) -> jax.Array:
+    """``offset``: Gemma stores norm gains as deltas applied as
+    ``(offset + w)`` with offset 1 (zero-init == identity); llama-style
+    weights use offset 0."""
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(dtype)
 
 
 def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-6) -> jax.Array:

@@ -59,6 +59,12 @@ class LlamaConfig:
     rope_scaling: Optional[dict] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Gemma-convention knobs (all default to the llama convention):
+    # norm gains stored as deltas applied as (1 + w); tanh-approx GeGLU
+    # instead of SwiGLU; embeddings scaled by sqrt(dim) on read.
+    norm_offset: float = 0.0
+    mlp_activation: str = "silu"  # silu | gelu_tanh
+    scale_embeddings: bool = False
     # Sliding-window (Mistral-style) causal attention: each position
     # attends to its last `sliding_window` tokens. None = full causal.
     sliding_window: Optional[int] = None
@@ -142,6 +148,21 @@ CONFIGS: dict[str, LlamaConfig] = {
         ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,
         tie_embeddings=True,
     ),
+    # Gemma-2B architecture (public config): MQA (1 kv head), GeGLU,
+    # (1+w) norms, sqrt(dim)-scaled embeddings, tied head, 256k vocab.
+    # head_dim = dim / n_heads = 256, matching the published value.
+    "gemma_2b": LlamaConfig(
+        vocab_size=256_000, dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
+        ffn_dim=16_384, max_seq_len=8192, rope_theta=10_000.0,
+        tie_embeddings=True, norm_offset=1.0, mlp_activation="gelu_tanh",
+        scale_embeddings=True,
+    ),
+    "gemma_tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=1,
+        ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,
+        tie_embeddings=True, norm_offset=1.0, mlp_activation="gelu_tanh",
+        scale_embeddings=True,
+    ),
 }
 
 
@@ -149,20 +170,23 @@ def init(cfg: LlamaConfig, rng: jax.Array) -> Variables:
     keys = jax.random.split(rng, 10)
     L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    # Identity-at-init norm gains: weight w applies as (norm_offset + w),
+    # so llama (offset 0) initializes ones, Gemma (offset 1) zeros.
+    gain = jnp.full((L, D), 1.0 - cfg.norm_offset)
     params = {
         "embed": truncated_normal_init(keys[0], (cfg.vocab_size, D)),
         "layers": {
-            "attn_norm": jnp.ones((L, D)),
+            "attn_norm": gain,
             "wq": scaled_init(keys[1], (L, D, H * Hd), fan_in=D),
             "wk": scaled_init(keys[2], (L, D, KV * Hd), fan_in=D),
             "wv": scaled_init(keys[3], (L, D, KV * Hd), fan_in=D),
             "wo": scaled_init(keys[4], (L, H * Hd, D), fan_in=H * Hd),
-            "mlp_norm": jnp.ones((L, D)),
+            "mlp_norm": gain,
             "w_gate": scaled_init(keys[5], (L, D, F), fan_in=D),
             "w_up": scaled_init(keys[6], (L, D, F), fan_in=D),
             "w_down": scaled_init(keys[7], (L, F, D), fan_in=F),
         },
-        "final_norm": jnp.ones((D,)),
+        "final_norm": jnp.full((D,), 1.0 - cfg.norm_offset),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = truncated_normal_init(keys[8], (D, cfg.vocab_size))
@@ -193,13 +217,53 @@ def logical_axes(cfg: LlamaConfig) -> Variables:
 _rope = rope  # shared impl (models.common.rope)
 
 
+def _norm(cfg, x: jax.Array, weight: jax.Array) -> jax.Array:
+    """Config-routed rms_norm: llama weights apply as w, Gemma-style
+    as (1 + w) (cfg.norm_offset). getattr keeps the shared attention
+    kernels usable from moe/t5 configs that carry no offset."""
+    return rms_norm(x, weight, cfg.norm_eps,
+                    offset=getattr(cfg, "norm_offset", 0.0))
+
+
+def _act(cfg):
+    """MLP gate activation: SwiGLU (silu) or Gemma's tanh-approx GeGLU."""
+    kind = getattr(cfg, "mlp_activation", "silu")
+    if kind == "silu":
+        return jax.nn.silu
+    if kind == "gelu_tanh":
+        return functools.partial(jax.nn.gelu, approximate=True)
+    raise ValueError(f"unknown mlp_activation `{kind}`")
+
+
+def _embed(cfg, params: dict, tokens: jax.Array, dt) -> jax.Array:
+    """Embedding read with the optional Gemma sqrt(dim) scaling —
+    every forward/decode path reads through here so the convention
+    cannot diverge between prefill and decode."""
+    x = _embed_rows(params["embed"], tokens, dt)
+    if getattr(cfg, "scale_embeddings", False):
+        x = x * jnp.asarray(cfg.dim ** 0.5, dt)
+    return x
+
+
+def _mlp(cfg, x: jax.Array, layer: dict) -> jax.Array:
+    """The gated-MLP residual block (norm → act(gate)·up → down),
+    shared by the training layer and every decode flavour so the
+    convention can never desync between them (this block was
+    previously copy-pasted five times)."""
+    dt = cfg.dtype
+    h = _norm(cfg, x, layer["mlp_norm"])
+    gate = _act(cfg)(h @ _w(layer["w_gate"], dt))
+    up = h @ _w(layer["w_up"], dt)
+    return x + (gate * up) @ _w(layer["w_down"], dt)
+
+
 def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
            segment_ids: Optional[jax.Array] = None) -> jax.Array:
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
 
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = _norm(cfg, x, layer["attn_norm"])
     q = (h @ _w(layer["wq"], dt)).reshape(B, S, H, Hd)
     k = (h @ _w(layer["wk"], dt)).reshape(B, S, KV, Hd)
     v = (h @ _w(layer["wv"], dt)).reshape(B, S, KV, Hd)
@@ -216,10 +280,7 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer: dict, positions: jax.Array,
                                  bwd_impl=cfg.flash_bwd_impl)
     x = x + attn.reshape(B, S, H * Hd) @ _w(layer["wo"], dt)
 
-    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
-    up = h @ _w(layer["w_up"], dt)
-    x = x + (gate * up) @ _w(layer["w_down"], dt)
+    x = _mlp(cfg, x, layer)
     return x
 
 
@@ -309,7 +370,7 @@ def hidden_states(
         else:
             positions = jnp.broadcast_to(
                 jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    x = _embed_rows(params["embed"], tokens, dt)
+    x = _embed(cfg, params, tokens, dt)
 
     body = _layer_body(cfg)
 
@@ -320,7 +381,7 @@ def hidden_states(
             return body(carry, layer_params, positions, segment_ids), None
 
         x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return _norm(cfg, x, params["final_norm"])
 
 
 def lm_head(cfg: LlamaConfig, params: dict) -> jax.Array:
@@ -433,7 +494,7 @@ def cached_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
     n_rep = H // KV
     rows = jnp.arange(B)
 
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = _norm(cfg, x, layer["attn_norm"])
     q = (h @ _w(layer["wq"], dt)).reshape(B, 1, H, Hd)
     k = (h @ _w(layer["wk"], dt)).reshape(B, 1, KV, Hd)
     v = (h @ _w(layer["wv"], dt)).reshape(B, 1, KV, Hd)
@@ -472,21 +533,18 @@ def decode_step_ragged(
     dt = cfg.dtype
     C = cache["k"].shape[2]
     positions, slot, valid = ragged_cache_coords(pos, C)
-    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]  # [B, 1, D]
+    x = _embed(cfg, params, tokens, dt)[:, None, :]  # [B, 1, D]
 
     def layer_step(x, inputs):
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
         x, k_cache, v_cache = cached_attn_step(
             cfg, layer, x, k_cache, v_cache, positions, slot, valid)
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
-        up = h @ _w(layer["w_up"], dt)
-        x = x + (gate * up) @ _w(layer["w_down"], dt)
+        x = _mlp(cfg, x, layer)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     logits = decode_logits(cfg, params, x[:, 0])
     return logits, {"k": new_k, "v": new_v}
 
@@ -501,10 +559,10 @@ def _prompt_pass(cfg: LlamaConfig, params: dict, prompt: jax.Array):
     B, P = prompt.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None], (B, P))
-    x = _embed_rows(params["embed"], prompt, dt)
+    x = _embed(cfg, params, prompt, dt)
 
     def layer_step(x, layer):
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        h = _norm(cfg, x, layer["attn_norm"])
         q = (h @ _w(layer["wq"], dt)).reshape(B, P, H, Hd)
         k = (h @ _w(layer["wk"], dt)).reshape(B, P, KV, Hd)
         v = (h @ _w(layer["wv"], dt)).reshape(B, P, KV, Hd)
@@ -517,10 +575,7 @@ def _prompt_pass(cfg: LlamaConfig, params: dict, prompt: jax.Array):
                                      block_k=cfg.flash_block_k,
                                      bwd_impl=cfg.flash_bwd_impl)
         x = x + attn.reshape(B, P, H * Hd) @ _w(layer["wo"], dt)
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
-        up = h @ _w(layer["w_up"], dt)
-        x = x + (gate * up) @ _w(layer["w_down"], dt)
+        x = _mlp(cfg, x, layer)
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(layer_step, x, params["layers"])
@@ -568,7 +623,7 @@ def prefill(
             "k": zeros.at[:, :, slots].set(k_all[:, :, P - keep:]),
             "v": zeros.at[:, :, slots].set(v_all[:, :, P - keep:]),
         }
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     logits = (x[:, -1] @ lm_head(cfg, params).astype(dt)).astype(jnp.float32)
     return logits, cache
 
@@ -631,7 +686,7 @@ def decode_chunk(
     B, c = tokens.shape
     C = cache["k"].shape[2]
     positions = pos0[:, None] + jnp.arange(c)[None, :]  # [B, c]
-    x = _embed_rows(params["embed"], tokens, dt)  # [B, c, D]
+    x = _embed(cfg, params, tokens, dt)  # [B, c, D]
 
     cols = jnp.arange(C)[None, None, :]  # [1, 1, C]
     # Column j visible to the query at position p iff j <= p: unwritten
@@ -642,15 +697,12 @@ def decode_chunk(
         layer, k_cache, v_cache = inputs  # caches [B, C, KV, Hd]
         x, k_cache, v_cache = chunk_attn_step(
             cfg, layer, x, k_cache, v_cache, positions, valid)
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
-        up = h @ _w(layer["w_up"], dt)
-        x = x + (gate * up) @ _w(layer["w_down"], dt)
+        x = _mlp(cfg, x, layer)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     logits = decode_logits(cfg, params, x)
     return logits, {"k": new_k, "v": new_v}
 
@@ -671,7 +723,7 @@ def chunk_attn_step(cfg, layer: dict, x: jax.Array, k_cache: jax.Array,
     rows = jnp.arange(B)
     scaling = getattr(cfg, "rope_scaling", None)
 
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = _norm(cfg, x, layer["attn_norm"])
     q = (h @ _w(layer["wq"], dt)).reshape(B, c, H, Hd)
     k = (h @ _w(layer["wk"], dt)).reshape(B, c, KV, Hd)
     v = (h @ _w(layer["wv"], dt)).reshape(B, c, KV, Hd)
@@ -725,7 +777,7 @@ def paged_attn_step(cfg, layer: dict, x: jax.Array, k_pages: jax.Array,
     n_rep = H // KV
     page = k_pages.shape[2]
 
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    h = _norm(cfg, x, layer["attn_norm"])
     q = (h @ _w(layer["wq"], dt)).reshape(B, 1, H, Hd)
     k = (h @ _w(layer["wk"], dt)).reshape(B, 1, KV, Hd)
     v = (h @ _w(layer["wv"], dt)).reshape(B, 1, KV, Hd)
@@ -799,22 +851,19 @@ def decode_step_paged(
     dt = cfg.dtype
     page = cache["k"].shape[2]
     positions, write_page, write_off, valid = paged_coords(pos, tables, page)
-    x = _embed_rows(params["embed"], tokens, dt)[:, None, :]
+    x = _embed(cfg, params, tokens, dt)[:, None, :]
 
     def layer_step(x, inputs):
         layer, k_pages, v_pages = inputs
         x, k_pages, v_pages = paged_attn_step(
             cfg, layer, x, k_pages, v_pages, positions,
             write_page, write_off, tables, valid)
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ _w(layer["w_gate"], dt))
-        up = h @ _w(layer["w_up"], dt)
-        x = x + (gate * up) @ _w(layer["w_down"], dt)
+        x = _mlp(cfg, x, layer)
         return x, (k_pages, v_pages)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     logits = decode_logits(cfg, params, x[:, 0])
     return logits, {"k": new_k, "v": new_v}
 

@@ -17,6 +17,57 @@ def _tokens(rng, b, s, vocab):
     return jax.random.randint(rng, (b, s), 0, vocab)
 
 
+class TestGemmaVariant:
+    """The Gemma-convention knobs on the llama family: (1+w) norms
+    (zero-init gains), tanh-GeGLU, sqrt(dim)-scaled embeddings, MQA
+    (1 kv head), tied head. gemma_2b carries the published 2B shape."""
+
+    def test_forward_and_init_loss(self):
+        cfg = llama.CONFIGS["gemma_tiny"]
+        assert cfg.norm_offset == 1.0 and cfg.tie_embeddings
+        v = llama.init(cfg, jax.random.key(0))
+        # Zero-init norm gains: (1 + 0) == identity at init.
+        assert float(jnp.abs(v["params"]["final_norm"]).max()) == 0.0
+        batch = {"tokens": _tokens(jax.random.key(1), 2, 16, cfg.vocab_size)}
+        loss, metrics, _ = llama.apply(cfg, v, batch)
+        assert abs(float(loss) - math.log(cfg.vocab_size)) < 0.5
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+    def test_decode_matches_forward(self):
+        cfg = llama.CONFIGS["gemma_tiny"]
+        v = llama.init(cfg, jax.random.key(0))
+        tokens = _tokens(jax.random.key(1), 2, 12, cfg.vocab_size)
+        full = llama.forward(cfg, v["params"], tokens)
+        cache = llama.init_cache(cfg, 2, 16)
+        for t in range(tokens.shape[1] - 1):
+            lg, cache = llama.decode_step(cfg, v["params"], cache,
+                                          tokens[:, t], jnp.int32(t))
+            np.testing.assert_allclose(np.asarray(lg),
+                                       np.asarray(full[:, t]),
+                                       atol=2e-2, rtol=2e-2)
+
+    def test_embeddings_are_scaled(self):
+        """scale_embeddings multiplies the gathered rows by sqrt(dim) —
+        checked against the unscaled variant so the knob cannot
+        silently become a no-op."""
+        import dataclasses
+
+        cfg = llama.CONFIGS["gemma_tiny"]
+        off = dataclasses.replace(cfg, scale_embeddings=False)
+        v = llama.init(cfg, jax.random.key(0))
+        tokens = _tokens(jax.random.key(1), 1, 4, cfg.vocab_size)
+        scaled = llama._embed(cfg, v["params"], tokens, cfg.dtype)
+        plain = llama._embed(off, v["params"], tokens, cfg.dtype)
+        np.testing.assert_allclose(np.asarray(scaled),
+                                   np.asarray(plain) * cfg.dim ** 0.5,
+                                   rtol=1e-2)
+
+    def test_gemma_2b_shape_contract(self):
+        cfg = llama.CONFIGS["gemma_2b"]
+        assert cfg.head_dim == 256 and cfg.n_kv_heads == 1
+        assert cfg.vocab_size == 256_000 and cfg.mlp_activation == "gelu_tanh"
+
+
 class TestLlama:
     def test_forward_and_init_loss(self):
         cfg = llama.CONFIGS["llama_tiny"]
