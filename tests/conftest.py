@@ -77,6 +77,7 @@ SMOKE_MODULES = {
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",
+    "test_models.py::TestGemmaVariant::test_forward_and_init_loss",
     "test_models.py::TestT5::test_forward_and_init_loss",
     "test_models.py::TestEncoderModels",
     "test_models.py::TestRegistry",
