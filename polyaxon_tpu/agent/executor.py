@@ -27,10 +27,24 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 from urllib.parse import urlparse
 
+from polyaxon_tpu import chaos
 from polyaxon_tpu.compiler import COORDINATOR_PLACEHOLDER, ENV_JAXJOB_SPEC
 from polyaxon_tpu.compiler.plan import V1LaunchPlan
 from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.lifecycle import V1Statuses
+
+
+class InitTimeoutError(RuntimeError):
+    """A build/clone init phase overran its wall-clock budget; the run
+    fails with ``reason="InitTimeout"`` instead of the timeout
+    propagating through the agent tick."""
+
+
+def _init_timeout(env_var: str, default: float) -> float:
+    try:
+        return float(os.environ.get(env_var, default))
+    except ValueError:
+        return default
 
 
 def _safe_join(root: str, rel: str) -> str:
@@ -55,6 +69,7 @@ class _Gang:
     preempted: bool = False
     stop_event: threading.Event = field(default_factory=threading.Event)
     reaping: bool = False  # a member died; survivors were signalled
+    warning: Optional[str] = None  # non-fatal anomaly → WARNING condition
 
 
 class LocalExecutor:
@@ -71,7 +86,10 @@ class LocalExecutor:
         os.makedirs(plan.artifacts_dir, exist_ok=True)
         os.makedirs(plan.outputs_dir, exist_ok=True)
         os.makedirs(os.path.join(plan.artifacts_dir, "logs"), exist_ok=True)
+        fault_plan = chaos.active_plan()
         for phase in plan.init:
+            if fault_plan is not None:
+                fault_plan.maybe_stall_init(phase.kind)
             if phase.kind == "build":
                 self._init_build(plan, phase)
             elif phase.kind == "auth":
@@ -86,18 +104,31 @@ class LocalExecutor:
                     # Store URL (gs://, s3://, ...): download the whole
                     # prefix through the fs layer (upstream's artifacts
                     # initializer over fsspec — SURVEY §3.3).
-                    from polyaxon_tpu.fs import StoreError, get_store
+                    from polyaxon_tpu.fs import (
+                        StoreError,
+                        get_store,
+                        is_transient_store_error,
+                    )
+                    from polyaxon_tpu.utils.retries import with_retries
 
                     store = get_store(src)
                     name = (os.path.basename(urlparse(src).path.rstrip("/"))
                             or "artifacts")
                     dest = _safe_join(
                         os.path.join(plan.artifacts_dir, "inputs"), name)
-                    if store.download_dir("", dest) == 0:
+                    # Retried as a unit: one transient store blip must
+                    # not fail the run (download_dir re-copies already-
+                    # fetched files, so the retry stays correct).
+                    if with_retries(lambda: store.download_dir("", dest),
+                                    transient=is_transient_store_error,
+                                    key=plan.run_uuid) == 0:
                         # A single-object URL lists empty: fetch it as
                         # one file instead.
                         try:
-                            store.download_file("", dest)
+                            with_retries(
+                                lambda: store.download_file("", dest),
+                                transient=is_transient_store_error,
+                                key=plan.run_uuid)
                         except StoreError as exc:
                             raise StoreError(
                                 f"artifacts init phase found no objects "
@@ -137,10 +168,17 @@ class LocalExecutor:
         env = dict(os.environ)
         env.update(phase.config.get("env") or {})
         log_path = os.path.join(plan.artifacts_dir, "logs", "build.log")
-        with open(log_path, "ab") as log_handle:
-            proc = subprocess.run(
-                [str(c) for c in cmd], env=env, cwd=plan.artifacts_dir,
-                stdout=log_handle, stderr=subprocess.STDOUT, timeout=3600)
+        timeout = _init_timeout("POLYAXON_TPU_BUILD_TIMEOUT", 3600)
+        try:
+            with open(log_path, "ab") as log_handle:
+                proc = subprocess.run(
+                    [str(c) for c in cmd], env=env, cwd=plan.artifacts_dir,
+                    stdout=log_handle, stderr=subprocess.STDOUT,
+                    timeout=timeout)
+        except subprocess.TimeoutExpired as exc:
+            raise InitTimeoutError(
+                f"build `{phase.config.get('hubRef')}` hung past "
+                f"{timeout:.0f}s and was killed") from exc
         if proc.returncode != 0:
             tail = ""
             try:
@@ -175,15 +213,26 @@ class LocalExecutor:
             shutil.rmtree(dest)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         # `--` stops git from parsing a dash-prefixed url as an option.
-        clone = subprocess.run(
-            ["git", "clone", "--quiet", "--", url, dest],
-            capture_output=True, text=True, timeout=600)
+        timeout = _init_timeout("POLYAXON_TPU_GIT_TIMEOUT", 600)
+        try:
+            clone = subprocess.run(
+                ["git", "clone", "--quiet", "--", url, dest],
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired as exc:
+            raise InitTimeoutError(
+                f"git clone {url} hung past {timeout:.0f}s and was "
+                "killed") from exc
         if clone.returncode != 0:
             raise RuntimeError(f"git clone {url} failed: {clone.stderr.strip()}")
         if revision:
-            checkout = subprocess.run(
-                ["git", "-C", dest, "checkout", "--quiet", revision, "--"],
-                capture_output=True, text=True, timeout=120)
+            try:
+                checkout = subprocess.run(
+                    ["git", "-C", dest, "checkout", "--quiet", revision, "--"],
+                    capture_output=True, text=True,
+                    timeout=min(timeout, 120))
+            except subprocess.TimeoutExpired as exc:
+                raise InitTimeoutError(
+                    f"git checkout {revision} hung and was killed") from exc
             if checkout.returncode != 0:
                 raise RuntimeError(
                     f"git checkout {revision} failed: {checkout.stderr.strip()}")
@@ -248,8 +297,10 @@ class LocalExecutor:
                 handle = getattr(proc, "_plx_log_handle", None)
                 if handle and not handle.closed:
                     handle.close()
+            reason = ("InitTimeout" if isinstance(exc, InitTimeoutError)
+                      else "StartError")
             self.store.transition(run_uuid, V1Statuses.FAILED,
-                                  reason="StartError", message=str(exc)[:500])
+                                  reason=reason, message=str(exc)[:500])
             return False
         self._gangs[run_uuid] = gang
         self.store.transition(run_uuid, V1Statuses.RUNNING)
@@ -271,17 +322,36 @@ class LocalExecutor:
         spec = json.loads(plan.processes[0].env[ENV_JAXJOB_SPEC])
         job = V1JAXJob.from_dict(spec)
         tracking = Run(plan.run_uuid, plan.artifacts_dir)
+        ckpt_dir = os.path.join(plan.artifacts_dir, "checkpoints")
+
+        def should_stop() -> bool:
+            # Chaos gang seam for the in-process fast path: a thread
+            # has no pid to SIGKILL, so a due kill-fault raises inside
+            # the step loop — the same abrupt member death, observed
+            # through the same FAILED reap.
+            fault_plan = chaos.active_plan()
+            if fault_plan is not None:
+                fault_plan.maybe_kill_gang(plan.run_uuid, ckpt_dir)
+            return gang.stop_event.is_set()
+
         try:
             tracking.log_status(V1Statuses.RUNNING)
             result = run_jaxjob(job, artifacts_dir=plan.artifacts_dir,
                                 on_metrics=tracking.log_metrics_cb(),
-                                should_stop=gang.stop_event.is_set)
+                                should_stop=should_stop)
+            if result.restore_skipped_steps:
+                gang.warning = (
+                    f"restored checkpoint step {result.restored_from_step} "
+                    f"after skipping corrupt step(s) "
+                    f"{result.restore_skipped_steps}")
             tracking.log_outputs(
                 steps=result.steps, throughput=result.throughput,
                 wall_time=result.wall_time, param_count=result.param_count,
                 # Same resume-audit field as the subprocess entrypoint
                 # (runtime/launch.py): None means cold start.
                 restored_from_step=result.restored_from_step,
+                **({"restore_skipped_steps": result.restore_skipped_steps}
+                   if result.restore_skipped_steps else {}),
                 **{f"final_{k}": v for k, v in result.final_metrics.items()},
             )
             if gang.stop_event.is_set():
@@ -299,7 +369,26 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------ poll
     def poll(self) -> int:
-        """Reap finished gangs → terminal statuses. Returns actions."""
+        """Reap finished gangs → terminal statuses. Returns actions.
+
+        Precedence is STOPPING > preempted > exit status: a gang whose
+        run was asked to stop reaps STOPPED even if a preemption landed
+        while it was dying (the operator's intent wins over weather).
+        """
+        fault_plan = chaos.active_plan()
+        if fault_plan is not None:
+            # Chaos gang seam for subprocess gangs: SIGKILL one member
+            # of a due gang; the normal reap path must terminate the
+            # survivors and fail the run with the signal code.
+            for run_uuid, gang in list(self._gangs.items()):
+                live = [p for p in gang.procs if p.poll() is None]
+                ckpt_dir = os.path.join(gang.plan.artifacts_dir,
+                                        "checkpoints")
+                if live and fault_plan.gang_kill_due(run_uuid, ckpt_dir):
+                    try:
+                        live[0].kill()
+                    except OSError:
+                        pass
         actions = 0
         for run_uuid, gang in list(self._gangs.items()):
             status = self._gang_status(gang)
@@ -313,6 +402,14 @@ class LocalExecutor:
                 self.store.transition(run_uuid, V1Statuses.PREEMPTED,
                                       reason="SlicePreempted", force=True)
             else:
+                if gang.warning:
+                    # Non-fatal anomaly (e.g. checkpoint fallback):
+                    # pinned as a WARNING condition so operators see it
+                    # without the run dying.
+                    self.store.transition(
+                        run_uuid, V1Statuses.WARNING,
+                        reason="CheckpointFallback",
+                        message=gang.warning[:500], force=True)
                 target = V1Statuses.SUCCEEDED if status == 0 else V1Statuses.FAILED
                 self.store.transition(
                     run_uuid, target,

@@ -1,0 +1,21 @@
+from polyaxon_tpu.chaos.plan import (
+    ENV_CHAOS_PLAN,
+    ChaosKill,
+    ChaosPlan,
+    ChaosStore,
+    Fault,
+    active_plan,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "ENV_CHAOS_PLAN",
+    "ChaosKill",
+    "ChaosPlan",
+    "ChaosStore",
+    "Fault",
+    "active_plan",
+    "install",
+    "uninstall",
+]

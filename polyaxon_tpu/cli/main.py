@@ -594,13 +594,21 @@ def admin_teardown(config_file):
 @click.option("--owner-token", "owner_tokens", multiple=True,
               help="OWNER=TOKEN per-owner scoped credential (repeatable); "
                    "implies auth")
+@click.option("--chaos-plan", default=None,
+              help="(with --with-agent) JSON fault plan injected at the "
+                   "store/gang/checkpoint/tick seams (docs/robustness.md)")
 def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout,
-               slices, auth_token, owner_tokens):
+               slices, auth_token, owner_tokens, chaos_plan):
     """Serve the REST API (control plane + streams) in the foreground."""
     import threading
 
     from polyaxon_tpu.api import ApiServer
 
+    if chaos_plan:
+        from polyaxon_tpu import chaos
+
+        chaos.install(chaos.ChaosPlan.load(chaos_plan))
+        click.echo(f"chaos plan armed from {chaos_plan}")
     scoped = {}
     for item in owner_tokens:
         owner, sep, token = item.partition("=")
@@ -677,9 +685,13 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout,
               help="alpha used when --checkpoint is a LoRA fine-tune "
                    "(adapters fold into dense weights at load; must "
                    "match training)")
+@click.option("--max-pending", default=None, type=int,
+              help="(--batching continuous) cap on queued requests; a "
+                   "saturated POST /v1/generate answers 503 with "
+                   "Retry-After instead of queueing unbounded work")
 def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
               quantize, kv, kv_page_size, kv_pages, draft_model,
-              draft_checkpoint, spec_k, lora_alpha):
+              draft_checkpoint, spec_k, lora_alpha, max_pending):
     """Serve a model for generation (KV-cache decode over HTTP)."""
     from polyaxon_tpu.serving import ServingServer
 
@@ -697,7 +709,7 @@ def serve_cmd(model, checkpoint, host, port, seed, batching, slots, mesh_str,
                            kv=kv, page_size=kv_page_size, kv_pages=kv_pages,
                            draft_model=draft_model,
                            draft_checkpoint=draft_checkpoint, spec_k=spec_k,
-                           lora_alpha=lora_alpha)
+                           lora_alpha=lora_alpha, max_pending=max_pending)
     click.echo(f"serving {model} at {server.url}")
     try:
         server.httpd.serve_forever()  # foreground; no background thread
@@ -871,10 +883,19 @@ def _export_to_hf(model: str, cfg, orbax_path: str, out_dir: str) -> None:
               help="Register a TPU slice: NAME:TOPOLOGY[:spot], e.g. "
                    "pool0:8x8 or spot0:4x4:spot. Enables the native "
                    "topology-aware gang scheduler.")
-def agent_cmd(poll, max_concurrent, slices):
+@click.option("--chaos-plan", default=None,
+              help="JSON fault plan (file or inline) injected at the "
+                   "store/gang/checkpoint/tick seams — resilience "
+                   "drills against a live agent (docs/robustness.md)")
+def agent_cmd(poll, max_concurrent, slices, chaos_plan):
     """Run the agent reconcile loop in the foreground."""
     from polyaxon_tpu.agent import Agent
 
+    if chaos_plan:
+        from polyaxon_tpu import chaos
+
+        chaos.install(chaos.ChaosPlan.load(chaos_plan))
+        click.echo(f"chaos plan armed from {chaos_plan}")
     manager = None
     if slices:
         from polyaxon_tpu.agent import SliceManager

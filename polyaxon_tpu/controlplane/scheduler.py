@@ -14,12 +14,16 @@ Matrix state machines live in the pipeline run's ``meta["tuner"]``:
 
 from __future__ import annotations
 
+import datetime as _dt
 import logging
+import os
 from typing import Any, Optional
 
+from polyaxon_tpu import chaos
 from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.controlplane.store import RunRecord
-from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.lifecycle import V1Statuses, now as _now
+from polyaxon_tpu.utils.retries import backoff_delay
 from polyaxon_tpu.polyaxonfile import get_operation
 from polyaxon_tpu.polyflow.matrix import (
     V1Asha,
@@ -49,6 +53,23 @@ from polyaxon_tpu.tune import (
 logger = logging.getLogger(__name__)
 
 _DONE = V1Statuses.terminal_values()
+
+
+def _backoff_params() -> dict:
+    """Requeue-backoff knobs (env-tunable; docs/robustness.md)."""
+    return {
+        "base": float(os.environ.get("POLYAXON_TPU_BACKOFF_BASE", "0.5")),
+        "factor": float(os.environ.get("POLYAXON_TPU_BACKOFF_FACTOR", "2.0")),
+        "max_delay": float(os.environ.get("POLYAXON_TPU_BACKOFF_MAX", "60")),
+        "jitter": float(os.environ.get("POLYAXON_TPU_BACKOFF_JITTER", "0.25")),
+    }
+
+
+def _parse_ts(value: str) -> _dt.datetime:
+    ts = _dt.datetime.fromisoformat(value)
+    if ts.tzinfo is None:
+        ts = ts.replace(tzinfo=_dt.timezone.utc)
+    return ts
 
 
 def _trigger_satisfied(policy: str, statuses: list[V1Statuses]) -> Optional[bool]:
@@ -87,10 +108,20 @@ class Scheduler:
     def __init__(self, plane: ControlPlane):
         self.plane = plane
         self.store = plane.store
+        # FAILED runs that will never restart (no policy / no plan):
+        # remembered so the failed pass stays O(new failures) per tick
+        # instead of re-parsing every historical failure's spec.
+        self._no_restart: set[str] = set()
 
     # ------------------------------------------------------------------ tick
     def tick(self) -> int:
         """One idempotent scheduling pass; returns number of actions."""
+        plan = chaos.active_plan()
+        if plan is not None and plan.fire("tick", "skip") is not None:
+            # Injected control-plane stall: this tick does nothing; all
+            # progress must be recoverable by the next tick (ticks are
+            # pure functions of store state).
+            return 0
         actions = 0
         for record in self.store.list_runs(statuses=[V1Statuses.CREATED]):
             if record.kind == V1RunKind.DAG and record.pipeline_uuid:
@@ -131,6 +162,10 @@ class Scheduler:
                 actions += 1
         for record in self.store.list_runs(statuses=[V1Statuses.PREEMPTED]):
             actions += self._tick_preempted(record)
+        for record in self.store.list_runs(statuses=[V1Statuses.FAILED]):
+            actions += self._tick_failed(record)
+        for record in self.store.list_runs(statuses=[V1Statuses.RETRYING]):
+            actions += self._tick_retrying(record)
         return actions
 
     # -------------------------------------------------------------- events
@@ -173,22 +208,133 @@ class Scheduler:
             return None
         return True
 
+    # ------------------------------------------------- requeue w/ backoff
+    def _schedule_requeue(self, record: RunRecord, *, counter: str,
+                          delays_key: str, reason: str,
+                          force: bool = False) -> float:
+        """Move a run into RETRYING with a persisted backoff gate.
+
+        ``meta["backoff"]`` carries the state that makes ticks
+        idempotent: per-cause attempt counters, the delay audit trail,
+        and ``not_before`` — the wall-clock time before which the
+        RETRYING pass refuses to requeue (so a crash-looping run cannot
+        hot-loop the scheduler, and a requeued run is never re-popped
+        early). Jitter is keyed by (uuid, attempt): recomputing the
+        same requeue yields the same delay.
+        """
+        meta = dict(record.meta or {})
+        backoff = dict(meta.get("backoff") or {})
+        attempt = int(backoff.get(counter, 0))
+        delay = backoff_delay(attempt, key=f"{record.uuid}:{counter}:{attempt}",
+                              **_backoff_params())
+        not_before = _now() + _dt.timedelta(seconds=delay)
+        backoff[counter] = attempt + 1
+        backoff[delays_key] = list(backoff.get(delays_key) or []) + [
+            round(delay, 4)]
+        backoff["not_before"] = not_before.isoformat()
+        meta["backoff"] = backoff
+        self.store.update_run(record.uuid, meta=meta)
+        self.store.transition(
+            record.uuid, V1Statuses.RETRYING, reason=reason,
+            message=f"requeue attempt {attempt + 1} in {delay:.2f}s",
+            force=force)
+        return delay
+
+    def _tick_retrying(self, record: RunRecord) -> int:
+        """RETRYING → QUEUED once the backoff gate has passed."""
+        backoff = (record.meta or {}).get("backoff") or {}
+        not_before = backoff.get("not_before")
+        if not_before and _now() < _parse_ts(not_before):
+            return 0
+        self.store.transition(record.uuid, V1Statuses.QUEUED)
+        return 1
+
     # ------------------------------------------------------------ preemption
     def _tick_preempted(self, record: RunRecord) -> int:
         """Requeue preempted runs per termination policy (preemption does
-        not consume a retry unless the spec says so — TPU-native rule)."""
+        not consume a retry unless the spec says so — TPU-native rule).
+        The requeue goes through the backoff gate so a flapping spot
+        slice cannot hot-loop preempt→requeue→preempt."""
         op = get_operation(record.spec)
         term = op.termination or (op.component.termination if op.component else None)
         counts = bool(term and term.preemption_counts_as_retry)
         max_retries = term.max_retries if term and term.max_retries is not None else 3
         if counts:
             if record.retries + 1 > max_retries:
+                # Stamp the backoff state exhausted so the failure-
+                # restart pass cannot resurrect a run whose preemption
+                # budget is already spent.
+                meta = dict(record.meta or {})
+                meta["backoff"] = {**(meta.get("backoff") or {}),
+                                   "exhausted": True}
+                self.store.update_run(record.uuid, meta=meta)
                 self.store.transition(record.uuid, V1Statuses.FAILED,
                                       reason="RetriesExhausted")
                 return 1
             self.store.update_run(record.uuid, retries=record.retries + 1)
-        self.store.transition(record.uuid, V1Statuses.RETRYING, reason="Preempted")
-        self.store.transition(record.uuid, V1Statuses.QUEUED)
+            record = self.store.get_run(record.uuid)
+        self._schedule_requeue(record, counter="preempts",
+                               delays_key="preempt_delays",
+                               reason="Preempted")
+        return 1
+
+    # ------------------------------------------------------ restart policy
+    @staticmethod
+    def _restart_policy(op: V1Operation) -> Optional[str]:
+        """Normalized run-level restart policy: {never, on_failure,
+        always} from the run environment (k8s spellings accepted)."""
+        run = op.component.run if op.component else None
+        env = getattr(run, "environment", None)
+        policy = getattr(env, "restart_policy", None)
+        if not policy:
+            return None
+        normalized = str(policy).replace("-", "_").lower()
+        if normalized == "onfailure":
+            normalized = "on_failure"
+        return normalized
+
+    def _tick_failed(self, record: RunRecord) -> int:
+        """Enforce ``restart_policy`` ∈ {never, on_failure, always} for
+        FAILED runs: requeue through the backoff gate until the retry
+        budget (``termination.maxRetries``, default 3) is spent, then
+        pin a terminal ``RetriesExhausted`` condition.
+
+        Only runs that actually launched (have a plan) restart —
+        re-running a spec that cannot compile converges to the same
+        failure without doing work.
+        """
+        if record.uuid in self._no_restart:
+            return 0
+        backoff = (record.meta or {}).get("backoff") or {}
+        if backoff.get("exhausted"):
+            self._no_restart.add(record.uuid)
+            return 0
+        try:
+            op = get_operation(record.spec)
+        except Exception:  # noqa: BLE001 — an unparsable spec never restarts
+            self._no_restart.add(record.uuid)
+            return 0
+        policy = self._restart_policy(op)
+        if policy not in ("on_failure", "always") or not record.launch_plan:
+            self._no_restart.add(record.uuid)
+            return 0
+        term = op.termination or (op.component.termination if op.component else None)
+        max_retries = term.max_retries if term and term.max_retries is not None else 3
+        attempts = int(backoff.get("restarts", 0))
+        if attempts >= max_retries:
+            meta = dict(record.meta or {})
+            meta["backoff"] = {**backoff, "exhausted": True}
+            self.store.update_run(record.uuid, meta=meta)
+            self.store.transition(
+                record.uuid, V1Statuses.FAILED, reason="RetriesExhausted",
+                message=f"restart_policy={policy} consumed all "
+                        f"{max_retries} retries", force=True)
+            self._no_restart.add(record.uuid)
+            return 1
+        self.store.update_run(record.uuid, retries=attempts + 1)
+        self._schedule_requeue(record, counter="restarts",
+                               delays_key="delays",
+                               reason="RestartPolicy", force=True)
         return 1
 
     # ------------------------------------------------------------------- dag

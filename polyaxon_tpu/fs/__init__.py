@@ -4,7 +4,9 @@ from polyaxon_tpu.fs.store import (
     MemoryStore,
     Store,
     StoreError,
+    TransientStoreError,
     get_store,
+    is_transient_store_error,
     register_store,
 )
 
@@ -14,6 +16,8 @@ __all__ = [
     "MemoryStore",
     "Store",
     "StoreError",
+    "TransientStoreError",
     "get_store",
+    "is_transient_store_error",
     "register_store",
 ]

@@ -27,6 +27,35 @@ class StoreError(RuntimeError):
     pass
 
 
+class TransientStoreError(StoreError):
+    """A store failure worth retrying (network blip, throttle, injected
+    chaos fault) — as opposed to a permanent one (missing key, bad
+    credentials, unknown scheme)."""
+
+
+def is_transient_store_error(exc: BaseException) -> bool:
+    """Shared transient-vs-permanent classification for store IO: typed
+    transients and network/timeout OSErrors retry; missing keys and
+    usage errors do not."""
+    if isinstance(exc, TransientStoreError):
+        return True
+    if isinstance(exc, StoreError):
+        return False
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError,
+                        NotADirectoryError, PermissionError)):
+        return False
+    return isinstance(exc, (TimeoutError, ConnectionError, OSError))
+
+
+def _store_retry_params() -> dict:
+    """Retry knobs for object-store ops (docs/robustness.md)."""
+    return {
+        "attempts": int(os.environ.get("POLYAXON_TPU_STORE_RETRIES", "3")),
+        "base": float(os.environ.get("POLYAXON_TPU_STORE_RETRY_BASE", "0.1")),
+        "transient": is_transient_store_error,
+    }
+
+
 class Store:
     """Blob-store interface: paths are '/'-separated keys under a root."""
 
@@ -282,27 +311,35 @@ class FsspecStore(Store):
         key = key.lstrip("/")
         return f"{self.root}/{key}" if key else self.root
 
+    def _retrying(self, fn):
+        """Bounded retries with exponential backoff around one fsspec
+        op: cloud stores throw transient OSErrors under load, and one
+        blip must not fail a whole run (ISSUE 1 retry layer)."""
+        from polyaxon_tpu.utils.retries import with_retries
+
+        return with_retries(fn, key=self.scheme, **_store_retry_params())
+
     def read_bytes(self, key: str) -> bytes:
         try:
-            return self.fs.cat_file(self._key(key))
+            return self._retrying(lambda: self.fs.cat_file(self._key(key)))
         except FileNotFoundError as exc:
             raise StoreError(f"no such key {key!r}") from exc
 
     def write_bytes(self, key: str, data: bytes) -> None:
-        self.fs.pipe_file(self._key(key), bytes(data))
+        self._retrying(lambda: self.fs.pipe_file(self._key(key), bytes(data)))
 
     def exists(self, key: str) -> bool:
-        return bool(self.fs.exists(self._key(key)))
+        return bool(self._retrying(lambda: self.fs.exists(self._key(key))))
 
     def delete(self, key: str) -> None:
         path = self._key(key)
-        if self.fs.exists(path):
-            self.fs.rm(path, recursive=True)
+        if self._retrying(lambda: self.fs.exists(path)):
+            self._retrying(lambda: self.fs.rm(path, recursive=True))
 
     def list(self, prefix: str = "") -> list[str]:
         base = self._key(prefix) if prefix else self.root
         try:
-            found = self.fs.find(base)
+            found = self._retrying(lambda: self.fs.find(base))
         except FileNotFoundError:
             return []
         out = []
@@ -314,12 +351,12 @@ class FsspecStore(Store):
 
     # Object-store fast paths: stream files instead of buffering bytes.
     def upload_file(self, local_path: str, key: str) -> None:
-        self.fs.put_file(local_path, self._key(key))
+        self._retrying(lambda: self.fs.put_file(local_path, self._key(key)))
 
     def download_file(self, key: str, local_path: str) -> str:
         os.makedirs(os.path.dirname(os.path.abspath(local_path)), exist_ok=True)
         try:
-            self.fs.get_file(self._key(key), local_path)
+            self._retrying(lambda: self.fs.get_file(self._key(key), local_path))
         except FileNotFoundError as exc:
             raise StoreError(f"no such key {key!r}") from exc
         return local_path
@@ -333,15 +370,28 @@ def register_store(scheme: str, factory: Callable[[str], Store]) -> None:
 
 
 def get_store(url: str) -> Store:
-    """Dispatch a store URL: file:///path, memory://ns, gs://bucket, ..."""
+    """Dispatch a store URL: file:///path, memory://ns, gs://bucket, ...
+
+    While a chaos fault plan with store faults is active (tests, or an
+    operator drill via ``POLYAXON_TPU_CHAOS_PLAN``), the store is
+    wrapped so the plan can inject typed ``StoreError``s on the Nth op;
+    with no plan the concrete store is returned untouched.
+    """
     parsed = urlparse(url)
     scheme = parsed.scheme or "file"
     if scheme in _REGISTRY:
-        return _REGISTRY[scheme](url)
-    if scheme == "file":
-        return LocalStore(parsed.path or url)
-    if scheme == "memory":
-        return MemoryStore(parsed.netloc or "default")
-    if scheme in ("gs", "gcs", "s3", "wasb", "wasbs", "az", "abfs"):
-        return FsspecStore(url)
-    raise StoreError(f"unknown store scheme {scheme!r} in {url!r}")
+        store = _REGISTRY[scheme](url)
+    elif scheme == "file":
+        store = LocalStore(parsed.path or url)
+    elif scheme == "memory":
+        store = MemoryStore(parsed.netloc or "default")
+    elif scheme in ("gs", "gcs", "s3", "wasb", "wasbs", "az", "abfs"):
+        store = FsspecStore(url)
+    else:
+        raise StoreError(f"unknown store scheme {scheme!r} in {url!r}")
+    from polyaxon_tpu import chaos
+
+    plan = chaos.active_plan()
+    if plan is not None and plan.has_faults("store"):
+        return chaos.ChaosStore(store, plan)
+    return store

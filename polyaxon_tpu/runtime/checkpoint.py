@@ -36,6 +36,10 @@ class CheckpointManager:
             enable_async_checkpointing=bool(self.spec.async_save),
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        # Steps skipped by the most recent restore() because their
+        # on-disk bytes failed to deserialize (newest first); surfaced
+        # through TrainResult → outputs + a WARNING run condition.
+        self.last_restore_skipped: list[int] = []
 
     @property
     def enabled(self) -> bool:
@@ -60,14 +64,64 @@ class CheckpointManager:
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the sharding/layout of ``state_like`` (an existing
-        state pytree or eval_shape'd abstract tree with shardings)."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"No checkpoint under {self.directory}")
+        state pytree or eval_shape'd abstract tree with shardings).
+
+        With no explicit ``step``, a latest checkpoint whose bytes fail
+        to deserialize (truncated by an eviction mid-write, bit-rotted,
+        chaos-corrupted) falls back to the NEXT-OLDER step instead of
+        bricking resume; skipped steps land in ``last_restore_skipped``
+        so the run surfaces ``restored_from_step`` + a WARNING instead
+        of dying. An explicit ``step`` never falls back — the caller
+        asked for those exact bytes.
+        """
+        self.last_restore_skipped = []
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-        logger.info("Restored checkpoint step=%s from %s", step, self.directory)
-        return restored
+        if step is not None:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+            logger.info("Restored checkpoint step=%s from %s", step,
+                        self.directory)
+            return restored
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"No checkpoint under {self.directory}")
+        from polyaxon_tpu import chaos
+
+        plan = chaos.active_plan()
+        if plan is not None:
+            plan.corrupt_checkpoint(self.directory, steps)
+        last_error: Optional[Exception] = None
+        for candidate in steps:
+            try:
+                restored = self._mgr.restore(
+                    candidate, args=ocp.args.StandardRestore(abstract))
+            except Exception as exc:  # noqa: BLE001 — fall back to older
+                last_error = exc
+                self.last_restore_skipped.append(candidate)
+                logger.warning(
+                    "checkpoint step %s under %s failed to restore (%s: "
+                    "%s); falling back to the next-older step", candidate,
+                    self.directory, type(exc).__name__, str(exc)[:200])
+                try:
+                    # A corrupt committed step is garbage: left in place
+                    # it poisons both the next resume (same fallback
+                    # dance) and re-saving that step number.
+                    self._mgr.delete(candidate)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    logger.warning("could not delete corrupt step %s",
+                                   candidate)
+                continue
+            if self.last_restore_skipped:
+                logger.warning(
+                    "restored step %s after skipping corrupt step(s) %s",
+                    candidate, self.last_restore_skipped)
+            else:
+                logger.info("Restored checkpoint step=%s from %s",
+                            candidate, self.directory)
+            return restored
+        raise RuntimeError(
+            f"no restorable checkpoint under {self.directory}: every step "
+            f"{steps} failed to deserialize") from last_error
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

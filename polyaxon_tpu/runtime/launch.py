@@ -77,6 +77,8 @@ def main() -> int:
                 # resumed instead of silently burning the budget from
                 # step 0 (SURVEY §5.4).
                 restored_from_step=result.restored_from_step,
+                **({"restore_skipped_steps": result.restore_skipped_steps}
+                   if result.restore_skipped_steps else {}),
                 **{f"final_{k}": v for k, v in result.final_metrics.items()},
             )
             tracking.log_succeeded()

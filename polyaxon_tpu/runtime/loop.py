@@ -38,6 +38,10 @@ class TrainResult:
     wall_time: float
     param_count: int
     restored_from_step: Optional[int] = None
+    # Checkpoint steps whose bytes were skipped as corrupt during the
+    # restore that produced restored_from_step (newest first; empty on
+    # a clean restore or cold start).
+    restore_skipped_steps: list[int] = dataclasses.field(default_factory=list)
 
 
 def _model_config_cls(model_name: str):
@@ -151,12 +155,14 @@ def run_jaxjob(
 
         ckpt: Optional[CheckpointManager] = None
         restored_from = None
+        restore_skipped: list[int] = []
         ckpt_spec = job.checkpointing or V1JaxCheckpointing(enabled=False)
         if artifacts_dir and ckpt_spec.enabled:
             ckpt = CheckpointManager(f"{artifacts_dir}/checkpoints", ckpt_spec)
             if ckpt_spec.restore_on_start and ckpt.latest_step() is not None:
                 state = ckpt.restore(state)
                 restored_from = int(state["step"])
+                restore_skipped = list(ckpt.last_restore_skipped)
 
         seq = ds_kwargs.get("seq_len", 1)
         units_per_step = global_batch * (seq if model_def.unit == "tokens" else 1)
@@ -180,6 +186,7 @@ def run_jaxjob(
                 wall_time=0.0,
                 param_count=int(n_params),
                 restored_from_step=restored_from,
+                restore_skipped_steps=restore_skipped,
             )
         # Periodic held-out evaluation: a FIXED batch set drawn from the
         # same dataset family at a disjoint seed (or from `eval_path`
@@ -325,6 +332,7 @@ def run_jaxjob(
         wall_time=wall,
         param_count=int(n_params),
         restored_from_step=restored_from,
+        restore_skipped_steps=restore_skipped,
     )
 
 

@@ -46,6 +46,9 @@ def main() -> int:
     parser.add_argument("--spec-k", type=int, default=4)
     parser.add_argument("--lora-alpha", type=float, default=16.0,
                         help="alpha when --checkpoint is a LoRA fine-tune")
+    parser.add_argument("--max-pending", type=int, default=None,
+                        help="(continuous) pending-queue cap; saturated "
+                             "generate requests answer 503 + Retry-After")
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -68,7 +71,8 @@ def main() -> int:
                        draft_model=args.draft_model,
                        draft_checkpoint=args.draft_checkpoint,
                        spec_k=args.spec_k, lora_alpha=args.lora_alpha,
-                       prefill_chunk=args.prefill_chunk) as s:
+                       prefill_chunk=args.prefill_chunk,
+                       max_pending=args.max_pending) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

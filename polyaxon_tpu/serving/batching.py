@@ -41,6 +41,16 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+class QueueFull(RuntimeError):
+    """The continuous engine's pending queue is at its cap: the caller
+    should shed load (HTTP 503 + Retry-After) instead of queueing
+    unbounded work it will serve long after the client gave up."""
+
+    def __init__(self, message: str, retry_after: int = 1):
+        super().__init__(message)
+        self.retry_after = max(int(retry_after), 1)
+
+
 def validate_sampling(top_p: float, top_k: int) -> None:
     """Shared request-sampling validation (HTTP handler AND direct
     engine callers): out-of-range knobs must raise, not silently
@@ -87,7 +97,8 @@ class ContinuousBatchingEngine:
     def __init__(self, model: str, cfg, params, *, slots: int = 4,
                  max_len: Optional[int] = None, kv: str = "dense",
                  page_size: int = 16, kv_pages: Optional[int] = None,
-                 draft=None, prefill_chunk: Optional[int] = None):
+                 draft=None, prefill_chunk: Optional[int] = None,
+                 max_pending: Optional[int] = None):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
@@ -238,6 +249,12 @@ class ContinuousBatchingEngine:
         self._keys = [jax.random.key(0)] * slots
         self._slot_req: list[Optional[_Request]] = [None] * slots
 
+        # Graceful degradation: a bounded pending queue. None =
+        # unbounded (library callers managing their own admission);
+        # the HTTP layer maps QueueFull to 503 + Retry-After.
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.max_pending = max_pending
         self._queue: collections.deque[_Request] = collections.deque()
         self._cv = threading.Condition()
         self._stopped = False
@@ -466,6 +483,15 @@ class ContinuousBatchingEngine:
         with self._cv:
             if self._stopped:
                 raise RuntimeError("engine stopped")
+            if (self.max_pending is not None
+                    and len(self._queue) >= self.max_pending):
+                # Retry-After scales with how much decode work sits
+                # ahead of the caller: ~one hint-second per queued
+                # request per slot, floored at 1.
+                raise QueueFull(
+                    f"pending queue is full ({len(self._queue)}/"
+                    f"{self.max_pending}); retry later",
+                    retry_after=max(1, len(self._queue) // max(self.slots, 1)))
             self._queue.append(req)
             self._cv.notify()
         return req
@@ -644,6 +670,20 @@ class ContinuousBatchingEngine:
                 # (_count_request_failure has the counting rules).
                 if not self._count_request_failure(exc):
                     return
+
+    def health(self) -> dict:
+        """Liveness + load view for /healthz: queue depth and slot
+        occupancy, so a balancer can shed or route before generate
+        requests start bouncing off the 503 cap."""
+        return {
+            "status": "stopped" if self._stopped else "ok",
+            "model": self.model,
+            "engine": "continuous",
+            "queued": len(self._queue),
+            "active": sum(1 for r in self._slot_req if r is not None),
+            "slots": self.slots,
+            "max_pending": self.max_pending,
+        }
 
     def stats(self) -> dict:
         """Live engine counters + occupancy gauges for /v1/stats."""

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from polyaxon_tpu.serving.batching import validate_sampling
+from polyaxon_tpu.serving.batching import QueueFull, validate_sampling
 from polyaxon_tpu.serving.quantize import quantize_tree, tree_bytes
 
 logger = logging.getLogger(__name__)
@@ -447,16 +447,23 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):
         pass
 
-    def _json(self, payload: Any, status: int = 200) -> None:
+    def _json(self, payload: Any, status: int = 200,
+              headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802
         if self.path == "/healthz":
+            # The continuous engine reports queue depth + slot
+            # occupancy; the static engine has no queue to report.
+            if hasattr(self.engine, "health"):
+                return self._json(self.engine.health())
             return self._json({"status": "ok", "model": self.engine.model})
         if self.path == "/v1/models":
             return self._json({"models": [self.engine.model]})
@@ -508,6 +515,11 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=temperature, seed=seed,
                 top_p=top_p, top_k=top_k, eos_tokens=eos_tokens)
             return self._json({"tokens": out})
+        except QueueFull as exc:
+            # Saturated: shed load honestly instead of queueing work
+            # the client will have abandoned by decode time.
+            return self._json({"error": str(exc)}, status=503,
+                              headers={"Retry-After": str(exc.retry_after)})
         except (KeyError, ValueError, TypeError) as exc:
             return self._json({"error": str(exc)}, status=400)
         except Exception as exc:  # pragma: no cover
@@ -610,7 +622,8 @@ class ServingServer:
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None, spec_k: int = 4,
                  lora_alpha: float = 16.0,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 max_pending: Optional[int] = None):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -663,12 +676,16 @@ class ServingServer:
             self.engine = ContinuousBatchingEngine(
                 model, cfg, params, slots=slots, kv=kv,
                 page_size=page_size, kv_pages=kv_pages, draft=draft,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk, max_pending=max_pending)
         elif batching == "static":
             if prefill_chunk is not None:
                 raise ValueError(
                     "--prefill-chunk requires --batching continuous "
                     "(the static engine compiles whole generations)")
+            if max_pending is not None:
+                raise ValueError(
+                    "--max-pending requires --batching continuous (the "
+                    "static engine has no pending queue to bound)")
             if kv != "dense":
                 raise ValueError(
                     "kv='paged' requires --batching continuous (the "

@@ -110,8 +110,18 @@ class SidecarSync:
 
     def sync_once(self) -> int:
         if self._store is not None:
-            return self._store.sync_dir(self.run_dir,
-                                        state=self._store_state)
+            from polyaxon_tpu.fs import is_transient_store_error
+            from polyaxon_tpu.utils.retries import with_retries
+
+            # Transient store failures (throttles, injected chaos
+            # faults — typed StoreErrors that sync_dir's per-file
+            # OSError net does not catch) retry the pass in place;
+            # sync_dir is incremental, so a re-pass only re-ships what
+            # the failed pass missed.
+            return with_retries(
+                lambda: self._store.sync_dir(self.run_dir,
+                                             state=self._store_state),
+                transient=is_transient_store_error, key=self.run_dir)
         return sync_tree(self.run_dir, self.store_dir)
 
     def _loop(self) -> None:

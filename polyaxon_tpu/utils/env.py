@@ -30,11 +30,33 @@ def cpu_mesh_xla_flags(n_devices: int = 8, *,
     if not any(f.startswith("--xla_force_host_platform_device_count")
                for f in flags):
         flags.append(f"--xla_force_host_platform_device_count={n_devices}")
-    if not any(f.startswith("--xla_cpu_collective_call_terminate_timeout")
-               for f in flags):
+    if (_jaxlib_knows_collective_watchdog()
+            and not any(
+                f.startswith("--xla_cpu_collective_call_terminate_timeout")
+                for f in flags)):
         flags.append("--xla_cpu_collective_call_terminate_timeout_seconds"
                      f"={watchdog_timeout_s}")
     os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def _jaxlib_knows_collective_watchdog() -> bool:
+    """Whether this jaxlib parses the collective-watchdog flag.
+
+    XLA CHECK-aborts the WHOLE process on any unknown flag in
+    ``XLA_FLAGS`` ("Unknown flags in XLA_FLAGS: ..." at first backend
+    init), so on a jaxlib predating the flag (< 0.5, e.g. the 0.4.36 in
+    some images) appending it turns every jax-touching test into a
+    fatal abort. Skipping it there only loses the watchdog-extension
+    mitigation — strictly better than guaranteed process death. The
+    version probe imports jaxlib metadata only (no backend init).
+    """
+    try:
+        import jaxlib
+
+        parts = tuple(int(p) for p in jaxlib.__version__.split(".")[:2])
+    except Exception:  # noqa: BLE001 — unknown jaxlib: don't risk it
+        return False
+    return parts >= (0, 5)
 
 
 def apply_jax_platforms_override() -> None:

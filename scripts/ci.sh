@@ -33,6 +33,12 @@ else
     echo "== pytest (smoke tier; use --full for the whole suite)"
     python -m pytest tests/ -q -m smoke
 fi
+# Chaos stage: every fault plan is fixed-seed/counter-deterministic
+# (tests/test_chaos.py), so this runs in tier-1 on every invocation —
+# restart policies, store retries, checkpoint fallback, gang reaping,
+# and serving load-shedding all exercised under injected faults.
+echo "== chaos drills (fixed-seed fault plans)"
+python -m pytest tests/test_chaos.py -q -m chaos
 echo "== native ASan/UBSan"
 make -C native sanitize
 printf 'ADD a 4x4 0\nREQ r 2x2 0 0\nTICK 0 30\nQUIT\n' | ./native/build/sliced_san >/dev/null

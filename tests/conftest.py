@@ -131,6 +131,10 @@ def pytest_collection_modifyitems(config, items):
             matched.update(hits)
         if fname == "test_multiprocess_gang.py":
             item.add_marker(pytest.mark.gang)
+        if fname == "test_chaos.py":
+            # Fault-injection drills: selected as their own fixed-seed
+            # CI stage (`-m chaos` in scripts/ci.sh) and part of tier-1.
+            item.add_marker(pytest.mark.chaos)
     # A stale entry (renamed/deleted test) must fail collection loudly,
     # not silently shrink the default CI tier. Checked PER ENTRY: an
     # entry is stale only if its FILE was fully collected yet the node

@@ -1,0 +1,601 @@
+"""Chaos harness + retry/backoff layer (ISSUE 1): seed-driven fault
+plans injected at the store/gang/checkpoint/tick seams, and the
+recovery machinery they prove out — restart policies with persisted
+backoff, typed store retries, checkpoint restore fallback, init
+timeouts, gang reaping, and serving load-shedding."""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from polyaxon_tpu import chaos
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    """Sub-second backoff + retry delays so fault drills stay quick,
+    and a clean chaos slate around every test."""
+    monkeypatch.setenv("POLYAXON_TPU_BACKOFF_BASE", "0.05")
+    monkeypatch.setenv("POLYAXON_TPU_BACKOFF_MAX", "2")
+    monkeypatch.setenv("POLYAXON_TPU_STORE_RETRY_BASE", "0.01")
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+@pytest.fixture()
+def agent(plane):
+    return Agent(plane, max_concurrent=4)
+
+
+def drive(agent, plane, uuid, until, timeout=120.0, poll=0.03):
+    """Reconcile until ``until(record)`` or fail the test."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        agent.reconcile_once()
+        record = plane.get_run(uuid)
+        if until(record):
+            return record
+        time.sleep(poll)
+    raise AssertionError(
+        f"run {uuid} never satisfied the predicate; last status "
+        f"{plane.get_run(uuid).status}: {plane.get_statuses(uuid)}")
+
+
+# =================================================================== retries
+class TestRetries:
+    def test_transient_retries_then_succeeds(self):
+        from polyaxon_tpu.utils.retries import with_retries
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        assert with_retries(flaky, attempts=3, base=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_permanent_raises_immediately(self):
+        from polyaxon_tpu.utils.retries import with_retries
+
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise FileNotFoundError("gone")
+
+        with pytest.raises(FileNotFoundError):
+            with_retries(broken, attempts=5, base=0.001)
+        assert len(calls) == 1
+
+    def test_exhausted_reraises_last_error(self):
+        from polyaxon_tpu.utils.retries import with_retries
+
+        with pytest.raises(TimeoutError):
+            with_retries(lambda: (_ for _ in ()).throw(TimeoutError("t")),
+                         attempts=2, base=0.001)
+
+    def test_backoff_is_monotone_and_deterministic(self):
+        from polyaxon_tpu.utils.retries import backoff_delay
+
+        delays = [backoff_delay(i, base=0.5, key="run:restarts")
+                  for i in range(5)]
+        assert all(b > a for a, b in zip(delays, delays[1:]))
+        again = [backoff_delay(i, base=0.5, key="run:restarts")
+                 for i in range(5)]
+        assert delays == again  # same key → same jitter: idempotent ticks
+        other = [backoff_delay(i, base=0.5, key="other") for i in range(5)]
+        assert delays != other  # different runs decorrelate
+
+
+# ================================================================ fault plan
+class TestChaosPlan:
+    def test_nth_event_and_times_window(self):
+        plan = chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "store", "op": "read_bytes", "at": 2, "times": 2}]})
+        fired = [plan.fire("store", "read_bytes") is not None
+                 for _ in range(5)]
+        assert fired == [False, True, True, False, False]
+        assert plan.done
+
+    def test_wildcard_op_and_seam_isolation(self):
+        plan = chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "store", "op": "*", "at": 1}]})
+        assert plan.fire("tick", "skip") is None  # other seam untouched
+        assert plan.fire("store", "write_bytes") is not None
+        assert plan.done
+
+    def test_env_var_activation(self, monkeypatch, tmp_path):
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(
+            {"faults": [{"seam": "tick", "op": "skip"}]}))
+        monkeypatch.setenv(chaos.ENV_CHAOS_PLAN, str(path))
+        chaos.uninstall()  # force the env re-read
+        plan = chaos.active_plan()
+        assert plan is not None and plan.has_faults("tick")
+
+
+# =============================================================== store seam
+class TestStoreFaults:
+    def test_transient_fault_is_retried_through(self, tmp_path):
+        from polyaxon_tpu.fs import (
+            get_store,
+            is_transient_store_error,
+        )
+        from polyaxon_tpu.utils.retries import with_retries
+
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "store", "op": "read_bytes", "at": 1, "times": 2}]}))
+        store = get_store("memory://chaos-unit")
+        store.write_bytes("k", b"v")
+        with pytest.raises(Exception):
+            store.read_bytes("k")  # first direct read: injected fault
+        # The retry layer absorbs the remaining fault budget.
+        assert with_retries(lambda: store.read_bytes("k"),
+                            transient=is_transient_store_error,
+                            base=0.01) == b"v"
+        assert chaos.active_plan().done
+
+    def test_permanent_fault_is_not_retried(self):
+        from polyaxon_tpu.fs import (
+            StoreError,
+            get_store,
+            is_transient_store_error,
+        )
+        from polyaxon_tpu.utils.retries import with_retries
+
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "store", "op": "read_bytes", "at": 1, "times": 3,
+             "config": {"error": "permanent"}}]}))
+        store = get_store("memory://chaos-perm")
+        store.write_bytes("k", b"v")
+        with pytest.raises(StoreError):
+            with_retries(lambda: store.read_bytes("k"),
+                         transient=is_transient_store_error, base=0.01)
+        # Permanent → one attempt, not three: two fault budget left.
+        assert not chaos.active_plan().done
+
+    def test_derived_ops_route_through_hooks(self, tmp_path):
+        """download_dir on a wrapped store must hit the read_bytes hook
+        (the real init-phase entry point), not bypass it."""
+        from polyaxon_tpu.fs import TransientStoreError, get_store
+
+        seed = get_store("memory://chaos-derived")
+        seed.write_bytes("data/a.txt", b"a")
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "store", "op": "download_file", "at": 1}]}))
+        store = get_store("memory://chaos-derived")
+        with pytest.raises(TransientStoreError):
+            store.download_dir("", str(tmp_path / "out"))
+        assert chaos.active_plan().done
+
+
+# =========================================================== checkpoint seam
+class TestCheckpointFallback:
+    def _state(self, step: int):
+        import numpy as np
+
+        return {"step": np.asarray(step, np.int32),
+                "params": {"w": np.arange(8, dtype=np.float32) + step}}
+
+    def test_corrupt_latest_falls_back_to_older(self, tmp_path):
+        from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(
+            str(tmp_path / "ckpt"),
+            V1JaxCheckpointing(enabled=True, async_save=False))
+        mgr.save(2, self._state(2), force=True)
+        mgr.save(4, self._state(4), force=True)
+        mgr.wait()
+        assert mgr.latest_step() == 4
+
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "checkpoint", "op": "corrupt_latest"}]}))
+        restored = mgr.restore(self._state(0))
+        assert int(restored["step"]) == 2
+        assert mgr.last_restore_skipped == [4]
+        # The corrupt step was culled so the next save/restore is clean.
+        assert mgr.latest_step() == 2
+        mgr.close()
+        assert chaos.active_plan().done
+
+    def test_all_steps_corrupt_raises(self, tmp_path):
+        from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
+        from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(
+            str(tmp_path / "ckpt"),
+            V1JaxCheckpointing(enabled=True, async_save=False))
+        mgr.save(2, self._state(2), force=True)
+        mgr.wait()
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "checkpoint", "op": "corrupt_latest"}]}))
+        with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+            mgr.restore(self._state(0))
+        mgr.close()
+
+
+# ================================================================ tick seam
+class TestTickSeam:
+    def test_swallowed_tick_is_recovered_by_the_next(self, plane):
+        from polyaxon_tpu.controlplane.scheduler import Scheduler
+
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "tick", "op": "skip", "at": 1}]}))
+        record = plane.submit({
+            "kind": "component",
+            "run": {"kind": "job",
+                    "container": {"command": ["python", "-c", "print(1)"]}},
+        })
+        sched = Scheduler(plane)
+        assert sched.tick() == 0  # injected stall: nothing happens
+        assert plane.get_run(record.uuid).status == V1Statuses.CREATED
+        assert sched.tick() >= 1  # identical state, next tick advances
+        assert plane.get_run(record.uuid).status == V1Statuses.QUEUED
+        assert chaos.active_plan().done
+
+
+# ====================================================== restart policy (AC2)
+class TestRestartPolicyBackoff:
+    def test_on_failure_consumes_retries_then_exhausts(self, plane, agent):
+        """Acceptance: restart_policy=on_failure consumes retries with
+        monotonically growing meta["backoff"] delays and ends FAILED
+        reason=RetriesExhausted once the budget is spent."""
+        record = plane.submit({
+            "kind": "operation",
+            "termination": {"maxRetries": 2},
+            "component": {
+                "run": {
+                    "kind": "job",
+                    "environment": {"restartPolicy": "on_failure"},
+                    "container": {"command": [
+                        "python", "-c", "raise SystemExit(3)"]},
+                },
+            },
+        })
+
+        def exhausted(rec):
+            reasons = [c.get("reason")
+                       for c in plane.get_statuses(rec.uuid)]
+            return "RetriesExhausted" in reasons
+
+        final = drive(agent, plane, record.uuid, exhausted, timeout=90)
+        assert final.status == V1Statuses.FAILED
+        assert final.retries == 2
+        backoff = final.meta["backoff"]
+        assert backoff["exhausted"] is True
+        assert backoff["restarts"] == 2
+        delays = backoff["delays"]
+        assert len(delays) == 2
+        assert delays[1] > delays[0]  # monotone growth, audited in meta
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert conditions.count("retrying") == 2
+        assert conditions.count("failed") >= 3  # 1 initial + 2 restarts
+
+    def test_never_policy_does_not_restart(self, plane, agent):
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "environment": {"restartPolicy": "never"},
+                "container": {"command": [
+                    "python", "-c", "raise SystemExit(1)"]},
+            },
+        })
+        final = drive(agent, plane, record.uuid, lambda r: r.is_done,
+                      timeout=60)
+        for _ in range(3):
+            agent.reconcile_once()
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert final.status == V1Statuses.FAILED
+        assert "retrying" not in conditions
+
+    def test_requeue_waits_for_not_before(self, plane, agent, monkeypatch):
+        """A RETRYING run must not be re-popped before its backoff gate:
+        with a long base delay, immediate ticks leave it RETRYING."""
+        monkeypatch.setenv("POLYAXON_TPU_BACKOFF_BASE", "30")
+        record = plane.submit({
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "environment": {"restartPolicy": "on_failure"},
+                "container": {"command": [
+                    "python", "-c", "raise SystemExit(1)"]},
+            },
+        })
+        final = drive(
+            agent, plane, record.uuid,
+            lambda r: r.status == V1Statuses.RETRYING, timeout=60)
+        for _ in range(5):
+            agent.reconcile_once()
+        record = plane.get_run(record.uuid)
+        assert record.status == V1Statuses.RETRYING  # gate holds
+        assert record.meta["backoff"]["not_before"] > final.updated_at
+
+
+# ============================================================= init failures
+class TestInitTimeout:
+    def test_hung_build_fails_run_with_init_timeout(self, plane, agent,
+                                                    monkeypatch):
+        monkeypatch.setenv("POLYAXON_TPU_BUILD_TIMEOUT", "0.4")
+        record = plane.submit({
+            "kind": "component",
+            "run": {"kind": "job",
+                    "container": {"command": ["python", "-c", "print(1)"]}},
+        })
+        plane.compile_run(record.uuid)
+        # Splice a hung build phase into the compiled plan (the builder
+        # path a hubRef build: section produces).
+        plan_dict = dict(plane.get_run(record.uuid).launch_plan)
+        plan_dict["init"] = [{
+            "kind": "build",
+            "config": {"command": [sys.executable, "-c",
+                                   "import time; time.sleep(30)"],
+                       "hubRef": "slow-builder"},
+        }] + list(plan_dict.get("init") or [])
+        plane.store.update_run(record.uuid, launch_plan=plan_dict)
+
+        t0 = time.monotonic()
+        final = drive(agent, plane, record.uuid, lambda r: r.is_done,
+                      timeout=60)
+        assert final.status == V1Statuses.FAILED
+        assert time.monotonic() - t0 < 25  # not the build's 30s sleep
+        last = plane.get_statuses(record.uuid)[-1]
+        assert last["reason"] == "InitTimeout"
+        assert "hung" in (last.get("message") or "")
+
+    def test_hung_git_clone_raises_init_timeout(self, tmp_path,
+                                                monkeypatch):
+        import subprocess as sp
+
+        from polyaxon_tpu.agent.executor import InitTimeoutError
+
+        src = tmp_path / "repo"
+        src.mkdir()
+        sp.run(["git", "init", "-q", str(src)], check=True)
+        (src / "f.txt").write_text("x")
+        monkeypatch.setenv("POLYAXON_TPU_GIT_TIMEOUT", "0.001")
+
+        class _Plan:
+            artifacts_dir = str(tmp_path / "arts")
+
+        class _Phase:
+            config = {"url": str(src)}
+            path = "code"
+
+        os.makedirs(_Plan.artifacts_dir, exist_ok=True)
+        from polyaxon_tpu.agent.executor import LocalExecutor
+
+        executor = LocalExecutor.__new__(LocalExecutor)
+        with pytest.raises(InitTimeoutError, match="hung"):
+            executor._init_git(_Plan, _Phase)
+
+    def test_chaos_init_stall_is_survivable(self, plane, agent):
+        """The init stall seam delays a phase without breaking it: the
+        run still succeeds and the fault is consumed."""
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "init", "op": "auth",
+             "config": {"seconds": 0.2}}]}))
+        record = plane.submit({
+            "kind": "component",
+            "run": {"kind": "job",
+                    "container": {"command": ["python", "-c", "print(1)"]}},
+        })
+        final = drive(agent, plane, record.uuid, lambda r: r.is_done,
+                      timeout=60)
+        assert final.status == V1Statuses.SUCCEEDED
+        assert chaos.active_plan().done
+
+
+# ============================================================= gang reaping
+class TestGangReaping:
+    SLEEPER = {
+        "kind": "component",
+        "run": {
+            "kind": "jaxjob",
+            "numProcesses": 2,
+            "container": {"command": [
+                "python", "-c", "import time; time.sleep(60)"]},
+        },
+    }
+
+    def _wait_active(self, agent, plane, uuid, timeout=30):
+        deadline = time.monotonic() + timeout
+        while uuid not in agent.executor.active_runs:
+            assert time.monotonic() < deadline, "gang never started"
+            agent.reconcile_once()
+            time.sleep(0.05)
+
+    def test_signal_killed_member_reaps_survivors_and_fails(self, plane,
+                                                            agent):
+        record = plane.submit(self.SLEEPER)
+        self._wait_active(agent, plane, record.uuid)
+        gang = agent.executor._gangs[record.uuid]
+        assert len(gang.procs) == 2
+        gang.procs[0].kill()  # SIGKILL one member → exit code -9
+        t0 = time.monotonic()
+        final = drive(agent, plane, record.uuid, lambda r: r.is_done,
+                      timeout=30)
+        assert final.status == V1Statuses.FAILED
+        assert time.monotonic() - t0 < 25  # survivor did not sleep out 60s
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "exit code -9" in (last.get("message") or "")
+        assert all(p.poll() is not None for p in gang.procs)
+
+    def test_stopping_wins_over_preemption_at_reap(self, plane, agent):
+        """poll() precedence pin: a STOPPING run whose gang also took a
+        preemption reaps STOPPED — operator intent over weather."""
+        record = plane.submit(self.SLEEPER)
+        self._wait_active(agent, plane, record.uuid)
+        plane.stop(record.uuid)  # → STOPPING
+        assert agent.executor.preempt(record.uuid)  # kills + preempt mark
+        final = drive(agent, plane, record.uuid, lambda r: r.is_done,
+                      timeout=30)
+        assert final.status == V1Statuses.STOPPED
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert "preempted" not in conditions
+
+    def test_chaos_kill_seam_fails_subprocess_gang(self, plane, agent):
+        """The gang seam's own kill path: the plan SIGKILLs one member
+        and the normal reap fails the run with the signal code."""
+        chaos.install(chaos.ChaosPlan.from_dict({"faults": [
+            {"seam": "gang", "op": "kill"}]}))
+        record = plane.submit(self.SLEEPER)
+        self._wait_active(agent, plane, record.uuid)
+        final = drive(agent, plane, record.uuid, lambda r: r.is_done,
+                      timeout=30)
+        assert final.status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "exit code -9" in (last.get("message") or "")
+        assert chaos.active_plan().done
+
+
+# ==================================================== the chaos gauntlet (AC1)
+class TestChaosJaxjobGauntlet:
+    def test_one_run_survives_store_fault_kill_and_corrupt_ckpt(
+            self, plane, tmp_path):
+        """Acceptance: ONE jaxjob run rides through (a) a transient
+        store fault during artifact init, (b) a gang-member kill after
+        two checkpoints exist, and (c) a corrupted latest checkpoint on
+        resume — and still reaches SUCCEEDED with restored_from_step
+        set from the OLDER checkpoint."""
+        from polyaxon_tpu.fs import get_store
+
+        seed_store = get_store("memory://chaos-gauntlet")
+        seed_store.write_bytes("vocab.txt", b"tokens")
+
+        chaos.install(chaos.ChaosPlan.from_dict({"seed": 7, "faults": [
+            {"seam": "store", "op": "*", "at": 1, "times": 1},
+            {"seam": "gang", "op": "kill",
+             "config": {"min_checkpoints": 2}},
+            {"seam": "checkpoint", "op": "corrupt_latest"},
+        ]}))
+
+        record = plane.submit({
+            "kind": "operation",
+            "termination": {"maxRetries": 2},
+            "component": {
+                "name": "gauntlet",
+                "run": {
+                    "kind": "jaxjob",
+                    "numProcesses": 1,
+                    "environment": {"restartPolicy": "on_failure"},
+                    "init": [{"artifacts": {
+                        "path": "memory://chaos-gauntlet"}}],
+                    "mesh": {"axes": {"dp": 8}},
+                    "checkpointing": {"enabled": True, "intervalSteps": 2,
+                                      "asyncSave": False,
+                                      "restoreOnStart": True},
+                    "runtime": {
+                        "model": "llama_tiny",
+                        "dataset": "lm_synthetic",
+                        "steps": 6,
+                        "seq_len": 64,
+                        "global_batch_size": 8,
+                    },
+                },
+            },
+        })
+        agent = Agent(plane, in_process=True)
+
+        def settled(rec):
+            if rec.status == V1Statuses.SUCCEEDED:
+                return True
+            reasons = [c.get("reason") for c in plane.get_statuses(rec.uuid)]
+            assert "RetriesExhausted" not in reasons, reasons
+            return False
+
+        final = drive(agent, plane, record.uuid, settled, timeout=420)
+        assert final.status == V1Statuses.SUCCEEDED
+
+        plan = chaos.active_plan()
+        assert plan.done, f"unconsumed faults; fired: {plan.consumed}"
+        seams = [c["seam"] for c in plan.consumed]
+        assert seams.count("store") == 1
+        assert seams.count("gang") == 1
+        assert seams.count("checkpoint") == 1
+
+        # The kill consumed exactly one restart, through the backoff gate.
+        assert final.retries == 1
+        assert len(final.meta["backoff"]["delays"]) == 1
+        conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
+        assert "retrying" in conditions
+
+        # Resume restored from the OLDER checkpoint (label 2 → state
+        # step 3), skipping the corrupted latest (label 4), and surfaced
+        # both the outputs audit and a WARNING condition.
+        outputs = plane.streams.get_outputs(record.uuid)
+        assert outputs["steps"] == 6
+        assert outputs["restored_from_step"] == 3
+        assert outputs["restore_skipped_steps"] == [4]
+        warning = [c for c in plane.get_statuses(record.uuid)
+                   if c["type"] == "warning"]
+        assert warning and warning[-1]["reason"] == "CheckpointFallback"
+        assert "4" in warning[-1]["message"]
+
+        # The transiently-faulted artifact download still landed.
+        arts_dir = plane.run_artifacts_dir(record.uuid)
+        assert os.path.exists(os.path.join(
+            arts_dir, "inputs", "artifacts", "vocab.txt"))
+
+
+# ======================================================== serving degradation
+class TestServingBackpressure:
+    def test_queue_cap_503_and_healthz_depth(self):
+        from polyaxon_tpu.serving import ServingServer
+
+        with ServingServer("llama_tiny", batching="continuous", slots=1,
+                           max_pending=1) as server:
+            # Saturate: one request decoding in the slot, one queued.
+            r1 = server.engine.submit([5, 6, 7], 32)
+            deadline = time.monotonic() + 120
+            while server.engine.stats()["queued"] > 0:
+                assert time.monotonic() < deadline, "r1 never admitted"
+                time.sleep(0.02)  # wait for r1 to occupy the only slot
+            r2 = server.engine.submit([5, 6, 7], 32)
+            body = json.dumps({"tokens": [[5, 6, 7]],
+                               "max_new_tokens": 32}).encode()
+            req = urllib.request.Request(
+                server.url + "/v1/generate", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc_info.value.code == 503
+            assert int(exc_info.value.headers["Retry-After"]) >= 1
+            payload = json.loads(exc_info.value.read())
+            assert "queue is full" in payload["error"]
+
+            with urllib.request.urlopen(server.url + "/healthz",
+                                        timeout=30) as resp:
+                health = json.load(resp)
+            assert health["status"] == "ok"
+            assert health["engine"] == "continuous"
+            assert health["slots"] == 1
+            assert health["max_pending"] == 1
+            assert health["queued"] >= 1  # the capped queue is visible
+
+            out1 = r1.wait(timeout=300)
+            out2 = r2.wait(timeout=300)
+            assert len(out1) == 32 and len(out2) == 32
+
+            # Drained: the same request is admitted again.
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                out = json.load(resp)
+            assert len(out["tokens"][0]) == 32
