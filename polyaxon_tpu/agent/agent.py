@@ -20,6 +20,11 @@ from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.agent.executor import LocalExecutor
 from polyaxon_tpu.lifecycle import V1Statuses
 from polyaxon_tpu.polyflow.runs import V1RunKind
+from polyaxon_tpu.scheduling import (
+    AdmissionController,
+    gang_priority,
+    sched_info,
+)
 
 _PIPELINE_KINDS = {"matrix", V1RunKind.DAG, "schedule"}
 
@@ -33,12 +38,14 @@ class Agent:
         max_concurrent: int = 4,
         in_process: bool = False,
         slice_manager=None,  # agent.slices.SliceManager (native pool)
+        admission: Optional[AdmissionController] = None,
     ):
         self.plane = plane
         self.scheduler = Scheduler(plane)
         self.executor = executor or LocalExecutor(plane, in_process=in_process)
         self.max_concurrent = max_concurrent
         self.slices = slice_manager
+        self.admission = admission or AdmissionController(plane)
         self._notified: set[str] = set()
         self._notify_service = None  # built lazily from the home catalog
 
@@ -132,8 +139,13 @@ class Agent:
                     hub_ref, record.uuid, exc)
         return spawned
 
-    def _cleared_to_start(self, record) -> bool:
-        """Topology-gated placement through the native slice pool."""
+    def _cleared_to_start(self, record, info=None) -> bool:
+        """Topology-gated placement through the native slice pool.
+
+        The gang's pool priority comes from the run's queue + priority
+        class (scheduling catalog), so a high-priority request can
+        evict lower-priority gangs from preemptible slices natively.
+        """
         if self.slices is None:
             return True
         plan = record.launch_plan or {}
@@ -142,9 +154,12 @@ class Agent:
         # Plans serialize by camelCase alias (schemas/base.py), so the
         # stored key is maxRetries; accept both for robustness.
         max_retries = term.get("maxRetries") or term.get("max_retries") or 0
+        if info is None:
+            info = sched_info(record)
         state = self.slices.ensure_placed(
             record.uuid,
             resources.get("topology"),
+            priority=gang_priority(info.queue_priority, info.priority),
             max_restarts=max_retries,
             preemptible=bool(resources.get("preemptible")),
         )
@@ -177,11 +192,30 @@ class Agent:
             r for r in self.plane.list_runs(statuses=[V1Statuses.QUEUED])
             if r.kind not in _PIPELINE_KINDS
         ]
-        capacity = self.max_concurrent - len(self.executor.active_runs)
-        for record in queued[: max(capacity, 0)]:
-            if not self._cleared_to_start(record):
+        capacity = max(self.max_concurrent - len(self.executor.active_runs), 0)
+        decision = self.admission.plan(
+            queued, capacity=capacity,
+            active=set(self.executor.active_runs))
+        for victim in decision.victims:
+            # Control-plane-driven priority preemption: kill the gang
+            # (reaps PREEMPTED next poll → backoff requeue) and vacate
+            # its chips so the starved run can place immediately.
+            if victim in self.executor.active_runs:
+                self.executor.preempt(victim)
+                if self.slices is not None:
+                    self.slices.release(victim)
+                actions += 1
+        started = 0
+        for record, info in decision.admitted:
+            if started >= capacity:
+                break
+            # Scan PAST placement-pending runs until capacity fills: one
+            # uncleared run must never waste a slot a clearable run
+            # behind it could use (head-of-line fix).
+            if not self._cleared_to_start(record, info):
                 continue
             self.executor.start(record.uuid)
+            started += 1
             actions += 1
         # Stop requests for gangs we own.
         for record in self.plane.list_runs(statuses=[V1Statuses.STOPPING]):

@@ -41,11 +41,15 @@ class SliceManager:
 
     # ------------------------------------------------------------ placement
     def ensure_placed(self, run_uuid: str, topology: Optional[str], *,
-                      priority: int = 0, max_restarts: int = 0,
+                      priority: Optional[int] = None, max_restarts: int = 0,
                       preemptible: bool = False) -> str:
         """Returns the gang state (``running`` means cleared to start).
 
         Runs without a topology request bypass placement entirely.
+        ``priority`` is the scheduling catalog's gang priority (queue ×
+        priority class — ``scheduling.gang_priority``); ``None`` falls
+        back to the legacy preemptible/reserved split. 0 is a real
+        priority (the ``low`` class on a priority-0 queue), not "unset".
         """
         if not topology:
             return "running"
@@ -64,7 +68,8 @@ class SliceManager:
             try:
                 gang_id = self.pool.request_gang(
                     run_uuid, topology,
-                    priority=priority if priority else (0 if preemptible else 1),
+                    priority=(priority if priority is not None
+                              else (0 if preemptible else 1)),
                     max_restarts=max_restarts,
                 )
             except SlicedError as exc:

@@ -246,6 +246,10 @@ class _Handler(BaseHTTPRequestHandler):
             if rest == ["projects"]:
                 self._require(caller, admin=True)
                 return self._json(self.plane.store.list_projects())
+            if rest and rest[0] == "queues":
+                return self._queues(method, caller, rest[1:])
+            if rest and rest[0] == "quotas":
+                return self._quotas(method, caller, rest[1:])
             if rest == ["agent", "slices"]:
                 # The C++ slice pool's operator view (empty when this
                 # server runs without a slice-managing agent).
@@ -275,6 +279,78 @@ class _Handler(BaseHTTPRequestHandler):
                 self._require(caller, owner=rest[0])
                 return self._logs(caller, rest[3], query)
         raise ApiError(404, f"no route for {method} {'/'.join(parts)}")
+
+    # -- scheduling catalog ------------------------------------------------
+    def _queues(self, method: str, caller: Optional[str],
+                rest: list[str]) -> None:
+        """GET /api/v1/queues            — queues + live depth/usage
+           GET /api/v1/queues/{name}     — one queue + its queued runs
+           POST /api/v1/queues           — create/update (admin)
+           POST /api/v1/queues/{name}/delete (admin)
+        Reads are open to any authenticated caller (queue depth is how
+        tenants see where their run sits); writes are operator-only."""
+        stats = None
+        if method == "GET":
+            self._require(caller)
+            stats = self.plane.scheduling_stats()
+            if not rest:
+                return self._json(stats["queues"])
+            name = rest[0]
+            for queue in stats["queues"]:
+                if queue["name"] == name:
+                    return self._json(queue)
+            raise ApiError(404, f"queue {name} not found")
+        self._require(caller, admin=True)
+        if not rest:
+            body = self._read_body()
+            name = body.get("name")
+            if not name:
+                raise ApiError(400, "queue body requires `name`")
+            queue = self.plane.upsert_queue(
+                name,
+                priority=int(body.get("priority") or 0),
+                concurrency=body.get("concurrency"),
+                preemptible=bool(body.get("preemptible")),
+                description=body.get("description") or "",
+            )
+            return self._json(queue, status=201)
+        if len(rest) == 2 and rest[1] == "delete":
+            try:
+                removed = self.plane.delete_queue(rest[0])
+            except ValueError as exc:
+                raise ApiError(400, str(exc)) from exc
+            if not removed:
+                raise ApiError(404, f"queue {rest[0]} not found")
+            return self._json({"deleted": rest[0]})
+        raise ApiError(404, f"no queue route for {'/'.join(rest)}")
+
+    def _quotas(self, method: str, caller: Optional[str],
+                rest: list[str]) -> None:
+        """GET /api/v1/quotas — per-project quota rows + usage;
+           POST /api/v1/quotas — set a project quota (admin)."""
+        if method == "GET":
+            self._require(caller)
+            stats = self.plane.scheduling_stats()
+            return self._json({"quotas": stats["quotas"],
+                               "projects": stats["projects"]})
+        self._require(caller, admin=True)
+        if not rest:
+            body = self._read_body()
+            project = body.get("project")
+            if not project:
+                raise ApiError(400, "quota body requires `project`")
+            quota = self.plane.set_quota(
+                project,
+                max_runs=body.get("maxRuns", body.get("max_runs")),
+                max_chips=body.get("maxChips", body.get("max_chips")),
+                weight=float(body.get("weight") or 1.0),
+            )
+            return self._json(quota, status=201)
+        if len(rest) == 2 and rest[1] == "delete":
+            if not self.plane.delete_quota(rest[0]):
+                raise ApiError(404, f"quota for {rest[0]} not found")
+            return self._json({"deleted": rest[0]})
+        raise ApiError(404, f"no quota route for {'/'.join(rest)}")
 
     def _dashboard(self) -> None:
         """Polyboard-lite (api.ui): the static runs dashboard."""
@@ -307,6 +383,17 @@ class _Handler(BaseHTTPRequestHandler):
         lines += [
             f'polyaxon_runs{{status="{status}"}} {n}'
             for status, n in sorted(counts.items())
+        ]
+        stats = self.plane.scheduling_stats()
+        lines.append("# TYPE polyaxon_queue_depth gauge")
+        lines += [
+            f'polyaxon_queue_depth{{queue="{q["name"]}"}} {q["depth"]}'
+            for q in stats["queues"]
+        ]
+        lines.append("# TYPE polyaxon_queue_running gauge")
+        lines += [
+            f'polyaxon_queue_running{{queue="{q["name"]}"}} {q["running"]}'
+            for q in stats["queues"]
         ]
         if started is not None:
             lines += [

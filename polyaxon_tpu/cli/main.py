@@ -479,6 +479,123 @@ def projects_ls():
         click.echo(f"{proj['name']}  {proj.get('description') or ''}")
 
 
+# -------------------------------------------------------------- scheduling
+@cli.group("queue")
+def queue_group():
+    """Manage scheduling queues (docs/scheduling.md)."""
+
+
+@queue_group.command("ls")
+def queue_ls():
+    """List queues with priority, caps, and live depth/usage."""
+    plane = get_plane()
+    stats = plane.scheduling_stats()
+    click.echo(f"{'NAME':16s} {'PRIO':>4s} {'CAP':>4s} {'SPOT':>4s} "
+               f"{'DEPTH':>5s} {'RUNNING':>7s}")
+    for queue in stats["queues"]:
+        cap = queue["concurrency"]
+        click.echo(f"{queue['name']:16s} {queue['priority']:>4d} "
+                   f"{('-' if cap is None else str(cap)):>4s} "
+                   f"{('yes' if queue['preemptible'] else 'no'):>4s} "
+                   f"{queue['depth']:>5d} {queue['running']:>7d}")
+
+
+@queue_group.command("add")
+@click.argument("name")
+@click.option("--priority", default=0, help="higher admits (and evicts) first")
+@click.option("--concurrency", default=None, type=int,
+              help="max concurrent runs admitted from this queue")
+@click.option("--preemptible", is_flag=True,
+              help="runs admitted here may be evicted for higher-priority work")
+@click.option("--description", default="")
+def queue_add(name, priority, concurrency, preemptible, description):
+    """Create or update a queue."""
+    plane = get_plane()
+    queue = plane.upsert_queue(name, priority=priority,
+                               concurrency=concurrency,
+                               preemptible=preemptible,
+                               description=description)
+    click.echo(json.dumps(queue, indent=2, default=str))
+
+
+@queue_group.command("rm")
+@click.argument("name")
+def queue_rm(name):
+    plane = get_plane()
+    try:
+        removed = plane.delete_queue(name)
+    except ValueError as exc:
+        raise click.ClickException(str(exc)) from exc
+    if not removed:
+        raise click.ClickException(f"queue `{name}` not found")
+    click.echo(f"Queue `{name}` removed")
+
+
+@queue_group.command("inspect")
+@click.argument("name")
+def queue_inspect(name):
+    """One queue's config + depth + the runs currently queued/live on it."""
+    from polyaxon_tpu.lifecycle import V1Statuses
+    from polyaxon_tpu.scheduling import LIVE_STATUSES, sched_info
+
+    plane = get_plane()
+    stats = plane.scheduling_stats()
+    queue = next((q for q in stats["queues"] if q["name"] == name), None)
+    if queue is None:
+        raise click.ClickException(f"queue `{name}` not found")
+    click.echo(json.dumps(queue, indent=2, default=str))
+    rows = plane.list_runs(statuses=[V1Statuses.QUEUED] + LIVE_STATUSES)
+    members = [r for r in rows if sched_info(r).queue == name]
+    if members:
+        click.echo("runs:")
+        for record in members:
+            _echo_run(record)
+
+
+@cli.group("quota")
+def quota_group():
+    """Manage per-project quotas (docs/scheduling.md)."""
+
+
+@quota_group.command("ls")
+def quota_ls():
+    """List project quotas with live usage."""
+    plane = get_plane()
+    stats = plane.scheduling_stats()
+    click.echo(f"{'PROJECT':16s} {'MAXRUNS':>7s} {'MAXCHIPS':>8s} "
+               f"{'WEIGHT':>6s} {'RUNS':>4s} {'CHIPS':>5s} {'QUEUED':>6s}")
+    for quota in stats["quotas"]:
+        click.echo(
+            f"{quota['project']:16s} "
+            f"{('-' if quota['max_runs'] is None else str(quota['max_runs'])):>7s} "
+            f"{('-' if quota['max_chips'] is None else str(quota['max_chips'])):>8s} "
+            f"{quota['weight']:>6.2f} {quota['used_runs']:>4d} "
+            f"{quota['used_chips']:>5d} {quota['queued']:>6d}")
+
+
+@quota_group.command("set")
+@click.argument("project")
+@click.option("--max-runs", default=None, type=int,
+              help="max concurrent runs for the project")
+@click.option("--max-chips", default=None, type=int,
+              help="max concurrent TPU chips for the project")
+@click.option("--weight", default=1.0, help="fair-share weight")
+def quota_set(project, max_runs, max_chips, weight):
+    plane = get_plane()
+    quota = plane.set_quota(project, max_runs=max_runs, max_chips=max_chips,
+                            weight=weight)
+    click.echo(json.dumps(quota, indent=2, default=str))
+
+
+@quota_group.command("rm")
+@click.argument("project")
+def quota_rm(project):
+    plane = get_plane()
+    if not plane.delete_quota(project):
+        raise click.ClickException(f"no quota for project `{project}`")
+    click.echo(f"Quota for `{project}` removed")
+
+
 # -------------------------------------------------------------------- check
 @cli.command()
 @click.option("-f", "--polyaxonfile", "files", multiple=True, required=True,

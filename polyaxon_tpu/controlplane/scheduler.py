@@ -273,9 +273,14 @@ class Scheduler:
                 return 1
             self.store.update_run(record.uuid, retries=record.retries + 1)
             record = self.store.get_run(record.uuid)
-        self._schedule_requeue(record, counter="preempts",
-                               delays_key="preempt_delays",
-                               reason="Preempted")
+        # Control-plane-driven evictions (admission starvation valve —
+        # scheduling/admission.py stamps the preemptor) requeue through
+        # the same backoff gate but keep the audit trail visible.
+        evicted_for = ((record.meta or {}).get("scheduling")
+                       or {}).get("evicted_for")
+        self._schedule_requeue(
+            record, counter="preempts", delays_key="preempt_delays",
+            reason="PreemptedForPriority" if evicted_for else "Preempted")
         return 1
 
     # ------------------------------------------------------ restart policy

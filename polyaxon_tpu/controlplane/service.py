@@ -36,6 +36,14 @@ class ControlPlane:
         from polyaxon_tpu.connections import ConnectionCatalog
 
         self.connections = ConnectionCatalog(home=self.home)
+        # The implicit default queue always exists so bare submits (no
+        # `queue:` in the spec) validate and list like any other queue.
+        from polyaxon_tpu.scheduling import DEFAULT_QUEUE
+
+        if self.store.get_queue(DEFAULT_QUEUE) is None:
+            self.store.upsert_queue(
+                DEFAULT_QUEUE, priority=0,
+                description="implicit default queue")
 
     # -- submission --------------------------------------------------------
     def submit(
@@ -181,6 +189,7 @@ class ControlPlane:
         self.store.update_run(
             run_uuid, resolved_spec=resolved.to_dict(), launch_plan=plan.to_dict()
         )
+        self._stamp_scheduling(run_uuid, resolved, plan)
 
         # Run memoization (upstream V1Cache lifecycle: created →
         # awaiting_cache → succeeded on hit / compiled on miss): an
@@ -205,6 +214,103 @@ class ControlPlane:
         self.store.transition(run_uuid, V1Statuses.COMPILED, reason="Compiled")
         self.store.transition(run_uuid, V1Statuses.QUEUED)
         return self.store.get_run(run_uuid)
+
+    def _stamp_scheduling(self, run_uuid: str, resolved: V1Operation,
+                          plan) -> None:
+        """Resolve queue + priority class against the catalog and stamp
+        ``meta["scheduling"]`` so admission ticks never re-parse specs.
+
+        Unknown queue/priority-class names raise ``SchedulingError``
+        here — at compile, where the submitting user sees the failure —
+        instead of silently landing at the back of the default queue.
+        """
+        from polyaxon_tpu.scheduling import (
+            DEFAULT_QUEUE,
+            RunSchedInfo,
+            SchedulingError,
+            resolve_priority_class,
+        )
+
+        queue_name = plan.queue or DEFAULT_QUEUE
+        if self.store.get_queue(queue_name) is None:
+            known = [q["name"] for q in self.store.list_queues()]
+            raise SchedulingError(
+                f"unknown queue `{queue_name}` (known: {known}); create it "
+                "with `plx queue add`")
+        run = resolved.component.run if resolved.component else None
+        env = getattr(run, "environment", None)
+        class_name = getattr(env, "priority_class_name", None) or None
+        priority = resolve_priority_class(class_name)  # raises on unknown
+        resources = plan.resources
+        info = RunSchedInfo(
+            queue=queue_name,
+            priority_class=(str(class_name).lower() if class_name
+                            else "default"),
+            priority=priority,
+            chips=int(getattr(resources, "chips", 0) or 0),
+            preemptible=bool(getattr(resources, "preemptible", False)),
+        )
+        record = self.store.get_run(run_uuid)
+        meta = dict(record.meta or {})
+        meta["scheduling"] = info.to_meta()
+        self.store.update_run(run_uuid, meta=meta)
+
+    # -- scheduling catalog ------------------------------------------------
+    def upsert_queue(self, name: str, **kwargs) -> dict:
+        return self.store.upsert_queue(name, **kwargs)
+
+    def delete_queue(self, name: str) -> bool:
+        from polyaxon_tpu.scheduling import DEFAULT_QUEUE
+
+        if name == DEFAULT_QUEUE:
+            raise ValueError("the default queue cannot be deleted")
+        return self.store.delete_queue(name)
+
+    def set_quota(self, project: str, **kwargs) -> dict:
+        return self.store.set_quota(project, **kwargs)
+
+    def delete_quota(self, project: str) -> bool:
+        return self.store.delete_quota(project)
+
+    def scheduling_stats(self) -> dict:
+        """Queue depth + quota usage, the operator view surfaced by
+        ``GET /api/v1/queues|quotas`` and ``plx queue ls``."""
+        from polyaxon_tpu.scheduling import LIVE_STATUSES, sched_info
+
+        pipeline_kinds = {"matrix", V1RunKind.DAG, "schedule"}
+        queued = [r for r in self.store.list_runs(statuses=[V1Statuses.QUEUED])
+                  if r.kind not in pipeline_kinds]
+        live = [r for r in self.store.list_runs(statuses=LIVE_STATUSES)
+                if r.kind not in pipeline_kinds]
+        depth: dict[str, int] = {}
+        running: dict[str, int] = {}
+        projects: dict[str, dict] = {}
+        for record in queued:
+            info = sched_info(record)
+            depth[info.queue] = depth.get(info.queue, 0) + 1
+            usage = projects.setdefault(
+                record.project, {"runs": 0, "chips": 0, "queued": 0})
+            usage["queued"] += 1
+        for record in live:
+            info = sched_info(record)
+            running[info.queue] = running.get(info.queue, 0) + 1
+            usage = projects.setdefault(
+                record.project, {"runs": 0, "chips": 0, "queued": 0})
+            usage["runs"] += 1
+            usage["chips"] += info.chips
+        queues = []
+        for row in self.store.list_queues():
+            queues.append({**row,
+                           "depth": depth.get(row["name"], 0),
+                           "running": running.get(row["name"], 0)})
+        quotas = []
+        for row in self.store.list_quotas():
+            usage = projects.get(row["project"],
+                                 {"runs": 0, "chips": 0, "queued": 0})
+            quotas.append({**row, "used_runs": usage["runs"],
+                           "used_chips": usage["chips"],
+                           "queued": usage["queued"]})
+        return {"queues": queues, "quotas": quotas, "projects": projects}
 
     @staticmethod
     def _cache_key(resolved: V1Operation) -> str:

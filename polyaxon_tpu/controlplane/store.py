@@ -59,6 +59,23 @@ CREATE TABLE IF NOT EXISTS conditions (
     created_at TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_conditions_run ON conditions(run_uuid);
+CREATE TABLE IF NOT EXISTS queues (
+    name TEXT PRIMARY KEY,
+    priority INTEGER NOT NULL DEFAULT 0,
+    concurrency INTEGER,
+    preemptible INTEGER NOT NULL DEFAULT 0,
+    description TEXT,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS quotas (
+    project TEXT PRIMARY KEY,
+    max_runs INTEGER,
+    max_chips INTEGER,
+    weight REAL NOT NULL DEFAULT 1.0,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL
+);
 """
 
 
@@ -275,7 +292,10 @@ class Store:
             clauses.append("kind=?")
             args.append(kind)
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
-        order = "created_at DESC" if newest_first else "created_at"
+        # rowid tie-break: isoformat timestamps collide at same-second
+        # submissions, and admission order must be insertion order then.
+        order = ("created_at DESC, rowid DESC" if newest_first
+                 else "created_at, rowid")
         rows = self._conn().execute(
             f"SELECT * FROM runs{where} ORDER BY {order} LIMIT ?", (*args, limit)
         ).fetchall()
@@ -335,12 +355,126 @@ class Store:
             )
         return True
 
+    def add_condition(
+        self,
+        run_uuid: str,
+        type: str,  # noqa: A002 - mirrors the conditions column
+        *,
+        reason: Optional[str] = None,
+        message: Optional[str] = None,
+    ) -> None:
+        """Pin a condition WITHOUT a status transition — used by the
+        admission pass to surface why a run is still QUEUED (e.g.
+        reason=QuotaExceeded) while the status itself stays put."""
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                "INSERT INTO conditions(run_uuid, type, reason, message, created_at)"
+                " VALUES (?,?,?,?,?)",
+                (run_uuid, type, reason, message, now().isoformat()),
+            )
+
+    def last_condition(self, run_uuid: str) -> Optional[dict]:
+        row = self._conn().execute(
+            "SELECT type, reason, message, created_at FROM conditions "
+            "WHERE run_uuid=? ORDER BY id DESC LIMIT 1", (run_uuid,),
+        ).fetchone()
+        return dict(row) if row is not None else None
+
     def get_conditions(self, run_uuid: str) -> list[dict]:
         rows = self._conn().execute(
             "SELECT type, reason, message, created_at FROM conditions "
             "WHERE run_uuid=? ORDER BY id", (run_uuid,),
         ).fetchall()
         return [dict(r) for r in rows]
+
+    # -- scheduling catalog (queues + quotas) ------------------------------
+    def upsert_queue(
+        self,
+        name: str,
+        *,
+        priority: int = 0,
+        concurrency: Optional[int] = None,
+        preemptible: bool = False,
+        description: str = "",
+    ) -> dict:
+        ts = now().isoformat()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO queues(name, priority, concurrency, preemptible,
+                       description, created_at, updated_at)
+                   VALUES (?,?,?,?,?,?,?)
+                   ON CONFLICT(name) DO UPDATE SET
+                       priority=excluded.priority,
+                       concurrency=excluded.concurrency,
+                       preemptible=excluded.preemptible,
+                       description=excluded.description,
+                       updated_at=excluded.updated_at""",
+                (name, int(priority), concurrency, int(preemptible),
+                 description, ts, ts),
+            )
+        return self.get_queue(name)  # type: ignore[return-value]
+
+    def get_queue(self, name: str) -> Optional[dict]:
+        row = self._conn().execute(
+            "SELECT * FROM queues WHERE name=?", (name,)).fetchone()
+        if row is None:
+            return None
+        out = dict(row)
+        out["preemptible"] = bool(out["preemptible"])
+        return out
+
+    def list_queues(self) -> list[dict]:
+        rows = self._conn().execute(
+            "SELECT * FROM queues ORDER BY priority DESC, name").fetchall()
+        out = []
+        for row in rows:
+            queue = dict(row)
+            queue["preemptible"] = bool(queue["preemptible"])
+            out.append(queue)
+        return out
+
+    def delete_queue(self, name: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute("DELETE FROM queues WHERE name=?", (name,))
+        return cur.rowcount > 0
+
+    def set_quota(
+        self,
+        project: str,
+        *,
+        max_runs: Optional[int] = None,
+        max_chips: Optional[int] = None,
+        weight: float = 1.0,
+    ) -> dict:
+        ts = now().isoformat()
+        with self._lock, self._conn() as conn:
+            conn.execute(
+                """INSERT INTO quotas(project, max_runs, max_chips, weight,
+                       created_at, updated_at)
+                   VALUES (?,?,?,?,?,?)
+                   ON CONFLICT(project) DO UPDATE SET
+                       max_runs=excluded.max_runs,
+                       max_chips=excluded.max_chips,
+                       weight=excluded.weight,
+                       updated_at=excluded.updated_at""",
+                (project, max_runs, max_chips, float(weight), ts, ts),
+            )
+        return self.get_quota(project)  # type: ignore[return-value]
+
+    def get_quota(self, project: str) -> Optional[dict]:
+        row = self._conn().execute(
+            "SELECT * FROM quotas WHERE project=?", (project,)).fetchone()
+        return dict(row) if row is not None else None
+
+    def list_quotas(self) -> list[dict]:
+        rows = self._conn().execute(
+            "SELECT * FROM quotas ORDER BY project").fetchall()
+        return [dict(r) for r in rows]
+
+    def delete_quota(self, project: str) -> bool:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute("DELETE FROM quotas WHERE project=?", (project,))
+        return cur.rowcount > 0
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)

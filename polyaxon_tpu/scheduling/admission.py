@@ -1,0 +1,299 @@
+"""Weighted fair-share admission + priority preemption (ISSUE 2).
+
+Replaces the agent's FIFO ``queued[:capacity]`` slice with a policy
+pass in the Borg/Kubernetes shape (PAPERS.md): desired-state queues and
+quotas enforced by an idempotent per-tick decision, priority preemption
+as the pressure valve. Every decision is recomputed from store state,
+so a restarted agent converges to the same admissions.
+
+Ordering: eligible QUEUED runs are admitted by
+
+    (queue priority desc, project fair-share deficit desc, age asc)
+
+where the deficit of project *p* is ``weight_p / Σweights − share_p``
+over the runs currently live plus the ones tentatively admitted earlier
+in the same pass — classic weighted fair queueing, so two projects
+flooding one queue converge to their quota weights.
+
+Preemption: a run that stays admissible but capacity-starved for
+``POLYAXON_TPU_STARVATION_TICKS`` consecutive passes picks ONE victim —
+the lowest-effective-priority RUNNING run on a *preemptible* queue —
+which the agent evicts (kill → PREEMPTED → PR 1 backoff requeue).
+Quota walls never trigger preemption: exceeding tenants wait, loudly
+(a ``reason=QuotaExceeded`` condition is pinned on the blocked run).
+
+Chaos seam ``admission``: a fault ``{"seam": "admission", "op":
+"<queue>"}`` starves that queue's candidates for ``times`` decisions,
+so drills can prove starvation stays bounded and observable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+from polyaxon_tpu import chaos
+from polyaxon_tpu.controlplane.store import RunRecord
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.scheduling.catalog import (
+    DEFAULT_QUEUE,
+    RunSchedInfo,
+    sched_info,
+)
+
+logger = logging.getLogger(__name__)
+
+# Statuses that occupy capacity/quota (anything the executor may own).
+LIVE_STATUSES = [
+    V1Statuses.SCHEDULED,
+    V1Statuses.STARTING,
+    V1Statuses.RUNNING,
+    V1Statuses.PROCESSING,
+    V1Statuses.WARNING,
+    V1Statuses.STOPPING,
+]
+
+_PIPELINE_KINDS = {"matrix", "dag", "schedule"}
+
+
+def _starvation_ticks() -> int:
+    try:
+        return max(1, int(os.environ.get("POLYAXON_TPU_STARVATION_TICKS", "3")))
+    except ValueError:
+        return 3
+
+
+@dataclasses.dataclass
+class AdmissionDecision:
+    """One pass's verdict. ``admitted`` is ordered and may be longer
+    than capacity: the agent starts entries until capacity is filled,
+    skipping ones whose slice placement is still pending — so a single
+    unplaceable run can never waste a slot a placeable one needs
+    (head-of-line fix)."""
+
+    admitted: list[tuple[RunRecord, RunSchedInfo]]
+    victims: list[str]  # run uuids to preempt for starved high-priority work
+    blocked: dict[str, str]  # run uuid -> reason (QuotaExceeded, ...)
+
+
+class AdmissionController:
+    def __init__(self, plane, *, starvation_ticks: int | None = None):
+        self.plane = plane
+        self.store = plane.store
+        self.starvation_ticks = starvation_ticks or _starvation_ticks()
+        self._starved: dict[str, int] = {}  # uuid -> consecutive starved passes
+
+    # ------------------------------------------------------------ helpers
+    def _queue_row(self, queues: dict[str, dict], name: str) -> dict:
+        row = queues.get(name)
+        if row is not None:
+            return row
+        # Unknown queue (legacy run / deleted queue): schedule like the
+        # implicit default — neutral priority, uncapped, non-preemptible.
+        return {"name": name or DEFAULT_QUEUE, "priority": 0,
+                "concurrency": None, "preemptible": False}
+
+    def _pin_blocked(self, record: RunRecord, reason: str, message: str) -> None:
+        """Surface WHY a run is still queued, once per block streak —
+        re-pinning every tick would flood the condition history."""
+        last = self.store.last_condition(record.uuid)
+        if last is not None and last.get("reason") == reason:
+            return
+        self.store.add_condition(
+            record.uuid, V1Statuses.QUEUED.value, reason=reason,
+            message=message)
+
+    # --------------------------------------------------------------- pass
+    def plan(self, queued: list[RunRecord], *, capacity: int,
+             active: set[str] | None = None) -> AdmissionDecision:
+        """Decide this tick's admissions (ordered) and preemptions.
+
+        ``queued``: eligible QUEUED run records (non-pipeline kinds).
+        ``capacity``: free executor slots. ``active``: run uuids the
+        executor currently owns (the only evictable victims).
+        """
+        if not queued:
+            # Idle ticks stay cheap (no catalog/usage queries), and an
+            # empty queue means nothing can be starved.
+            self._starved.clear()
+            return AdmissionDecision(admitted=[], victims=[], blocked={})
+        queues = {q["name"]: q for q in self.store.list_queues()}
+        quotas = {q["project"]: q for q in self.store.list_quotas()}
+        live = [
+            r for r in self.store.list_runs(statuses=LIVE_STATUSES)
+            if r.kind not in _PIPELINE_KINDS
+        ]
+        live_info = {r.uuid: sched_info(r) for r in live}
+
+        # Usage (runs + chips per project, runs per queue), tentatively
+        # extended as candidates are admitted within this pass.
+        runs_by_project: dict[str, int] = {}
+        chips_by_project: dict[str, int] = {}
+        runs_by_queue: dict[str, int] = {}
+        for r in live:
+            info = live_info[r.uuid]
+            runs_by_project[r.project] = runs_by_project.get(r.project, 0) + 1
+            chips_by_project[r.project] = (
+                chips_by_project.get(r.project, 0) + info.chips)
+            runs_by_queue[info.queue] = runs_by_queue.get(info.queue, 0) + 1
+
+        candidates = []
+        for i, r in enumerate(queued):
+            info = sched_info(r)
+            info.queue_priority = self._queue_row(queues, info.queue)["priority"]
+            candidates.append((i, r, info))
+        plan = chaos.active_plan()
+        blocked: dict[str, str] = {}
+        admitted: list[tuple[RunRecord, RunSchedInfo]] = []
+
+        def weight(project: str) -> float:
+            quota = quotas.get(project)
+            w = float(quota.get("weight") or 1.0) if quota else 1.0
+            return max(w, 1e-9)
+
+        active_projects = ({r.project for r in live}
+                           | {r.project for r in queued})
+        total_weight = sum(weight(p) for p in active_projects) or 1.0
+
+        def deficit(project: str) -> float:
+            total_live = sum(runs_by_project.values())
+            share = (runs_by_project.get(project, 0) / total_live
+                     if total_live else 0.0)
+            return weight(project) / total_weight - share
+
+        remaining = list(candidates)
+        while remaining:
+            # Re-rank each round: admissions shift the fair-share
+            # deficits, which is exactly what makes this converge.
+            remaining.sort(key=lambda item: (
+                -self._queue_row(queues, item[2].queue)["priority"],
+                -deficit(item[1].project),
+                item[0],  # age: store order is (created_at, rowid)
+            ))
+            pick = None
+            for entry in remaining:
+                _, record, info = entry
+                queue = self._queue_row(queues, info.queue)
+                if plan is not None and plan.fire(
+                        "admission", info.queue, detail=record.uuid) is not None:
+                    blocked[record.uuid] = "ChaosStarved"
+                    remaining.remove(entry)
+                    pick = "retry"  # candidate consumed; re-rank and rescan
+                    break
+                cap = queue.get("concurrency")
+                if cap is not None and runs_by_queue.get(info.queue, 0) >= cap:
+                    blocked[record.uuid] = "QueueSaturated"
+                    self._pin_blocked(
+                        record, "QueueSaturated",
+                        f"queue `{info.queue}` at concurrency cap {cap}")
+                    remaining.remove(entry)
+                    pick = "retry"
+                    break
+                quota = quotas.get(record.project)
+                if quota is not None:
+                    max_runs = quota.get("max_runs")
+                    max_chips = quota.get("max_chips")
+                    used_runs = runs_by_project.get(record.project, 0)
+                    used_chips = chips_by_project.get(record.project, 0)
+                    if max_runs is not None and used_runs >= max_runs:
+                        blocked[record.uuid] = "QuotaExceeded"
+                        self._pin_blocked(
+                            record, "QuotaExceeded",
+                            f"project `{record.project}` at max_runs="
+                            f"{max_runs} ({used_runs} live)")
+                        remaining.remove(entry)
+                        pick = "retry"
+                        break
+                    if (max_chips is not None
+                            and used_chips + info.chips > max_chips):
+                        blocked[record.uuid] = "QuotaExceeded"
+                        self._pin_blocked(
+                            record, "QuotaExceeded",
+                            f"project `{record.project}` chips quota "
+                            f"{used_chips}+{info.chips} > {max_chips}")
+                        remaining.remove(entry)
+                        pick = "retry"
+                        break
+                pick = entry
+                break
+            if pick is None or pick == "retry":
+                if pick is None:
+                    break
+                continue
+            _, record, info = pick
+            remaining.remove(pick)
+            admitted.append((record, info))
+            runs_by_project[record.project] = (
+                runs_by_project.get(record.project, 0) + 1)
+            chips_by_project[record.project] = (
+                chips_by_project.get(record.project, 0) + info.chips)
+            runs_by_queue[info.queue] = runs_by_queue.get(info.queue, 0) + 1
+
+        victims = self._select_victims(
+            admitted[max(capacity, 0):], queues, live, live_info,
+            active or set())
+
+        # Starvation counters only live for runs still queued.
+        queued_uuids = {r.uuid for r in queued}
+        for uuid in list(self._starved):
+            if uuid not in queued_uuids:
+                del self._starved[uuid]
+        return AdmissionDecision(admitted=admitted, victims=victims,
+                                 blocked=blocked)
+
+    # --------------------------------------------------------- preemption
+    def _select_victims(self, overflow, queues, live, live_info,
+                        active: set[str]) -> list[str]:
+        """Pick victims for admissible-but-capacity-starved runs.
+
+        One victim per starved run per tick, strictly lower effective
+        priority, on a preemptible queue, currently owned by the
+        executor — the gentlest eviction that unblocks the starved run.
+        """
+        victims: list[str] = []
+        overflow_uuids = {r.uuid for r, _ in overflow}
+        for record, info in overflow:
+            ticks = self._starved.get(record.uuid, 0) + 1
+            self._starved[record.uuid] = ticks
+            if ticks < self.starvation_ticks:
+                continue
+            starved_eff = info.effective(
+                self._queue_row(queues, info.queue)["priority"])
+            best = None
+            for candidate in live:
+                if candidate.uuid in victims or candidate.uuid not in active:
+                    continue
+                if candidate.status != V1Statuses.RUNNING:
+                    continue
+                cinfo = live_info[candidate.uuid]
+                cqueue = self._queue_row(queues, cinfo.queue)
+                if not cqueue["preemptible"]:
+                    continue
+                ceff = cinfo.effective(cqueue["priority"])
+                if ceff >= starved_eff:
+                    continue
+                # Lowest priority first; among equals evict the
+                # youngest (least progress lost).
+                key = (ceff, candidate.started_at or candidate.created_at)
+                if best is None or key[0] < best[0] or (
+                        key[0] == best[0] and key[1] > best[1]):
+                    best = (key[0], key[1], candidate)
+            if best is None:
+                continue
+            victim = best[2]
+            victims.append(victim.uuid)
+            self._starved[record.uuid] = 0
+            meta = dict(victim.meta or {})
+            sched = dict(meta.get("scheduling") or {})
+            sched["evicted_for"] = record.uuid
+            meta["scheduling"] = sched
+            self.store.update_run(victim.uuid, meta=meta)
+            logger.info("admission: preempting %s (eff=%s) for starved %s "
+                        "(eff=%s)", victim.uuid, best[0], record.uuid,
+                        starved_eff)
+        # Drop counters for runs that were admitted within capacity.
+        for uuid in list(self._starved):
+            if uuid not in overflow_uuids:
+                self._starved.pop(uuid, None)
+        return victims

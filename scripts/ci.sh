@@ -39,6 +39,11 @@ fi
 # and serving load-shedding all exercised under injected faults.
 echo "== chaos drills (fixed-seed fault plans)"
 python -m pytest tests/test_chaos.py -q -m chaos
+# Scheduling stage: multi-tenant admission invariants (queue priority,
+# fair-share convergence, quota walls, bounded starvation, the
+# preemption-for-priority drill) — deterministic and CPU-only.
+echo "== scheduling invariants (queues/quotas/fair-share/preemption)"
+python -m pytest tests/test_scheduling.py -q -m scheduling
 echo "== native ASan/UBSan"
 make -C native sanitize
 printf 'ADD a 4x4 0\nREQ r 2x2 0 0\nTICK 0 30\nQUIT\n' | ./native/build/sliced_san >/dev/null

@@ -73,7 +73,7 @@ SMOKE_MODULES = {
     "test_deploy.py", "test_connections.py", "test_fs.py", "test_cli.py",
     "test_api.py", "test_tracking.py", "test_schedules_cache.py",
     "test_joins_events.py", "test_sliced.py", "test_controlplane.py",
-    "test_utils_env.py",
+    "test_utils_env.py", "test_scheduling.py",
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",
@@ -135,6 +135,11 @@ def pytest_collection_modifyitems(config, items):
             # Fault-injection drills: selected as their own fixed-seed
             # CI stage (`-m chaos` in scripts/ci.sh) and part of tier-1.
             item.add_marker(pytest.mark.chaos)
+        if fname == "test_scheduling.py":
+            # Multi-tenant scheduling invariants (queues, quotas,
+            # fair-share, preemption): deterministic + CPU-only, its
+            # own `-m scheduling` stage in scripts/ci.sh.
+            item.add_marker(pytest.mark.scheduling)
     # A stale entry (renamed/deleted test) must fail collection loudly,
     # not silently shrink the default CI tier. Checked PER ENTRY: an
     # entry is stale only if its FILE was fully collected yet the node

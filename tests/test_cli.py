@@ -139,6 +139,37 @@ class TestRunAndOps:
         result = runner.invoke(cli, ["projects", "ls"])
         assert "p9" in result.output
 
+    def test_queue_and_quota_smoke(self, runner):
+        """`plx queue` / `plx quota` happy path: add, list with depth,
+        inspect a queued run, remove (ISSUE 2 smoke case)."""
+        result = runner.invoke(cli, ["queue", "add", "prod",
+                                     "--priority", "10"])
+        assert result.exit_code == 0, result.output
+        assert json.loads(result.output)["priority"] == 10
+        result = runner.invoke(cli, ["quota", "set", "demo",
+                                     "--max-runs", "2", "--weight", "2"])
+        assert result.exit_code == 0, result.output
+
+        # A queued run shows up as queue depth and in inspect.
+        result = runner.invoke(cli, ["run", "-f", FIXTURE, "-p", "demo"])
+        uid = result.output.split("Run created: ")[1].split()[0]
+        from polyaxon_tpu.cli.main import get_plane
+
+        get_plane().compile_run(uid)
+        result = runner.invoke(cli, ["queue", "ls"])
+        assert result.exit_code == 0, result.output
+        assert "prod" in result.output and "default" in result.output
+        result = runner.invoke(cli, ["queue", "inspect", "default"])
+        assert result.exit_code == 0, result.output
+        assert uid in result.output
+        result = runner.invoke(cli, ["quota", "ls"])
+        assert result.exit_code == 0, result.output
+        assert "demo" in result.output
+
+        assert runner.invoke(cli, ["queue", "rm", "prod"]).exit_code == 0
+        result = runner.invoke(cli, ["queue", "rm", "default"])
+        assert result.exit_code != 0  # the implicit queue is permanent
+
     def test_models_listing(self, runner):
         result = runner.invoke(cli, ["models"])
         assert "llama3_8b" in result.output

@@ -1,0 +1,475 @@
+"""Multi-tenant scheduling tests (ISSUE 2): queue/quota catalog CRUD,
+compile-time validation + stamping, fair-share admission ordering,
+starvation-bounded priority preemption, and the end-to-end
+preemption-for-priority drill against the native slice pool.
+
+Everything here is CPU-only and deterministic (`scheduling` marker;
+its own stage in scripts/ci.sh).
+"""
+
+import time
+
+import pytest
+
+from polyaxon_tpu import chaos
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.scheduling import (
+    AdmissionController,
+    PRIORITY_CLASSES,
+    SchedulingError,
+    gang_priority,
+    resolve_priority_class,
+    sched_info,
+)
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    return ControlPlane(str(tmp_path / "home"))
+
+
+def job_spec(*, sleep=0.1, queue=None, priority_class=None, project_env=None,
+             topology=None, preemptible=False):
+    env = {}
+    if priority_class:
+        env["priorityClassName"] = priority_class
+    if topology:
+        env["tpu"] = {"accelerator": "v5e", "topology": topology,
+                      "preemptible": preemptible}
+    spec = {
+        "kind": "operation",
+        "component": {
+            "run": {
+                "kind": "job",
+                **({"environment": env} if env else {}),
+                "container": {"command": [
+                    "python", "-c", f"import time; time.sleep({sleep})"]},
+            },
+        },
+    }
+    if queue:
+        spec["queue"] = queue
+    return spec
+
+
+def submit_queued(plane, project="default", **kwargs):
+    """Submit + compile so the run lands in QUEUED."""
+    record = plane.submit(job_spec(**kwargs), project=project)
+    plane.compile_run(record.uuid)
+    return plane.get_run(record.uuid)
+
+
+def mark_running(plane, record):
+    for status in (V1Statuses.SCHEDULED, V1Statuses.STARTING,
+                   V1Statuses.RUNNING):
+        plane.store.transition(record.uuid, status)
+    return plane.get_run(record.uuid)
+
+
+class TestCatalog:
+    def test_priority_classes(self):
+        assert resolve_priority_class(None) == PRIORITY_CLASSES["default"]
+        assert resolve_priority_class("CRITICAL") == 3
+        with pytest.raises(SchedulingError, match="unknown priority class"):
+            resolve_priority_class("platinum")
+
+    def test_gang_priority_queue_dominates_class(self):
+        # Any class on a higher-priority queue outranks every class on
+        # a lower one; within a queue the class ladder breaks ties.
+        assert gang_priority(1, 0) > gang_priority(0, 3)
+        assert gang_priority(0, 2) > gang_priority(0, 1)
+
+    def test_queue_crud_roundtrip(self, plane):
+        plane.upsert_queue("prod", priority=10, concurrency=2,
+                           preemptible=False)
+        row = plane.store.get_queue("prod")
+        assert row["priority"] == 10 and row["concurrency"] == 2
+        plane.upsert_queue("prod", priority=20)  # upsert updates
+        assert plane.store.get_queue("prod")["priority"] == 20
+        assert plane.delete_queue("prod")
+        assert plane.store.get_queue("prod") is None
+        with pytest.raises(ValueError, match="default queue"):
+            plane.delete_queue("default")
+
+    def test_quota_crud_roundtrip(self, plane):
+        plane.set_quota("team-a", max_runs=3, max_chips=16, weight=2.0)
+        row = plane.store.get_quota("team-a")
+        assert row["max_runs"] == 3 and row["weight"] == 2.0
+        assert plane.delete_quota("team-a")
+        assert plane.store.get_quota("team-a") is None
+
+
+class TestCompileValidation:
+    def test_unknown_queue_fails_at_compile(self, plane):
+        record = plane.submit(job_spec(queue="nope"))
+        with pytest.raises(SchedulingError, match="unknown queue"):
+            plane.compile_run(record.uuid)
+
+    def test_unknown_priority_class_fails_at_compile(self, plane):
+        record = plane.submit(job_spec(priority_class="platinum"))
+        with pytest.raises(SchedulingError, match="unknown priority class"):
+            plane.compile_run(record.uuid)
+
+    def test_scheduler_tick_fails_bad_queue_run_not_loop(self, plane):
+        from polyaxon_tpu.controlplane.scheduler import Scheduler
+
+        record = plane.submit(job_spec(queue="nope"))
+        Scheduler(plane).tick()
+        final = plane.get_run(record.uuid)
+        assert final.status == V1Statuses.FAILED
+        last = plane.get_statuses(record.uuid)[-1]
+        assert "unknown queue" in (last.get("message") or "")
+
+    def test_compile_stamps_scheduling_meta(self, plane):
+        plane.upsert_queue("prod", priority=7)
+        record = submit_queued(plane, queue="prod", priority_class="high",
+                               topology="2x2")
+        stamp = record.meta["scheduling"]
+        assert stamp == {"queue": "prod", "priority_class": "high",
+                         "priority": 2, "chips": 4, "preemptible": False}
+
+    def test_sched_info_fallback_without_stamp(self, plane):
+        plane.upsert_queue("prod", priority=7)
+        record = submit_queued(plane, queue="prod", priority_class="high",
+                               topology="2x2")
+        meta = dict(record.meta)
+        meta.pop("scheduling")
+        plane.store.update_run(record.uuid, meta=meta)
+        info = sched_info(plane.get_run(record.uuid))
+        assert info.queue == "prod" and info.priority == 2
+        assert info.chips == 4
+
+
+class TestStoreOrdering:
+    def test_created_at_tie_breaks_by_insertion_order(self, plane):
+        uuids = [plane.submit(job_spec()).uuid for _ in range(5)]
+        # Force identical timestamps: same-second submissions must
+        # still admit in insertion (rowid) order.
+        with plane.store._lock, plane.store._conn() as conn:
+            conn.execute("UPDATE runs SET created_at='2026-01-01T00:00:00'")
+        listed = [r.uuid for r in plane.list_runs()]
+        assert listed == uuids
+        newest = [r.uuid for r in plane.list_runs(newest_first=True)]
+        assert newest == list(reversed(uuids))
+
+
+class TestAdmissionOrdering:
+    def test_queue_priority_orders_admission(self, plane):
+        plane.upsert_queue("prod", priority=10)
+        plane.upsert_queue("batch", priority=0)
+        low = submit_queued(plane, queue="batch")
+        high = submit_queued(plane, queue="prod")
+        controller = AdmissionController(plane)
+        decision = controller.plan(
+            plane.list_runs(statuses=[V1Statuses.QUEUED]), capacity=2,
+            active=set())
+        order = [r.uuid for r, _ in decision.admitted]
+        assert order == [high.uuid, low.uuid]
+
+    def test_fair_share_converges_to_weights(self, plane):
+        """Two projects flooding one queue split admissions by their
+        quota weights (2:1), regardless of submission order."""
+        plane.set_quota("heavy", weight=2.0)
+        plane.set_quota("light", weight=1.0)
+        for _ in range(9):
+            submit_queued(plane, project="heavy")
+        for _ in range(9):
+            submit_queued(plane, project="light")
+        controller = AdmissionController(plane)
+        admitted_by_project = {"heavy": 0, "light": 0}
+        # Simulate 3 ticks of capacity 3: admitted runs become live.
+        for _ in range(3):
+            queued = [r for r in plane.list_runs(statuses=[V1Statuses.QUEUED])]
+            decision = controller.plan(queued, capacity=3, active=set())
+            for record, _ in decision.admitted[:3]:
+                mark_running(plane, record)
+                admitted_by_project[record.project] += 1
+        assert admitted_by_project["heavy"] == 6
+        assert admitted_by_project["light"] == 3
+
+    def test_quota_max_runs_blocks_with_visible_condition(self, plane):
+        plane.set_quota("team-a", max_runs=1)
+        first = submit_queued(plane, project="team-a")
+        mark_running(plane, first)
+        blocked = submit_queued(plane, project="team-a")
+        controller = AdmissionController(plane)
+        decision = controller.plan([plane.get_run(blocked.uuid)], capacity=4,
+                                   active=set())
+        assert decision.admitted == []
+        assert decision.blocked[blocked.uuid] == "QuotaExceeded"
+        conditions = plane.get_statuses(blocked.uuid)
+        last = conditions[-1]
+        assert last["type"] == "queued"
+        assert last["reason"] == "QuotaExceeded"
+        # Re-planning must not spam a condition per tick.
+        controller.plan([plane.get_run(blocked.uuid)], capacity=4,
+                        active=set())
+        assert len(plane.get_statuses(blocked.uuid)) == len(conditions)
+
+    def test_quota_max_chips_blocks_topology_runs(self, plane):
+        plane.set_quota("team-a", max_chips=4)
+        first = submit_queued(plane, project="team-a", topology="2x2")
+        mark_running(plane, first)  # 4 chips in use
+        blocked = submit_queued(plane, project="team-a", topology="2x2")
+        small = submit_queued(plane, project="team-a")  # 0 chips: admissible
+        controller = AdmissionController(plane)
+        decision = controller.plan(
+            [plane.get_run(blocked.uuid), plane.get_run(small.uuid)],
+            capacity=4, active=set())
+        assert [r.uuid for r, _ in decision.admitted] == [small.uuid]
+        assert decision.blocked[blocked.uuid] == "QuotaExceeded"
+
+    def test_queue_concurrency_cap(self, plane):
+        plane.upsert_queue("narrow", priority=0, concurrency=1)
+        first = submit_queued(plane, queue="narrow")
+        mark_running(plane, first)
+        blocked = submit_queued(plane, queue="narrow")
+        controller = AdmissionController(plane)
+        decision = controller.plan([plane.get_run(blocked.uuid)], capacity=4,
+                                   active=set())
+        assert decision.admitted == []
+        assert decision.blocked[blocked.uuid] == "QueueSaturated"
+
+
+class TestStarvationPreemption:
+    def test_starved_high_priority_picks_one_lowest_victim(self, plane):
+        plane.upsert_queue("batch", priority=0, preemptible=True)
+        plane.upsert_queue("prod", priority=10)
+        victims = [submit_queued(plane, queue="batch") for _ in range(2)]
+        for v in victims:
+            mark_running(plane, v)
+        high = submit_queued(plane, queue="prod", priority_class="critical")
+        controller = AdmissionController(plane, starvation_ticks=2)
+        active = {v.uuid for v in victims}
+        # Tick 1: starved but under the K-tick threshold — no eviction.
+        decision = controller.plan([plane.get_run(high.uuid)], capacity=0,
+                                   active=active)
+        assert decision.victims == []
+        # Tick 2: exactly ONE victim, stamped with the preemptor.
+        decision = controller.plan([plane.get_run(high.uuid)], capacity=0,
+                                   active=active)
+        assert len(decision.victims) == 1
+        victim = plane.get_run(decision.victims[0])
+        assert victim.uuid in active
+        assert victim.meta["scheduling"]["evicted_for"] == high.uuid
+
+    def test_non_preemptible_queue_is_never_victimized(self, plane):
+        plane.upsert_queue("prod", priority=10)
+        low = submit_queued(plane)  # default queue: not preemptible
+        mark_running(plane, low)
+        high = submit_queued(plane, queue="prod")
+        controller = AdmissionController(plane, starvation_ticks=1)
+        decision = controller.plan([plane.get_run(high.uuid)], capacity=0,
+                                   active={low.uuid})
+        assert decision.victims == []
+
+    def test_quota_wall_never_triggers_preemption(self, plane):
+        plane.upsert_queue("batch", priority=0, preemptible=True)
+        plane.upsert_queue("prod", priority=10)
+        plane.set_quota("greedy", max_runs=1)
+        low = submit_queued(plane, queue="batch")
+        mark_running(plane, low)
+        running = submit_queued(plane, project="greedy")
+        mark_running(plane, running)
+        blocked = submit_queued(plane, project="greedy", queue="prod")
+        controller = AdmissionController(plane, starvation_ticks=1)
+        for _ in range(3):
+            decision = controller.plan([plane.get_run(blocked.uuid)],
+                                       capacity=0,
+                                       active={low.uuid, running.uuid})
+        assert decision.victims == []
+        assert decision.blocked[blocked.uuid] == "QuotaExceeded"
+
+
+class TestChaosAdmissionSeam:
+    def test_admission_fault_starves_named_queue(self, plane):
+        plane.upsert_queue("batch", priority=0)
+        record = submit_queued(plane, queue="batch")
+        other = submit_queued(plane)  # default queue: unaffected
+        plan = chaos.install(chaos.ChaosPlan.from_dict(
+            {"faults": [{"seam": "admission", "op": "batch", "times": 2}]}))
+        try:
+            controller = AdmissionController(plane)
+            for _ in range(2):
+                decision = controller.plan(
+                    [plane.get_run(record.uuid), plane.get_run(other.uuid)],
+                    capacity=4, active=set())
+                assert [r.uuid for r, _ in decision.admitted] == [other.uuid]
+                assert decision.blocked[record.uuid] == "ChaosStarved"
+            # Fault budget spent: the queue drains again.
+            decision = controller.plan([plane.get_run(record.uuid)],
+                                       capacity=4, active=set())
+            assert [r.uuid for r, _ in decision.admitted] == [record.uuid]
+            assert plan.done
+        finally:
+            chaos.uninstall()
+
+
+class TestAgentIntegration:
+    def _drive(self, agent, predicate, timeout=30, label=""):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            agent.reconcile_once()
+            if predicate():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {label or predicate}")
+
+    def test_head_of_line_blocking_fixed(self, plane):
+        """Regression (ISSUE 2 satellite 1): one placement-pending run
+        at the head of the queue must not waste the only free slot a
+        clearable run behind it could use."""
+        from polyaxon_tpu.agent import SliceManager
+
+        manager = SliceManager([("pool", "2x2", False)])
+        agent = Agent(plane, max_concurrent=2, slice_manager=manager)
+        try:
+            hog = plane.submit(job_spec(sleep=10, topology="2x2"))
+            self._drive(agent,
+                        lambda: hog.uuid in agent.executor.active_runs,
+                        label="hog running")
+            # Head of queue: same topology, pool full → pending forever.
+            stuck = plane.submit(job_spec(sleep=0.1, topology="2x2"))
+            behind = plane.submit(job_spec(sleep=0.1))  # no topology
+            self._drive(
+                agent,
+                lambda: plane.get_run(behind.uuid).status
+                == V1Statuses.SUCCEEDED,
+                label="behind run succeeded past the stuck head")
+            assert plane.get_run(stuck.uuid).status == V1Statuses.QUEUED
+            plane.stop(hog.uuid)
+        finally:
+            manager.close()
+
+    def test_quota_exceeded_surfaces_while_agent_runs(self, plane):
+        plane.set_quota("team-a", max_runs=1)
+        agent = Agent(plane, max_concurrent=4)
+        first = plane.submit(job_spec(sleep=5), project="team-a")
+        self._drive(agent,
+                    lambda: first.uuid in agent.executor.active_runs,
+                    label="first running")
+        blocked = plane.submit(job_spec(sleep=0.1), project="team-a")
+        self._drive(
+            agent,
+            lambda: any(c.get("reason") == "QuotaExceeded"
+                        for c in plane.get_statuses(blocked.uuid)),
+            label="QuotaExceeded condition pinned")
+        assert plane.get_run(blocked.uuid).status == V1Statuses.QUEUED
+        stats = plane.scheduling_stats()
+        assert stats["quotas"][0]["used_runs"] == 1
+        assert stats["quotas"][0]["queued"] == 1
+        plane.stop(first.uuid)
+        agent.reconcile_once()
+
+    def test_low_priority_flood_never_starves_high_beyond_bound(
+            self, plane, monkeypatch):
+        """Starvation invariant: a saturating preemptible low-priority
+        flood yields to a high-priority submission within a bounded
+        number of ticks (K starvation ticks + kill/reap/admit)."""
+        monkeypatch.setenv("POLYAXON_TPU_BACKOFF_BASE", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_BACKOFF_MAX", "0.1")
+        plane.upsert_queue("batch", priority=0, preemptible=True)
+        plane.upsert_queue("prod", priority=10)
+        agent = Agent(
+            plane, max_concurrent=2,
+            admission=AdmissionController(plane, starvation_ticks=2))
+        flood = [plane.submit(job_spec(sleep=30, queue="batch",
+                                       priority_class="low"))
+                 for _ in range(4)]
+        self._drive(agent, lambda: len(agent.executor.active_runs) == 2,
+                    label="flood saturates capacity")
+        high = plane.submit(job_spec(sleep=0.1, queue="prod",
+                                     priority_class="high"))
+        ticks = 0
+        while plane.get_run(high.uuid).status != V1Statuses.SUCCEEDED:
+            agent.reconcile_once()
+            ticks += 1
+            assert ticks < 200, "high-priority run starved past the bound"
+            time.sleep(0.02)
+        preempted = [r for r in flood
+                     if any(c["type"] == "preempted"
+                            for c in plane.get_statuses(r.uuid))]
+        assert len(preempted) == 1  # exactly one victim evicted
+        for record in flood:
+            plane.stop(record.uuid)
+        for _ in range(10):
+            agent.reconcile_once()
+
+
+@pytest.mark.gang
+class TestPreemptionDrillE2E:
+    """Acceptance drill: an agent at capacity running a preemptible
+    low-priority gang on a spot slice; a high-priority run on a
+    higher-priority queue evicts exactly one victim (PREEMPTED →
+    backoff requeue), reaches RUNNING within a bounded tick budget, and
+    the victim later reaches SUCCEEDED — with queue depth and quota
+    usage queryable throughout."""
+
+    def test_priority_preemption_end_to_end(self, tmp_path, monkeypatch):
+        from polyaxon_tpu.agent import SliceManager
+
+        monkeypatch.setenv("POLYAXON_TPU_BACKOFF_BASE", "0.05")
+        monkeypatch.setenv("POLYAXON_TPU_BACKOFF_MAX", "0.1")
+        plane = ControlPlane(str(tmp_path / "home"))
+        plane.upsert_queue("batch", priority=0, preemptible=True)
+        plane.upsert_queue("prod", priority=10)
+        plane.set_quota("tenant", max_runs=2)
+        manager = SliceManager([("spot", "2x2", True)])
+        agent = Agent(
+            plane, max_concurrent=1, slice_manager=manager,
+            admission=AdmissionController(plane, starvation_ticks=2))
+        try:
+            victim = plane.submit(
+                job_spec(sleep=1.5, queue="batch", priority_class="low",
+                         topology="2x2", preemptible=True),
+                project="tenant")
+            deadline = time.monotonic() + 30
+            while victim.uuid not in agent.executor.active_runs:
+                assert time.monotonic() < deadline
+                agent.reconcile_once()
+                time.sleep(0.05)
+
+            high = plane.submit(
+                job_spec(sleep=0.2, queue="prod", priority_class="critical",
+                         topology="2x2"),
+                project="tenant")
+            # Queue depth + quota usage are queryable mid-drill.
+            agent.reconcile_once()
+            stats = plane.scheduling_stats()
+            by_name = {q["name"]: q for q in stats["queues"]}
+            assert by_name["prod"]["depth"] == 1
+            assert by_name["batch"]["running"] == 1
+            quota = next(q for q in stats["quotas"]
+                         if q["project"] == "tenant")
+            assert quota["used_runs"] == 1 and quota["queued"] == 1
+
+            ticks = 0
+            seen_running = False
+            while True:
+                agent.reconcile_once()
+                ticks += 1
+                assert ticks < 400, "drill did not converge"
+                status = plane.get_run(high.uuid).status
+                if status in (V1Statuses.RUNNING, V1Statuses.SUCCEEDED):
+                    seen_running = True
+                if (seen_running
+                        and plane.get_run(high.uuid).status
+                        == V1Statuses.SUCCEEDED
+                        and plane.get_run(victim.uuid).status
+                        == V1Statuses.SUCCEEDED):
+                    break
+                time.sleep(0.02)
+
+            victim_conditions = plane.get_statuses(victim.uuid)
+            kinds = [c["type"] for c in victim_conditions]
+            assert "preempted" in kinds and "retrying" in kinds
+            assert any(c.get("reason") == "PreemptedForPriority"
+                       for c in victim_conditions)
+            assert plane.get_run(victim.uuid).meta["scheduling"][
+                "evicted_for"] == high.uuid
+            # Exactly one eviction: the victim was preempted once.
+            assert kinds.count("preempted") == 1
+        finally:
+            manager.close()
