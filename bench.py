@@ -733,6 +733,12 @@ def main() -> int:
         "flops_per_token": flops_tok,
         "tflops_per_sec_per_chip": round(achieved / 1e12, 2) if achieved else None,
         "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        # Input-pipeline attribution: host ms/step blocked on data and
+        # the warm-up compile wall, so BENCH_r* rounds can tell an
+        # input-bound regression from a device one and see persistent-
+        # compile-cache hits.
+        "input_wait_ms": round(result.input_wait_ms, 3),
+        "compile_time_s": round(result.compile_time_s, 3),
         "device_kind": record["device_kind"],
         **({"fallback": fallback} if fallback else {}),
     }))

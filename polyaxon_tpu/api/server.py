@@ -129,7 +129,16 @@ class _Handler(BaseHTTPRequestHandler):
             if not query_token:
                 return None
             if query_token.startswith("st:") and query_token.count(":") >= 3:
-                return self._verify_stream_token(query_token)
+                # A primary token may itself look like a stream token
+                # ("st:"-prefixed with colons); if stream verification
+                # rejects, fall through to the primary comparison below
+                # instead of locking that credential out of the
+                # header-less routes (ADVICE r5). Forged/expired stream
+                # tokens still 401 there — they match no primary.
+                try:
+                    return self._verify_stream_token(query_token)
+                except ApiError:
+                    pass
             raw = query_token
         else:
             raw = header[len("Bearer "):]

@@ -205,12 +205,14 @@ class ControlPlane:
                 meta = dict(record.meta or {})
                 meta["cache_hit_from"] = hit.uuid
                 self.store.update_run(run_uuid, meta=meta, cache_key=key)
+                self._index_lineage(run_uuid)
                 self.store.transition(
                     run_uuid, V1Statuses.SUCCEEDED, reason="CacheHit",
                     message=f"reused outputs of {hit.uuid}")
                 return self.store.get_run(run_uuid)
             self.store.update_run(run_uuid, cache_key=key)
 
+        self._index_lineage(run_uuid)
         self.store.transition(run_uuid, V1Statuses.COMPILED, reason="Compiled")
         self.store.transition(run_uuid, V1Statuses.QUEUED)
         return self.store.get_run(run_uuid)
@@ -455,6 +457,34 @@ class ControlPlane:
                     out.append((sib.uuid, "dag", None))
         return out
 
+    def _index_lineage(self, run_uuid: str) -> None:
+        """Mirror this run's upstream edges onto each upstream's
+        ``meta["downstream_runs"]`` at compile time (ADVICE r5: the
+        per-request ``lineage_graph`` downstream scan re-derived edges
+        for every run in the project — O(runs) store reads per call).
+        Every edge kind the data model records (param refs, DAG deps,
+        joins, cache adoption) is known by the time a run leaves
+        compile, so submit time is the one place the index stays
+        consistent. ``meta["lineage_indexed"]`` marks the run so the
+        request-time scan skips re-deriving it."""
+        record = self.store.get_run(run_uuid)
+        for uuid, kind, label in self._upstream_edges(record):
+            try:
+                up = self.store.get_run(uuid)
+            except Exception:  # noqa: BLE001 — deleted upstream: no edge
+                continue
+            meta = dict(up.meta or {})
+            edges = list(meta.get("downstream_runs") or [])
+            entry = {"uuid": run_uuid, "kind": kind,
+                     **({"label": label} if label else {})}
+            if entry not in edges:
+                edges.append(entry)
+                meta["downstream_runs"] = edges
+                self.store.update_run(uuid, meta=meta)
+        meta = dict(record.meta or {})
+        meta["lineage_indexed"] = True
+        self.store.update_run(run_uuid, meta=meta)
+
     def lineage_graph(self, run_uuid: str) -> dict:
         """Inputs → run → outputs across runs (SURVEY §2 "Tracking":
         upstream's artifact-lineage graph view): upstream runs feeding
@@ -484,12 +514,33 @@ class ControlPlane:
             node(up)
             edges.append({"from": uuid, "to": run_uuid, "kind": kind,
                           **({"label": label} if label else {})})
+        # Downstream edges come from the submit-time index (mirrored
+        # into meta["downstream_runs"] by _index_lineage); the
+        # re-deriving scan survives ONLY for legacy records compiled
+        # before the index existed (meta.lineage_indexed unset), so a
+        # hot-path request costs one list query + O(edges) lookups
+        # instead of O(runs) edge derivations (ADVICE r5).
+        seen_down: set[tuple] = set()
+        for entry in (record.meta or {}).get("downstream_runs") or []:
+            try:
+                down = self.store.get_run(entry["uuid"])
+            except Exception:  # noqa: BLE001 — deleted downstream
+                continue
+            node(down)
+            edge = {"from": run_uuid, "to": down.uuid,
+                    "kind": entry.get("kind"),
+                    **({"label": entry["label"]}
+                       if entry.get("label") else {})}
+            seen_down.add((down.uuid, edge["kind"], entry.get("label")))
+            edges.append(edge)
         for other in self.store.list_runs(project=record.project):
-            if other.uuid == run_uuid:
+            if other.uuid == run_uuid or (other.meta or {}).get(
+                    "lineage_indexed"):
                 continue
             for uuid, kind, label in self._upstream_edges(
                     other, sibling_cache):
-                if uuid == run_uuid:
+                if uuid == run_uuid and (other.uuid, kind,
+                                         label) not in seen_down:
                     node(other)
                     edges.append({
                         "from": run_uuid, "to": other.uuid, "kind": kind,

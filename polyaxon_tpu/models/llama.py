@@ -149,19 +149,21 @@ CONFIGS: dict[str, LlamaConfig] = {
         tie_embeddings=True,
     ),
     # Gemma-2B architecture (public config): MQA (1 kv head), GeGLU,
-    # (1+w) norms, sqrt(dim)-scaled embeddings, tied head, 256k vocab.
+    # (1+w) norms, sqrt(dim)-scaled embeddings, tied head, 256k vocab,
+    # rms_norm_eps 1e-6 (the llama default 1e-5 deviates from the
+    # published config — ADVICE r5).
     # head_dim = dim / n_heads = 256, matching the published value.
     "gemma_2b": LlamaConfig(
         vocab_size=256_000, dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
         ffn_dim=16_384, max_seq_len=8192, rope_theta=10_000.0,
         tie_embeddings=True, norm_offset=1.0, mlp_activation="gelu_tanh",
-        scale_embeddings=True,
+        scale_embeddings=True, norm_eps=1e-6,
     ),
     "gemma_tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=1,
         ffn_dim=128, max_seq_len=128, rope_theta=10_000.0,
         tie_embeddings=True, norm_offset=1.0, mlp_activation="gelu_tanh",
-        scale_embeddings=True,
+        scale_embeddings=True, norm_eps=1e-6,
     ),
 }
 

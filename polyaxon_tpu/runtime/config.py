@@ -34,6 +34,15 @@ class RuntimeConfig(BaseModel):
     seq_len: Optional[int] = None
     seed: int = 0
     log_every: int = 10
+    # Input-pipeline overlap: a background thread generates and
+    # device-commits batch i+k while the device runs step i, keeping up
+    # to `prefetch` ready batches queued. 0 = synchronous (the host
+    # pays generation + transfer inside every step).
+    prefetch: int = Field(default=2, ge=0)
+    # Persistent XLA compilation cache (runtime/compile_cache.py):
+    # a directory here (or via POLYAXON_TPU_COMPILE_CACHE_DIR) lets
+    # requeued/preempted runs skip recompilation. None = env-driven.
+    compile_cache_dir: Optional[str] = None
     # Attention/remat knobs forwarded to the model config when supported.
     remat: Optional[str] = None
     attention_impl: Optional[str] = None

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import queue
+import threading
 from typing import Any, Callable, Iterator, Optional
 
 import jax
@@ -48,14 +50,20 @@ def lm_synthetic(batch_size: int, seq_len: int = 2048, vocab_size: int = 32_000,
 
     Batch ``i`` is a pure function of ``(seed, i)`` so checkpoint-resume
     continues the stream exactly (``start_batch`` = restored step).
+
+    Sampling is inverse-CDF via ``searchsorted`` over a cumulative
+    probability table built once per stream — ``rng.choice(p=...)``
+    rebuilt its alias machinery per call and dominated host time at
+    32k-vocab scale, serializing the device behind the generator.
     """
     ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
-    probs = 1.0 / ranks
-    probs /= probs.sum()
+    cdf = np.cumsum(1.0 / ranks)
+    cdf /= cdf[-1]
     i = start_batch
     while True:
         rng = np.random.default_rng((seed, i))
-        yield {"tokens": rng.choice(vocab_size, size=(batch_size, seq_len), p=probs).astype(np.int32)}
+        u = rng.random((batch_size, seq_len))
+        yield {"tokens": np.searchsorted(cdf, u, side="right").astype(np.int32)}
         i += 1
 
 
@@ -290,20 +298,27 @@ def lm_packed_synthetic(batch_size: int, seq_len: int = 2048,
                         **_) -> Iterator[dict[str, np.ndarray]]:
     """Packed-document LM stream: each row concatenates documents of
     random length with per-token ``segments`` ids (attention and RoPE
-    restart at each boundary in the model). Resume-exact per batch."""
+    restart at each boundary in the model). Resume-exact per batch.
+
+    Segments come from a cumsum over sampled doc lengths (segment of
+    position t = number of document ends ≤ t) instead of a per-row
+    Python while loop — the loop was the host bottleneck that left the
+    device idle between steps.
+    """
+    low = max(mean_doc_len // 2, 1)
+    high = max(mean_doc_len * 2, low + 1)
+    # Enough docs that even all-minimum-length draws cover the row.
+    n_docs = seq_len // low + 1
+    positions = np.arange(seq_len)
     i = start_batch
     while True:
         rng = np.random.default_rng((seed, i))
         tokens = rng.integers(2, vocab_size,
                               size=(batch_size, seq_len)).astype(np.int32)
-        segments = np.zeros((batch_size, seq_len), np.int32)
-        for b in range(batch_size):
-            pos, seg = 0, 0
-            while pos < seq_len:
-                doc = int(rng.integers(mean_doc_len // 2, mean_doc_len * 2))
-                segments[b, pos:pos + doc] = seg
-                pos += doc
-                seg += 1
+        ends = np.cumsum(rng.integers(low, high,
+                                      size=(batch_size, n_docs)), axis=1)
+        segments = (positions[None, :] >= ends[:, :, None]).sum(
+            axis=1).astype(np.int32)
         yield {"tokens": tokens, "segments": segments}
         i += 1
 
@@ -398,6 +413,83 @@ def shard_batches(
             sharding = NamedSharding(mesh, batch_spec(mesh, rules, ndim=value.ndim))
             global_batch[key] = jax.make_array_from_process_local_data(sharding, value)
         yield global_batch
+
+
+class PrefetchIterator:
+    """Bounded background prefetch over a batch iterator.
+
+    A producer thread pulls from ``it`` — generating batch ``i+k`` and
+    committing it to device while the device runs step ``i`` (``it`` is
+    normally ``shard_batches``'s output, so the ``device_put`` under
+    ``make_array_from_process_local_data`` happens off the step loop) —
+    and parks up to ``depth`` ready batches in a queue. Order is
+    preserved, so the resume-exact ``batch i = f(seed, i)`` contract is
+    untouched: prefetched-but-unconsumed batches are simply regenerated
+    by a fresh iterator after restore.
+
+    A producer exception is re-raised on the consumer's next
+    ``__next__``; ``close()`` stops the producer, drains the queue, and
+    joins the thread (the loop calls it on stop/exception so no thread
+    outlives its run).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, it: Iterator[Any], depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._it = it
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._fill, name="plx-data-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item: Any) -> bool:
+        """Put with stop-responsiveness; False once closing."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self) -> None:
+        try:
+            for batch in self._it:
+                if not self._put(batch):
+                    return
+        except BaseException as exc:  # noqa: BLE001 — surfaced to consumer
+            self._error = exc
+        self._put(self._SENTINEL)
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self) -> Any:
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        # Drain so a producer blocked on a full queue observes the stop
+        # promptly and queued device arrays are released.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
 
 
 def dataset_for_model(model_name: str) -> str:

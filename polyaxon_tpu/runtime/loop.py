@@ -42,6 +42,14 @@ class TrainResult:
     # restore that produced restored_from_step (newest first; empty on
     # a clean restore or cold start).
     restore_skipped_steps: list[int] = dataclasses.field(default_factory=list)
+    # Host time blocked on `next(batches)`, averaged per timed step —
+    # ~0 when the prefetcher keeps up, ≈ generation+transfer time when
+    # the input pipeline is the bottleneck.
+    input_wait_ms: float = 0.0
+    # Wall time of the warm-up train_step dispatch+completion (XLA
+    # compile dominates); drops to executable-load time on a
+    # persistent-compile-cache hit.
+    compile_time_s: float = 0.0
 
 
 def _model_config_cls(model_name: str):
@@ -86,6 +94,24 @@ def run_jaxjob(
         raise ValueError("run_jaxjob requires a jaxjob with a `runtime` section")
     cfg = RuntimeConfig.model_validate(job.runtime)
 
+    from polyaxon_tpu.runtime import compile_cache
+
+    with compile_cache.compilation_cache(
+            compile_cache.resolve_cache_dir(cfg.compile_cache_dir)):
+        return _run_jaxjob(job, cfg, artifacts_dir=artifacts_dir,
+                           on_metrics=on_metrics, devices=devices,
+                           should_stop=should_stop)
+
+
+def _run_jaxjob(
+    job: V1JAXJob,
+    cfg: RuntimeConfig,
+    *,
+    artifacts_dir: Optional[str],
+    on_metrics: Optional[MetricsCallback],
+    devices: Optional[list],
+    should_stop: Optional[Callable[[], bool]],
+) -> TrainResult:
     mesh = build_mesh(job.mesh, job.get_topology(), devices=devices)
     rules = rules_for_mesh(mesh)
     logger.info("mesh axes=%s devices=%d", dict(zip(mesh.axis_names, mesh.devices.shape)),
@@ -121,7 +147,12 @@ def run_jaxjob(
         logger.info("lora: rank=%d alpha=%s targets=%s", cfg.lora_rank,
                     cfg.lora_alpha, cfg.lora_targets or "default")
 
-    with mesh:
+    import contextlib
+
+    # The prefetch producer registers its close() here: stop, drain,
+    # join on EVERY exit — normal completion, should_stop, or a raise
+    # anywhere in the loop — so no thread outlives its run.
+    with mesh, contextlib.ExitStack() as cleanup:
         init_fn = build_init(model_def, optimizer, mesh, rules)
         accum = max(int(cfg.grad_accum_steps or 1), 1)
         if accum > 1:
@@ -168,12 +199,6 @@ def run_jaxjob(
         units_per_step = global_batch * (seq if model_def.unit == "tokens" else 1)
 
         start_step = int(state["step"])
-        # Data streams are index-addressable (batch i = f(seed, i)), so a
-        # restored run resumes the stream at its step instead of replaying
-        # from batch 0 — the iterator is built only after restore.
-        host_iter = data_lib.get_dataset(dataset_name, start_batch=start_step,
-                                         **ds_kwargs)
-        batches = data_lib.shard_batches(host_iter, mesh, rules)
         if start_step >= cfg.steps:
             if ckpt:
                 ckpt.close()
@@ -188,6 +213,22 @@ def run_jaxjob(
                 restored_from_step=restored_from,
                 restore_skipped_steps=restore_skipped,
             )
+        # Data streams are index-addressable (batch i = f(seed, i)), so a
+        # restored run resumes the stream at its step instead of replaying
+        # from batch 0 — the iterator is built only after restore (which
+        # also makes prefetch resume-exact for free: batches that were
+        # prefetched but unconsumed at interrupt are simply regenerated).
+        host_iter = data_lib.get_dataset(dataset_name, start_batch=start_step,
+                                         **ds_kwargs)
+        batches = data_lib.shard_batches(host_iter, mesh, rules)
+        prefetcher: Optional[data_lib.PrefetchIterator] = None
+        if cfg.prefetch > 0:
+            # Overlap the host with the device: batch i+k generates and
+            # commits to its NamedSharding on a background thread while
+            # the device runs step i.
+            batches = prefetcher = data_lib.PrefetchIterator(
+                batches, depth=cfg.prefetch)
+            cleanup.callback(prefetcher.close)
         # Periodic held-out evaluation: a FIXED batch set drawn from the
         # same dataset family at a disjoint seed (or from `eval_path`
         # when given — e.g. a separate validation corpus for lm_text),
@@ -223,10 +264,15 @@ def run_jaxjob(
         last_eval: dict[str, float] = {}
         evaled_at = -1  # state["step"] value the last eval scored
         step_rng = jax.random.key(cfg.seed + 17)
-        # Warm up compile outside the timed window.
+        # Warm up compile outside the timed window; the dispatch-to-
+        # ready wall of this first step IS the compile cost (execution
+        # of one step rides along, noise next to XLA), emitted as
+        # compile_time_s so cache-hit restarts are attributable.
         first_batch = next(batches)
+        t_compile = time.perf_counter()
         state, metrics = train_step(state, first_batch, step_rng)
         jax.block_until_ready(metrics["loss"])
+        compile_time_s = time.perf_counter() - t_compile
 
         # Per-step MFU self-reporting (SURVEY §5.1): every emission
         # carries tokens/sec + achieved TFLOPs/chip, and MFU when both
@@ -240,6 +286,9 @@ def run_jaxjob(
         peak = peak_flops(getattr(jax.devices()[0], "device_kind", ""))
         t_emit = time.perf_counter()
         steps_since_emit = 0
+        emitted_compile = False
+        wait_window = 0.0  # host seconds blocked on data, per emission
+        wait_total = 0.0   # ... over all timed steps
 
         t0 = time.perf_counter()
         timed_steps = 0
@@ -251,7 +300,11 @@ def run_jaxjob(
             profiling = cfg.profile_steps and step in cfg.profile_steps and artifacts_dir
             if profiling:
                 jax.profiler.start_trace(f"{artifacts_dir}/profile")
+            t_wait = time.perf_counter()
             batch = next(batches)
+            dt_wait = time.perf_counter() - t_wait
+            wait_window += dt_wait
+            wait_total += dt_wait
             state, metrics = train_step(state, batch, step_rng)
             timed_steps += 1
             steps_since_emit += 1
@@ -268,12 +321,24 @@ def run_jaxjob(
                     ups = units_per_step * steps_since_emit / window
                     vals[f"{model_def.unit}_per_sec"] = ups
                     vals["step_time_ms"] = 1e3 * window / steps_since_emit
+                    # Host time blocked on next(batches), per step:
+                    # ~0 when prefetch keeps up; ≈ generation+transfer
+                    # when the input pipeline is the bottleneck.
+                    vals["input_wait_ms"] = (1e3 * wait_window
+                                             / steps_since_emit)
                     if flops_unit:
                         achieved = ups * flops_unit / n_chips
                         vals["tflops_per_sec_per_chip"] = achieved / 1e12
                         if peak:
                             vals["mfu"] = achieved / peak
+                if not emitted_compile:
+                    # One-shot: the warm-up compile wall, so a metric
+                    # stream can attribute a cheap restart to the
+                    # persistent compile cache.
+                    vals["compile_time_s"] = compile_time_s
+                    emitted_compile = True
                 steps_since_emit = 0
+                wait_window = 0.0
                 on_metrics(step, vals)
                 # Stamp AFTER the callback: tracking I/O must not
                 # deflate the next window's reported throughput.
@@ -333,6 +398,8 @@ def run_jaxjob(
         param_count=int(n_params),
         restored_from_step=restored_from,
         restore_skipped_steps=restore_skipped,
+        input_wait_ms=1e3 * wait_total / timed_steps if timed_steps else 0.0,
+        compile_time_s=compile_time_s,
     )
 
 

@@ -44,6 +44,12 @@ python -m pytest tests/test_chaos.py -q -m chaos
 # preemption-for-priority drill) — deterministic and CPU-only.
 echo "== scheduling invariants (queues/quotas/fair-share/preemption)"
 python -m pytest tests/test_scheduling.py -q -m scheduling
+# Host/device overlap stage: prefetch pipeline + vectorized generators
+# on CPU — functional invariants (resume-exactness, drain-on-stop,
+# per-(seed,i) determinism) plus the `perf`-marked relative-timing
+# checks (prefetch-vs-sync throughput, compile-cache reuse).
+echo "== input pipeline (prefetch/generators/compile-cache)"
+python -m pytest tests/test_prefetch.py -q
 echo "== native ASan/UBSan"
 make -C native sanitize
 printf 'ADD a 4x4 0\nREQ r 2x2 0 0\nTICK 0 30\nQUIT\n' | ./native/build/sliced_san >/dev/null

@@ -90,6 +90,8 @@ SMOKE_NODES = (
     "test_runtime.py::TestLmTextPacked::"
     "test_segments_follow_document_boundaries",
     "test_runtime.py::TestTrainLoop::test_loss_decreases",
+    "test_prefetch.py::TestVectorizedGenerators",
+    "test_prefetch.py::TestPrefetchIterator",
     "test_serving.py::TestServing::test_health_and_models",
     "test_serving.py::TestServing::test_generate_shapes_and_determinism",
     "test_serving.py::TestQuantize::test_static_serving_end_to_end_int8",

@@ -605,6 +605,37 @@ class TestAuth:
             client.list_runs()
         assert exc.value.status == 401
 
+    def test_primary_token_shaped_like_stream_token(self, tmp_path):
+        """ADVICE r5: a PRIMARY token that happens to start with `st:`
+        and carry ≥3 colons used to be routed unconditionally into
+        stream-token verification on ?token= routes and always 401 —
+        locking that credential out of SSE/artifact loads. Verification
+        failure now falls back to the primary comparison."""
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        weird = "st:alice:12345:not-an-hmac"
+        plane = ControlPlane(str(tmp_path / "home"))
+        with ApiServer(plane, owner_tokens={"alice": weird}) as server:
+            alice = PolyaxonClient(server.url, owner="alice", token=weird)
+            mine = alice.post("/api/v1/alice/default/runs",
+                              body={"content": TRIAL,
+                                    "params": {"lr": 0.1}})
+            logs = (f"{server.url}/streams/v1/alice/default/runs/"
+                    f"{mine['uuid']}/logs")
+            quoted = urllib.parse.quote(weird, safe="")
+            with urllib.request.urlopen(f"{logs}?token={quoted}",
+                                        timeout=10) as r:
+                assert r.status == 200
+            # Tokens that match NEITHER a valid stream token NOR a
+            # primary still 401 through the fallback.
+            bad = urllib.parse.quote("st:alice:12345:wrong-sig-too",
+                                     safe="")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{logs}?token={bad}", timeout=10)
+            assert err.value.code == 401
+
     def test_admin_token_full_access(self, auth_stack):
         _, server = auth_stack
         admin = PolyaxonClient(server.url, owner="anyone",

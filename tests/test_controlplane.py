@@ -65,6 +65,65 @@ class TestService:
         conditions = [c["type"] for c in plane.get_statuses(record.uuid)]
         assert conditions == ["created", "compiled", "queued"]
 
+    def test_lineage_downstream_indexed_at_compile(self, plane):
+        """ADVICE r5 perf: lineage_graph used to re-derive upstream
+        edges for EVERY run in the project per request. Edges are now
+        mirrored onto the upstream's meta["downstream_runs"] at compile
+        time, and the request-time scan skips indexed runs entirely."""
+        from polyaxon_tpu.tracking import Run
+
+        prod = plane.submit(TRIAL_COMPONENT, params={"lr": 0.1})
+        plane.compile_run(prod.uuid)
+        d = plane.run_artifacts_dir(prod.uuid)
+        os.makedirs(d, exist_ok=True)
+        with Run(prod.uuid, d) as r:
+            r.log_outputs(accuracy=0.9)
+
+        cons = plane.submit({
+            "kind": "operation",
+            "name": "consumer",
+            "params": {"acc": {"ref": f"runs.{prod.uuid}",
+                               "value": "outputs.accuracy"}},
+            "component": {
+                "inputs": [{"name": "acc", "type": "float",
+                            "isOptional": True, "value": 0.0}],
+                "run": {"kind": "job", "container": {
+                    "command": ["python", "-c", "print('ok')"]}},
+            },
+        })
+        plane.compile_run(cons.uuid)
+
+        # The index landed on the producer at the consumer's compile.
+        prod_rec = plane.store.get_run(prod.uuid)
+        assert prod_rec.meta.get("downstream_runs") == [
+            {"uuid": cons.uuid, "kind": "param", "label": "acc"}]
+        assert plane.store.get_run(cons.uuid).meta.get("lineage_indexed")
+
+        # The graph serves the edge from the index without re-deriving
+        # any indexed run's edges in the downstream scan.
+        derived = []
+        orig = plane._upstream_edges
+
+        def counting(record, sibling_cache=None):
+            derived.append(record.uuid)
+            return orig(record, sibling_cache)
+
+        plane._upstream_edges = counting
+        try:
+            graph = plane.lineage_graph(prod.uuid)
+        finally:
+            plane._upstream_edges = orig
+        assert any(e["from"] == prod.uuid and e["to"] == cons.uuid
+                   and e["kind"] == "param" and e["label"] == "acc"
+                   for e in graph["edges"])
+        # Only the queried run's own upstream half derives; the
+        # project scan skipped the indexed consumer.
+        assert derived == [prod.uuid]
+        # Re-compiling must not duplicate the mirrored edge.
+        plane.compile_run(cons.uuid)
+        assert len(plane.store.get_run(prod.uuid).meta[
+            "downstream_runs"]) == 1
+
     def test_restart_links_origin(self, plane):
         record = plane.submit(TRIAL_COMPONENT, params={"lr": 0.1})
         restarted = plane.restart(record.uuid)

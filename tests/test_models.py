@@ -66,6 +66,10 @@ class TestGemmaVariant:
         cfg = llama.CONFIGS["gemma_2b"]
         assert cfg.head_dim == 256 and cfg.n_kv_heads == 1
         assert cfg.vocab_size == 256_000 and cfg.mlp_activation == "gelu_tanh"
+        # Published Gemma rms_norm_eps is 1e-6, not the llama-family
+        # default 1e-5 (ADVICE r5) — on both the real and tiny variant.
+        assert cfg.norm_eps == 1e-6
+        assert llama.CONFIGS["gemma_tiny"].norm_eps == 1e-6
 
 
 class TestLlama:

@@ -1,0 +1,301 @@
+"""Host/device overlap: prefetching input pipeline, vectorized
+synthetic generators, persistent compile cache (ISSUE 3).
+
+Functional invariants (determinism, resume-exactness, drain-on-stop,
+exception propagation) are exact; the relative-timing assertions
+(throughput parity, cache-hit compile speedup) carry the `perf` marker
+and retry internally because this 1-core host schedules noisily.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.polyflow import V1JAXJob
+from polyaxon_tpu.runtime import data as data_lib, run_jaxjob
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "plx-data-prefetch" and t.is_alive()]
+
+
+def _job(steps=6, mesh=None, **runtime_extra):
+    runtime = {
+        "model": "llama_tiny",
+        "dataset": "lm_synthetic",
+        "steps": steps,
+        "learning_rate": 1e-3,
+        "batch_size": 2,
+        "seq_len": 32,
+        "log_every": 2,
+        **runtime_extra,
+    }
+    return V1JAXJob.from_dict({
+        "kind": "jaxjob",
+        "mesh": {"axes": mesh or {"dp": 2, "fsdp": 4}},
+        "runtime": runtime,
+    })
+
+
+class TestVectorizedGenerators:
+    """The searchsorted-Zipf and cumsum-packed generators must keep the
+    stream contract the loop's resume depends on: batch i is a pure
+    function of (seed, i)."""
+
+    def test_lm_synthetic_deterministic_per_seed_i(self):
+        kw = dict(batch_size=4, seq_len=64, vocab_size=32_000, seed=11)
+        a = data_lib.get_dataset("lm_synthetic", **kw)
+        b = data_lib.get_dataset("lm_synthetic", **kw)
+        a0, a1 = next(a), next(a)
+        np.testing.assert_array_equal(next(b)["tokens"], a0["tokens"])
+        # start_batch=k replays batch k exactly (the resume seek).
+        c = data_lib.get_dataset("lm_synthetic", start_batch=1, **kw)
+        np.testing.assert_array_equal(next(c)["tokens"], a1["tokens"])
+        # Different i → different batch (the stream moves).
+        assert not np.array_equal(a0["tokens"], a1["tokens"])
+
+    def test_lm_synthetic_range_and_zipf_skew(self):
+        batch = next(data_lib.get_dataset(
+            "lm_synthetic", batch_size=8, seq_len=256, vocab_size=32_000,
+            seed=0))
+        tok = batch["tokens"]
+        assert tok.dtype == np.int32
+        assert tok.min() >= 0 and tok.max() < 32_000
+        # Zipf mass concentrates at low ranks: the bottom 1% of ids must
+        # carry far more mass than the top half (≈55% vs ≈7% analytically).
+        low = (tok < 320).mean()
+        high = (tok >= 16_000).mean()
+        assert low > 0.3 > high, (low, high)
+
+    def test_lm_packed_synthetic_deterministic_and_structure(self):
+        kw = dict(batch_size=4, seq_len=128, vocab_size=1000,
+                  mean_doc_len=16, seed=9)
+        a = data_lib.get_dataset("lm_packed_synthetic", **kw)
+        a0, a1 = next(a), next(a)
+        b = data_lib.get_dataset("lm_packed_synthetic", start_batch=1, **kw)
+        b1 = next(b)
+        np.testing.assert_array_equal(b1["tokens"], a1["tokens"])
+        np.testing.assert_array_equal(b1["segments"], a1["segments"])
+        seg, tok = a0["segments"], a0["tokens"]
+        assert tok.min() >= 2 and tok.max() < 1000
+        # Segment ids: start at 0, monotone, step by at most 1 (cumsum
+        # over doc ends), and rows actually pack multiple documents.
+        assert (seg[:, 0] == 0).all()
+        d = np.diff(seg, axis=1)
+        assert ((d == 0) | (d == 1)).all()
+        assert (seg.max(axis=1) >= 2).all()
+
+    def test_mean_doc_len_one_terminates(self):
+        # Degenerate knob: doc length floor clamps to 1 instead of
+        # sampling zero-length docs forever.
+        batch = next(data_lib.get_dataset(
+            "lm_packed_synthetic", batch_size=1, seq_len=16,
+            vocab_size=100, mean_doc_len=1, seed=0))
+        assert batch["segments"].shape == (1, 16)
+
+
+class TestPrefetchIterator:
+    def test_preserves_order_and_content(self):
+        kw = dict(batch_size=2, seq_len=16, vocab_size=500, seed=4)
+        sync = data_lib.get_dataset("lm_synthetic", **kw)
+        pf = data_lib.PrefetchIterator(
+            data_lib.get_dataset("lm_synthetic", **kw), depth=3)
+        try:
+            for _ in range(8):
+                np.testing.assert_array_equal(next(pf)["tokens"],
+                                              next(sync)["tokens"])
+        finally:
+            pf.close()
+        assert not pf.alive
+
+    def test_close_drains_and_joins(self):
+        pf = data_lib.PrefetchIterator(
+            data_lib.get_dataset("lm_synthetic", batch_size=2, seq_len=16),
+            depth=2)
+        next(pf)  # producer is certainly live
+        pf.close()
+        assert not pf.alive
+        assert not _prefetch_threads()
+
+    def test_producer_exception_propagates(self):
+        def boom():
+            yield {"x": np.zeros(1)}
+            yield {"x": np.ones(1)}
+            raise RuntimeError("generator exploded")
+
+        pf = data_lib.PrefetchIterator(boom(), depth=2)
+        assert next(pf)["x"][0] == 0
+        assert next(pf)["x"][0] == 1
+        with pytest.raises(RuntimeError, match="generator exploded"):
+            next(pf)
+        pf.close()
+        assert not pf.alive
+
+    def test_finite_iterator_stops(self):
+        pf = data_lib.PrefetchIterator(iter(range(3)), depth=2)
+        assert list(pf) == [0, 1, 2]
+        pf.close()
+        assert not pf.alive
+
+    def test_rejects_zero_depth(self):
+        with pytest.raises(ValueError, match="depth"):
+            data_lib.PrefetchIterator(iter(()), depth=0)
+
+
+class TestLoopPrefetch:
+    def test_metrics_carry_input_wait_and_compile_time(self, cpu_devices):
+        seen = []
+        result = run_jaxjob(_job(steps=6, prefetch=2),
+                            on_metrics=lambda s, m: seen.append(m))
+        throughput_emissions = [m for m in seen if "tokens_per_sec" in m]
+        assert throughput_emissions
+        for m in throughput_emissions:
+            assert m["input_wait_ms"] >= 0
+        # compile_time_s is one-shot, on the first emission.
+        assert "compile_time_s" in seen[0]
+        assert sum("compile_time_s" in m for m in seen) == 1
+        assert result.compile_time_s > 0
+        assert result.input_wait_ms >= 0
+        # The producer thread never outlives its run.
+        assert not _prefetch_threads()
+
+    def test_drain_on_should_stop_no_leaked_threads(self, cpu_devices):
+        calls = {"n": 0}
+
+        def should_stop():
+            calls["n"] += 1
+            return calls["n"] > 2
+
+        result = run_jaxjob(_job(steps=50, prefetch=3),
+                            should_stop=should_stop)
+        assert result.steps < 50
+        assert not _prefetch_threads()
+
+    def test_exception_in_loop_drains_threads(self, cpu_devices):
+        def bad_metrics(step, vals):
+            raise RuntimeError("callback exploded")
+
+        with pytest.raises(RuntimeError, match="callback exploded"):
+            run_jaxjob(_job(steps=6, prefetch=2, log_every=1),
+                       on_metrics=bad_metrics)
+        assert not _prefetch_threads()
+
+    def test_prefetch_resume_exact(self, cpu_devices, tmp_path):
+        """Restore at step k yields the identical batch sequence (and so
+        identical final loss) to a never-interrupted run — prefetched-
+        but-unconsumed batches are regenerated, not replayed stale."""
+        def spec(steps, prefetch):
+            return V1JAXJob.from_dict({
+                "kind": "jaxjob",
+                "mesh": {"axes": {"dp": -1}},
+                "checkpointing": {"enabled": True, "intervalSteps": 4,
+                                  "asyncSave": False},
+                "runtime": {"model": "llama_tiny", "steps": steps,
+                            "batch_size": 2, "seq_len": 16,
+                            "learning_rate": 1e-3, "prefetch": prefetch},
+            })
+
+        straight = run_jaxjob(spec(8, 2), artifacts_dir=str(tmp_path / "a"))
+        run_jaxjob(spec(4, 2), artifacts_dir=str(tmp_path / "b"))
+        resumed = run_jaxjob(spec(8, 2), artifacts_dir=str(tmp_path / "b"))
+        assert resumed.restored_from_step == 4
+        assert abs(straight.final_metrics["loss"]
+                   - resumed.final_metrics["loss"]) < 1e-5
+        # And the prefetched stream IS the synchronous stream: the same
+        # run with prefetch off lands on the same loss.
+        sync = run_jaxjob(spec(8, 0), artifacts_dir=str(tmp_path / "c"))
+        assert abs(straight.final_metrics["loss"]
+                   - sync.final_metrics["loss"]) < 1e-5
+        assert not _prefetch_threads()
+
+
+class TestCompileCacheResolution:
+    """Dir resolution is pure env/config logic — no jax involved."""
+
+    def test_precedence_and_kill_switch(self, monkeypatch):
+        from polyaxon_tpu.runtime import compile_cache as cc
+
+        monkeypatch.delenv(cc.ENV_CACHE, raising=False)
+        monkeypatch.delenv(cc.ENV_CACHE_DIR, raising=False)
+        assert cc.resolve_cache_dir(None) is None  # opt-in: off by default
+        assert cc.resolve_cache_dir("/cfg") == "/cfg"
+        monkeypatch.setenv(cc.ENV_CACHE_DIR, "/envdir")
+        assert cc.resolve_cache_dir(None) == "/envdir"
+        assert cc.resolve_cache_dir("/cfg") == "/cfg"  # config wins
+        monkeypatch.setenv(cc.ENV_CACHE, "0")  # force-disable beats all
+        assert cc.resolve_cache_dir("/cfg") is None
+
+    def test_executor_resolves_shared_default(self, tmp_path, monkeypatch):
+        """POLYAXON_TPU_COMPILE_CACHE=1 without an explicit dir: the
+        executor points every gang (env-inherited) at ONE cache under
+        the agent's artifacts root, so a preemption-requeued run finds
+        the first attempt's executables."""
+        from polyaxon_tpu.agent.executor import LocalExecutor
+        from polyaxon_tpu.controlplane import ControlPlane
+        from polyaxon_tpu.runtime import compile_cache as cc
+
+        monkeypatch.setenv(cc.ENV_CACHE, "1")
+        monkeypatch.delenv(cc.ENV_CACHE_DIR, raising=False)
+        plane = ControlPlane(str(tmp_path / "home"))
+        LocalExecutor(plane)
+        assert os.environ[cc.ENV_CACHE_DIR] == os.path.join(
+            plane.artifacts_root, cc.SHARED_CACHE_DIRNAME)
+
+
+@pytest.mark.perf
+@pytest.mark.slow
+class TestOverlapPerf:
+    """Relative-timing assertions; retried internally (host-load
+    sensitive on this oversubscribed 1-core runner). `slow`: they burn
+    ~80s of repeated jaxjob runs, so they live in the ci.sh input-
+    pipeline stage (which runs this whole module) rather than tier-1."""
+
+    def test_prefetch_throughput_not_worse_than_sync(self, cpu_devices):
+        """`prefetch: 2` must not lose to `prefetch: 0` in the same
+        process: with a spare core the overlap is a win; on this 1-core
+        host the producer and device compete, so the honest bound is
+        parity within scheduler noise."""
+        def tps(prefetch):
+            result = run_jaxjob(_job(
+                steps=14, prefetch=prefetch, seq_len=64, batch_size=2,
+                log_every=10**9))
+            return result.throughput
+
+        best_ratio = 0.0
+        for _ in range(3):
+            sync = tps(0)
+            overlapped = tps(2)
+            best_ratio = max(best_ratio, overlapped / sync)
+            if best_ratio >= 1.0:
+                break
+        assert best_ratio >= 0.9, best_ratio
+        assert not _prefetch_threads()
+
+    def test_compile_cache_reuse_across_runs(self, cpu_devices, tmp_path):
+        """Two identical run_jaxjob invocations against one persistent
+        compile cache: the second's warm-up (compile_time_s) is a disk
+        load, not an XLA compile. Single-device mesh on purpose — this
+        host's XLA:CPU AOT reload of SHARDED executables is the known
+        hazard tests/conftest.py documents."""
+        import jax
+
+        cache = str(tmp_path / "xla-cache")
+
+        def run(tag):
+            return run_jaxjob(
+                _job(steps=2, mesh={"dp": 1}, log_every=1,
+                     compile_cache_dir=cache),
+                artifacts_dir=str(tmp_path / tag),
+                devices=jax.devices()[:1])
+
+        cold = run("cold")
+        import os
+        assert os.listdir(cache), "cache dir is empty after a cold run"
+        warm = run("warm")
+        assert warm.compile_time_s < cold.compile_time_s, (
+            cold.compile_time_s, warm.compile_time_s)
+        # Scoped config: the run restored the global jax setting.
+        assert jax.config.jax_compilation_cache_dir is None
