@@ -191,13 +191,26 @@ const base = (project) => `/api/v1/${encodeURIComponent(OWNER)}/${encodeURICompo
 // resolves, URLs fall back to the primary so nothing breaks.
 let streamTok = "", streamTokExp = 0, streamTokPending = null;
 function refreshStreamToken() {
-  if (!getToken()) return;
-  if (streamTok && Date.now() < streamTokExp - 30000) return;
-  if (streamTokPending) return;
+  if (!getToken()) return Promise.resolve();
+  if (streamTok && Date.now() < streamTokExp - 30000) return Promise.resolve();
+  if (streamTokPending) return streamTokPending;
   streamTokPending = api("/api/v1/stream-token").then(d => {
     streamTok = d.token;
     streamTokExp = Date.now() + (d.expiresIn || 300) * 1000;
   }).catch(() => {}).finally(() => { streamTokPending = null; });
+  return streamTokPending;
+}
+// First-paint ordering (ADVICE r5 #4): every URL-constructing render
+// awaits the mint — retrying once on failure — BEFORE building its
+// first SSE/artifact URLs, so the primary secret never rides a URL
+// merely because the eager mint hadn't resolved yet. After two failed
+// mints tokenQS still falls back to the primary (servers without the
+// mint route would otherwise lose SSE/images entirely) — but that is
+// now a capability fallback, not a race.
+async function ensureStreamToken() {
+  if (!getToken()) return;
+  await refreshStreamToken();
+  if (!(streamTok && Date.now() < streamTokExp)) await refreshStreamToken();
 }
 const tokenQS = (sep) => {
   if (!getToken()) return "";
@@ -942,6 +955,9 @@ async function showRun(uuid, opts) {
   const detail = $("#detail");
   const gen = ++renderGen;
   stopDetailTimers();
+  // Stream token BEFORE any tokenQS-built URL below (img/artifact
+  // hrefs, the logs EventSource) — see ensureStreamToken.
+  await ensureStreamToken();
   const [run, metrics, images, hists] = await Promise.all([
     api(`${base()}/runs/${uuid}`),
     api(`${base()}/runs/${uuid}/metrics`).catch(() => ({})),

@@ -60,6 +60,7 @@ from polyaxon_tpu.models.common import (
 from polyaxon_tpu.models.common import _embed_rows, _w, lm_logits
 from polyaxon_tpu.models.llama import _rope
 from polyaxon_tpu.ops.attention import dot_product_attention
+from polyaxon_tpu.parallel import compat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -318,7 +319,7 @@ def _moe_ragged(cfg: MoEConfig, x, router_w, w_gate, w_up, w_down):
     if _axis_bound("ep"):
         out, aux = _moe_ragged_sharded(
             cfg, tokens, router_w, w_gate, w_up, w_down,
-            ep=jax.lax.axis_size("ep"), axis_name="ep")
+            ep=compat.axis_size("ep"), axis_name="ep")
         return out.reshape(B, S, D), aux
 
     mesh = ambient_mesh()
@@ -330,7 +331,7 @@ def _moe_ragged(cfg: MoEConfig, x, router_w, w_gate, w_up, w_down):
             ep=1, axis_name=None)
         return out.reshape(B, S, D), aux
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         functools.partial(_moe_ragged_sharded, cfg, ep=ep, axis_name="ep"),
         mesh=mesh,
         in_specs=(jax.sharding.PartitionSpec("ep", None),

@@ -34,6 +34,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from polyaxon_tpu.parallel import compat
 from jax.experimental import pallas as pl
 
 try:  # pltpu only imports cleanly where libtpu/mosaic is present
@@ -229,7 +231,8 @@ def _flash_fwd_pallas(
     )
     compiler_params = None
     if pltpu is not None and not interpret:
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = compat.tpu_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         )
     scratch = [
@@ -535,8 +538,8 @@ def _flash_bwd_pallas(
     def cparams(n_parallel: int, n_arbitrary: int):
         if pltpu is None or interpret:
             return None
-        return pltpu.CompilerParams(
-            dimension_semantics=("parallel",) * n_parallel
+        return compat.tpu_compiler_params(
+            pltpu, dimension_semantics=("parallel",) * n_parallel
             + ("arbitrary",) * n_arbitrary)
 
     # dk/dv: grid (b, kv, k_block, group_rep, q_block); the two inner

@@ -23,6 +23,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from polyaxon_tpu.parallel import compat
 from jax.experimental import pallas as pl
 
 try:  # pltpu only imports cleanly where libtpu/mosaic is present
@@ -126,7 +128,8 @@ def paged_decode_attention(
     kernel = functools.partial(_decode_kernel, scale=scale, page=page)
     compiler_params = None
     if pltpu is not None and not interpret:
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = compat.tpu_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

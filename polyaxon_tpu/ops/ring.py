@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from polyaxon_tpu.parallel import compat
+
 NEG_INF = -1e30
 
 _warned_einsum_fallback = False
@@ -126,7 +128,7 @@ def _block_attn(q, k, v, *, causal, scale):
 
 def _ring_causal_zigzag(q, k, v, *, scale, axis_name):
     """Causal ring attention with zigzag placement (module docstring)."""
-    cp = jax.lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[1]
     half = s_loc // 2
@@ -229,7 +231,7 @@ def _ring_dense(q, k, v, *, scale, axis_name):
     The permute issued by the final iteration is unused (~1/cp extra
     bandwidth, itself hidden under that step's compute).
     """
-    cp = jax.lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     rotate = [(i, (i + 1) % cp) for i in range(cp)]
     attn = functools.partial(_block_attn, scale=scale, causal=False)
 
@@ -256,7 +258,7 @@ def _ring_einsum_causal(q, k, v, *, scale, axis_name):
     diagonal are masked, not skipped."""
     from polyaxon_tpu.ops.attention import repeat_kv
 
-    cp = jax.lax.axis_size(axis_name)
+    cp = compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     n_rep = h // k.shape[2]
@@ -356,15 +358,19 @@ def ring_attention(
         q = jnp.pad(q, widths)
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
-    spec = P(None, axis_name, None, None)  # seq dim sharded over cp
-    fn = jax.shard_map(
+    # Seq shards over cp; the batch dim keeps its dp/fsdp sharding
+    # through the shard_map (an unmentioned batch axis would all-gather
+    # Q/K/V at the boundary and attend dp-redundantly — the audit
+    # measured that spelling at 3.2x the step time on dp2xcp4; see
+    # docs/performance.md "Communication audit").
+    spec = P(compat.batch_axes_in(mesh), axis_name, None, None)
+    fn = compat.shard_map(
         functools.partial(
             _ring_attention_sharded, causal=causal, scale=scale, axis_name=axis_name
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={axis_name},
         check_vma=False,
     )
     out = fn(q, k, v)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from polyaxon_tpu.ops.ring import _axis_bound, ambient_mesh
+from polyaxon_tpu.parallel import compat
 
 
 def _ulysses_sharded(
@@ -40,7 +41,7 @@ def _ulysses_sharded(
 ) -> jax.Array:
     from polyaxon_tpu.ops.attention import repeat_kv, xla_attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     h = q.shape[2]
     if h % n:
         raise ValueError(f"Ulysses needs heads ({h}) % axis size ({n}) == 0")
@@ -98,8 +99,14 @@ def ulysses_attention(
             f"ulysses_attention needs mesh axis `{axis_name}`: call inside "
             "shard_map, pass mesh=, or enter `with mesh:`"
         )
-    spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    # Batch stays sharded over dp/fsdp THROUGH the shard_map: leaving
+    # the batch dim unmentioned would all-gather Q/K/V over dp at the
+    # boundary and run attention dp-redundantly, then re-shard O — the
+    # avoidable reshard the collective audit flagged around the ulysses
+    # all-to-all passes (4 extra all-gathers/step on dp2xcp4; see
+    # docs/performance.md "Communication audit").
+    spec = P(compat.batch_axes_in(mesh), axis_name, None, None)
+    fn = compat.shard_map(
         functools.partial(
             _ulysses_sharded, causal=causal, scale=softmax_scale,
             axis_name=axis_name, attn_impl=attn_impl,
@@ -107,7 +114,6 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={axis_name},
         check_vma=False,
     )
     return fn(q, k, v)

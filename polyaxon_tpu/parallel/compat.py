@@ -1,0 +1,90 @@
+"""Version-portable manual-collective entry points.
+
+The manual schedules (ring/ulysses attention, SPMD pipeline, ragged MoE
+dispatch) were written against the modern ``jax.shard_map`` partial-
+manual API (``axis_names=``/``check_vma=``). Older jaxlibs ship only
+``jax.experimental.shard_map.shard_map`` — and on the jaxlib pinned in
+this image the partial-manual mode (``auto=`` nonempty) CHECK-aborts
+inside the SPMD partitioner (``spmd_partitioner.cc: IsManualSubgroup``
+mismatch, reproduced on the 8-device CPU mesh 2026-08-04). So this shim
+normalizes everything onto the one mode that works everywhere: **full
+manual** over the whole mesh, with every axis a tensor is actually
+sharded over named explicitly in its specs.
+
+The consequence callers must honor: an axis left out of a spec is
+*replicated* into the body (a full-manual shard_map all-gathers over
+it), not left to GSPMD. Schedules that take batch-sharded activations
+therefore name the batch axes in their specs — see ``batch_axes_in``.
+The communication audit (``polyaxon_tpu/perf``) counts exactly the
+collectives this choice produces, so a spec that silently gathers the
+batch shows up as an all-gather regression in the budget gate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["shard_map", "axis_size", "batch_axes_in",
+           "tpu_compiler_params"]
+
+# Mesh axes that carry the batch dimension of activations (the rule
+# tables map logical "batch" onto these — parallel/sharding.py).
+_BATCH_AXES = ("dp", "fsdp")
+
+
+def axis_size(axis_name: str) -> int:
+    """Size of a bound manual axis (``jax.lax.axis_size`` is newer than
+    some supported jaxlibs; ``psum(1)`` over the axis is the portable
+    spelling and folds to a compile-time constant)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def batch_axes_in(mesh: Mesh):
+    """The nontrivial batch-carrying mesh axes, as a PartitionSpec entry
+    (None / a name / a tuple of names). Manual schedules put this on the
+    batch dim of their specs so a full-manual shard_map keeps the batch
+    sharded instead of gathering it — the audit showed the replicated
+    spelling costs 4 extra all-gathers + dp-redundant attention compute
+    per step on a dp2xcp4 mesh (docs/performance.md)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = tuple(a for a in _BATCH_AXES if shape.get(a, 1) > 1)
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
+def tpu_compiler_params(pltpu, **kwargs):
+    """Mosaic compiler params across the pallas-TPU rename
+    (``CompilerParams`` on modern jax, ``TPUCompilerParams`` before)."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              axis_names: Optional[set] = None, check_vma: bool = False):
+    """``jax.shard_map`` with the signature the schedules were written
+    against, lowered onto whichever API this jax ships.
+
+    ``axis_names`` is accepted for source fidelity but NOT honored as
+    partial-manual on old jaxlibs (see module docstring): the body
+    always runs full-manual, so collectives over any mesh axis are
+    legal, and specs are the single source of placement truth.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from polyaxon_tpu.parallel import compat
+
 
 def spmd_pipeline(
     stage_fn: Callable,  # (local_params, x [mb, ...]) -> [mb, ...]
@@ -37,7 +39,7 @@ def spmd_pipeline(
 ) -> jax.Array:
     """Run the pipeline INSIDE shard_map; returns [n_micro, mb, ...]
     stage outputs, valid on the LAST stage (callers psum-select)."""
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = compat.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
     total_ticks = n_micro + n_stages - 1
@@ -105,7 +107,7 @@ def pipeline_forward(
             axis_name=axis_name)
         return outs[None]  # [1(stage), n_micro, mb, ...]
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         sharded,
         mesh=mesh,
         in_specs=(param_specs, P()),

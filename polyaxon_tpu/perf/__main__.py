@@ -1,0 +1,142 @@
+"""``python -m polyaxon_tpu.perf`` — the communication audit CLI.
+
+Default: audit every standard schedule point on the 8-device virtual
+CPU mesh, print the per-schedule collective table, and write the full
+report artifact (``collective_audit.json``). ``--check`` gates against
+the committed budgets (the ci.sh audit stage); ``--update-budgets``
+regenerates them after an intentional sharding change; ``--aot-probe``
+runs the topology-only TPU compile probe instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu_mesh(n: int) -> None:
+    from polyaxon_tpu.utils import cpu_mesh_xla_flags
+
+    cpu_mesh_xla_flags(n)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m polyaxon_tpu.perf",
+        description="HLO collective audit over the standard schedule "
+                    "points (8-device virtual CPU mesh)")
+    parser.add_argument("--schedules", default=None,
+                        help="comma-separated subset of standard points "
+                             "(default: all)")
+    parser.add_argument("--check", action="store_true",
+                        help="fail (exit 1) on any budget violation")
+    parser.add_argument("--update-budgets", action="store_true",
+                        help="regenerate polyaxon_tpu/perf/budgets.json "
+                             "from this run")
+    parser.add_argument("--json", default="collective_audit.json",
+                        help="report artifact path ('' = don't write)")
+    parser.add_argument("--inject-reshard", action="store_true",
+                        help="deliberately replicate the batch inside the "
+                             "step (demonstrates the gate failing)")
+    parser.add_argument("--ops", action="store_true",
+                        help="include the per-instruction op list in the "
+                             "JSON artifact (large)")
+    parser.add_argument("--aot-probe", action="store_true",
+                        help="run the AOT topology-only TPU compile probe "
+                             "and write aot_probe_results.json")
+    parser.add_argument("--aot-timeout", type=float, default=None,
+                        help="probe subprocess timeout seconds "
+                             "(per topology candidate)")
+    parser.add_argument("--aot-train-step", default=None, metavar="POINTS",
+                        help="comma-separated standard points to also "
+                             "compile as full train steps against the "
+                             "topology (TPU collective reports), e.g. "
+                             "'ulysses-cp,ring-cp'")
+    parser.add_argument("--devices", type=int, default=8,
+                        help="virtual CPU mesh size (default 8)")
+    args = parser.parse_args(argv)
+
+    if args.aot_probe:
+        from polyaxon_tpu.perf import aot
+
+        result = aot.run_probe(args.aot_timeout or aot.PROBE_TIMEOUT_S,
+                               train_step_points=args.aot_train_step)
+        out_path = "aot_probe_results.json"
+        with open(out_path, "w") as fh:
+            json.dump(result, fh, indent=2)
+            fh.write("\n")
+        print(json.dumps(result))
+        print(f"# wrote {out_path}", file=sys.stderr)
+        # A negative probe is a recorded RESULT, not a failure: only a
+        # harness-level error (no JSON at all) exits nonzero.
+        return 0 if ("topologies" in result or result.get("ok")) else 1
+
+    _force_cpu_mesh(args.devices)
+
+    from polyaxon_tpu.perf import audit, budgets
+
+    points = list(audit.STANDARD_POINTS)
+    if args.schedules:
+        points = [audit.point_by_name(s.strip())
+                  for s in args.schedules.split(",") if s.strip()]
+
+    reports = []
+    for point in points:
+        print(f"→ {point.name} ...", flush=True, file=sys.stderr)
+        reports.append(audit.audit_point(
+            point, inject_reshard=args.inject_reshard, keep_ops=args.ops))
+
+    kinds = sorted({k for r in reports for k in r["counts"]})
+    header = f"{'schedule':<12} {'mesh':<18} " + " ".join(
+        f"{k:>18}" for k in kinds) + f" {'est MiB/step':>13}"
+    print(header)
+    for r in reports:
+        mesh = "x".join(f"{a}{s}" for a, s in r["axes"].items())
+        row = f"{r['name']:<12} {mesh:<18} " + " ".join(
+            f"{r['counts'].get(k, 0):>18}" for k in kinds)
+        row += f" {r['est_wire_bytes_per_step'] / 2**20:>13.2f}"
+        print(row)
+
+    if args.json:
+        artifact = {"reports": reports}
+        ring = next((r for r in reports if r["name"] == "ring-cp"), None)
+        uly = next((r for r in reports if r["name"] == "ulysses-cp"), None)
+        if ring and uly:
+            artifact["ring_vs_ulysses"] = audit.diff_reports(ring, uly)
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+            fh.write("\n")
+        print(f"# wrote {args.json}", file=sys.stderr)
+
+    if args.update_budgets:
+        if args.inject_reshard:
+            print("refusing to bake an injected reshard into budgets",
+                  file=sys.stderr)
+            return 2
+        import jax
+
+        path = budgets.write_budgets(
+            reports, meta={"jax": jax.__version__,
+                           "backend": "cpu-virtual",
+                           "n_devices": args.devices})
+        print(f"# wrote {path}", file=sys.stderr)
+        return 0
+
+    if args.check:
+        violations = budgets.check_reports(reports)
+        if violations:
+            for v in violations:
+                print(f"BUDGET VIOLATION: {v}", file=sys.stderr)
+            return 1
+        print("# collective budgets OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Per-schedule collective budgets — the CI regression gate.
+
+``budgets.json`` (checked in next to this module) records, per standard
+schedule point, the expected collective op counts and wire-byte
+estimate of the compiled train step. The gate fails when a schedule
+emits MORE ops of any kind than budgeted, or when estimated traffic
+grows past the byte tolerance — i.e. an accidental reshard fails the
+build instead of silently costing 4.7x at the next measurement round.
+
+Counts *below* budget pass with a note (a genuine optimization should
+be locked in by regenerating: ``python -m polyaxon_tpu.perf
+--update-budgets``). Budgets are an artifact of this image's pinned
+jax/XLA — regenerate alongside a toolchain bump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+DEFAULT_BUDGET_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
+
+# Estimated-bytes drift allowed before the gate trips: shape-level
+# compiler variation (fusion choices resizing a gathered temp) should
+# not fail CI, a doubled all-to-all volume should.
+BYTES_TOLERANCE = 0.25
+
+
+def load_budgets(path: Optional[str] = None) -> dict:
+    with open(path or DEFAULT_BUDGET_PATH) as fh:
+        return json.load(fh)
+
+
+def write_budgets(reports: list[dict], path: Optional[str] = None,
+                  meta: Optional[dict] = None) -> str:
+    out = {"_meta": dict(meta or {})}
+    out["_meta"].setdefault("bytes_tolerance", BYTES_TOLERANCE)
+    for rep in reports:
+        out[rep["name"]] = {
+            "counts": rep["counts"],
+            "est_wire_bytes_per_step": rep["est_wire_bytes_per_step"],
+            "axes": rep["axes"],
+            "model": rep["model"],
+            "attention": rep["attention"],
+            "seq_len": rep["seq_len"],
+            "global_batch": rep["global_batch"],
+        }
+    path = path or DEFAULT_BUDGET_PATH
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def check_report(report: dict, budgets: dict) -> list[str]:
+    """Violations for one point report against the budget table.
+
+    Empty list = within budget. A schedule with no budget entry is
+    itself a violation: new schedules must be budgeted the PR they
+    land, or the gate silently stops covering them.
+    """
+    name = report.get("name")
+    entry = budgets.get(name)
+    if entry is None:
+        return [f"{name}: no budget entry (run --update-budgets and "
+                f"commit budgets.json)"]
+    violations: list[str] = []
+    for key in ("axes", "model", "attention", "seq_len", "global_batch"):
+        if key in entry and entry[key] != report.get(key):
+            violations.append(
+                f"{name}: budget was recorded for {key}={entry[key]!r} "
+                f"but the audit ran {key}={report.get(key)!r} — "
+                f"regenerate budgets for the new point definition")
+    if violations:
+        return violations
+
+    budget_counts = entry.get("counts", {})
+    for kind, count in sorted(report.get("counts", {}).items()):
+        allowed = budget_counts.get(kind, 0)
+        if count > allowed:
+            violations.append(
+                f"{name}: {kind} x{count} exceeds budget x{allowed} "
+                f"(an unbudgeted reshard?)")
+    tol = budgets.get("_meta", {}).get("bytes_tolerance", BYTES_TOLERANCE)
+    budget_bytes = entry.get("est_wire_bytes_per_step", 0)
+    got = report.get("est_wire_bytes_per_step", 0)
+    if budget_bytes and got > budget_bytes * (1 + tol):
+        violations.append(
+            f"{name}: est wire bytes {got} exceed budget {budget_bytes} "
+            f"by more than {tol:.0%}")
+    return violations
+
+
+def check_reports(reports: list[dict],
+                  budgets: Optional[dict] = None,
+                  path: Optional[str] = None) -> list[str]:
+    if budgets is None:
+        budgets = load_budgets(path)
+    out: list[str] = []
+    for rep in reports:
+        out.extend(check_report(rep, budgets))
+    return out

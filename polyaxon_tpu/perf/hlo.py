@@ -1,0 +1,174 @@
+"""Collective accounting over compiled HLO text.
+
+The sharded program GSPMD emits makes every byte of inter-device
+traffic explicit as a collective instruction; parsing the
+post-optimization module therefore gives an exact op census and a
+shape-derived traffic estimate without running a single step. Wire
+bytes use the standard ring-algorithm costs **per participant**:
+
+    all-reduce          2 * B * (g-1)/g     (reduce-scatter + all-gather)
+    all-gather          B_out * (g-1)/g     (B_out = gathered result)
+    reduce-scatter      B_out * (g-1)       (receives (g-1)/g of input)
+    all-to-all          B * (g-1)/g         (keeps 1/g locally)
+    collective-permute  B                   (one hop per pair)
+
+where ``g`` is the replica-group size. These are estimates of traffic
+*volume* — topology (ICI hop count, DCN crossings) is out of scope; the
+budget gate cares about op counts and byte deltas, both of which these
+formulas rank faithfully.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+# f8 variants first so "f8e4m3fn" doesn't half-match "f8".
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `%name = <result-type> <op>(`. The result type is everything between
+# `=` and the op token — matched that way because TPU HLO layouts embed
+# colons and parens (`bf16[4,2048]{2,1,0:T(2,128)(2,1)S(1)}`) that
+# defeat any character-class spelling. Async collectives appear as
+# `-start`/`-done` pairs; only the `-start` carries the transfer (the
+# `-done` result aliases it), so `-done` lines never match the op
+# pattern (the kind token must be followed directly by `(`).
+_ASSIGN_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w\.\-]+)\s*=\s*(?P<rest>.+)$")
+_OP_RE = re.compile(
+    r"(?:^|\s)(?P<op>"
+    + "|".join(k + r"(?:-start)?" for k in COLLECTIVE_KINDS)
+    + r")\(",
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{\{")
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    kind: str            # canonical kind (no -start suffix)
+    name: str            # HLO instruction name
+    result_bytes: int    # total bytes of the result shape(s)
+    group_size: int      # replica-group participants
+    wire_bytes: float    # estimated bytes on the wire per participant
+    line: str            # the source line (diagnostics / report detail)
+
+
+def _shape_bytes_list(type_str: str) -> list[int]:
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue  # token[], opaque[] — carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * size)
+    return out
+
+
+def _result_bytes(type_str: str, async_start: bool) -> int:
+    """Payload bytes of a collective's result type.
+
+    Sync form: the (possibly tuple) result IS the payload — sum it.
+    ``-start`` form: the result tuple aliases (source, destination,
+    context scalars); summing would double-count the transfer, so take
+    the largest member (the destination — equal to the sync form's
+    result for every kind)."""
+    sizes = _shape_bytes_list(type_str)
+    if not sizes:
+        return 0
+    return max(sizes) if async_start else sum(sizes)
+
+
+def _group_size(line: str, n_devices: Optional[int]) -> int:
+    m = _GROUPS_RE.search(line)
+    if m:
+        first = [p for p in m.group(1).split(",") if p.strip()]
+        return max(len(first), 1)
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return max(int(m.group(2)), 1)
+    if _PAIRS_RE.search(line):
+        return 2  # permute: pairwise
+    return max(n_devices or 1, 1)
+
+
+def _wire_bytes(kind: str, result_bytes: int, g: int) -> float:
+    if g <= 1:
+        return 0.0
+    if kind == "all-reduce":
+        return 2.0 * result_bytes * (g - 1) / g
+    if kind == "all-gather":
+        return result_bytes * (g - 1) / g
+    if kind == "reduce-scatter":
+        return float(result_bytes) * (g - 1)
+    if kind == "all-to-all":
+        return result_bytes * (g - 1) / g
+    if kind == "collective-permute":
+        return float(result_bytes)
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+def parse_collectives(hlo_text: str,
+                      n_devices: Optional[int] = None) -> list[CollectiveOp]:
+    """All collective instructions in a post-optimization HLO module."""
+    ops: list[CollectiveOp] = []
+    for line in hlo_text.splitlines():
+        assign = _ASSIGN_RE.match(line)
+        if not assign:
+            continue
+        rest = assign.group("rest")
+        m = _OP_RE.search(rest)
+        if not m:
+            continue
+        op_token = m.group("op")
+        async_start = op_token.endswith("-start")
+        kind = op_token[: -len("-start")] if async_start else op_token
+        # Result type = everything before the op token; operand shapes
+        # (inside the call parens) stay out of the census.
+        result_bytes = _result_bytes(rest[: m.start()], async_start)
+        g = _group_size(line, n_devices)
+        ops.append(CollectiveOp(
+            kind=kind,
+            name=assign.group("name"),
+            result_bytes=result_bytes,
+            group_size=g,
+            wire_bytes=_wire_bytes(kind, result_bytes, g),
+            line=line.strip(),
+        ))
+    return ops
+
+
+def summarize_collectives(ops: list[CollectiveOp]) -> dict:
+    """Aggregate an op list into the budget-comparable report shape."""
+    counts: dict[str, int] = {}
+    bytes_by_kind: dict[str, int] = {}
+    for op in ops:
+        counts[op.kind] = counts.get(op.kind, 0) + 1
+        bytes_by_kind[op.kind] = (
+            bytes_by_kind.get(op.kind, 0) + int(op.wire_bytes))
+    return {
+        "counts": dict(sorted(counts.items())),
+        "wire_bytes_by_kind": dict(sorted(bytes_by_kind.items())),
+        "est_wire_bytes_per_step": int(sum(o.wire_bytes for o in ops)),
+        "n_collectives": len(ops),
+    }

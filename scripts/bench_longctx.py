@@ -108,9 +108,46 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--cpu-mesh", action="store_true",
                         help="ring @ 16k on an 8-device virtual CPU mesh")
+    parser.add_argument("--ab-mesh", action="store_true",
+                        help="ring vs ulysses on the SAME dp2xcp4 mesh "
+                             "(the VERDICT r5 #4 attribution A/B: equal "
+                             "mesh, data, steps — wall-time deltas are "
+                             "schedule-only)")
+    parser.add_argument("--seq", type=int, default=None,
+                        help="--ab-mesh sequence length (default 2048)")
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--model", default=None)
     args = parser.parse_args()
+
+    if args.ab_mesh:
+        from polyaxon_tpu.utils import cpu_mesh_xla_flags
+
+        cpu_mesh_xla_flags(8)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        seq = args.seq or 2048
+        entries = []
+        for attention in ("ring", "ulysses"):
+            entries.append(run_point(
+                f"{attention}-cpu8-dp2cp4-seq{seq}",
+                model=args.model or "llama_tiny", seq=seq, batch=4,
+                steps=args.steps or 4, mesh_axes={"dp": 2, "cp": 4},
+                attention=attention, remat="none"))
+        losses = [e["loss"] for e in entries]
+        agree = (all(l == l for l in losses)
+                 and abs(losses[0] - losses[1]) < 5e-3)
+        ring_e, uly_e = entries
+        print(json.dumps({
+            "summary": f"ring vs ulysses @{seq} on the SAME dp2xcp4 mesh",
+            "losses": {"ring": losses[0], "ulysses": losses[1]},
+            "ring_over_ulysses_throughput": round(
+                ring_e["tokens_per_sec_per_chip"]
+                / max(uly_e["tokens_per_sec_per_chip"], 1e-9), 2),
+            "ok": bool(agree),
+        }))
+        return 0 if agree else 1
 
     if args.cpu_mesh:
         from polyaxon_tpu.utils import cpu_mesh_xla_flags

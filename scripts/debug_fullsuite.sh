@@ -1,6 +1,9 @@
 #!/bin/sh
-# Single-process full-suite re-test for the intermittent abort that
-# ci.sh --full quarantines with per-module processes.
+# Single-process full-suite DIAGNOSTIC harness (faulthandler + RSS
+# sampling) for the intermittent abort history. Since the 2026-08-04
+# promotion, plain `ci.sh --full` already runs one process; the
+# per-module quarantine lives on as `ci.sh --full-modules`. Run THIS
+# when a crash needs attribution, not just a green/red.
 #
 # Root cause (identified 2026-08-01, see tests/conftest.py NOTE 2):
 # XLA:CPU's collective-rendezvous watchdog CHECK-aborts the whole

@@ -204,6 +204,31 @@ def moe_dispatch_sweep(platform: str, steps: int) -> int:
     return 0
 
 
+def run_audit_artifacts() -> None:
+    """The communication-audit companion artifacts for a sweep round
+    (ISSUE 4): the CPU-mesh collective census per schedule and the AOT
+    topology-only TPU evidence. Each runs as its own subprocess with a
+    bounded budget — a hung audit costs its timeout, not the sweep."""
+    for name, cmd, budget_s in (
+        ("collective audit (CPU mesh)",
+         [sys.executable, "-m", "polyaxon_tpu.perf",
+          "--json", os.path.join(REPO, "collective_audit.json")], 900),
+        ("AOT topology audit (TPU, no device)",
+         [sys.executable, "-m", "polyaxon_tpu.perf", "--aot-probe",
+          "--aot-train-step", "ulysses-cp,ring-cp"], 1500),
+    ):
+        print(f"→ {name} ...", flush=True)
+        try:
+            proc = subprocess.run(cmd, cwd=REPO, timeout=budget_s,
+                                  capture_output=True, text=True)
+            tail = (proc.stdout or proc.stderr).strip().splitlines()
+            print("  " + (tail[-1][:200] if tail else f"rc={proc.returncode}"),
+                  flush=True)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            print(f"  audit step failed: {type(exc).__name__} "
+                  f"(sweep continues)", flush=True)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=30)
@@ -229,7 +254,19 @@ def main() -> int:
                         help="rerun only the points that errored in the "
                              "existing perf_sweep_results.json (tunnel "
                              "flakes), keeping prior successes")
+    parser.add_argument("--audit", action="store_true",
+                        help="also emit the per-point HLO/collective "
+                             "report artifacts: the CPU-mesh schedule "
+                             "census (collective_audit.json) and the AOT "
+                             "topology-only TPU evidence incl. train-step "
+                             "collective reports + flash VMEM fits "
+                             "(aot_probe_results.json) — both run in "
+                             "isolated subprocesses and never block the "
+                             "sweep points")
     args = parser.parse_args()
+
+    if args.audit:
+        run_audit_artifacts()
 
     if args.moe:
         return moe_dispatch_sweep(args.moe_platform,

@@ -36,12 +36,15 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # pytest process). Reproduced deterministically on cache hits of the
 # dp2xfsdp4 checkpoint tests, 2026-07-30.
 #
-# NOTE 2: run the FULL suite via `scripts/ci.sh --full` (one pytest
-# process per module), not one `pytest tests/` process. Hour-long
-# single-process runs intermittently died with what looked like a
-# segfault "inside backend_compile_and_load" (observed 2026-07-31
-# twice, with 120+ GB free — flaky, not test-correlated). Root cause
-# likely IDENTIFIED 2026-08-01: XLA:CPU's collective rendezvous
+# NOTE 2: `scripts/ci.sh --full` runs the suite as ONE pytest process
+# (promoted to the default 2026-08-04 after the watchdog fix below
+# validated green twice; VERDICT r5 #7). `--full-modules` keeps the
+# old one-process-per-module loop as the crash-isolation fallback and
+# `scripts/debug_fullsuite.sh` stays the diagnostic harness. History:
+# hour-long single-process runs intermittently died with what looked
+# like a segfault "inside backend_compile_and_load" (observed
+# 2026-07-31 twice, with 120+ GB free — flaky, not test-correlated).
+# Root cause IDENTIFIED 2026-08-01: XLA:CPU's collective rendezvous
 # watchdog CHECK-aborts the process when any device thread misses a
 # rendezvous for 40 s (`InProcessCommunicator::AllReduce` →
 # `AwaitAndLogIfStuck` → "Termination timeout ... exceeded. Exiting to
@@ -109,6 +112,11 @@ SMOKE_NODES = (
     "test_tune.py::TestHyperband::test_rung_shapes_paper_table",
     "test_convert_decode.py::TestDecode::test_decode_step_logits_match_forward",
     "test_acceptance.py::TestEstimate",
+    # Communication audit: parser + budget-gate logic (pure python, no
+    # compiles — the compiling golden tests are slow-tier and run in
+    # the ci.sh audit stage / --full).
+    "test_perf_audit.py::TestHloParse",
+    "test_perf_audit.py::TestBudgetGate",
 )
 
 

@@ -347,11 +347,13 @@ class TestRing:
         ref = xla_attention(q, k, v, causal=True)
         ring._warned_einsum_fallback = False
         spec = P(None, "cp", None, None)
-        fn = jax.shard_map(
+        from polyaxon_tpu.parallel import compat
+
+        fn = compat.shard_map(
             functools.partial(ring._ring_attention_sharded, causal=True,
                               scale=q.shape[-1] ** -0.5, axis_name="cp"),
             mesh=cp_mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            axis_names={"cp"}, check_vma=False)
+            check_vma=False)
         with pytest.warns(RuntimeWarning, match="masked-einsum ring"):
             out = jax.jit(fn)(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
@@ -375,11 +377,13 @@ class TestRing:
         q, k, v = _qkv(b=1, s=4096, h=4, kv=2)
         spec = jax.sharding.PartitionSpec(None, "cp", None, None)
 
+        from polyaxon_tpu.parallel import compat
+
         def build(fn):
-            f = jax.shard_map(
+            f = compat.shard_map(
                 functools.partial(fn, scale=64 ** -0.5, axis_name="cp"),
                 mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
-                axis_names={"cp"}, check_vma=False)
+                check_vma=False)
             return jax.jit(f)
 
         f2 = build(ring._ring_causal_zigzag)
