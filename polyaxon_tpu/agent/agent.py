@@ -139,6 +139,26 @@ class Agent:
                     hub_ref, record.uuid, exc)
         return spawned
 
+    def _record_placement_span(self, record, t0: float, *,
+                               state: str, topology=None) -> None:
+        """``placement`` span on the run's lifecycle timeline — written
+        only when the decision lands (cleared or unplaceable), so each
+        start attempt gets exactly one placement span, not one per
+        pending tick."""
+        from polyaxon_tpu.obs import trace as obs_trace
+
+        try:
+            obs_trace.record_completed(
+                self.plane.run_artifacts_dir(record.uuid), record.uuid,
+                "placement", start=t0, end=time.time(), component="agent",
+                status="error" if state == "unplaceable" else "ok",
+                attributes={"state": state,
+                            **({"topology": topology} if topology else {}),
+                            "provider": ("slice_pool" if self.slices
+                                         is not None else "local")})
+        except OSError:
+            pass  # tracing must never block a start
+
     def _cleared_to_start(self, record, info=None) -> bool:
         """Topology-gated placement through the native slice pool.
 
@@ -146,7 +166,9 @@ class Agent:
         class (scheduling catalog), so a high-priority request can
         evict lower-priority gangs from preemptible slices natively.
         """
+        t0 = time.time()
         if self.slices is None:
+            self._record_placement_span(record, t0, state="running")
             return True
         plan = record.launch_plan or {}
         resources = plan.get("resources") or {}
@@ -164,11 +186,16 @@ class Agent:
             preemptible=bool(resources.get("preemptible")),
         )
         if state == "unplaceable":
+            self._record_placement_span(
+                record, t0, state=state, topology=resources.get("topology"))
             self.plane.store.transition(
                 record.uuid, V1Statuses.FAILED, reason="Unschedulable",
                 message=f"topology {resources.get('topology')!r} fits no slice",
             )
             return False
+        if state == "running":
+            self._record_placement_span(
+                record, t0, state=state, topology=resources.get("topology"))
         return state == "running"
 
     def reconcile_once(self) -> int:
@@ -193,9 +220,11 @@ class Agent:
             if r.kind not in _PIPELINE_KINDS
         ]
         capacity = max(self.max_concurrent - len(self.executor.active_runs), 0)
+        t_admission = time.time()
         decision = self.admission.plan(
             queued, capacity=capacity,
             active=set(self.executor.active_runs))
+        t_admission_end = time.time()
         for victim in decision.victims:
             # Control-plane-driven priority preemption: kill the gang
             # (reaps PREEMPTED next poll → backoff requeue) and vacate
@@ -214,6 +243,23 @@ class Agent:
             # behind it could use (head-of-line fix).
             if not self._cleared_to_start(record, info):
                 continue
+            # The pass that cleared this run becomes its ``admission``
+            # span: queue/class/priority attributes explain WHY it won
+            # the slot (obs.trace).
+            from polyaxon_tpu.obs import trace as obs_trace
+
+            try:
+                obs_trace.record_completed(
+                    self.plane.run_artifacts_dir(record.uuid), record.uuid,
+                    "admission", start=t_admission, end=t_admission_end,
+                    component="agent",
+                    attributes={"queue": info.queue,
+                                "priority_class": info.priority_class,
+                                "priority": info.priority,
+                                "capacity": capacity,
+                                "queued": len(queued)})
+            except OSError:
+                pass
             self.executor.start(record.uuid)
             started += 1
             actions += 1

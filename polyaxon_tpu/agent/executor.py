@@ -32,6 +32,7 @@ from polyaxon_tpu.compiler import COORDINATOR_PLACEHOLDER, ENV_JAXJOB_SPEC
 from polyaxon_tpu.compiler.plan import V1LaunchPlan
 from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import trace as obs_trace
 
 
 class InitTimeoutError(RuntimeError):
@@ -70,6 +71,11 @@ class _Gang:
     stop_event: threading.Event = field(default_factory=threading.Event)
     reaping: bool = False  # a member died; survivors were signalled
     warning: Optional[str] = None  # non-fatal anomaly → WARNING condition
+    # Lifecycle tracing (obs.trace): the `execute` span covers the gang
+    # from start() to its reap; subprocess children parent under it via
+    # POLYAXON_TRACE_PARENT, the in-process runtime via a passed tracer.
+    tracer: Optional[obs_trace.RunTracer] = None
+    span: Optional[obs_trace.Span] = None
 
 
 class LocalExecutor:
@@ -262,8 +268,19 @@ class LocalExecutor:
         self.store.transition(run_uuid, V1Statuses.STARTING)
 
         gang = _Gang(run_uuid=run_uuid, plan=plan)
+        gang.tracer = obs_trace.RunTracer(
+            plan.artifacts_dir, run_uuid, component="agent")
+        gang.span = gang.tracer.start_span(
+            "execute", attributes={"kind": plan.run_kind,
+                                   "processes": plan.num_processes,
+                                   "in_process": self.in_process})
         try:
-            self._run_init_phases(plan)
+            # Init runs inside a child span AS the current span, so the
+            # deep seams it crosses (chaos store faults, with_retries
+            # attempts, init stalls) annotate it (obs.trace.add_event).
+            with gang.tracer.span("init", parent=gang.span) as init_span:
+                init_span.set(phases=[p.kind for p in plan.init])
+                self._run_init_phases(plan)
             if self.in_process and self._can_run_in_process(plan):
                 gang.thread = threading.Thread(
                     target=self._run_in_process, args=(gang,), daemon=True
@@ -273,6 +290,12 @@ class LocalExecutor:
                 for proc_spec in plan.processes:
                     env = dict(os.environ)
                     env.update(proc_spec.env)
+                    # Trace propagation rides the same env plumbing as
+                    # the graft/tracking contract: the child's runtime
+                    # spans parent under this gang's `execute` span.
+                    env[obs_trace.ENV_TRACE_PARENT] = (
+                        obs_trace.format_trace_parent(run_uuid,
+                                                      gang.span.span_id))
                     for key, value in list(env.items()):
                         if isinstance(value, str) and COORDINATOR_PLACEHOLDER in value:
                             env[key] = value.replace(COORDINATOR_PLACEHOLDER, "127.0.0.1")
@@ -311,12 +334,28 @@ class LocalExecutor:
                     handle.close()
             reason = ("InitTimeout" if isinstance(exc, InitTimeoutError)
                       else "StartError")
+            self._finish_gang_span(gang, status="error",
+                                   error=f"{reason}: {exc}")
             self.store.transition(run_uuid, V1Statuses.FAILED,
                                   reason=reason, message=str(exc)[:500])
             return False
         self._gangs[run_uuid] = gang
         self.store.transition(run_uuid, V1Statuses.RUNNING)
         return True
+
+    def _finish_gang_span(self, gang: _Gang, *, status: str = "ok",
+                          error: Optional[str] = None, **attrs) -> None:
+        """Close the gang's `execute` span + its writer handle (the
+        EventWriter-close contract: a reaped gang pins no fds)."""
+        if gang.tracer is None:
+            return
+        try:
+            if gang.span is not None:
+                gang.span.set(**attrs)
+                gang.tracer.finish(gang.span, status=status, error=error)
+        finally:
+            gang.tracer.close()
+            gang.tracer = gang.span = None
 
     def _can_run_in_process(self, plan: V1LaunchPlan) -> bool:
         return (
@@ -334,6 +373,12 @@ class LocalExecutor:
         spec = json.loads(plan.processes[0].env[ENV_JAXJOB_SPEC])
         job = V1JAXJob.from_dict(spec)
         tracking = Run(plan.run_uuid, plan.artifacts_dir)
+        # The runtime thread gets its OWN tracer (thread-owned writer
+        # handle) parented under the gang's `execute` span — the same
+        # shape the subprocess path gets via POLYAXON_TRACE_PARENT.
+        tracer = obs_trace.RunTracer(
+            plan.artifacts_dir, plan.run_uuid, component="runtime",
+            parent_id=gang.span.span_id if gang.span is not None else None)
         ckpt_dir = os.path.join(plan.artifacts_dir, "checkpoints")
 
         def should_stop() -> bool:
@@ -350,7 +395,7 @@ class LocalExecutor:
             tracking.log_status(V1Statuses.RUNNING)
             result = run_jaxjob(job, artifacts_dir=plan.artifacts_dir,
                                 on_metrics=tracking.log_metrics_cb(),
-                                should_stop=should_stop)
+                                should_stop=should_stop, tracer=tracer)
             if result.restore_skipped_steps:
                 gang.warning = (
                     f"restored checkpoint step {result.restored_from_step} "
@@ -376,6 +421,7 @@ class LocalExecutor:
                 fh.write(traceback.format_exc())
             tracking.log_failed(reason=type(exc).__name__, message=str(exc)[:2000])
         finally:
+            tracer.close()
             tracking.close()
             gang.thread_done = True
 
@@ -409,8 +455,11 @@ class LocalExecutor:
             del self._gangs[run_uuid]
             record = self.store.get_run(run_uuid)
             if record.status == V1Statuses.STOPPING:
+                self._finish_gang_span(gang, final="stopped")
                 self.store.transition(run_uuid, V1Statuses.STOPPED)
             elif gang.preempted:
+                self._finish_gang_span(gang, status="error",
+                                       error="preempted", final="preempted")
                 self.store.transition(run_uuid, V1Statuses.PREEMPTED,
                                       reason="SlicePreempted", force=True)
             else:
@@ -423,6 +472,11 @@ class LocalExecutor:
                         reason="CheckpointFallback",
                         message=gang.warning[:500], force=True)
                 target = V1Statuses.SUCCEEDED if status == 0 else V1Statuses.FAILED
+                self._finish_gang_span(
+                    gang, status="ok" if status == 0 else "error",
+                    error=(None if status == 0 else
+                           gang.thread_error or f"exit code {status}"),
+                    final=target.value, exit_code=status)
                 self.store.transition(
                     run_uuid, target,
                     reason="Completed" if status == 0 else "ProcessFailed",
@@ -474,6 +528,8 @@ class LocalExecutor:
         gang = self._gangs.get(run_uuid)
         if gang is None:
             return
+        if gang.span is not None:
+            gang.span.add_event("stop_requested")
         gang.stop_event.set()  # in-process runtime loop checks this per step
         for proc in gang.procs:
             try:
@@ -487,6 +543,8 @@ class LocalExecutor:
         gang = self._gangs.get(run_uuid)
         if gang is None:
             return False
+        if gang.span is not None:
+            gang.span.add_event("preempt")
         gang.preempted = True
         for proc in gang.procs:
             try:

@@ -373,43 +373,49 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _prometheus(self) -> None:
-        """Prometheus text exposition of control-plane state (the
-        reference's haupt exposes server metrics the same way —
-        SURVEY.md §5.5)."""
+        """Prometheus text exposition backed by the unified registry
+        (obs.metrics — ISSUE 5; the reference's haupt exposes server
+        metrics the same way, SURVEY.md §5.5).
+
+        Scrape-time gauges rebuilt from store state here: per-lifecycle-
+        phase run counts (every V1Statuses phase, zeros included) and
+        queue depth/occupancy. Everything else — scheduler tick
+        histograms, admission outcomes, retry/requeue counters, store
+        op latency, training step time — accumulates in the registry as
+        the co-located agent/runtime records it, and renders with the
+        same scrape."""
         import time
 
         from polyaxon_tpu.lifecycle import V1Statuses
+        from polyaxon_tpu.obs import metrics as obs_metrics
 
+        registry = obs_metrics.REGISTRY
+        registry.gauge("polyaxon_tpu_info", "Build info",
+                       ("version",)).set(1, version=__version__)
+        runs = registry.gauge(
+            "polyaxon_runs", "Runs per lifecycle phase", ("status",))
         counts: dict[str, int] = {s.value: 0 for s in V1Statuses}
         for record in self.plane.list_runs():
             counts[record.status.value] = counts.get(record.status.value, 0) + 1
+        for status, n in counts.items():
+            runs.set(n, status=status)
+        depth = registry.gauge(
+            "polyaxon_queue_depth", "Queued runs per queue", ("queue",))
+        running = registry.gauge(
+            "polyaxon_queue_running", "Live runs per queue", ("queue",))
+        depth.clear()
+        running.clear()
+        for q in self.plane.scheduling_stats()["queues"]:
+            depth.set(q["depth"], queue=q["name"])
+            running.set(q["running"], queue=q["name"])
         started = getattr(self.server, "started_at", None)
-        lines = [
-            "# TYPE polyaxon_tpu_info gauge",
-            f'polyaxon_tpu_info{{version="{__version__}"}} 1',
-            "# TYPE polyaxon_runs gauge",
-        ]
-        lines += [
-            f'polyaxon_runs{{status="{status}"}} {n}'
-            for status, n in sorted(counts.items())
-        ]
-        stats = self.plane.scheduling_stats()
-        lines.append("# TYPE polyaxon_queue_depth gauge")
-        lines += [
-            f'polyaxon_queue_depth{{queue="{q["name"]}"}} {q["depth"]}'
-            for q in stats["queues"]
-        ]
-        lines.append("# TYPE polyaxon_queue_running gauge")
-        lines += [
-            f'polyaxon_queue_running{{queue="{q["name"]}"}} {q["running"]}'
-            for q in stats["queues"]
-        ]
         if started is not None:
-            lines += [
-                "# TYPE polyaxon_uptime_seconds gauge",
-                f"polyaxon_uptime_seconds {time.time() - started:.1f}",
-            ]
-        body = ("\n".join(lines) + "\n").encode()
+            registry.gauge("polyaxon_uptime_seconds",
+                           "API server uptime").set(time.time() - started)
+        # Stable scrape schema: the documented families (incl. the
+        # histograms) exist even before their first sample.
+        obs_metrics.ensure_core_metrics(registry)
+        body = registry.render().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
         self.send_header("Content-Length", str(len(body)))
@@ -484,6 +490,12 @@ class _Handler(BaseHTTPRequestHandler):
             raise ApiError(404, f"unknown action {action}")
         if action == "statuses":
             return self._json(plane.get_statuses(uuid))
+        if action == "timeline":
+            # Ordered lifecycle span tree (obs.trace): compile →
+            # admission → placement → execute → runtime → sync, with
+            # chaos/retry annotations. Backs the dashboard waterfall
+            # and `plx ops timeline`.
+            return self._json(plane.timeline(uuid))
         if action == "metrics":
             names = query.get("names")
             return self._json(plane.streams.get_metrics(uuid, names))

@@ -123,6 +123,24 @@ DASHBOARD_HTML = r"""<!doctype html>
   .dag .dagnode text { fill: var(--ink); font-size: 12px; }
   .dag .dagnode .st { fill: var(--ink-2); font-size: 10px; }
   .dag .edge { fill: none; stroke: var(--axis); stroke-width: 1.3; }
+  .tl { background: var(--surface); border: 1px solid var(--ring);
+        border-radius: 8px; padding: 10px 14px; margin-top: 12px; }
+  .tl h3 { margin: 0 0 6px; font-size: 13px; font-weight: 600; }
+  .tl-row { display: flex; align-items: center; gap: 8px; padding: 2px 0;
+            font-size: 12px; }
+  .tl-name { flex: 0 0 180px; overflow: hidden; text-overflow: ellipsis;
+             white-space: nowrap; color: var(--ink-2); }
+  .tl-track { position: relative; flex: 1; height: 12px;
+              background: color-mix(in srgb, var(--ink) 4%, transparent);
+              border-radius: 3px; }
+  .tl-bar { position: absolute; top: 1px; height: 10px; min-width: 2px;
+            border-radius: 3px; background: var(--series-1); }
+  .tl-bar.err { background: var(--bad, #c0392b); }
+  .tl-ev { position: absolute; top: 2px; width: 5px; height: 8px;
+           border-radius: 50%; background: var(--ink-2); }
+  .tl-ev.chaos { background: var(--bad, #c0392b); }
+  .tl-dur { flex: 0 0 76px; text-align: right; color: var(--ink-2);
+            font-variant-numeric: tabular-nums; }
 </style>
 </head>
 <body>
@@ -610,6 +628,52 @@ function artifactsPanel(uuid, lineage, files) {
   </details>`;
 }
 
+function fmtMs(ms) {
+  if (ms == null) return "";
+  return ms >= 1000 ? (ms / 1000).toFixed(2) + "s" : ms.toFixed(1) + "ms";
+}
+
+// Run-lifecycle waterfall over /runs/{uuid}/timeline (obs.trace):
+// one bar per span (indented by tree depth, error spans red), chaos
+// and retry annotations as dot markers ON the phase they hit.
+function timelinePanel(tl) {
+  if (!tl || !Array.isArray(tl.spans) || !tl.spans.length) return "";
+  const t0 = tl.t0, total = Math.max(tl.duration_ms || 0, 1);
+  const pct = (epoch) =>
+    Math.max(0, Math.min(((epoch - t0) * 1000) / total * 100, 99.6));
+  const rows = [];
+  const walk = (s, depth) => {
+    const width = Math.max((s.duration_ms || 0) / total * 100, 0.4);
+    const events = (s.events || []).map((ev) => {
+      const label = ev.name +
+        (ev.attributes ? " " + JSON.stringify(ev.attributes) : "");
+      return `<span class="tl-ev${/^chaos\\./.test(ev.name) ? " chaos" : ""}"` +
+        ` style="left:${pct(ev.time).toFixed(2)}%" title="${esc(label)}"></span>`;
+    }).join("");
+    const title = s.name + (s.error ? " — " + s.error : "");
+    rows.push(`<div class="tl-row">` +
+      `<span class="tl-name" style="padding-left:${depth * 12}px"` +
+      ` title="${esc(title)}">${esc(s.name)}</span>` +
+      `<span class="tl-track"><span class="tl-bar${
+        s.status === "error" ? " err" : ""}"` +
+      ` style="left:${pct(s.start).toFixed(2)}%;width:${width.toFixed(2)}%">` +
+      `</span>${events}</span>` +
+      `<span class="tl-dur">${fmtMs(s.duration_ms)}</span></div>`);
+    (s.children || []).forEach((c) => walk(c, depth + 1));
+  };
+  tl.spans.forEach((s) => walk(s, 0));
+  const loose = (tl.events || []).map((ev) =>
+    `<div class="tl-row"><span class="tl-name" title="${esc(ev.name)}">` +
+    `* ${esc(ev.name)}</span><span class="tl-track">` +
+    `<span class="tl-ev" style="left:${pct(ev.time).toFixed(2)}%"></span>` +
+    `</span><span class="tl-dur">+${fmtMs((ev.time - t0) * 1000)}</span>` +
+    `</div>`).join("");
+  return `<div class="tl" aria-label="run lifecycle timeline">` +
+    `<h3>timeline <span style="font-weight:400;color:var(--muted)">` +
+    `${fmtMs(tl.duration_ms)} · ${tl.span_count} spans</span></h3>` +
+    rows.join("") + loose + `</div>`;
+}
+
 function lineageGraphPanel(uuid, graph) {
   // Cross-run lineage: inputs → run → outputs as a three-column SVG
   // (upstream runs | this run + its artifact records | downstream
@@ -970,11 +1034,13 @@ async function showRun(uuid, opts) {
   // Artifact listing stats the whole run tree server-side — skip it
   // for pipelines (their artifacts live in child runs) so the 5 s live
   // rerender loop doesn't re-walk the tree forever.
-  const [lineage, files, lingraph] = isPipeline ? [[], [], null]
+  const [lineage, files, lingraph, timeline] = isPipeline
+    ? [[], [], null, null]
     : await Promise.all([
     api(`${base()}/runs/${uuid}/lineage`).catch(() => []),
     api(`${base()}/runs/${uuid}/artifacts?detail=1`).catch(() => []),
     api(`${base()}/runs/${uuid}/lineage/graph`).catch(() => null),
+    api(`${base()}/runs/${uuid}/timeline`).catch(() => null),
   ]);
   const sweep = isSweep ? await sweepView(run)
     : isDag ? await dagView(run) : "";
@@ -992,6 +1058,7 @@ async function showRun(uuid, opts) {
     ${sweep}
     <div class="charts">${charts || (isPipeline ? "" : "<div class='sub' style='color:var(--muted)'>no metrics yet</div>")}</div>
     ${media ? `<div class="charts">${media}</div>` : ""}
+    ${timelinePanel(timeline)}
     ${artifactsPanel(uuid, Array.isArray(lineage) ? lineage : [],
                      Array.isArray(files) ? files : [])}
     ${lineageGraphPanel(uuid, lingraph)}

@@ -123,6 +123,17 @@ class ChaosPlan:
                          "event": fault.seen})
                     logger.warning("chaos: firing %s/%s (event %d) %s",
                                    seam, op, fault.seen, detail)
+                    # Annotate the lifecycle phase the fault hit
+                    # (obs.trace): a drill reads as events on the run's
+                    # timeline instead of log archaeology. add_event is
+                    # a no-op outside an active span and never raises.
+                    try:
+                        from polyaxon_tpu.obs import trace as _trace
+
+                        _trace.add_event(f"chaos.{seam}", op=op,
+                                         detail=detail, event=fault.seen)
+                    except ImportError:  # pragma: no cover
+                        pass
                     return fault
         return None
 

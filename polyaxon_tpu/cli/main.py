@@ -260,6 +260,57 @@ def ops_statuses(uid):
                    f"{cond.get('reason') or ''} {cond.get('message') or ''}")
 
 
+@ops.command("timeline")
+@click.option("-uid", "--uid", required=True)
+@click.option("--json", "as_json", is_flag=True,
+              help="raw span tree instead of the waterfall rendering")
+def ops_timeline(uid, as_json):
+    """Run-lifecycle waterfall (ISSUE 5): the ordered span tree —
+    compile → admission → placement → execute → runtime steps →
+    checkpoint → sidecar sync — with chaos faults and retries as
+    annotated events, so a slow or chaos-drilled run explains itself."""
+    plane = get_plane()
+    get_run_or_fail(plane, uid)
+    timeline = plane.timeline(uid)
+    if as_json:
+        click.echo(json.dumps(timeline, indent=2, default=str))
+        return
+    if not timeline["spans"]:
+        click.echo("(no lifecycle spans recorded for this run yet)")
+        return
+    t0 = timeline["t0"]
+    click.echo(f"trace {timeline['trace_id']}  "
+               f"spans={timeline['span_count']}  "
+               f"wall={timeline['duration_ms']/1e3:.2f}s")
+
+    def fmt_attrs(attrs):
+        keep = {k: v for k, v in (attrs or {}).items() if v is not None}
+        return (" " + " ".join(f"{k}={v}" for k, v in keep.items())
+                if keep else "")
+
+    def walk(node, depth):
+        offset_ms = (node["start"] - t0) * 1e3
+        marker = "!" if node.get("status") == "error" else " "
+        click.echo(
+            f"{marker} {'  ' * depth}{node['name']:<14} "
+            f"+{offset_ms:9.1f}ms {node['duration_ms']:10.1f}ms"
+            f"{fmt_attrs(node.get('attributes'))}"
+            + (f"  [{node['error']}]" if node.get("error") else ""))
+        for event in node.get("events") or []:
+            ev_off = ((event.get("time") or node["start"]) - t0) * 1e3
+            click.echo(f"  {'  ' * depth}* {event['name']} "
+                       f"+{ev_off:.1f}ms{fmt_attrs(event.get('attributes'))}")
+        for child in node.get("children") or []:
+            walk(child, depth + 1)
+
+    for root in timeline["spans"]:
+        walk(root, 0)
+    for event in timeline.get("events") or []:
+        ev_off = ((event.get("time") or t0) - t0) * 1e3
+        click.echo(f"* {event['name']} +{ev_off:.1f}ms"
+                   f"{fmt_attrs(event.get('attributes'))}")
+
+
 @ops.command("logs")
 @click.option("-uid", "--uid", required=True)
 @click.option("--follow", is_flag=True)

@@ -115,7 +115,21 @@ class Scheduler:
 
     # ------------------------------------------------------------------ tick
     def tick(self) -> int:
-        """One idempotent scheduling pass; returns number of actions."""
+        """One idempotent scheduling pass; returns number of actions.
+        Wall time lands in the ``polyaxon_scheduler_tick_seconds``
+        histogram — tick latency is the control plane's heartbeat."""
+        import time as _time
+
+        from polyaxon_tpu.obs import metrics as obs_metrics
+
+        t0 = _time.perf_counter()
+        try:
+            return self._tick()
+        finally:
+            obs_metrics.scheduler_tick_hist().observe(
+                _time.perf_counter() - t0)
+
+    def _tick(self) -> int:
         plan = chaos.active_plan()
         if plan is not None and plan.fire("tick", "skip") is not None:
             # Injected control-plane stall: this tick does nothing; all
@@ -238,6 +252,23 @@ class Scheduler:
             record.uuid, V1Statuses.RETRYING, reason=reason,
             message=f"requeue attempt {attempt + 1} in {delay:.2f}s",
             force=force)
+        # The requeue is a timeline annotation (obs.trace) + a counter:
+        # a chaos drill's kill→retry reads off the run's waterfall, and
+        # requeue volume per reason is a scrapeable signal.
+        from polyaxon_tpu.obs import metrics as obs_metrics
+        from polyaxon_tpu.obs import trace as obs_trace
+
+        obs_metrics.requeues_total().inc(reason=reason)
+        try:
+            obs_trace.record_event(
+                self.plane.run_artifacts_dir(record.uuid), record.uuid,
+                "requeue", component="controlplane",
+                attributes={"reason": reason, "counter": counter,
+                            "attempt": attempt + 1,
+                            "delay_s": round(delay, 4)})
+        except OSError:
+            logger.warning("could not record requeue span event for %s",
+                           record.uuid, exc_info=True)
         return delay
 
     def _tick_retrying(self, record: RunRecord) -> int:

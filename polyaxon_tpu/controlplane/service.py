@@ -130,7 +130,35 @@ class ControlPlane:
             f"hub component `{ref}` not found under {hub_dir}")
 
     def compile_run(self, run_uuid: str) -> RunRecord:
-        """created → compiled → queued (SURVEY §3.1 lifecycle tail)."""
+        """created → compiled → queued (SURVEY §3.1 lifecycle tail).
+
+        The whole resolution+compilation is one ``compile`` span on the
+        run's lifecycle timeline (obs.trace): trace_id = run uuid, and
+        a failed compile records an error span before the scheduler
+        pins the FAILED condition.
+        """
+        import time as _time
+
+        from polyaxon_tpu.obs import trace as obs_trace
+
+        t0 = _time.time()
+        try:
+            record = self._compile_run(run_uuid)
+        except Exception as exc:
+            obs_trace.record_completed(
+                self.run_artifacts_dir(run_uuid), run_uuid, "compile",
+                start=t0, end=_time.time(), component="controlplane",
+                status="error", error=f"{type(exc).__name__}: {exc}")
+            raise
+        obs_trace.record_completed(
+            self.run_artifacts_dir(run_uuid), run_uuid, "compile",
+            start=t0, end=_time.time(), component="controlplane",
+            attributes={"kind": record.kind, "status": record.status.value,
+                        "queue": ((record.meta or {}).get("scheduling")
+                                  or {}).get("queue")})
+        return record
+
+    def _compile_run(self, run_uuid: str) -> RunRecord:
         record = self.store.get_run(run_uuid)
         op = get_operation(record.spec)
         if op.component is None and op.hub_ref:
@@ -408,6 +436,18 @@ class ControlPlane:
 
     def run_artifacts_dir(self, run_uuid: str) -> str:
         return os.path.join(self.artifacts_root, run_uuid)
+
+    def timeline(self, run_uuid: str) -> dict:
+        """The run's ordered lifecycle span tree (obs.trace):
+        compile → admission → placement → execute(init) →
+        runtime(jit_compile/step/checkpoint/...) → sync, with chaos and
+        retry annotations attached to the phase they hit. Backs
+        ``GET .../runs/<uuid>/timeline`` and ``plx ops timeline``."""
+        from polyaxon_tpu.obs.trace import build_timeline, read_trace
+
+        self.store.get_run(run_uuid)  # 404s unknown uuids at the API edge
+        return build_timeline(read_trace(self.run_artifacts_dir(run_uuid)),
+                              trace_id=run_uuid)
 
     # -- cross-run lineage -------------------------------------------------
     def _upstream_edges(

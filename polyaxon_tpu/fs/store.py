@@ -16,9 +16,11 @@ fsspec-backed one:
 
 from __future__ import annotations
 
+import functools
 import os
 import shutil
 import threading
+import time
 from typing import Callable, Iterator, Optional
 from urllib.parse import urlparse
 
@@ -360,6 +362,47 @@ class FsspecStore(Store):
         except FileNotFoundError as exc:
             raise StoreError(f"no such key {key!r}") from exc
         return local_path
+
+
+# ------------------------------------------------------------ op latency
+# Every concrete store op lands in the unified registry's
+# `polyaxon_store_op_seconds{op,scheme}` histogram (ISSUE 5). The
+# timing wraps the CLASS methods (not a store wrapper object) so
+# `isinstance(get_store(...), LocalStore)` contracts — and the chaos
+# wrapper's delegation — stay intact; derived ops (sync_dir,
+# download_dir) flow through the timed primitives they call.
+_TIMED_OPS = ("read_bytes", "write_bytes", "exists", "delete", "list",
+              "upload_file", "download_file")
+
+
+def _observe_store_op(op: str, scheme: str, seconds: float) -> None:
+    try:
+        from polyaxon_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.store_op_hist().observe(seconds, op=op, scheme=scheme)
+    except Exception:  # noqa: BLE001 — observability stays passive
+        pass
+
+
+def _timed_store_op(op: str, fn):
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            _observe_store_op(op, str(getattr(self, "scheme", "?")),
+                              time.perf_counter() - t0)
+
+    wrapper.__timed_op__ = op
+    return wrapper
+
+
+for _cls in (LocalStore, MemoryStore, FsspecStore):
+    for _op in _TIMED_OPS:
+        _fn = getattr(_cls, _op)
+        if getattr(_fn, "__timed_op__", None) != _op:
+            setattr(_cls, _op, _timed_store_op(_op, _fn))
 
 
 _REGISTRY: dict[str, Callable[[str], Store]] = {}

@@ -1,0 +1,39 @@
+"""Observability layer (ISSUE 5): end-to-end run-lifecycle tracing
+(``obs.trace``) + the unified Prometheus metrics registry
+(``obs.metrics``). See docs/observability.md for the span model and
+metric catalog."""
+
+from polyaxon_tpu.obs import metrics, trace
+from polyaxon_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from polyaxon_tpu.obs.trace import (
+    ENV_TRACE_PARENT,
+    RunTracer,
+    Span,
+    add_event,
+    build_timeline,
+    current_span,
+    read_trace,
+)
+
+__all__ = [
+    "metrics",
+    "trace",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "ENV_TRACE_PARENT",
+    "RunTracer",
+    "Span",
+    "add_event",
+    "build_timeline",
+    "current_span",
+    "read_trace",
+]

@@ -1,0 +1,343 @@
+"""Unified metrics registry with Prometheus text exposition (ISSUE 5).
+
+One process-global :data:`REGISTRY` replaces the hand-rolled gauge
+strings that used to live in ``api/server.py``: every layer registers
+typed instruments (counters, gauges, histograms) by name and the
+``/metrics`` routes (control-plane API server AND the serving server)
+render the whole registry in the Prometheus text format
+(``text/plain; version=0.0.4``). Instruments are get-or-create — the
+first caller wins the type/labels/buckets, a conflicting re-register
+raises — so instrumentation sites stay one-liners:
+
+    from polyaxon_tpu.obs import metrics
+    metrics.scheduler_tick_hist().observe(dt)
+    metrics.admission_outcomes().inc(outcome="admitted")
+
+Everything is stdlib + thread-safe (the API handler threads scrape
+while the agent/runtime threads record). The metric CATALOG — the
+accessor functions at the bottom — is the single source of truth for
+names, label sets, and bucket layouts (docs/observability.md mirrors
+it), and :func:`ensure_core_metrics` pre-registers the families so a
+fresh scrape exposes a stable schema before any sample lands.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Iterable, Optional
+
+# Latency buckets in seconds: sub-ms store hits through minute-scale
+# compiles. The +Inf bucket is implicit.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _fmt_value(value: float) -> str:
+    """Prometheus sample rendering: integral values print as integers
+    (scrape consumers — and this repo's own tests — parse counts with
+    int())."""
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if isinstance(value, bool):
+        return str(int(value))
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _escape_label(value: Any) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(labelnames: tuple[str, ...], labelvalues: tuple[str, ...],
+               extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"'
+             for k, v in zip(labelnames, labelvalues)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    """Base: one named family with a fixed label set."""
+
+    type = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, ...], Any] = {}
+        if not labelnames:
+            # Label-less instruments expose their single series from
+            # birth: a scrape sees the family with a zero sample, not a
+            # bare HELP/TYPE header.
+            self._series[()] = self._zero()
+
+    def _zero(self):
+        return 0.0
+
+    def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labels)}")
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def clear(self) -> None:
+        """Drop all label series (scrape-time gauges rebuilt from store
+        state call this so deleted queues/projects don't linger)."""
+        with self._lock:
+            self._series.clear()
+            if not self.labelnames:
+                self._series[()] = self._zero()
+
+    # -- exposition --------------------------------------------------------
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            for values, sample in sorted(self._series.items()):
+                lines.extend(self._render_series(values, sample))
+        return lines
+
+    def _render_series(self, values, sample) -> list[str]:
+        return [f"{self.name}{_label_str(self.labelnames, values)} "
+                f"{_fmt_value(sample)}"]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "type": self.type,
+                "series": {",".join(k) if k else "": self._snap_sample(v)
+                           for k, v in self._series.items()},
+            }
+
+    def _snap_sample(self, sample):
+        return sample
+
+
+class Counter(_Metric):
+    type = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    type = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class _HistSample:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    type = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...],
+                 buckets: Iterable[float] = LATENCY_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        super().__init__(name, help, labelnames)
+
+    def _zero(self):
+        return _HistSample(len(self.buckets) + 1)  # + the +Inf bucket
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        value = float(value)
+        with self._lock:
+            sample = self._series.get(key)
+            if sample is None:
+                sample = self._series[key] = self._zero()
+            idx = len(self.buckets)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    idx = i
+                    break
+            sample.counts[idx] += 1
+            sample.sum += value
+            sample.count += 1
+
+    def _render_series(self, values, sample: _HistSample) -> list[str]:
+        lines = []
+        cumulative = 0
+        bounds = [*(_fmt_value(b) for b in self.buckets), "+Inf"]
+        for bound, n in zip(bounds, sample.counts):
+            cumulative += n
+            labels = _label_str(self.labelnames, values,
+                                extra=f'le="{bound}"')
+            lines.append(f"{self.name}_bucket{labels} {cumulative}")
+        base = _label_str(self.labelnames, values)
+        lines.append(f"{self.name}_sum{base} {_fmt_value(sample.sum)}")
+        lines.append(f"{self.name}_count{base} {sample.count}")
+        return lines
+
+    def _snap_sample(self, sample: _HistSample) -> dict:
+        return {"count": sample.count, "sum": round(sample.sum, 6),
+                "buckets": dict(zip(
+                    [*(_fmt_value(b) for b in self.buckets), "+Inf"],
+                    sample.counts))}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: tuple[str, ...], **kwargs) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or (
+                        existing.labelnames != labelnames):
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{existing.type}{existing.labelnames}")
+                return existing
+            metric = cls(name, help, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, tuple(labelnames))
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, tuple(labelnames))
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: tuple[str, ...] = (),
+                  buckets: Iterable[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, tuple(labelnames),
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """The whole registry in Prometheus text-format 0.0.4."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for metric in metrics:
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able dump for perf sweeps / bench records."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        return {m.name: m.snapshot() for m in metrics}
+
+
+# The process-global default registry every subsystem records into.
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------- catalog
+# Accessor per family: ONE place owns each name/labels/buckets tuple, so
+# the instrumentation site and the scrape route can never disagree.
+
+def scheduler_tick_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_scheduler_tick_seconds",
+        "Control-plane scheduler tick duration")
+
+
+def admission_outcomes(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_admission_outcomes_total",
+        "Admission-pass verdicts per run "
+        "(admitted/QueueSaturated/QuotaExceeded/ChaosStarved/victim)",
+        ("outcome",))
+
+
+def requeues_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_requeues_total",
+        "Backoff-gated requeues by reason (restart policy, preemption)",
+        ("reason",))
+
+
+def retry_attempts(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_retry_attempts_total",
+        "Transient-failure retries through utils.retries.with_retries")
+
+
+def store_op_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_store_op_seconds",
+        "Artifact-store operation latency",
+        ("op", "scheme"))
+
+
+def training_step_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_training_step_seconds",
+        "Mean device step time per metrics-emission window")
+
+
+def serving_queue_depth(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_queue_depth",
+        "Continuous-batching pending-request queue depth")
+
+
+def serving_request_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_serving_request_seconds",
+        "Serving request latency, submit to retire")
+
+
+def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
+    """Pre-register the documented families (idempotent) so /metrics
+    exposes a stable schema — including at least one histogram — even
+    before the first sample lands."""
+    scheduler_tick_hist(registry)
+    admission_outcomes(registry)
+    requeues_total(registry)
+    retry_attempts(registry)
+    store_op_hist(registry)
+    training_step_hist(registry)

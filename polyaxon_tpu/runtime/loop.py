@@ -10,10 +10,14 @@ import logging
 import time
 from typing import Any, Callable, Optional
 
+import contextlib
+
 import jax
 import numpy as np
 
 from polyaxon_tpu.models import get_model
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import trace as obs_trace
 from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
 from polyaxon_tpu.parallel.sharding import param_bytes
 from polyaxon_tpu.polyflow.runs import V1JAXJob, V1JaxCheckpointing
@@ -81,6 +85,14 @@ def _dataset_kwargs(cfg: RuntimeConfig, model_cfg, per_host_batch: int) -> dict:
     return kwargs
 
 
+def _span(tracer: Optional["obs_trace.RunTracer"], name: str, **attrs):
+    """Span when tracing is on, nullcontext (yielding None) when off —
+    keeps every instrumentation site a one-line `with`."""
+    if tracer is None:
+        return contextlib.nullcontext()
+    return tracer.span(name, attributes=attrs or None)
+
+
 def run_jaxjob(
     job: V1JAXJob,
     *,
@@ -88,11 +100,27 @@ def run_jaxjob(
     on_metrics: Optional[MetricsCallback] = None,
     devices: Optional[list] = None,
     should_stop: Optional[Callable[[], bool]] = None,
+    tracer: Optional[obs_trace.RunTracer] = None,
 ) -> TrainResult:
-    """Execute a builtin-runtime JAXJob in-process."""
+    """Execute a builtin-runtime JAXJob in-process.
+
+    Lifecycle tracing: with an ``artifacts_dir`` the loop emits
+    runtime/jit_compile/restore/step/checkpoint/eval spans. An explicit
+    ``tracer`` (the in-process executor passes one parented under its
+    `execute` span) is used as-is and left open for its owner; without
+    one a tracer is built from the env contract (the subprocess path —
+    the executor stamps ``POLYAXON_TRACE_PARENT``) and closed by the
+    loop's ExitStack.
+    """
     if not job.runtime:
         raise ValueError("run_jaxjob requires a jaxjob with a `runtime` section")
     cfg = RuntimeConfig.model_validate(job.runtime)
+
+    close_tracer = False
+    if tracer is None and artifacts_dir:
+        tracer = obs_trace.RunTracer.from_env(artifacts_dir,
+                                              component="runtime")
+        close_tracer = True
 
     from polyaxon_tpu.runtime import compile_cache
 
@@ -100,7 +128,8 @@ def run_jaxjob(
             compile_cache.resolve_cache_dir(cfg.compile_cache_dir)):
         return _run_jaxjob(job, cfg, artifacts_dir=artifacts_dir,
                            on_metrics=on_metrics, devices=devices,
-                           should_stop=should_stop)
+                           should_stop=should_stop, tracer=tracer,
+                           close_tracer=close_tracer)
 
 
 def _run_jaxjob(
@@ -111,6 +140,8 @@ def _run_jaxjob(
     on_metrics: Optional[MetricsCallback],
     devices: Optional[list],
     should_stop: Optional[Callable[[], bool]],
+    tracer: Optional[obs_trace.RunTracer] = None,
+    close_tracer: bool = False,
 ) -> TrainResult:
     mesh = build_mesh(job.mesh, job.get_topology(), devices=devices)
     rules = rules_for_mesh(mesh)
@@ -147,12 +178,19 @@ def _run_jaxjob(
         logger.info("lora: rank=%d alpha=%s targets=%s", cfg.lora_rank,
                     cfg.lora_alpha, cfg.lora_targets or "default")
 
-    import contextlib
-
     # The prefetch producer registers its close() here: stop, drain,
     # join on EVERY exit — normal completion, should_stop, or a raise
-    # anywhere in the loop — so no thread outlives its run.
+    # anywhere in the loop — so no thread outlives its run. The tracer's
+    # EventWriter rides the same stack when this loop owns it.
     with mesh, contextlib.ExitStack() as cleanup:
+        run_span = None
+        if tracer is not None:
+            if close_tracer:
+                cleanup.callback(tracer.close)
+            run_span = cleanup.enter_context(tracer.span(
+                "runtime", attributes={"model": cfg.model,
+                                       "steps": cfg.steps,
+                                       "devices": mesh.devices.size}))
         init_fn = build_init(model_def, optimizer, mesh, rules)
         accum = max(int(cfg.grad_accum_steps or 1), 1)
         if accum > 1:
@@ -191,9 +229,13 @@ def _run_jaxjob(
         if artifacts_dir and ckpt_spec.enabled:
             ckpt = CheckpointManager(f"{artifacts_dir}/checkpoints", ckpt_spec)
             if ckpt_spec.restore_on_start and ckpt.latest_step() is not None:
-                state = ckpt.restore(state)
-                restored_from = int(state["step"])
-                restore_skipped = list(ckpt.last_restore_skipped)
+                with _span(tracer, "restore") as sp:
+                    state = ckpt.restore(state)
+                    restored_from = int(state["step"])
+                    restore_skipped = list(ckpt.last_restore_skipped)
+                    if sp is not None:
+                        sp.set(restored_from_step=restored_from,
+                               skipped_steps=restore_skipped)
 
         seq = ds_kwargs.get("seq_len", 1)
         units_per_step = global_batch * (seq if model_def.unit == "tokens" else 1)
@@ -269,10 +311,13 @@ def _run_jaxjob(
         # of one step rides along, noise next to XLA), emitted as
         # compile_time_s so cache-hit restarts are attributable.
         first_batch = next(batches)
-        t_compile = time.perf_counter()
-        state, metrics = train_step(state, first_batch, step_rng)
-        jax.block_until_ready(metrics["loss"])
-        compile_time_s = time.perf_counter() - t_compile
+        with _span(tracer, "jit_compile") as sp:
+            t_compile = time.perf_counter()
+            state, metrics = train_step(state, first_batch, step_rng)
+            jax.block_until_ready(metrics["loss"])
+            compile_time_s = time.perf_counter() - t_compile
+            if sp is not None:
+                sp.set(compile_time_s=round(compile_time_s, 3))
 
         # Per-step MFU self-reporting (SURVEY §5.1): every emission
         # carries tokens/sec + achieved TFLOPs/chip, and MFU when both
@@ -285,6 +330,7 @@ def _run_jaxjob(
                       if model_def.unit == "tokens" else None)
         peak = peak_flops(getattr(jax.devices()[0], "device_kind", ""))
         t_emit = time.perf_counter()
+        t_emit_wall = time.time()  # wall twin of t_emit for step spans
         steps_since_emit = 0
         emitted_compile = False
         wait_window = 0.0  # host seconds blocked on data, per emission
@@ -337,19 +383,41 @@ def _run_jaxjob(
                     # persistent compile cache.
                     vals["compile_time_s"] = compile_time_s
                     emitted_compile = True
+                # The emission window is one `step` span on the
+                # timeline (reusing the already-derived step_time_ms /
+                # input_wait_ms) and one histogram sample — per-window,
+                # not per-step, so tracing cost stays off the hot path.
+                if steps_since_emit and window > 0:
+                    obs_metrics.training_step_hist().observe(
+                        window / steps_since_emit)
+                if tracer is not None and steps_since_emit:
+                    tracer.record_completed(
+                        "step", start=t_emit_wall, end=time.time(),
+                        parent_id=(run_span.span_id if run_span is not None
+                                   else None),
+                        attributes={
+                            "from_step": step - steps_since_emit + 1,
+                            "to_step": step,
+                            "steps": steps_since_emit,
+                            **{k: round(vals[k], 3) for k in
+                               ("step_time_ms", "input_wait_ms")
+                               if k in vals},
+                        })
                 steps_since_emit = 0
                 wait_window = 0.0
                 on_metrics(step, vals)
                 # Stamp AFTER the callback: tracking I/O must not
                 # deflate the next window's reported throughput.
                 t_emit = time.perf_counter()
+                t_emit_wall = time.time()
             if eval_step is not None and step % cfg.eval_every == 0:
                 # Drain queued train dispatches BEFORE stamping the
                 # exclusion window, or their device time would be
                 # charged to eval and inflate reported throughput/MFU.
                 jax.block_until_ready(metrics["loss"])
                 t_eval = time.perf_counter()
-                last_eval = run_eval(state)
+                with _span(tracer, "eval", step=step):
+                    last_eval = run_eval(state)
                 evaled_at = int(state["step"])
                 if on_metrics:
                     on_metrics(step, last_eval)
@@ -357,15 +425,18 @@ def _run_jaxjob(
                 # both the per-emission window AND the run-level wall.
                 dt_eval = time.perf_counter() - t_eval
                 t_emit += dt_eval
+                t_emit_wall = time.time()
                 off_clock += dt_eval
             if ckpt and ckpt.should_save(step):
                 t_save = time.perf_counter()
-                ckpt.save(step, state)
+                with _span(tracer, "checkpoint", step=step):
+                    ckpt.save(step, state)
                 # Exclude (synchronous) checkpoint time too — an MFU
                 # dip every save interval would make real regressions
                 # indistinguishable from checkpoint cadence.
                 dt_save = time.perf_counter() - t_save
                 t_emit += dt_save
+                t_emit_wall = time.time()
                 off_clock += dt_save
         jax.block_until_ready(state["params"])
         # Run-level throughput matches the emitted stream: eval and
@@ -384,7 +455,8 @@ def _run_jaxjob(
         final_step = int(state["step"])
 
         if ckpt:
-            ckpt.save(final_step, state, force=True)
+            with _span(tracer, "checkpoint", step=final_step, final=True):
+                ckpt.save(final_step, state, force=True)
             ckpt.close()
 
     throughput = units_per_step * timed_steps / wall if wall > 0 and timed_steps else 0.0

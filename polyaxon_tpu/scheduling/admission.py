@@ -234,6 +234,19 @@ class AdmissionController:
             admitted[max(capacity, 0):], queues, live, live_info,
             active or set())
 
+        # Admission outcomes feed the unified registry: per-reason
+        # blocked counts, admissions (capped at real capacity — the
+        # overflow tail is ranked, not admitted), and evictions.
+        from polyaxon_tpu.obs import metrics as obs_metrics
+
+        outcomes = obs_metrics.admission_outcomes()
+        for _ in admitted[:max(capacity, 0)]:
+            outcomes.inc(outcome="admitted")
+        for reason in blocked.values():
+            outcomes.inc(outcome=reason)
+        for _ in victims:
+            outcomes.inc(outcome="victim")
+
         # Starvation counters only live for runs still queued.
         queued_uuids = {r.uuid for r in queued}
         for uuid in list(self._starved):

@@ -29,6 +29,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 import functools
 from functools import lru_cache
@@ -79,6 +80,9 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None
     cancelled: bool = False
+    # Stamped at submit; the retire path feeds submit→done wall time
+    # into the unified registry's serving-latency histogram (ISSUE 5).
+    submitted_at: float = field(default_factory=time.time)
 
     def wait(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -493,6 +497,9 @@ class ContinuousBatchingEngine:
                     f"{self.max_pending}); retry later",
                     retry_after=max(1, len(self._queue) // max(self.slots, 1)))
             self._queue.append(req)
+            from polyaxon_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.serving_queue_depth().set(len(self._queue))
             self._cv.notify()
         return req
 
@@ -609,6 +616,9 @@ class ContinuousBatchingEngine:
                                              self._queue[0].tokens)):
                     break
                 req = self._queue.popleft()
+                from polyaxon_tpu.obs import metrics as obs_metrics
+
+                obs_metrics.serving_queue_depth().set(len(self._queue))
             if self._pool is not None and not self._pool.admit(
                     b, len(req.tokens), req.tokens):
                 # can_admit raced/drifted: put the request back at the
@@ -906,6 +916,11 @@ class ContinuousBatchingEngine:
             if not req.error:  # count only successfully-served requests
                 self._served += 1
                 self._tokens_out += len(req.out)
+            from polyaxon_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.serving_request_hist().observe(
+                time.time() - req.submitted_at)
+            obs_metrics.serving_queue_depth().set(len(self._queue))
             req.done.set()
 
     def _loop(self) -> None:

@@ -465,6 +465,21 @@ class _Handler(BaseHTTPRequestHandler):
             if hasattr(self.engine, "health"):
                 return self._json(self.engine.health())
             return self._json({"status": "ok", "model": self.engine.model})
+        if self.path == "/metrics":
+            # Prometheus scrape backed by the unified registry
+            # (obs.metrics): serving queue depth + request latency
+            # histogram, plus whatever else this process recorded.
+            from polyaxon_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.serving_queue_depth()
+            obs_metrics.serving_request_hist()
+            body = obs_metrics.REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path == "/v1/models":
             return self._json({"models": [self.engine.model]})
         if self.path == "/v1/stats":

@@ -88,10 +88,20 @@ def sync_tree(src_root: str, dest_root: str) -> int:
 
 
 class SidecarSync:
-    def __init__(self, run_dir: str, store_dir: str, interval_seconds: float = 5.0):
+    def __init__(self, run_dir: str, store_dir: str, interval_seconds: float = 5.0,
+                 run_uuid: Optional[str] = None):
         self.run_dir = run_dir
         self.store_dir = store_dir
         self.interval = interval_seconds
+        # Lifecycle tracing: run dirs are <artifacts_root>/<uuid>, so
+        # the basename is the trace id when none is given; the remote
+        # parent (the agent's `execute` span) rides the env contract.
+        self.run_uuid = run_uuid or os.path.basename(
+            os.path.abspath(run_dir))
+        from polyaxon_tpu.obs import trace as obs_trace
+
+        _, self._trace_parent = obs_trace.parse_trace_parent(
+            os.environ.get(obs_trace.ENV_TRACE_PARENT))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # A URL destination ships through the store layer with the
@@ -109,6 +119,7 @@ class SidecarSync:
                 self.store_dir = parsed.path
 
     def sync_once(self) -> int:
+        t0 = time.time()
         if self._store is not None:
             from polyaxon_tpu.fs import is_transient_store_error
             from polyaxon_tpu.utils.retries import with_retries
@@ -118,11 +129,43 @@ class SidecarSync:
             # OSError net does not catch) retry the pass in place;
             # sync_dir is incremental, so a re-pass only re-ships what
             # the failed pass missed.
-            return with_retries(
+            synced = with_retries(
                 lambda: self._store.sync_dir(self.run_dir,
                                              state=self._store_state),
                 transient=is_transient_store_error, key=self.run_dir)
-        return sync_tree(self.run_dir, self.store_dir)
+        else:
+            synced = sync_tree(self.run_dir, self.store_dir)
+        if synced:
+            self._record_sync_span(t0, synced)
+        return synced
+
+    def _record_sync_span(self, t0: float, synced: int) -> None:
+        """`sync` span per pass that shipped files, then ship the span
+        file itself IN this pass (recording its mtime) — otherwise the
+        span write would make the next pass non-empty and the sidecar
+        would emit sync spans about syncing sync spans forever."""
+        from polyaxon_tpu.obs import trace as obs_trace
+
+        try:
+            span_path = obs_trace.record_completed(
+                self.run_dir, self.run_uuid, "sync", component="sidecar",
+                start=t0, end=time.time(), parent_id=self._trace_parent,
+                attributes={"files": synced,
+                            "dest": ("store" if self._store is not None
+                                     else "local")})
+            rel = os.path.relpath(span_path, self.run_dir)
+            if self._store is not None:
+                key = rel.replace(os.sep, "/")
+                self._store.upload_file(span_path, key)
+                self._store_state[span_path] = os.path.getmtime(span_path)
+            else:
+                dest = os.path.join(self.store_dir, rel)
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                shutil.copy2(span_path, dest)  # mtime preserved → no re-copy
+        except Exception as exc:  # noqa: BLE001 — tracing must never
+            # break the sync loop (incl. chaos-injected StoreErrors on
+            # the span-file ship; the file re-ships next pass).
+            warn_sync_file(self.run_dir, "span/lifecycle", exc)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):

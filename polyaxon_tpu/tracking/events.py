@@ -35,9 +35,10 @@ class V1EventKind:
     CURVE = "curve"
     CONFUSION = "confusion"
     SYSTEM = "system"
+    SPAN = "span"  # lifecycle trace spans (obs.trace)
 
     VALUES = {METRIC, IMAGE, HISTOGRAM, TEXT, HTML, AUDIO, VIDEO, MODEL,
-              DATAFRAME, ARTIFACT, CURVE, CONFUSION, SYSTEM}
+              DATAFRAME, ARTIFACT, CURVE, CONFUSION, SYSTEM, SPAN}
 
 
 def _now_iso() -> str:
@@ -72,9 +73,22 @@ class EventWriter:
             handle.flush()
 
     def close(self) -> None:
+        """Release every lazily-opened handle. Idempotent; invoked from
+        the tracking Run teardown, the runtime loop's ExitStack (via its
+        RunTracer), and the executor's gang reap — a finished run must
+        not pin open fds for its whole process lifetime."""
         for handle in self._handles.values():
-            handle.close()
+            try:
+                handle.close()
+            except OSError:
+                pass
         self._handles.clear()
+
+    def __enter__(self) -> "EventWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def safe_subpath(root: str, rel: str) -> str:

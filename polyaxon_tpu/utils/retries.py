@@ -105,5 +105,25 @@ def with_retries(
                 raise
             if on_retry is not None:
                 on_retry(attempt, exc)
+            _note_retry(attempt, exc, key)
             sleep(backoff_delay(attempt, base=base, factor=factor,
                                 max_delay=max_delay, jitter=jitter, key=key))
+
+
+def _note_retry(attempt: int, exc: BaseException,
+                key: Optional[str]) -> None:
+    """Observability tap on every transient retry: bump the unified
+    registry's counter and annotate the active lifecycle span (so a
+    chaos-injected store fault shows up as fault + retry ON the phase
+    it hit). Passive by contract — never raises into the retry loop."""
+    try:
+        from polyaxon_tpu.obs import metrics as obs_metrics
+        from polyaxon_tpu.obs import trace as obs_trace
+
+        obs_metrics.retry_attempts().inc()
+        obs_trace.add_event(
+            "retry", attempt=attempt + 1,
+            error=f"{type(exc).__name__}: {exc}"[:200],
+            **({"key": key} if key else {}))
+    except Exception:  # noqa: BLE001 — observability stays passive
+        pass

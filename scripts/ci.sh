@@ -55,6 +55,13 @@ python -m pytest tests/test_scheduling.py -q -m scheduling
 # checks (prefetch-vs-sync throughput, compile-cache reuse).
 echo "== input pipeline (prefetch/generators/compile-cache)"
 python -m pytest tests/test_prefetch.py -q
+# Observability stage: span/registry/timeline invariants plus the two
+# acceptance drills — an e2e jaxjob whose timeline covers compile →
+# admission → placement → steps → checkpoint → sidecar sync, and a
+# chaos drill whose injected fault + retry read as span events on that
+# timeline. The registry-backed /metrics scrape is parsed line-by-line.
+echo "== observability (lifecycle spans / metrics registry / timeline)"
+python -m pytest tests/test_obs.py -q -m obs
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives
 # in the compiled HLO, and gate against polyaxon_tpu/perf/budgets.json

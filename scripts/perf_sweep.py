@@ -73,6 +73,12 @@ def run_point(name: str, timeout_s: float = 1200, **kw):
         tail = " | ".join(proc.stderr.strip().splitlines()[-3:])[-300:]
         return {"name": name, "error": f"rc={proc.returncode}: {tail}", **kw}
     out = {"name": name, "wall_s": round(time.time() - t0, 1), **kw, **line}
+    # Per-point metrics-registry snapshot (ISSUE 5): bench.py emits the
+    # unified registry (training-step histogram, store-op latency,
+    # retry counters) in its JSON line; normalize the key so every
+    # sweep point in perf_sweep_results.json carries one — None for
+    # error points and pre-registry bench binaries.
+    out.setdefault("metrics_registry", None)
     # OOM shows up as an error field from bench's catch-all.
     if kw.get("profile") and "error" not in out:
         out.update(_analyze_profile(proc.stderr))

@@ -117,6 +117,12 @@ SMOKE_NODES = (
     # the ci.sh audit stage / --full).
     "test_perf_audit.py::TestHloParse",
     "test_perf_audit.py::TestBudgetGate",
+    # Observability: span model + registry + timeline assembly (pure
+    # python; the jax-heavy e2e/chaos timelines run in the ci.sh obs
+    # stage and the full tier).
+    "test_obs.py::TestSpanModel",
+    "test_obs.py::TestRegistry",
+    "test_obs.py::TestTimelineBuild",
 )
 
 
@@ -150,6 +156,11 @@ def pytest_collection_modifyitems(config, items):
             # fair-share, preemption): deterministic + CPU-only, its
             # own `-m scheduling` stage in scripts/ci.sh.
             item.add_marker(pytest.mark.scheduling)
+        if fname == "test_obs.py":
+            # Observability: span/registry/timeline invariants + the
+            # e2e and chaos-drill timelines — its own `-m obs` stage in
+            # scripts/ci.sh, and part of tier-1.
+            item.add_marker(pytest.mark.obs)
     # A stale entry (renamed/deleted test) must fail collection loudly,
     # not silently shrink the default CI tier. Checked PER ENTRY: an
     # entry is stale only if its FILE was fully collected yet the node

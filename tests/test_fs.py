@@ -239,7 +239,12 @@ class TestSidecarStoreDestination:
             (run_dir / "metrics.jsonl").write_text('{"loss": 1}\n')
             assert sync.sync_once() == 1
             store = FsspecStore("memory://side-ns")
-            assert store.list() == ["logs/out.log", "metrics.jsonl"]
+            # Each shipping pass also records + ships a `sync` lifecycle
+            # span (docs/observability.md) — shipped within the same
+            # pass (its mtime recorded), which is exactly why the
+            # unchanged pass above still synced 0.
+            assert store.list() == ["events/span/lifecycle.jsonl",
+                                    "logs/out.log", "metrics.jsonl"]
             assert store.read_text("metrics.jsonl") == '{"loss": 1}\n'
         finally:
             from polyaxon_tpu.fs import store as store_mod

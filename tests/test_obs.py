@@ -1,0 +1,531 @@
+"""Observability layer (ISSUE 5): run-lifecycle span tracing, the
+unified Prometheus metrics registry, the timeline endpoint/CLI, and
+the chaos-drill-as-annotated-timeline acceptance."""
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from polyaxon_tpu import chaos
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("POLYAXON_TPU_BACKOFF_BASE", "0.05")
+    monkeypatch.setenv("POLYAXON_TPU_BACKOFF_MAX", "2")
+    monkeypatch.setenv("POLYAXON_TPU_STORE_RETRY_BASE", "0.01")
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def drive(agent, plane, uuid, until, timeout=240.0, poll=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        agent.reconcile_once()
+        record = plane.get_run(uuid)
+        if until(record):
+            return record
+        time.sleep(0.03)
+    raise AssertionError(
+        f"run {uuid} never satisfied the predicate; last status "
+        f"{plane.get_run(uuid).status}: {plane.get_statuses(uuid)}")
+
+
+def walk_spans(nodes):
+    for node in nodes:
+        yield node
+        yield from walk_spans(node["children"])
+
+
+# ================================================================ span model
+class TestSpanModel:
+    def test_span_context_manager_writes_parent_linked_records(self, tmp_path):
+        tracer = obs_trace.RunTracer(str(tmp_path), "trace-1",
+                                     component="test")
+        with tracer.span("outer") as outer:
+            with tracer.span("inner", attributes={"k": 1}) as inner:
+                assert obs_trace.current_span() is inner
+                assert inner.parent_id == outer.span_id
+            assert obs_trace.current_span() is outer
+        assert obs_trace.current_span() is None
+        tracer.close()
+        records = obs_trace.read_trace(str(tmp_path))
+        assert [r["name"] for r in records] == ["inner", "outer"]
+        by_name = {r["name"]: r for r in records}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        assert by_name["inner"]["attributes"] == {"k": 1}
+        for rec in records:
+            assert rec["trace_id"] == "trace-1"
+            assert rec["status"] == "ok"
+            assert rec["end"] >= rec["start"]
+            assert rec["duration_ms"] >= 0
+
+    def test_exception_records_error_status_and_reraises(self, tmp_path):
+        tracer = obs_trace.RunTracer(str(tmp_path), "trace-e")
+        with pytest.raises(RuntimeError, match="boom"):
+            with tracer.span("doomed"):
+                raise RuntimeError("boom")
+        tracer.close()
+        (rec,) = obs_trace.read_trace(str(tmp_path))
+        assert rec["status"] == "error"
+        assert "RuntimeError: boom" in rec["error"]
+
+    def test_add_event_attaches_to_the_active_span(self, tmp_path):
+        tracer = obs_trace.RunTracer(str(tmp_path), "trace-ev")
+        assert obs_trace.add_event("orphan") is False  # no active span
+        with tracer.span("phase"):
+            assert obs_trace.add_event("chaos.store", op="read_bytes")
+        tracer.close()
+        (rec,) = obs_trace.read_trace(str(tmp_path))
+        (event,) = rec["events"]
+        assert event["name"] == "chaos.store"
+        assert event["attributes"] == {"op": "read_bytes"}
+        assert rec["start"] <= event["time"] <= rec["end"]
+
+    def test_one_shot_helpers_and_env_propagation(self, tmp_path,
+                                                  monkeypatch):
+        obs_trace.record_completed(
+            str(tmp_path), "t", "admission", start=1.0, end=2.5,
+            component="agent", attributes={"queue": "default"})
+        obs_trace.record_event(str(tmp_path), "t", "requeue",
+                               attributes={"reason": "RestartPolicy"})
+        records = obs_trace.read_trace(str(tmp_path))
+        assert {r["type"] for r in records} == {"span", "event"}
+        span = next(r for r in records if r["type"] == "span")
+        assert span["duration_ms"] == 1500.0
+
+        monkeypatch.setenv("POLYAXON_RUN_UUID", "uuid-9")
+        monkeypatch.setenv(obs_trace.ENV_TRACE_PARENT, "uuid-9:abcd1234")
+        tracer = obs_trace.RunTracer.from_env(str(tmp_path))
+        assert tracer.trace_id == "uuid-9"
+        assert tracer.parent_id == "abcd1234"
+        assert obs_trace.parse_trace_parent("garbage") == (None, None)
+        assert obs_trace.parse_trace_parent(None) == (None, None)
+
+    def test_torn_tail_lines_are_tolerated(self, tmp_path):
+        obs_trace.record_event(str(tmp_path), "t", "ok-line")
+        with open(obs_trace.span_file(str(tmp_path)), "a") as fh:
+            fh.write('{"type": "span", "torn...')
+        assert [r["name"] for r in obs_trace.read_trace(str(tmp_path))] == [
+            "ok-line"]
+
+
+# ================================================================= registry
+def parse_prometheus(text):
+    """Strict-ish 0.0.4 parser: returns ({name: type}, {sample: value})
+    and asserts every non-comment line is a well-formed sample."""
+    types, samples = {}, {}
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+        r' ([-+0-9.eE]+|\+Inf|-Inf|NaN)$')
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ")
+            types[name] = mtype
+        elif line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) >= 3
+        else:
+            match = sample_re.match(line)
+            assert match, f"unparseable exposition line: {line!r}"
+            samples[match.group(1) + (match.group(2) or "")] = float(
+                match.group(3))
+    return types, samples
+
+
+class TestRegistry:
+    def test_counter_gauge_roundtrip_and_labels(self):
+        registry = obs_metrics.MetricsRegistry()
+        counter = registry.counter("c_total", "a counter", ("queue",))
+        counter.inc(queue="a")
+        counter.inc(2, queue="a")
+        counter.inc(queue="b")
+        assert counter.value(queue="a") == 3
+        with pytest.raises(ValueError):
+            counter.inc(-1, queue="a")
+        with pytest.raises(ValueError):
+            counter.inc(queue="a", extra="nope")
+        gauge = registry.gauge("g", "a gauge")
+        gauge.set(5)
+        gauge.dec()
+        assert gauge.value() == 4
+
+    def test_get_or_create_is_idempotent_and_type_checked(self):
+        registry = obs_metrics.MetricsRegistry()
+        assert registry.counter("x_total") is registry.counter("x_total")
+        with pytest.raises(ValueError):
+            registry.gauge("x_total")
+        with pytest.raises(ValueError):
+            registry.counter("x_total", labelnames=("other",))
+
+    def test_histogram_buckets_are_cumulative_and_sum_matches(self):
+        registry = obs_metrics.MetricsRegistry()
+        hist = registry.histogram("h_seconds", "hist", ("op",),
+                                  buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            hist.observe(v, op="read")
+        types, samples = parse_prometheus(registry.render())
+        assert types["h_seconds"] == "histogram"
+        buckets = [samples[f'h_seconds_bucket{{op="read",le="{le}"}}']
+                   for le in ("0.1", "1", "10", "+Inf")]
+        assert buckets == [1, 3, 4, 5]
+        assert buckets == sorted(buckets)  # cumulative, nondecreasing
+        assert samples['h_seconds_count{op="read"}'] == 5
+        assert samples['h_seconds_sum{op="read"}'] == pytest.approx(56.05)
+
+    def test_labelless_families_expose_zero_samples_from_birth(self):
+        registry = obs_metrics.MetricsRegistry()
+        obs_metrics.ensure_core_metrics(registry)
+        types, samples = parse_prometheus(registry.render())
+        assert "histogram" in types.values()
+        assert samples["polyaxon_retry_attempts_total"] == 0
+        assert samples['polyaxon_scheduler_tick_seconds_count'] == 0
+
+    def test_label_escaping(self):
+        registry = obs_metrics.MetricsRegistry()
+        registry.gauge("esc", "", ("path",)).set(1, path='a"b\\c\nd')
+        types, samples = parse_prometheus(registry.render())
+        assert len(samples) == 1
+
+    def test_snapshot_is_json_serializable(self):
+        registry = obs_metrics.MetricsRegistry()
+        registry.histogram("h", "").observe(0.2)
+        registry.counter("c_total", "").inc()
+        snap = json.loads(json.dumps(registry.snapshot()))
+        assert snap["h"]["series"][""]["count"] == 1
+        assert snap["c_total"]["series"][""] == 1
+
+
+# ============================================================ timeline build
+class TestTimelineBuild:
+    def _span(self, name, span_id, start, end, parent=None, **extra):
+        return {"type": "span", "name": name, "span_id": span_id,
+                "parent_id": parent, "trace_id": "t", "start": start,
+                "end": end, "duration_ms": (end - start) * 1e3,
+                "status": "ok", "attributes": {}, "events": [], **extra}
+
+    def test_tree_nesting_and_start_ordering(self):
+        records = [
+            self._span("b-child", "c2", 3.0, 4.0, parent="root"),
+            self._span("a-child", "c1", 1.5, 2.0, parent="root"),
+            self._span("root", "root", 1.0, 5.0),
+            self._span("second-root", "r2", 6.0, 7.0),
+        ]
+        timeline = obs_trace.build_timeline(records, trace_id="t")
+        assert [s["name"] for s in timeline["spans"]] == [
+            "root", "second-root"]
+        assert [c["name"] for c in timeline["spans"][0]["children"]] == [
+            "a-child", "b-child"]
+        assert timeline["span_count"] == 4
+        assert timeline["t0"] == 1.0
+        assert timeline["duration_ms"] == pytest.approx(6000.0)
+
+    def test_unknown_parent_degrades_to_root_and_events_attach(self):
+        records = [
+            self._span("orphan", "o1", 2.0, 3.0, parent="never-synced"),
+            self._span("root", "root", 1.0, 5.0),
+            {"type": "event", "name": "requeue", "time": 4.0,
+             "parent_id": None, "attributes": {"reason": "RestartPolicy"}},
+            {"type": "event", "name": "note", "time": 4.5,
+             "parent_id": "root", "attributes": {}},
+        ]
+        timeline = obs_trace.build_timeline(records)
+        assert {s["name"] for s in timeline["spans"]} == {"orphan", "root"}
+        root = next(s for s in timeline["spans"] if s["name"] == "root")
+        assert [e["name"] for e in root["events"]] == ["note"]
+        assert [e["name"] for e in timeline["events"]] == ["requeue"]
+
+    def test_empty_trace(self):
+        timeline = obs_trace.build_timeline([], trace_id="t")
+        assert timeline["spans"] == [] and timeline["span_count"] == 0
+
+
+# =============================================================== e2e timeline
+JAXJOB = {
+    "kind": "operation",
+    "component": {
+        "name": "obs-e2e",
+        "run": {
+            "kind": "jaxjob",
+            "numProcesses": 1,
+            "mesh": {"axes": {"dp": 8}},
+            "checkpointing": {"enabled": True, "intervalSteps": 2,
+                              "asyncSave": False, "restoreOnStart": True},
+            "runtime": {"model": "llama_tiny", "dataset": "lm_synthetic",
+                        "steps": 5, "seq_len": 32, "global_batch_size": 8,
+                        "log_every": 2},
+        },
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def e2e(tmp_path_factory):
+    """ONE in-process jaxjob through the whole control plane, plus a
+    sidecar sync pass — shared by the timeline/API/scrape tests."""
+    home = tmp_path_factory.mktemp("obs-e2e")
+    plane = ControlPlane(str(home / "home"))
+    record = plane.submit(JAXJOB)
+    agent = Agent(plane, in_process=True)
+    final = drive(agent, plane, record.uuid, lambda r: r.is_done)
+    assert final.status == V1Statuses.SUCCEEDED, plane.get_statuses(
+        record.uuid)
+    from polyaxon_tpu.sidecar.sync import SidecarSync
+
+    sync = SidecarSync(plane.run_artifacts_dir(record.uuid),
+                       str(home / "shipped"))
+    assert sync.sync_once() > 0
+    return plane, record.uuid, str(home / "shipped")
+
+
+class TestE2ETimeline:
+    def test_timeline_covers_the_whole_lifecycle(self, e2e):
+        """Acceptance: compile, admission, placement, ≥1 training step,
+        checkpoint, and sidecar sync all appear on ONE span tree."""
+        plane, uuid, _ = e2e
+        timeline = plane.timeline(uuid)
+        spans = list(walk_spans(timeline["spans"]))
+        names = {s["name"] for s in spans}
+        assert {"compile", "admission", "placement", "execute", "init",
+                "runtime", "jit_compile", "step", "checkpoint",
+                "sync"} <= names
+        assert timeline["trace_id"] == uuid
+        assert all(s["trace_id"] == uuid for s in spans)
+
+    def test_parent_links_and_ordering_invariants(self, e2e):
+        plane, uuid, _ = e2e
+        timeline = plane.timeline(uuid)
+        spans = list(walk_spans(timeline["spans"]))
+        by_id = {s["span_id"]: s for s in spans}
+        for span in spans:
+            assert span["end"] >= span["start"]
+            parent = by_id.get(span.get("parent_id") or "")
+            if parent is not None:
+                # A child never starts before its parent (all stamps
+                # come from one host clock here).
+                assert parent["start"] <= span["start"] + 1e-3, span["name"]
+        by_name = {}
+        for span in spans:
+            by_name.setdefault(span["name"], []).append(span)
+        # The lifecycle reads in order along the tree.
+        assert (by_name["compile"][0]["end"]
+                <= by_name["admission"][0]["start"] + 1e-3)
+        assert (by_name["admission"][0]["start"]
+                <= by_name["execute"][0]["start"] + 1e-3)
+        assert (by_name["execute"][0]["start"]
+                <= by_name["runtime"][0]["start"] + 1e-3)
+        # runtime children parent under runtime, runtime under execute.
+        runtime = by_name["runtime"][0]
+        assert (by_id[runtime["parent_id"]]["name"] == "execute")
+        for child in ("jit_compile", "step", "checkpoint"):
+            assert all(s["parent_id"] == runtime["span_id"]
+                       for s in by_name[child]), child
+        # Step spans carry the reused runtime metrics.
+        step = by_name["step"][0]
+        assert step["attributes"]["steps"] >= 1
+        assert "step_time_ms" in step["attributes"]
+        assert "input_wait_ms" in step["attributes"]
+        # Siblings are ordered by start within each children list.
+        def assert_sorted(nodes):
+            starts = [n["start"] for n in nodes]
+            assert starts == sorted(starts)
+            for node in nodes:
+                assert_sorted(node["children"])
+        assert_sorted(timeline["spans"])
+
+    def test_sync_span_ships_to_the_store_and_does_not_self_feed(self, e2e):
+        plane, uuid, shipped = e2e
+        # The span file itself was shipped in the same pass…
+        shipped_file = os.path.join(shipped, "events", "span",
+                                    "lifecycle.jsonl")
+        assert os.path.exists(shipped_file)
+        # …so an idle follow-up pass ships nothing (no sync-span loop).
+        from polyaxon_tpu.sidecar.sync import SidecarSync
+
+        sync = SidecarSync(plane.run_artifacts_dir(uuid), shipped)
+        assert sync.sync_once() == 0
+        sync_spans = [r for r in obs_trace.read_trace(
+            plane.run_artifacts_dir(uuid)) if r.get("name") == "sync"]
+        assert len(sync_spans) == 1
+        assert sync_spans[0]["attributes"]["files"] > 0
+
+    def test_timeline_endpoint_and_unknown_run_404(self, e2e):
+        plane, uuid, _ = e2e
+        from polyaxon_tpu.api.server import ApiServer
+
+        with ApiServer(plane) as server:
+            url = f"{server.url}/api/v1/default/default/runs/{uuid}/timeline"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["trace_id"] == uuid
+            assert payload["span_count"] >= 6
+            names = {s["name"] for s in walk_spans(payload["spans"])}
+            assert "runtime" in names and "compile" in names
+            bad = f"{server.url}/api/v1/default/default/runs/nope/timeline"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(bad, timeout=10)
+            assert err.value.code == 404
+
+    def test_cli_timeline_renders_the_waterfall(self, e2e, monkeypatch):
+        plane, uuid, _ = e2e
+        from click.testing import CliRunner
+
+        import polyaxon_tpu.cli.main as cli_main
+
+        monkeypatch.setattr(cli_main, "get_plane", lambda: plane)
+        result = CliRunner().invoke(cli_main.cli,
+                                    ["ops", "timeline", "-uid", uuid])
+        assert result.exit_code == 0, result.output
+        for name in ("compile", "admission", "runtime", "checkpoint",
+                     "sync"):
+            assert name in result.output
+        as_json = CliRunner().invoke(
+            cli_main.cli, ["ops", "timeline", "-uid", uuid, "--json"])
+        assert as_json.exit_code == 0
+        assert json.loads(as_json.output)["trace_id"] == uuid
+
+    def test_dashboard_carries_the_waterfall_panel(self, e2e):
+        plane, _, _ = e2e
+        from polyaxon_tpu.api.ui import DASHBOARD_HTML
+
+        for marker in ("timelinePanel", "tl-bar", "/timeline", "tl-ev"):
+            assert marker in DASHBOARD_HTML, marker
+
+
+# ================================================================== /metrics
+class TestPrometheusScrape:
+    def test_metrics_is_registry_backed_and_parses(self, e2e):
+        """Acceptance: /metrics serves registry-backed Prometheus text
+        incl. per-phase run counts and ≥1 histogram, and every line
+        parses."""
+        plane, uuid, _ = e2e
+        from polyaxon_tpu.api.server import ApiServer
+
+        with ApiServer(plane) as server:
+            with urllib.request.urlopen(server.url + "/metrics",
+                                        timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode()
+        types, samples = parse_prometheus(text)
+        # Per-lifecycle-phase run counts from the store (zeros incl.).
+        assert samples['polyaxon_runs{status="succeeded"}'] >= 1
+        assert 'polyaxon_runs{status="queued"}' in samples
+        assert 'polyaxon_runs{status="failed"}' in samples
+        assert 'polyaxon_runs{status="running"}' in samples
+        assert types["polyaxon_runs"] == "gauge"
+        assert samples['polyaxon_queue_depth{queue="default"}'] == 0
+        # The e2e run exercised the instrumented seams in-process: the
+        # tick histogram has samples, admission counted an admission.
+        assert types["polyaxon_scheduler_tick_seconds"] == "histogram"
+        assert samples["polyaxon_scheduler_tick_seconds_count"] >= 1
+        assert samples[
+            'polyaxon_admission_outcomes_total{outcome="admitted"}'] >= 1
+        assert samples["polyaxon_training_step_seconds_count"] >= 1
+        # Histogram invariants on the scrape itself.
+        tick_buckets = [v for k, v in samples.items()
+                        if k.startswith("polyaxon_scheduler_tick_seconds_bucket")]
+        assert max(tick_buckets) == samples[
+            "polyaxon_scheduler_tick_seconds_count"]
+        assert "polyaxon_uptime_seconds" in samples
+        from polyaxon_tpu import __version__
+
+        assert samples['polyaxon_tpu_info{version="%s"}' % __version__] == 1
+
+
+# ============================================================== chaos drill
+class TestChaosDrillTimeline:
+    def test_drill_reads_as_an_annotated_timeline(self, tmp_path):
+        """Acceptance: a chaos-drill run shows the injected faults and
+        their retries as span events on the timeline — the transient
+        store fault + its retry annotate the init span, the gang kill
+        annotates the failed attempt, and the backoff requeue appears
+        as a timeline event before the second (successful) attempt."""
+        from polyaxon_tpu.fs import get_store
+
+        seed_store = get_store("memory://obs-drill")
+        seed_store.write_bytes("vocab.txt", b"tokens")
+        chaos.install(chaos.ChaosPlan.from_dict({"seed": 3, "faults": [
+            {"seam": "store", "op": "*", "at": 1, "times": 1},
+            {"seam": "gang", "op": "kill",
+             "config": {"min_checkpoints": 1}},
+        ]}))
+        plane = ControlPlane(str(tmp_path / "home"))
+        record = plane.submit({
+            "kind": "operation",
+            "termination": {"maxRetries": 2},
+            "component": {
+                "name": "obs-drill",
+                "run": {
+                    "kind": "jaxjob",
+                    "numProcesses": 1,
+                    "environment": {"restartPolicy": "on_failure"},
+                    "init": [{"artifacts": {"path": "memory://obs-drill"}}],
+                    "mesh": {"axes": {"dp": 8}},
+                    "checkpointing": {"enabled": True, "intervalSteps": 2,
+                                      "asyncSave": False,
+                                      "restoreOnStart": True},
+                    "runtime": {"model": "llama_tiny",
+                                "dataset": "lm_synthetic", "steps": 5,
+                                "seq_len": 32, "global_batch_size": 8,
+                                "log_every": 2},
+                },
+            },
+        })
+        agent = Agent(plane, in_process=True)
+        final = drive(agent, plane, record.uuid,
+                      lambda r: r.status == V1Statuses.SUCCEEDED)
+        assert chaos.active_plan().done
+
+        timeline = plane.timeline(record.uuid)
+        spans = list(walk_spans(timeline["spans"]))
+        by_name = {}
+        for span in spans:
+            by_name.setdefault(span["name"], []).append(span)
+
+        # Two start attempts: the killed gang and the successful rerun.
+        executes = sorted(by_name["execute"], key=lambda s: s["start"])
+        assert len(executes) == 2
+        assert executes[0]["status"] == "error"
+        assert executes[1]["status"] == "ok"
+
+        def events_of(spans_list):
+            return [e for s in spans_list for e in s["events"]]
+
+        # Injected store fault + its retry annotate the init phase.
+        init_events = {e["name"] for e in events_of(by_name["init"])}
+        assert "chaos.store" in init_events
+        assert "retry" in init_events
+        # The gang kill annotates the runtime span it killed.
+        runtime_events = {e["name"] for e in events_of(by_name["runtime"])}
+        assert "chaos.gang" in runtime_events
+        failed_runtime = [s for s in by_name["runtime"]
+                          if s["status"] == "error"]
+        assert failed_runtime and "ChaosKill" in failed_runtime[0]["error"]
+        # The backoff requeue is a timeline event between the attempts.
+        requeues = [e for e in timeline["events"] if e["name"] == "requeue"]
+        assert requeues
+        assert requeues[0]["attributes"]["reason"] == "RestartPolicy"
+        assert (executes[0]["end"] - 1e-3 <= requeues[0]["time"]
+                <= executes[1]["start"] + 1e-3)
+        # The rerun restored from the checkpoint: a restore span exists
+        # on the second attempt.
+        assert any(s["start"] >= executes[1]["start"] - 1e-3
+                   for s in by_name.get("restore", [])), by_name.keys()
+        # And the registry counted the requeue + the retry.
+        assert obs_metrics.requeues_total().value(
+            reason="RestartPolicy") >= 1
+        assert obs_metrics.retry_attempts().value() >= 1
+        assert final.retries == 1
