@@ -682,10 +682,22 @@ def main() -> int:
         os.makedirs(profile_dir, exist_ok=True)
         spec["runtime"]["profile_steps"] = [max(steps - 2, 1)]
         print(f"# profiler trace -> {profile_dir}/profile", file=sys.stderr)
+    # The run always gets an artifacts dir (a throwaway when not
+    # profiling) so the runtime loop emits lifecycle spans; obs.analyze
+    # folds them into the per-record perf report below — a sweep
+    # regression arrives pre-attributed (compile vs input-wait vs step)
+    # instead of as a bare tokens/sec delta.
+    trace_dir = profile_dir
+    trace_dir_tmp = False
+    if trace_dir is None:
+        import tempfile
+
+        trace_dir = tempfile.mkdtemp(prefix="plx-bench-trace-")
+        trace_dir_tmp = True
     fallback = None
     try:
         result = run_jaxjob(V1JAXJob.from_dict(spec),
-                            artifacts_dir=profile_dir,
+                            artifacts_dir=trace_dir,
                             on_metrics=_noop_metrics)
     except Exception as exc:  # noqa: BLE001 — degrade, don't erase
         # The Pallas backward is the newest kernel on the hot path; if
@@ -702,7 +714,7 @@ def main() -> int:
             print(f"# {fallback}", file=sys.stderr)
             spec["runtime"]["flash_bwd_impl"] = "xla"
             result = run_jaxjob(V1JAXJob.from_dict(spec),
-                                artifacts_dir=profile_dir,
+                                artifacts_dir=trace_dir,
                                 on_metrics=_noop_metrics)
         else:
             raise
@@ -756,6 +768,9 @@ def main() -> int:
         # bench record, so perf_sweep points carry their own latency
         # distributions instead of a single mean.
         "metrics_registry": _registry_snapshot(),
+        # Phase attribution from the run's own lifecycle spans
+        # (obs.analyze): where the wall went + step-trend verdict.
+        "perf_report": _perf_report(trace_dir, cleanup=trace_dir_tmp),
     }))
     return 0
 
@@ -767,6 +782,21 @@ def _registry_snapshot():
         return obs_metrics.REGISTRY.snapshot()
     except Exception:  # noqa: BLE001 — the JSON contract outranks obs
         return None
+
+
+def _perf_report(trace_dir, cleanup=False):
+    try:
+        from polyaxon_tpu.obs import analyze as obs_analyze
+
+        report = obs_analyze.compact_report(
+            obs_analyze.analyze_run_dir(trace_dir))
+    except Exception:  # noqa: BLE001 — the JSON contract outranks obs
+        report = None
+    if cleanup:
+        import shutil
+
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    return report
 
 
 if __name__ == "__main__":

@@ -198,10 +198,27 @@ class Agent:
                 record, t0, state=state, topology=resources.get("topology"))
         return state == "running"
 
+    def _evaluate_alerts(self) -> None:
+        """One alert-rule pass over the live registry (obs.rules): the
+        reconcile loop is the evaluation clock, the same way the Borgmon
+        lineage runs rules next to collection. Fired rules with
+        ``annotate_runs`` stamp the live runs through the plane. Never
+        raises — alerting must not take scheduling down."""
+        try:
+            from polyaxon_tpu.obs import rules as obs_rules
+
+            obs_rules.default_engine().evaluate(plane=self.plane)
+        except Exception:  # noqa: BLE001 — fail-open observability
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "alert evaluation pass failed", exc_info=True)
+
     def reconcile_once(self) -> int:
         actions = self.scheduler.tick()
         actions += self.executor.poll()
         self._notify_terminal_runs()
+        self._evaluate_alerts()
         if self.slices is not None:
             # Heartbeat live gangs, advance the native pool, surface events.
             for uuid in self.executor.active_runs:

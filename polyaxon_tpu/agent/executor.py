@@ -32,6 +32,7 @@ from polyaxon_tpu.compiler import COORDINATOR_PLACEHOLDER, ENV_JAXJOB_SPEC
 from polyaxon_tpu.compiler.plan import V1LaunchPlan
 from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import flight as obs_flight
 from polyaxon_tpu.obs import trace as obs_trace
 
 
@@ -268,6 +269,10 @@ class LocalExecutor:
         self.store.transition(run_uuid, V1Statuses.STARTING)
 
         gang = _Gang(run_uuid=run_uuid, plan=plan)
+        # Arm the flight recorder before any span lands: the registry
+        # baseline taken here is what turns the postmortem's metric
+        # section into DELTAS (what moved while this gang lived).
+        obs_flight.RECORDER.mark_start(run_uuid)
         gang.tracer = obs_trace.RunTracer(
             plan.artifacts_dir, run_uuid, component="agent")
         gang.span = gang.tracer.start_span(
@@ -338,6 +343,10 @@ class LocalExecutor:
                                    error=f"{reason}: {exc}")
             self.store.transition(run_uuid, V1Statuses.FAILED,
                                   reason=reason, message=str(exc)[:500])
+            # A run that died in init gets its black box too.
+            obs_flight.RECORDER.dump(run_uuid, plan.artifacts_dir,
+                                     status=V1Statuses.FAILED.value,
+                                     reason=reason, message=str(exc)[:500])
             return False
         self._gangs[run_uuid] = gang
         self.store.transition(run_uuid, V1Statuses.RUNNING)
@@ -457,11 +466,19 @@ class LocalExecutor:
             if record.status == V1Statuses.STOPPING:
                 self._finish_gang_span(gang, final="stopped")
                 self.store.transition(run_uuid, V1Statuses.STOPPED)
+                obs_flight.RECORDER.discard(run_uuid)  # operator intent
             elif gang.preempted:
                 self._finish_gang_span(gang, status="error",
                                        error="preempted", final="preempted")
                 self.store.transition(run_uuid, V1Statuses.PREEMPTED,
                                       reason="SlicePreempted", force=True)
+                # Preemption is a death the operator did not ask for:
+                # dump the black box (the backoff requeue keeps the ring
+                # alive, so a later fatal reap overwrites with more).
+                obs_flight.RECORDER.dump(
+                    run_uuid, gang.plan.artifacts_dir,
+                    status=V1Statuses.PREEMPTED.value,
+                    reason="SlicePreempted")
             else:
                 if gang.warning:
                     # Non-fatal anomaly (e.g. checkpoint fallback):
@@ -483,6 +500,17 @@ class LocalExecutor:
                     message=gang.thread_error or (None if status == 0
                                                   else f"exit code {status}"),
                 )
+                if target == V1Statuses.FAILED:
+                    # The reap that declared the run dead writes its
+                    # postmortem: ring of recent spans/notes, metric
+                    # deltas since gang start, and every log tail.
+                    obs_flight.RECORDER.dump(
+                        run_uuid, gang.plan.artifacts_dir,
+                        status=target.value, reason="ProcessFailed",
+                        message=gang.thread_error
+                        or f"exit code {status}")
+                else:
+                    obs_flight.RECORDER.discard(run_uuid)
             actions += 1
         return actions
 

@@ -255,6 +255,15 @@ class _Handler(BaseHTTPRequestHandler):
             if rest == ["projects"]:
                 self._require(caller, admin=True)
                 return self._json(self.plane.store.list_projects())
+            if rest == ["alerts"]:
+                # Live alert-rule state (obs.rules): firing alerts,
+                # per-rule values vs thresholds, fired/resolved history.
+                # Any authenticated caller may read — alert state is how
+                # tenants learn the cluster (not another tenant's data)
+                # is degraded. Evaluated on read so a plane without a
+                # reconciling agent still answers truthfully.
+                self._require(caller)
+                return self._json(self._alerts())
             if rest and rest[0] == "queues":
                 return self._queues(method, caller, rest[1:])
             if rest and rest[0] == "quotas":
@@ -360,6 +369,13 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ApiError(404, f"quota for {rest[0]} not found")
             return self._json({"deleted": rest[0]})
         raise ApiError(404, f"no quota route for {'/'.join(rest)}")
+
+    def _alerts(self) -> dict:
+        from polyaxon_tpu.obs import rules as obs_rules
+
+        engine = obs_rules.default_engine()
+        engine.evaluate(plane=self.plane)
+        return engine.to_json()
 
     def _dashboard(self) -> None:
         """Polyboard-lite (api.ui): the static runs dashboard."""
@@ -496,6 +512,11 @@ class _Handler(BaseHTTPRequestHandler):
             # chaos/retry annotations. Backs the dashboard waterfall
             # and `plx ops timeline`.
             return self._json(plane.timeline(uuid))
+        if action == "report":
+            # Performance attribution (obs.analyze): wall clock by
+            # phase, step-time trend + anomaly flags, fault annotations
+            # per phase. Backs `plx ops report`.
+            return self._json(plane.report(uuid))
         if action == "metrics":
             names = query.get("names")
             return self._json(plane.streams.get_metrics(uuid, names))

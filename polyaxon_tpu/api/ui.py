@@ -141,6 +141,17 @@ DASHBOARD_HTML = r"""<!doctype html>
   .tl-ev.chaos { background: var(--bad, #c0392b); }
   .tl-dur { flex: 0 0 76px; text-align: right; color: var(--ink-2);
             font-variant-numeric: tabular-nums; }
+  .alert { display: flex; gap: 10px; align-items: baseline;
+           padding: 6px 10px; margin: 4px 0; border-radius: 6px;
+           border-left: 4px solid var(--status-warning);
+           background: color-mix(in srgb,
+             var(--status-warning) 12%, transparent); }
+  .alert.page { border-left-color: var(--status-critical);
+                background: color-mix(in srgb,
+                  var(--status-critical) 12%, transparent); }
+  .alert .alert-val { font-variant-numeric: tabular-nums;
+                      color: var(--ink-2); }
+  .alert .alert-desc { color: var(--ink-2); }
 </style>
 </head>
 <body>
@@ -166,6 +177,7 @@ DASHBOARD_HTML = r"""<!doctype html>
 </header>
 <main>
   <div class="tiles" id="tiles"></div>
+  <div id="alertsPanel" aria-live="polite"></div>
   <div id="projectPanel"></div>
   <div id="slicesPanel"></div>
   <table id="runs">
@@ -281,6 +293,24 @@ function tile(k, v) {
 let lastRows = [];      // last successful fetch — search filters this
 let lastProjects = "";  // rendered option set, rebuilt only on change
 
+// Alerts banner (obs.rules): firing alerts from /api/v1/alerts render
+// above the run table — a degraded cluster announces itself before
+// the operator goes digging. Quiet when nothing fires.
+async function loadAlerts() {
+  const el = $("#alertsPanel");
+  let data;
+  try { data = await api("/api/v1/alerts"); }
+  catch (e) { return; }  // transient/auth failure: keep the last banner
+  const firing = data.alerts || [];
+  if (!firing.length) { el.innerHTML = ""; return; }
+  el.innerHTML = `<div class="alerts">` + firing.map(a => `
+    <div class="alert ${esc(a.severity)}" role="alert">
+      <strong>${esc(a.rule)}</strong>
+      <span class="alert-val">value=${esc(a.value)} threshold=${esc(a.threshold)}</span>
+      <span class="alert-desc">${esc(a.description)}</span>
+    </div>`).join("") + `</div>`;
+}
+
 async function loadRuns() {
   const status = $("#statusFilter").value;
   const q = status ? `?status=${encodeURIComponent(status)}` : "";
@@ -318,6 +348,7 @@ async function loadRuns() {
   }
   renderRuns();
   renderSlices();
+  loadAlerts();
 }
 
 function renderRuns() {

@@ -311,6 +311,81 @@ def ops_timeline(uid, as_json):
                    f"{fmt_attrs(event.get('attributes'))}")
 
 
+@ops.command("report")
+@click.option("-uid", "--uid", required=True)
+@click.option("--json", "as_json", is_flag=True,
+              help="raw report instead of the rendered tables")
+def ops_report(uid, as_json):
+    """Performance attribution report (ISSUE 6): where the run's wall
+    clock went (compile / input-wait / step / checkpoint / restore /
+    sync ...), whether step time drifted (rolling-median/MAD anomaly
+    flags), and which phases absorbed retries, chaos faults, and
+    requeues — a regression arrives pre-attributed."""
+    plane = get_plane()
+    get_run_or_fail(plane, uid)
+    report = plane.report(uid)
+    if as_json:
+        click.echo(json.dumps(report, indent=2, default=str))
+        return
+    click.echo(f"run {report['run_uuid']}  status={report['status']}  "
+               f"attempts={report['attempts']}  "
+               f"wall={report['wall_clock_ms'] / 1e3:.2f}s  "
+               f"(phases sum {report['phase_sum_ms'] / 1e3:.2f}s)")
+    for name, entry in report["phases"].items():
+        frac = (f"{entry['fraction'] * 100:5.1f}%"
+                if entry["fraction"] is not None else "    -")
+        click.echo(f"  {name:<13} {entry['ms']:>10.1f}ms  {frac}"
+                   f"  x{entry['count']}")
+    steps = report["steps"]
+    if steps["windows"]:
+        click.echo(f"step windows: {len(steps['windows'])}  "
+                   f"rolling median {steps['rolling_median_ms']}ms  "
+                   f"anomalies {len(steps['anomalies'])}")
+        for anom in steps["anomalies"]:
+            click.echo(f"  ! step<={anom['to_step']} "
+                       f"{anom['step_time_ms']}ms vs median "
+                       f"{anom['median_ms']}ms "
+                       f"({anom['deviation_sigmas']:+.1f} sigma)")
+    notes = report["annotations"]
+    for kind in ("retries", "chaos", "requeues"):
+        if notes.get(kind):
+            pairs = " ".join(f"{k}={v}" for k, v in notes[kind].items())
+            click.echo(f"{kind}: {pairs}")
+    for alert in report.get("alerts") or []:
+        click.echo(f"alert: {alert['rule']} ({alert['severity']}) "
+                   f"fired on this run")
+
+
+@ops.command("alerts")
+@click.option("--json", "as_json", is_flag=True)
+@click.option("--all", "show_all", is_flag=True,
+              help="every rule's state, not just firing alerts")
+def ops_alerts(as_json, show_all):
+    """Alert-rule state over the live registry (ISSUE 6): the committed
+    ruleset (obs/rules.json) evaluated now — firing alerts first, then
+    (with --all) every rule's current value vs its threshold."""
+    from polyaxon_tpu.obs import rules as obs_rules
+
+    plane = get_plane()
+    engine = obs_rules.default_engine()
+    engine.evaluate(plane=plane)
+    payload = engine.to_json()
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+        return
+    if not payload["alerts"]:
+        click.echo("no firing alerts")
+    for alert in payload["alerts"]:
+        click.echo(f"FIRING [{alert['severity']}] {alert['rule']}: "
+                   f"value={alert['value']} threshold={alert['threshold']}"
+                   f"  {alert['description']}")
+    if show_all:
+        for rule in payload["rules"]:
+            click.echo(f"  {rule['state']:<9} {rule['rule']:<24} "
+                       f"{rule['metric']} value={rule['value']} "
+                       f"threshold={rule['threshold']}")
+
+
 @ops.command("logs")
 @click.option("-uid", "--uid", required=True)
 @click.option("--follow", is_flag=True)

@@ -449,6 +449,21 @@ class ControlPlane:
         return build_timeline(read_trace(self.run_artifacts_dir(run_uuid)),
                               trace_id=run_uuid)
 
+    def report(self, run_uuid: str) -> dict:
+        """Performance attribution report (obs.analyze): the run's wall
+        clock decomposed into phases, step-time trend with anomaly
+        flags, and retry/chaos/requeue annotations per phase — plus the
+        run's status and any alerts that fired on it. Backs
+        ``GET .../runs/<uuid>/report`` and ``plx ops report``."""
+        from polyaxon_tpu.obs.analyze import analyze_timeline
+
+        record = self.store.get_run(run_uuid)
+        report = analyze_timeline(self.timeline(run_uuid))
+        report["status"] = record.status.value
+        report["retries"] = record.retries
+        report["alerts"] = (record.meta or {}).get("alerts") or []
+        return report
+
     # -- cross-run lineage -------------------------------------------------
     def _upstream_edges(
         self, record: RunRecord,

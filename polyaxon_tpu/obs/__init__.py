@@ -1,9 +1,13 @@
-"""Observability layer (ISSUE 5): end-to-end run-lifecycle tracing
-(``obs.trace``) + the unified Prometheus metrics registry
-(``obs.metrics``). See docs/observability.md for the span model and
-metric catalog."""
+"""Observability layer: end-to-end run-lifecycle tracing
+(``obs.trace``), the unified Prometheus metrics registry
+(``obs.metrics``) — and, closing the loop (ISSUE 6), the ANALYSIS
+plane that reads them: declarative alert rules with SLO burn-rate
+support (``obs.rules``), per-run performance attribution reports
+(``obs.analyze``), and the failure flight recorder that gives every
+dead run a postmortem (``obs.flight``). See docs/observability.md for
+the span model, metric catalog, rule schema, and report reference."""
 
-from polyaxon_tpu.obs import metrics, trace
+from polyaxon_tpu.obs import analyze, flight, metrics, rules, trace
 from polyaxon_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -22,7 +26,10 @@ from polyaxon_tpu.obs.trace import (
 )
 
 __all__ = [
+    "analyze",
+    "flight",
     "metrics",
+    "rules",
     "trace",
     "Counter",
     "Gauge",

@@ -62,15 +62,29 @@ def _label_str(labelnames: tuple[str, ...], labelvalues: tuple[str, ...],
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+# Per-instrument cap on distinct label sets: a request-path label
+# (user-supplied queue names, artifact paths...) must not grow the
+# registry without bound. Series past the cap fold into one `other`
+# row and count into polyaxon_metrics_dropped_labels_total.
+DEFAULT_MAX_SERIES = 64
+OVERFLOW_LABEL = "other"
+DROPPED_LABELS_METRIC = "polyaxon_metrics_dropped_labels_total"
+
+
 class _Metric:
     """Base: one named family with a fixed label set."""
 
     type = "untyped"
 
-    def __init__(self, name: str, help: str, labelnames: tuple[str, ...]):
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...],
+                 max_series: int = DEFAULT_MAX_SERIES):
         self.name = name
         self.help = help
         self.labelnames = labelnames
+        self.max_series = max_series
+        # Set by the owning registry: called (outside the series lock)
+        # once per observation folded into the overflow row.
+        self._on_drop = None
         self._lock = threading.Lock()
         self._series: dict[tuple[str, ...], Any] = {}
         if not labelnames:
@@ -88,6 +102,24 @@ class _Metric:
                 f"metric {self.name} takes labels {self.labelnames}, "
                 f"got {tuple(labels)}")
         return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _admit(self, key: tuple[str, ...]) -> tuple[tuple[str, ...], bool]:
+        """Cardinality cap, checked under ``self._lock``: an existing
+        series always passes; a NEW series past ``max_series`` folds
+        into the ``other`` row (created on first overflow — it does not
+        count against the cap, so the fold always lands)."""
+        if not self.labelnames or key in self._series:
+            return key, False
+        if len(self._series) < self.max_series:
+            return key, False
+        return (OVERFLOW_LABEL,) * len(self.labelnames), True
+
+    def _dropped(self) -> None:
+        if self._on_drop is not None:
+            try:
+                self._on_drop(self.name)
+            except Exception:  # noqa: BLE001 — accounting stays passive
+                pass
 
     def clear(self) -> None:
         """Drop all label series (scrape-time gauges rebuilt from store
@@ -130,7 +162,10 @@ class Counter(_Metric):
             raise ValueError("counters only go up")
         key = self._key(labels)
         with self._lock:
+            key, dropped = self._admit(key)
             self._series[key] = self._series.get(key, 0.0) + amount
+        if dropped:
+            self._dropped()
 
     def value(self, **labels: Any) -> float:
         with self._lock:
@@ -143,12 +178,18 @@ class Gauge(_Metric):
     def set(self, value: float, **labels: Any) -> None:
         key = self._key(labels)
         with self._lock:
+            key, dropped = self._admit(key)
             self._series[key] = float(value)
+        if dropped:
+            self._dropped()
 
     def inc(self, amount: float = 1.0, **labels: Any) -> None:
         key = self._key(labels)
         with self._lock:
+            key, dropped = self._admit(key)
             self._series[key] = self._series.get(key, 0.0) + amount
+        if dropped:
+            self._dropped()
 
     def dec(self, amount: float = 1.0, **labels: Any) -> None:
         self.inc(-amount, **labels)
@@ -171,11 +212,12 @@ class Histogram(_Metric):
     type = "histogram"
 
     def __init__(self, name: str, help: str, labelnames: tuple[str, ...],
-                 buckets: Iterable[float] = LATENCY_BUCKETS):
+                 buckets: Iterable[float] = LATENCY_BUCKETS,
+                 max_series: int = DEFAULT_MAX_SERIES):
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket bound")
-        super().__init__(name, help, labelnames)
+        super().__init__(name, help, labelnames, max_series=max_series)
 
     def _zero(self):
         return _HistSample(len(self.buckets) + 1)  # + the +Inf bucket
@@ -184,6 +226,7 @@ class Histogram(_Metric):
         key = self._key(labels)
         value = float(value)
         with self._lock:
+            key, dropped = self._admit(key)
             sample = self._series.get(key)
             if sample is None:
                 sample = self._series[key] = self._zero()
@@ -195,6 +238,51 @@ class Histogram(_Metric):
             sample.counts[idx] += 1
             sample.sum += value
             sample.count += 1
+        if dropped:
+            self._dropped()
+
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
+        """Prometheus-style ``histogram_quantile(q)`` over the le-bucket
+        counts: rank ``q*count`` lands in a bucket, the estimate is a
+        linear interpolation within it (the lowest bucket interpolates
+        from 0). A rank landing in the +Inf bucket clamps to the
+        largest finite bound — the data says "beyond the layout", and a
+        finite, monotone answer beats a fabricated one. ``None`` when
+        the series has no observations (or does not exist). Shared by
+        the alert-rule engine (obs.rules), the trace analyzer
+        (obs.analyze), and bench reporting."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        key = self._key(labels)
+        with self._lock:
+            sample = self._series.get(key)
+            if sample is None or sample.count == 0:
+                return None
+            counts = list(sample.counts)
+            total = sample.count
+        rank = q * total
+        cumulative = 0
+        for i, n in enumerate(counts):
+            prev = cumulative
+            cumulative += n
+            if n and cumulative >= rank:
+                if i == len(self.buckets):
+                    return self.buckets[-1]  # +Inf clamps to last bound
+                hi = self.buckets[i]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                return lo + (hi - lo) * max(rank - prev, 0.0) / n
+        return self.buckets[-1]  # unreachable with count > 0
+
+    def quantile_max(self, q: float) -> Optional[float]:
+        """Worst-series quantile: max of :meth:`quantile` across every
+        label set (the rules engine's view of a labeled histogram when
+        a rule names no labels). ``None`` when nothing has samples."""
+        with self._lock:
+            keys = list(self._series)
+        values = [self.quantile(q, **dict(zip(self.labelnames, key)))
+                  for key in keys]
+        values = [v for v in values if v is not None]
+        return max(values) if values else None
 
     def _render_series(self, values, sample: _HistSample) -> list[str]:
         lines = []
@@ -234,26 +322,52 @@ class MetricsRegistry:
                         f"{existing.type}{existing.labelnames}")
                 return existing
             metric = cls(name, help, labelnames, **kwargs)
+            if name != DROPPED_LABELS_METRIC:
+                metric._on_drop = self._count_dropped
             self._metrics[name] = metric
             return metric
 
+    def _count_dropped(self, name: str) -> None:
+        """One folded observation on ``name`` — its own cardinality is
+        bounded by the instrument count, so the accounting counter gets
+        a cap far above any real registry and no drop hook (the fold of
+        folds would recurse)."""
+        self.counter(
+            DROPPED_LABELS_METRIC,
+            "Observations folded into the `other` series by the "
+            "per-instrument label-cardinality cap",
+            ("metric",), max_series=4096).inc(metric=name)
+
     def counter(self, name: str, help: str = "",
-                labelnames: tuple[str, ...] = ()) -> Counter:
-        return self._get_or_create(Counter, name, help, tuple(labelnames))
+                labelnames: tuple[str, ...] = (),
+                max_series: int = DEFAULT_MAX_SERIES) -> Counter:
+        return self._get_or_create(Counter, name, help, tuple(labelnames),
+                                   max_series=max_series)
 
     def gauge(self, name: str, help: str = "",
-              labelnames: tuple[str, ...] = ()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, tuple(labelnames))
+              labelnames: tuple[str, ...] = (),
+              max_series: int = DEFAULT_MAX_SERIES) -> Gauge:
+        return self._get_or_create(Gauge, name, help, tuple(labelnames),
+                                   max_series=max_series)
 
     def histogram(self, name: str, help: str = "",
                   labelnames: tuple[str, ...] = (),
-                  buckets: Iterable[float] = LATENCY_BUCKETS) -> Histogram:
+                  buckets: Iterable[float] = LATENCY_BUCKETS,
+                  max_series: int = DEFAULT_MAX_SERIES) -> Histogram:
         return self._get_or_create(Histogram, name, help, tuple(labelnames),
-                                   buckets=buckets)
+                                   buckets=buckets, max_series=max_series)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Drop every instrument AND its samples (test-visible): the
+        process-global REGISTRY otherwise leaks series across tests —
+        get-or-create re-creates families fresh on next touch, so a
+        reset between tests is safe for every accessor-style caller."""
+        with self._lock:
+            self._metrics.clear()
 
     def render(self) -> str:
         """The whole registry in Prometheus text-format 0.0.4."""
@@ -341,3 +455,29 @@ def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     retry_attempts(registry)
     store_op_hist(registry)
     training_step_hist(registry)
+
+
+# Families registered at scrape time (api/server.py) rather than by an
+# accessor above — listed so the rule-schema validator knows the FULL
+# metric vocabulary, not just the accessor catalog.
+SCRAPE_TIME_METRICS = (
+    "polyaxon_runs",
+    "polyaxon_queue_depth",
+    "polyaxon_queue_running",
+    "polyaxon_uptime_seconds",
+    "polyaxon_tpu_info",
+)
+
+
+def catalog_metric_names() -> set[str]:
+    """Every metric name this codebase can expose — the closed
+    vocabulary ``obs.rules`` validates rule specs against (an alert on
+    a typo'd name would never fire; CI fails it instead)."""
+    scratch = MetricsRegistry()
+    ensure_core_metrics(scratch)
+    serving_queue_depth(scratch)
+    serving_request_hist(scratch)
+    names = set(scratch._metrics)
+    names.update(SCRAPE_TIME_METRICS)
+    names.add(DROPPED_LABELS_METRIC)
+    return names

@@ -251,6 +251,15 @@ class RunTracer:
     # -- io ---------------------------------------------------------------
     def write(self, record: dict[str, Any]) -> None:
         self._writer.write(V1EventKind.SPAN, SPAN_STREAM, record)
+        # Every written span/event also lands in the run's flight-
+        # recorder ring (obs.flight): the postmortem of a dead run is
+        # fed as a side effect of normal tracing, no second producer.
+        try:
+            from polyaxon_tpu.obs import flight as _flight
+
+            _flight.RECORDER.record_trace(self.trace_id, record)
+        except Exception:  # noqa: BLE001 — the recorder is fail-open
+            pass
 
     def flush(self) -> None:
         self._writer.flush()
@@ -346,9 +355,16 @@ def build_timeline(records: list[dict[str, Any]],
             top_events.append(event)
 
     def sort_tree(nodes: list[dict]) -> None:
-        nodes.sort(key=lambda n: (n.get("start") or 0, n.get("name") or ""))
+        # span_id as the final tie-break: same-millisecond siblings with
+        # the same name (e.g. two per-attempt init spans) would otherwise
+        # order by dict insertion — i.e. file order, which the sidecar
+        # may interleave — and golden report/timeline output would
+        # wobble across runs.
+        nodes.sort(key=lambda n: (n.get("start") or 0, n.get("name") or "",
+                                  n.get("span_id") or ""))
         for node in nodes:
-            node["events"].sort(key=lambda e: e.get("time") or 0)
+            node["events"].sort(key=lambda e: (e.get("time") or 0,
+                                               e.get("name") or ""))
             sort_tree(node["children"])
 
     sort_tree(roots)

@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from polyaxon_tpu.models import get_model
+from polyaxon_tpu.obs import flight as obs_flight
 from polyaxon_tpu.obs import metrics as obs_metrics
 from polyaxon_tpu.obs import trace as obs_trace
 from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
@@ -405,6 +406,12 @@ def _run_jaxjob(
                         })
                 steps_since_emit = 0
                 wait_window = 0.0
+                if tracer is not None:
+                    # The flight ring keeps the last emissions a dying
+                    # run saw — the postmortem's "final instruments".
+                    obs_flight.RECORDER.note(
+                        tracer.trace_id, "metrics", step=step,
+                        **{k: round(float(v), 5) for k, v in vals.items()})
                 on_metrics(step, vals)
                 # Stamp AFTER the callback: tracking I/O must not
                 # deflate the next window's reported throughput.

@@ -480,6 +480,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path == "/alerts":
+            # The serving process evaluates the same committed ruleset
+            # (obs.rules) against ITS registry — the request-p99 and
+            # queue-saturation rules live where those series do.
+            from polyaxon_tpu.obs import rules as obs_rules
+
+            alert_engine = obs_rules.default_engine()
+            alert_engine.evaluate()
+            return self._json(alert_engine.to_json())
         if self.path == "/v1/models":
             return self._json({"models": [self.engine.model]})
         if self.path == "/v1/stats":

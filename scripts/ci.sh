@@ -55,12 +55,22 @@ python -m pytest tests/test_scheduling.py -q -m scheduling
 # checks (prefetch-vs-sync throughput, compile-cache reuse).
 echo "== input pipeline (prefetch/generators/compile-cache)"
 python -m pytest tests/test_prefetch.py -q
-# Observability stage: span/registry/timeline invariants plus the two
-# acceptance drills — an e2e jaxjob whose timeline covers compile →
-# admission → placement → steps → checkpoint → sidecar sync, and a
-# chaos drill whose injected fault + retry read as span events on that
-# timeline. The registry-backed /metrics scrape is parsed line-by-line.
-echo "== observability (lifecycle spans / metrics registry / timeline)"
+# Alert-rule schema gate: the committed default ruleset
+# (polyaxon_tpu/obs/rules.json) must load clean — unknown metric names
+# (checked against the registry catalog), malformed windows, duplicate
+# rule ids, bad kinds/ops all fail the build HERE, not as an alert
+# that silently never fires in production.
+echo "== obs rules (schema-validate the committed ruleset)"
+python -c "from polyaxon_tpu.obs import rules; \
+    raise SystemExit(rules._main(['--check']))"
+# Observability stage: span/registry/timeline invariants plus the
+# analysis plane (ISSUE 6) — alert-rule fire→hysteresis→resolve
+# lifecycle, histogram_quantile goldens, label-cardinality cap,
+# flight-recorder ring bounds + dump-on-FAILED — and the acceptance
+# drills: an e2e jaxjob whose report's phase decomposition sums to the
+# wall clock, and a chaos gauntlet that leaves a postmortem.json, a
+# fired-then-resolved retry-storm alert, and an attributed report.
+echo "== observability (spans / registry / rules / reports / flight)"
 python -m pytest tests/test_obs.py -q -m obs
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives

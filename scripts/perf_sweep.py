@@ -79,6 +79,12 @@ def run_point(name: str, timeout_s: float = 1200, **kw):
     # sweep point in perf_sweep_results.json carries one — None for
     # error points and pre-registry bench binaries.
     out.setdefault("metrics_registry", None)
+    # Per-point phase attribution (ISSUE 6): bench.py analyzes its own
+    # run's lifecycle spans (obs.analyze) into a compact perf report —
+    # normalize the key so every sweep point carries one (None for
+    # error points and pre-report bench binaries), and a regression
+    # between rounds names the phase that moved, not just the number.
+    out.setdefault("perf_report", None)
     # OOM shows up as an error field from bench's catch-all.
     if kw.get("profile") and "error" not in out:
         out.update(_analyze_profile(proc.stderr))

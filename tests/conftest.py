@@ -117,12 +117,21 @@ SMOKE_NODES = (
     # the ci.sh audit stage / --full).
     "test_perf_audit.py::TestHloParse",
     "test_perf_audit.py::TestBudgetGate",
-    # Observability: span model + registry + timeline assembly (pure
-    # python; the jax-heavy e2e/chaos timelines run in the ci.sh obs
-    # stage and the full tier).
+    # Observability: span model + registry + timeline assembly, plus
+    # the analysis plane (ISSUE 6) — quantile goldens, cardinality cap,
+    # rule schema + fire/hysteresis/resolve lifecycle, flight-recorder
+    # bounds/dump, and the report unit math (all pure python; the
+    # jax-heavy e2e/chaos acceptance runs in the ci.sh obs stage and
+    # the full tier).
     "test_obs.py::TestSpanModel",
     "test_obs.py::TestRegistry",
     "test_obs.py::TestTimelineBuild",
+    "test_obs.py::TestHistogramQuantile",
+    "test_obs.py::TestCardinalityCap",
+    "test_obs.py::TestRuleSchema",
+    "test_obs.py::TestRuleLifecycle",
+    "test_obs.py::TestFlightRecorder",
+    "test_obs.py::TestReportUnit",
 )
 
 

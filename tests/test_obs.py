@@ -1,6 +1,9 @@
-"""Observability layer (ISSUE 5): run-lifecycle span tracing, the
-unified Prometheus metrics registry, the timeline endpoint/CLI, and
-the chaos-drill-as-annotated-timeline acceptance."""
+"""Observability layer (ISSUES 5+6): run-lifecycle span tracing, the
+unified Prometheus metrics registry, the timeline endpoint/CLI, the
+chaos-drill-as-annotated-timeline acceptance — and the ANALYSIS plane:
+alert rules (fire→hysteresis→resolve), histogram-quantile goldens,
+label-cardinality caps, per-run attribution reports, and the failure
+flight recorder's postmortem contract."""
 
 import json
 import os
@@ -15,7 +18,10 @@ from polyaxon_tpu import chaos
 from polyaxon_tpu.agent import Agent
 from polyaxon_tpu.controlplane import ControlPlane
 from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import analyze as obs_analyze
+from polyaxon_tpu.obs import flight as obs_flight
 from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import rules as obs_rules
 from polyaxon_tpu.obs import trace as obs_trace
 
 
@@ -208,6 +214,108 @@ class TestRegistry:
         assert snap["h"]["series"][""]["count"] == 1
         assert snap["c_total"]["series"][""] == 1
 
+    def test_reset_drops_instruments_and_recreates_fresh(self):
+        registry = obs_metrics.MetricsRegistry()
+        registry.counter("c_total", "").inc(5)
+        registry.reset()
+        assert registry.get("c_total") is None
+        assert registry.counter("c_total", "").value() == 0
+
+
+# ======================================================= histogram quantile
+class TestHistogramQuantile:
+    def _hist(self, values, buckets=(1.0, 2.0, 4.0)):
+        registry = obs_metrics.MetricsRegistry()
+        hist = registry.histogram("h_seconds", "", buckets=buckets)
+        for v in values:
+            hist.observe(v)
+        return hist
+
+    def test_golden_interpolation_within_winning_bucket(self):
+        # counts: le=1 → 1, le=2 → 1, le=4 → 1. q=0.5 → rank 1.5 lands
+        # in the (1, 2] bucket with prev-cum 1 → 1 + (2-1)*(0.5/1).
+        hist = self._hist([0.5, 1.5, 3.0])
+        assert hist.quantile(0.5) == pytest.approx(1.5)
+        # q=1 → rank 3 lands at the top of the (2, 4] bucket.
+        assert hist.quantile(1.0) == pytest.approx(4.0)
+        # Lowest bucket interpolates from 0: one sample, q=0.5 → 0.5.
+        assert self._hist([0.7]).quantile(0.5) == pytest.approx(0.5)
+
+    def test_uniform_fill_golden(self):
+        # 10 samples in (0, 1]: rank q*10 interpolates linearly from 0.
+        hist = self._hist([0.5] * 10, buckets=(1.0, 2.0))
+        assert hist.quantile(0.9) == pytest.approx(0.9)
+        assert hist.quantile(0.25) == pytest.approx(0.25)
+
+    def test_inf_bucket_clamps_to_largest_finite_bound(self):
+        hist = self._hist([0.5, 100.0, 200.0])
+        assert hist.quantile(0.99) == pytest.approx(4.0)
+        assert hist.quantile(1.0) == pytest.approx(4.0)
+
+    def test_empty_and_missing_series_are_none(self):
+        registry = obs_metrics.MetricsRegistry()
+        hist = registry.histogram("h", "", buckets=(1.0,))
+        assert hist.quantile(0.5) is None
+        labeled = registry.histogram("hl", "", ("op",), buckets=(1.0,))
+        assert labeled.quantile(0.5, op="never-observed") is None
+        assert labeled.quantile_max(0.5) is None
+
+    def test_labeled_series_and_quantile_max(self):
+        registry = obs_metrics.MetricsRegistry()
+        hist = registry.histogram("hl", "", ("op",), buckets=(1.0, 2.0, 4.0))
+        hist.observe(0.5, op="fast")
+        hist.observe(3.0, op="slow")
+        assert hist.quantile(1.0, op="fast") == pytest.approx(1.0)
+        assert hist.quantile(1.0, op="slow") == pytest.approx(4.0)
+        assert hist.quantile_max(1.0) == pytest.approx(4.0)
+
+    def test_out_of_range_q_raises(self):
+        with pytest.raises(ValueError):
+            self._hist([1.0]).quantile(1.5)
+
+
+# ======================================================== cardinality cap
+class TestCardinalityCap:
+    def test_overflow_folds_into_other_and_counts_drops(self):
+        registry = obs_metrics.MetricsRegistry()
+        counter = registry.counter("req_total", "", ("path",), max_series=3)
+        for i in range(6):
+            counter.inc(path=f"/p{i}")
+        snap = counter.snapshot()["series"]
+        assert len(snap) == 4  # 3 admitted + the `other` fold
+        assert snap[obs_metrics.OVERFLOW_LABEL] == 3
+        dropped = registry.get(obs_metrics.DROPPED_LABELS_METRIC)
+        assert dropped.value(metric="req_total") == 3
+        # Admitted series keep recording normally past the cap.
+        counter.inc(path="/p0")
+        assert counter.value(path="/p0") == 2
+
+    def test_gauge_and_histogram_fold_too(self):
+        registry = obs_metrics.MetricsRegistry()
+        gauge = registry.gauge("g", "", ("queue",), max_series=2)
+        for i in range(4):
+            gauge.set(i, queue=f"q{i}")
+        assert len(gauge.snapshot()["series"]) == 3
+        hist = registry.histogram("h", "", ("op",), buckets=(1.0,),
+                                  max_series=2)
+        for i in range(4):
+            hist.observe(0.5, op=f"op{i}")
+        series = hist.snapshot()["series"]
+        assert len(series) == 3
+        assert series[obs_metrics.OVERFLOW_LABEL]["count"] == 2
+        assert registry.get(obs_metrics.DROPPED_LABELS_METRIC).value(
+            metric="h") == 2
+
+    def test_capped_exposition_still_parses(self):
+        registry = obs_metrics.MetricsRegistry()
+        counter = registry.counter("req_total", "", ("path",), max_series=2)
+        for i in range(5):
+            counter.inc(path=f"/p{i}")
+        types, samples = parse_prometheus(registry.render())
+        assert types[obs_metrics.DROPPED_LABELS_METRIC] == "counter"
+        assert samples[
+            'req_total{path="%s"}' % obs_metrics.OVERFLOW_LABEL] == 3
+
 
 # ============================================================ timeline build
 class TestTimelineBuild:
@@ -251,6 +359,398 @@ class TestTimelineBuild:
     def test_empty_trace(self):
         timeline = obs_trace.build_timeline([], trace_id="t")
         assert timeline["spans"] == [] and timeline["span_count"] == 0
+
+    def test_same_start_siblings_tie_break_on_span_id(self):
+        """Deterministic ordering (ISSUE 6 small fix): same-millisecond
+        same-name siblings order by span_id regardless of record
+        (= sidecar sync) order, so golden report/timeline output is
+        stable across runs."""
+        root = self._span("root", "root", 1.0, 5.0)
+        twin_b = self._span("init", "bbbb", 2.0, 3.0, parent="root")
+        twin_a = self._span("init", "aaaa", 2.0, 3.0, parent="root")
+        for records in ([root, twin_b, twin_a], [twin_a, root, twin_b],
+                        [twin_b, twin_a, root]):
+            timeline = obs_trace.build_timeline(list(records))
+            children = timeline["spans"][0]["children"]
+            assert [c["span_id"] for c in children] == ["aaaa", "bbbb"]
+
+
+# ================================================================ alert rules
+class _FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+def _engine(rule_dicts, registry, clock=None):
+    rules = [obs_rules.Rule.from_dict(d) for d in rule_dicts]
+    return obs_rules.AlertEngine(rules, registry=registry,
+                                 clock=clock or _FakeClock())
+
+
+class TestRuleSchema:
+    def test_committed_default_ruleset_validates(self):
+        rules = obs_rules.check_ruleset()
+        ids = [r.id for r in rules]
+        assert "retry-storm" in ids
+        assert "scheduler-tick-p99" in ids
+        assert "step-time-regression" in ids
+        assert len(ids) == len(set(ids))
+
+    @pytest.mark.parametrize("bad,match", [
+        ({"rules": [{"id": "x", "kind": "nope", "metric": "m"}]}, "kind"),
+        ({"rules": [{"id": "x", "kind": "threshold",
+                     "metric": "polyaxon_runs", "value": 1,
+                     "for": "5 parsecs"}]}, "malformed for"),
+        ({"rules": [{"id": "x", "kind": "rate",
+                     "metric": "polyaxon_retry_attempts_total", "value": 1,
+                     "window": "soon"}]}, "malformed window"),
+        ({"rules": [{"id": "x", "kind": "threshold",
+                     "metric": "polyaxon_runs", "value": 1, "op": "!="}]},
+         "unknown op"),
+        ({"rules": [{"id": "x", "kind": "threshold",
+                     "metric": "polyaxon_runs"}]}, "exactly one"),
+        ({"rules": [{"id": "x", "kind": "slo_burn_rate",
+                     "metric": "polyaxon_scheduler_tick_seconds",
+                     "objective": 0.99}]}, "needs `le`"),
+    ])
+    def test_malformed_rules_raise(self, bad, match):
+        with pytest.raises(obs_rules.RuleError, match=match):
+            obs_rules.load_ruleset(bad)
+
+    def test_duplicate_ids_and_unknown_metrics_raise(self):
+        rule = {"id": "dup", "kind": "threshold",
+                "metric": "polyaxon_runs", "value": 1}
+        with pytest.raises(obs_rules.RuleError, match="duplicate"):
+            obs_rules.load_ruleset({"rules": [rule, dict(rule)]})
+        with pytest.raises(obs_rules.RuleError, match="unknown metric"):
+            obs_rules.load_ruleset({"rules": [
+                {"id": "x", "kind": "threshold",
+                 "metric": "polyaxon_typo_total", "value": 1}]})
+
+    def test_window_parser_goldens(self):
+        assert obs_rules.parse_window("250ms") == pytest.approx(0.25)
+        assert obs_rules.parse_window("30s") == 30.0
+        assert obs_rules.parse_window("5m") == 300.0
+        assert obs_rules.parse_window("1h") == 3600.0
+        assert obs_rules.parse_window(15) == 15.0
+        with pytest.raises(obs_rules.RuleError):
+            obs_rules.parse_window("-3s")
+
+
+class TestRuleLifecycle:
+    def test_threshold_fire_hysteresis_resolve(self):
+        """The full episode: breach → pending (`for` not served) →
+        firing → clear held `resolve_after` → resolved. A blip inside
+        either window changes nothing."""
+        registry = obs_metrics.MetricsRegistry()
+        gauge = registry.gauge("depth", "")
+        clock = _FakeClock()
+        engine = _engine([{"id": "sat", "kind": "threshold",
+                           "metric": "depth", "op": ">", "value": 10,
+                           "for": "5s", "resolve_after": "5s"}],
+                         registry, clock)
+        gauge.set(50)
+        assert engine.evaluate() == []  # pending, `for` not yet served
+        assert engine.active() == []
+        clock.now += 3
+        assert engine.evaluate() == []
+        clock.now += 3  # breach held 6s >= 5s
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired" and fired["rule"] == "sat"
+        assert engine.active()[0]["value"] == 50
+        # A clear blip shorter than resolve_after keeps it firing.
+        gauge.set(0)
+        clock.now += 2
+        assert engine.evaluate() == []
+        assert engine.active()
+        gauge.set(60)  # re-breach resets the clear clock
+        clock.now += 1
+        assert engine.evaluate() == []
+        gauge.set(0)
+        clock.now += 3
+        assert engine.evaluate() == []  # clear clock (re)starts here
+        clock.now += 3
+        assert engine.evaluate() == []  # clear held 3s < 5s
+        clock.now += 3
+        (resolved,) = engine.evaluate()
+        assert resolved["event"] == "resolved"
+        assert engine.active() == []
+        events = [e["event"] for e in engine.history]
+        assert events == ["fired", "resolved"]
+
+    def test_pending_blip_never_fires(self):
+        registry = obs_metrics.MetricsRegistry()
+        gauge = registry.gauge("depth", "")
+        clock = _FakeClock()
+        engine = _engine([{"id": "sat", "kind": "threshold",
+                           "metric": "depth", "op": ">", "value": 10,
+                           "for": "10s"}], registry, clock)
+        gauge.set(99)
+        engine.evaluate()
+        clock.now += 2
+        gauge.set(0)  # clears before `for` is served
+        engine.evaluate()
+        clock.now += 20
+        engine.evaluate()
+        assert list(engine.history) == []
+
+    def test_rate_rule_windows_a_counter(self):
+        registry = obs_metrics.MetricsRegistry()
+        counter = registry.counter("polyaxon_retry_attempts_total", "")
+        clock = _FakeClock()
+        engine = _engine([{"id": "storm", "kind": "rate",
+                           "metric": "polyaxon_retry_attempts_total",
+                           "window": "60s", "op": ">", "value": 0.2}],
+                         registry, clock)
+        engine.evaluate()  # baseline sample at value 0
+        clock.now += 10
+        counter.inc(5)  # 5 events / 10 s = 0.5/s > 0.2
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["value"] == pytest.approx(0.5)
+        # No further increments: the rate decays as the window slides
+        # past the burst, and the alert resolves.
+        clock.now += 120
+        engine.evaluate()
+        transitions = [e["event"] for e in engine.history]
+        assert transitions == ["fired", "resolved"]
+
+    def test_threshold_against_derived_value_step_regression(self):
+        """value_from: p99 > 3x p50 — the relative rule the default
+        step-time-regression alert uses."""
+        registry = obs_metrics.MetricsRegistry()
+        hist = registry.histogram("step_s", "", buckets=(0.1, 1.0, 10.0))
+        clock = _FakeClock()
+        engine = _engine([{"id": "reg", "kind": "threshold",
+                           "metric": "step_s", "quantile": 0.99, "op": ">",
+                           "value_from": {"quantile": 0.5, "factor": 3.0}}],
+                         registry, clock)
+        for _ in range(50):
+            hist.observe(0.05)  # tight distribution: p99 ≈ p50
+        assert engine.evaluate() == []
+        for _ in range(5):
+            hist.observe(9.0)  # a tail appears
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["value"] > fired["threshold"]
+
+    def test_slo_burn_rate_fires_on_budget_burn(self):
+        registry = obs_metrics.MetricsRegistry()
+        hist = registry.histogram("tick_s", "", buckets=(0.5, 1.0, 5.0))
+        clock = _FakeClock()
+        engine = _engine([{"id": "burn", "kind": "slo_burn_rate",
+                           "metric": "tick_s", "le": 1.0,
+                           "objective": 0.99, "window": "300s",
+                           "factor": 14.4}], registry, clock)
+        for _ in range(100):
+            hist.observe(0.1)
+        engine.evaluate()  # baseline window edge
+        clock.now += 30
+        for _ in range(50):
+            hist.observe(0.2)  # healthy traffic: no burn
+        assert engine.evaluate() == []
+        clock.now += 30
+        for _ in range(20):
+            hist.observe(3.0)  # 20 breaches / 20 obs = 100x allowed 1%
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["value"] > 14.4
+
+    def test_slo_le_must_match_a_bucket_bound(self):
+        registry = obs_metrics.MetricsRegistry()
+        registry.histogram("tick_s", "", buckets=(0.5, 1.0)).observe(0.1)
+        engine = _engine([{"id": "burn", "kind": "slo_burn_rate",
+                           "metric": "tick_s", "le": 0.7,
+                           "objective": 0.99, "window": "60s"}],
+                         registry, _FakeClock())
+        engine.evaluate()
+        engine.evaluate()
+        assert engine.active() == []  # no matching bucket → no data
+
+    def test_missing_metric_is_not_a_breach(self):
+        engine = _engine([{"id": "x", "kind": "threshold",
+                           "metric": "never_registered", "op": ">",
+                           "value": 0}],
+                         obs_metrics.MetricsRegistry(), _FakeClock())
+        assert engine.evaluate() == []
+        assert engine.active() == []
+
+
+# ============================================================ flight recorder
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_lru_evicts_runs(self):
+        recorder = obs_flight.FlightRecorder(
+            ring=8, max_runs=2, registry=obs_metrics.MetricsRegistry())
+        for i in range(100):
+            recorder.record_trace("run-a", {"type": "span", "name": f"s{i}"})
+        with recorder._lock:
+            ring = list(recorder._runs["run-a"]["ring"])
+        assert len(ring) == 8
+        assert ring[-1]["name"] == "s99"  # newest kept, oldest evicted
+        recorder.record_trace("run-b", {"type": "span", "name": "b"})
+        recorder.record_trace("run-c", {"type": "span", "name": "c"})
+        assert recorder.tracked_runs() == ["run-b", "run-c"]  # a evicted
+
+    def test_dump_writes_ring_deltas_and_log_tails(self, tmp_path):
+        registry = obs_metrics.MetricsRegistry()
+        recorder = obs_flight.FlightRecorder(ring=16, registry=registry)
+        counter = registry.counter("polyaxon_retry_attempts_total", "")
+        hist = registry.histogram("polyaxon_training_step_seconds", "",
+                                  buckets=(1.0,))
+        counter.inc(3)  # pre-run noise: must NOT appear in the deltas
+        recorder.mark_start("run-x")
+        counter.inc(2)
+        hist.observe(0.5)
+        recorder.record_trace("run-x", {
+            "type": "span", "name": "runtime", "status": "error",
+            "error": "ChaosKill: boom", "duration_ms": 12.0,
+            "events": [{"name": "chaos.gang", "time": 1.0}],
+            "ignored_field": "dropped"})
+        recorder.note("run-x", "metrics", step=4, loss=2.5)
+        run_dir = tmp_path / "run-x"
+        (run_dir / "logs").mkdir(parents=True)
+        (run_dir / "logs" / "main-0.log").write_text(
+            "\n".join(f"line {i}" for i in range(200)))
+        path = recorder.dump("run-x", str(run_dir), status="failed",
+                             reason="ProcessFailed", message="exit code 1")
+        assert path == str(run_dir / "postmortem.json")
+        with open(path) as fh:
+            pm = json.load(fh)
+        assert pm["status"] == "failed" and pm["reason"] == "ProcessFailed"
+        kinds = [(e.get("type"), e.get("name")) for e in pm["ring"]]
+        assert ("span", "runtime") in kinds and ("note", "metrics") in kinds
+        span = next(e for e in pm["ring"] if e.get("name") == "runtime")
+        assert span["error"] == "ChaosKill: boom"
+        assert "ignored_field" not in span
+        deltas = pm["metric_deltas"]
+        assert deltas["absolute"] is False
+        assert deltas["deltas"]["polyaxon_retry_attempts_total"][
+            "series"][""] == 2  # the pre-mark 3 is baseline, not delta
+        assert deltas["deltas"]["polyaxon_training_step_seconds"][
+            "series"][""]["count"] == 1
+        tail = pm["logs"]["main-0.log"]
+        assert len(tail) == obs_flight.LOG_TAIL_LINES
+        assert tail[-1] == "line 199"
+
+    def test_dump_without_baseline_is_flagged_absolute(self, tmp_path):
+        recorder = obs_flight.FlightRecorder(
+            registry=obs_metrics.MetricsRegistry())
+        recorder.note("run-y", "hello")
+        path = recorder.dump("run-y", str(tmp_path), status="failed")
+        with open(path) as fh:
+            assert json.load(fh)["metric_deltas"]["absolute"] is True
+
+    def test_discard_frees_the_ring(self):
+        recorder = obs_flight.FlightRecorder(
+            registry=obs_metrics.MetricsRegistry())
+        recorder.note("run-z", "x")
+        recorder.discard("run-z")
+        assert recorder.tracked_runs() == []
+
+    def test_tracer_write_feeds_the_global_recorder(self, tmp_path):
+        obs_flight.RECORDER.discard("trace-tap")
+        tracer = obs_trace.RunTracer(str(tmp_path), "trace-tap")
+        with tracer.span("phase"):
+            pass
+        tracer.close()
+        assert "trace-tap" in obs_flight.RECORDER.tracked_runs()
+        with obs_flight.RECORDER._lock:
+            ring = list(obs_flight.RECORDER._runs["trace-tap"]["ring"])
+        assert ring and ring[-1]["name"] == "phase"
+        obs_flight.RECORDER.discard("trace-tap")
+
+
+# ======================================================== report (unit)
+class TestReportUnit:
+    def _timeline(self):
+        def span(name, sid, start, end, parent=None, attrs=None,
+                 events=None):
+            return {"type": "span", "name": name, "span_id": sid,
+                    "parent_id": parent, "trace_id": "r", "start": start,
+                    "end": end, "duration_ms": (end - start) * 1e3,
+                    "status": "ok", "attributes": attrs or {},
+                    "events": events or []}
+
+        records = [
+            span("compile", "c", 0.0, 0.1),
+            span("execute", "x", 0.5, 10.0),
+            span("init", "i", 0.5, 1.0, parent="x",
+                 events=[{"name": "chaos.store", "time": 0.6},
+                         {"name": "retry", "time": 0.7}]),
+            span("runtime", "r", 1.0, 10.0, parent="x"),
+            span("jit_compile", "j", 1.0, 3.0, parent="r"),
+            span("restore", "re", 3.0, 3.5, parent="r"),
+        ]
+        t = 3.5
+        for k in range(6):
+            step_ms = 900.0 if k != 4 else 4000.0  # window 4 spikes
+            dur = 1.0 if k != 4 else 1.0
+            records.append(span(
+                "step", f"s{k}", t, t + dur, parent="r",
+                attrs={"from_step": k * 2, "to_step": k * 2 + 1, "steps": 2,
+                       "step_time_ms": step_ms, "input_wait_ms": 100.0}))
+            t += dur
+        records.append(span("checkpoint", "k", t, t + 0.4, parent="r"))
+        records.append(span("sync", "sy", 10.2, 10.5))
+        records.append({"type": "event", "name": "requeue", "time": 0.4,
+                        "parent_id": None,
+                        "attributes": {"reason": "RestartPolicy"}})
+        return obs_trace.build_timeline(records, trace_id="r")
+
+    def test_phase_decomposition_sums_to_wall(self):
+        report = obs_analyze.analyze_timeline(self._timeline())
+        assert report["run_uuid"] == "r"
+        phases = report["phases"]
+        assert phases["compile"]["ms"] == pytest.approx(100.0)
+        assert phases["jit_compile"]["ms"] == pytest.approx(2000.0)
+        assert phases["restore"]["ms"] == pytest.approx(500.0)
+        assert phases["init"]["ms"] == pytest.approx(500.0)
+        assert phases["checkpoint"]["ms"] == pytest.approx(400.0)
+        assert phases["sync"]["ms"] == pytest.approx(300.0)
+        # 6 step windows x (1000ms span - 200ms input wait).
+        assert phases["step"]["ms"] == pytest.approx(4800.0)
+        assert phases["input_wait"]["ms"] == pytest.approx(1200.0)
+        assert phases["queue_wait"]["ms"] == pytest.approx(400.0)
+        # Containers are frames, not phases.
+        assert "execute" not in phases and "runtime" not in phases
+        wall = report["wall_clock_ms"]
+        assert abs(report["phase_sum_ms"] - wall) / wall < 0.10
+        fractions = [p["fraction"] for p in phases.values()]
+        assert all(f is not None and 0 <= f <= 1 for f in fractions)
+
+    def test_step_trend_flags_the_spike(self):
+        report = obs_analyze.analyze_timeline(self._timeline())
+        steps = report["steps"]
+        assert len(steps["windows"]) == 6
+        assert steps["rolling_median_ms"] == pytest.approx(900.0)
+        (anom,) = steps["anomalies"]
+        assert anom["to_step"] == 9  # the spiked window
+        assert anom["step_time_ms"] == pytest.approx(4000.0)
+        assert anom["deviation_sigmas"] > 3.5
+
+    def test_annotations_counted_per_phase(self):
+        report = obs_analyze.analyze_timeline(self._timeline())
+        notes = report["annotations"]
+        assert notes["retries"] == {"init": 1}
+        assert notes["chaos"] == {"init": 1}
+        assert notes["requeues"] == {"RestartPolicy": 1}
+
+    def test_empty_timeline_reports_cleanly(self):
+        report = obs_analyze.analyze_timeline(
+            obs_trace.build_timeline([], trace_id="r"))
+        assert report["wall_clock_ms"] == 0.0
+        assert report["attempts"] == 0
+        assert report["steps"]["anomalies"] == []
+
+    def test_compact_report_shape(self):
+        compact = obs_analyze.compact_report(
+            obs_analyze.analyze_timeline(self._timeline()))
+        assert compact["anomalous_windows"] == 1
+        assert compact["phases_ms"]["step"] > 0
+        json.dumps(compact)  # bench's JSON-line contract
 
 
 # =============================================================== e2e timeline
@@ -445,6 +945,78 @@ class TestPrometheusScrape:
         assert samples['polyaxon_tpu_info{version="%s"}' % __version__] == 1
 
 
+# ============================================================ e2e report
+class TestE2EReport:
+    def test_report_phases_sum_to_wall_clock(self, e2e):
+        """Acceptance: the jaxjob's attribution report decomposes the
+        wall clock into phases that sum to within 10% of it, with real
+        jit_compile / step / checkpoint / sync content."""
+        plane, uuid, _ = e2e
+        report = plane.report(uuid)
+        assert report["run_uuid"] == uuid
+        assert report["status"] == "succeeded"
+        assert report["attempts"] == 1
+        wall = report["wall_clock_ms"]
+        assert wall > 0
+        assert abs(report["phase_sum_ms"] - wall) / wall < 0.10
+        phases = report["phases"]
+        for name in ("compile", "jit_compile", "step", "checkpoint",
+                     "sync"):
+            assert name in phases and phases[name]["ms"] > 0, name
+        assert report["steps"]["windows"]
+        for window in report["steps"]["windows"]:
+            assert window["step_time_ms"] > 0
+            assert "input_wait_ms" in window
+
+    def test_report_endpoint_and_unknown_run_404(self, e2e):
+        plane, uuid, _ = e2e
+        from polyaxon_tpu.api.server import ApiServer
+
+        with ApiServer(plane) as server:
+            url = f"{server.url}/api/v1/default/default/runs/{uuid}/report"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["run_uuid"] == uuid
+            assert payload["phases"]["step"]["ms"] > 0
+            bad = f"{server.url}/api/v1/default/default/runs/nope/report"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(bad, timeout=10)
+            assert err.value.code == 404
+
+    def test_cli_report_renders_and_json_roundtrips(self, e2e, monkeypatch):
+        plane, uuid, _ = e2e
+        from click.testing import CliRunner
+
+        import polyaxon_tpu.cli.main as cli_main
+
+        monkeypatch.setattr(cli_main, "get_plane", lambda: plane)
+        result = CliRunner().invoke(cli_main.cli,
+                                    ["ops", "report", "-uid", uuid])
+        assert result.exit_code == 0, result.output
+        for marker in ("jit_compile", "step", "checkpoint", "wall="):
+            assert marker in result.output, marker
+        as_json = CliRunner().invoke(
+            cli_main.cli, ["ops", "report", "-uid", uuid, "--json"])
+        assert as_json.exit_code == 0
+        assert json.loads(as_json.output)["run_uuid"] == uuid
+
+    def test_alerts_endpoint_and_dashboard_panel(self, e2e):
+        plane, _, _ = e2e
+        from polyaxon_tpu.api.server import ApiServer
+        from polyaxon_tpu.api.ui import DASHBOARD_HTML
+
+        with ApiServer(plane) as server:
+            with urllib.request.urlopen(server.url + "/api/v1/alerts",
+                                        timeout=10) as resp:
+                payload = json.loads(resp.read())
+        rule_ids = {r["rule"] for r in payload["rules"]}
+        assert {"retry-storm", "scheduler-tick-p99",
+                "step-time-regression"} <= rule_ids
+        assert isinstance(payload["alerts"], list)
+        for marker in ("alertsPanel", "loadAlerts", "/api/v1/alerts"):
+            assert marker in DASHBOARD_HTML, marker
+
+
 # ============================================================== chaos drill
 class TestChaosDrillTimeline:
     def test_drill_reads_as_an_annotated_timeline(self, tmp_path):
@@ -528,4 +1100,120 @@ class TestChaosDrillTimeline:
         assert obs_metrics.requeues_total().value(
             reason="RestartPolicy") >= 1
         assert obs_metrics.retry_attempts().value() >= 1
+        assert final.retries == 1
+
+
+# ================================================= gauntlet acceptance (AC)
+class TestGauntletClosesTheLoop:
+    """ISSUE 6 acceptance: ONE chaos-gauntlet run (store fault + gang
+    kill + restart) must leave (a) a postmortem.json for the killed
+    attempt, (b) a fired-then-resolved retry-storm alert visible via
+    GET /api/v1/alerts, and (c) a report whose phase decomposition sums
+    to within 10% of the run's wall clock."""
+
+    @pytest.fixture(autouse=True)
+    def _engine_guard(self):
+        yield
+        obs_rules.set_default_engine(None)
+
+    def test_postmortem_alert_and_report(self, tmp_path):
+        from polyaxon_tpu.fs import get_store
+
+        # The committed DEFAULT ruleset on an offset-injectable clock:
+        # the gauntlet runs in real time (the storm fires there), then
+        # the offset fast-forwards past the rate window so resolution
+        # is asserted without waiting out 60 real seconds.
+        offset = [0.0]
+        engine = obs_rules.AlertEngine(
+            obs_rules.load_ruleset(),
+            clock=lambda: time.time() + offset[0])
+        obs_rules.set_default_engine(engine)
+
+        seed_store = get_store("memory://obs-loop")
+        seed_store.write_bytes("vocab.txt", b"tokens")
+        chaos.install(chaos.ChaosPlan.from_dict({"seed": 7, "faults": [
+            {"seam": "store", "op": "*", "at": 1, "times": 1},
+            {"seam": "gang", "op": "kill",
+             "config": {"min_checkpoints": 1}},
+        ]}))
+        plane = ControlPlane(str(tmp_path / "home"))
+        record = plane.submit({
+            "kind": "operation",
+            "termination": {"maxRetries": 2},
+            "component": {
+                "name": "obs-loop",
+                "run": {
+                    "kind": "jaxjob",
+                    "numProcesses": 1,
+                    "environment": {"restartPolicy": "on_failure"},
+                    "init": [{"artifacts": {"path": "memory://obs-loop"}}],
+                    "mesh": {"axes": {"dp": 8}},
+                    "checkpointing": {"enabled": True, "intervalSteps": 2,
+                                      "asyncSave": False,
+                                      "restoreOnStart": True},
+                    "runtime": {"model": "llama_tiny",
+                                "dataset": "lm_synthetic", "steps": 5,
+                                "seq_len": 32, "global_batch_size": 8,
+                                "log_every": 2},
+                },
+            },
+        })
+        agent = Agent(plane, in_process=True)
+        final = drive(agent, plane, record.uuid,
+                      lambda r: r.status == V1Statuses.SUCCEEDED)
+        assert chaos.active_plan().done
+        run_dir = plane.run_artifacts_dir(record.uuid)
+
+        # (a) The killed attempt left its black box, and the final
+        # SUCCEEDED reap did not delete it.
+        postmortem = obs_flight.read_postmortem(run_dir)
+        assert postmortem is not None
+        assert postmortem["status"] == "failed"
+        assert postmortem["run_uuid"] == record.uuid
+        assert postmortem["ring"], "flight ring must not be empty"
+        dead_runtime = [e for e in postmortem["ring"]
+                        if e.get("name") == "runtime"
+                        and e.get("status") == "error"]
+        assert dead_runtime and "ChaosKill" in dead_runtime[0]["error"]
+        deltas = postmortem["metric_deltas"]
+        assert deltas["absolute"] is False  # gang-start baseline held
+        assert deltas["deltas"], "something moved while the gang lived"
+        assert "ChaosKill" in "\n".join(
+            postmortem["logs"].get("main-0.log", []))
+
+        # (b) The retry-storm alert fired DURING the gauntlet and was
+        # attributed to the run (condition + meta stamp)...
+        assert ("retry-storm", "fired") in [
+            (e["rule"], e["event"]) for e in engine.history]
+        fresh = plane.get_run(record.uuid)
+        assert any(a["rule"] in ("retry-storm", "requeue-storm")
+                   for a in (fresh.meta or {}).get("alerts") or [])
+        reasons = [c.get("reason") for c in plane.get_statuses(record.uuid)]
+        assert "AlertFiring" in reasons
+        # ...and resolves once the window slides past the burst.
+        offset[0] = 600.0
+        engine.evaluate(plane=plane)
+        from polyaxon_tpu.api.server import ApiServer
+
+        with ApiServer(plane) as server:
+            with urllib.request.urlopen(server.url + "/api/v1/alerts",
+                                        timeout=10) as resp:
+                payload = json.loads(resp.read())
+        episodes = [(e["rule"], e["event"]) for e in payload["history"]]
+        assert ("retry-storm", "fired") in episodes
+        assert ("retry-storm", "resolved") in episodes
+        assert all(a["rule"] != "retry-storm" for a in payload["alerts"])
+
+        # (c) The attribution report: two attempts, phases summing to
+        # the wall clock, faults counted against the phase they hit.
+        report = plane.report(record.uuid)
+        assert report["attempts"] == 2
+        wall = report["wall_clock_ms"]
+        assert wall > 0
+        assert abs(report["phase_sum_ms"] - wall) / wall < 0.10
+        assert report["phases"]["requeue_wait"]["ms"] > 0
+        assert report["annotations"]["retries"].get("init", 0) >= 1
+        assert "runtime" in report["annotations"]["chaos"]
+        assert report["annotations"]["requeues"] == {"RestartPolicy": 1}
+        assert report["alerts"], "the fired alert rides the report"
         assert final.retries == 1
