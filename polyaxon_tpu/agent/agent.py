@@ -232,10 +232,12 @@ class Agent:
             for uuid in self.slices.tracked_runs():
                 if uuid not in active and self.plane.get_run(uuid).is_done:
                     self.slices.release(uuid)
-        queued = [
-            r for r in self.plane.list_runs(statuses=[V1Statuses.QUEUED])
-            if r.kind not in _PIPELINE_KINDS
-        ]
+        # Kind filter pushed into SQL (ISSUE 8): at 10k queued trials a
+        # Python-side filter would deserialize every record per tick.
+        queued = self.plane.list_runs(
+            statuses=[V1Statuses.QUEUED],
+            exclude_kinds=sorted(str(k) for k in _PIPELINE_KINDS),
+            limit=100000)
         capacity = max(self.max_concurrent - len(self.executor.active_runs), 0)
         t_admission = time.time()
         decision = self.admission.plan(

@@ -104,14 +104,27 @@ def _trigger_satisfied(policy: str, statuses: list[V1Statuses]) -> Optional[bool
     return None
 
 
+# Parent kinds the pipeline pass advances; matches the agent's skip set.
+_PIPELINE_KINDS = ("dag", "matrix", "schedule")
+
+
 class Scheduler:
-    def __init__(self, plane: ControlPlane):
+    def __init__(self, plane: ControlPlane, *, legacy_scan: bool = False):
         self.plane = plane
         self.store = plane.store
         # FAILED runs that will never restart (no policy / no plan):
         # remembered so the failed pass stays O(new failures) per tick
         # instead of re-parsing every historical failure's spec.
         self._no_restart: set[str] = set()
+        # Per-tick memo of pipeline-children lookups: a DAG/tuner tick
+        # touches the same child list from several passes; within one
+        # tick the store is only mutated by THIS thread, so the memo is
+        # exact as long as every submit/stop path invalidates it.
+        self._children_cache: dict[str, list[RunRecord]] = {}
+        # Bench hook (sim --deopt / the measured A/B): replay the
+        # pre-ISSUE-8 six-scans-per-tick behavior, uncached and
+        # unbatched, so the budget gate has a "before" it can fail.
+        self.legacy_scan = legacy_scan
 
     # ------------------------------------------------------------------ tick
     def tick(self) -> int:
@@ -136,44 +149,103 @@ class Scheduler:
             # progress must be recoverable by the next tick (ticks are
             # pure functions of store state).
             return 0
+        if self.legacy_scan:
+            return self._tick_legacy()
+        self._children_cache.clear()
+        # All of this tick's writes land in ONE commit: N transitions
+        # cost one WAL fsync. Ticks stay idempotent, so a crash that
+        # rolls the batch back just means the next tick redoes it.
+        with self.store.transaction():
+            return self._tick_fast()
+
+    def _tick_fast(self) -> int:
+        """One status-partitioned scan instead of six full-status
+        queries (sized by the fleet sim — sim/fleet_curve.json): the
+        QUEUED/RUNNING partition is kind-filtered AT THE SQL LAYER, so a
+        10k-deep backlog of plain jobs contributes zero rows here, and
+        lazy RunRecords defer each row's JSON until a pass touches it.
+        FAILED is swept via a key-only uuid projection diffed against
+        ``_no_restart`` — O(new failures), not O(every failure ever)."""
+        snapshot = self.store.scan_runs([
+            ([V1Statuses.CREATED, V1Statuses.PREEMPTED,
+              V1Statuses.RETRYING], None),
+            ([V1Statuses.QUEUED, V1Statuses.RUNNING], _PIPELINE_KINDS),
+        ])
+        actions = 0
+        compiled_pipelines: list[str] = []
+        for record in snapshot[V1Statuses.CREATED]:
+            actions += self._tick_created(record, compiled_pipelines)
+        pipelines = snapshot[V1Statuses.QUEUED] + snapshot[V1Statuses.RUNNING]
+        if compiled_pipelines:
+            # A pipeline compiled THIS tick is already QUEUED but missed
+            # the snapshot above — fold it in so a fresh DAG/tuner still
+            # fans out on the tick that compiled it (scan-era behavior).
+            pipelines += [r for r in self.store.get_runs(compiled_pipelines)
+                          if r.status in (V1Statuses.QUEUED,
+                                          V1Statuses.RUNNING)]
+        for record in pipelines:
+            actions += self._tick_pipeline(record)
+        for record in snapshot[V1Statuses.PREEMPTED]:
+            actions += self._tick_preempted(record)
+        failed_fresh = [u for u in self.store.list_run_uuids(
+            statuses=[V1Statuses.FAILED]) if u not in self._no_restart]
+        for record in self.store.get_runs(failed_fresh):
+            actions += self._tick_failed(record)
+        for record in snapshot[V1Statuses.RETRYING]:
+            actions += self._tick_retrying(record)
+        return actions
+
+    def _tick_created(self, record: RunRecord,
+                      compiled_pipelines: list[str]) -> int:
+        verdict = self._events_satisfied(record)
+        if verdict is None:
+            return 0  # still waiting on referenced run events
+        if verdict is False:
+            self.store.transition(
+                record.uuid, V1Statuses.UPSTREAM_FAILED,
+                reason="EventNeverFires",
+                message="referenced run finished without the awaited event")
+            return 1
+        try:
+            self.plane.compile_run(record.uuid)
+            if record.kind in _PIPELINE_KINDS:
+                compiled_pipelines.append(record.uuid)
+        except Exception as exc:
+            # A bad spec (dangling connection, invalid topology...)
+            # fails that run; it must not kill the scheduler loop.
+            self.store.transition(
+                record.uuid, V1Statuses.FAILED,
+                reason="CompilationError", message=str(exc)[:500])
+        return 1
+
+    def _tick_pipeline(self, record: RunRecord) -> int:
+        try:
+            if record.kind == "matrix":
+                return self._tick_matrix(record)
+            if record.kind == V1RunKind.DAG:
+                return self._tick_dag(record)
+            if record.kind == "schedule":
+                return self._tick_schedule(record)
+        except Exception as exc:
+            # A bad spec (invalid cron, broken matrix...) fails that
+            # pipeline; it must never kill the reconcile loop.
+            self.store.transition(
+                record.uuid, V1Statuses.FAILED,
+                reason="PipelineError", message=str(exc)[:500])
+            return 1
+        return 0
+
+    def _tick_legacy(self) -> int:
+        """Pre-ISSUE-8 tick: six separate full-status scans, every row
+        eagerly fetched, one commit per transition. Kept as the sim's
+        ``--deopt`` baseline and the measured A/B's 'before' side."""
         actions = 0
         for record in self.store.list_runs(statuses=[V1Statuses.CREATED]):
-            if record.kind == V1RunKind.DAG and record.pipeline_uuid:
-                pass  # nested dags compile like any pipeline
-            verdict = self._events_satisfied(record)
-            if verdict is None:
-                continue  # still waiting on referenced run events
-            if verdict is False:
-                self.store.transition(
-                    record.uuid, V1Statuses.UPSTREAM_FAILED,
-                    reason="EventNeverFires",
-                    message="referenced run finished without the awaited event")
-                actions += 1
-                continue
-            try:
-                self.plane.compile_run(record.uuid)
-            except Exception as exc:
-                # A bad spec (dangling connection, invalid topology...)
-                # fails that run; it must not kill the scheduler loop.
-                self.store.transition(
-                    record.uuid, V1Statuses.FAILED,
-                    reason="CompilationError", message=str(exc)[:500])
-            actions += 1
-        for record in self.store.list_runs(statuses=[V1Statuses.QUEUED, V1Statuses.RUNNING]):
-            try:
-                if record.kind == "matrix":
-                    actions += self._tick_matrix(record)
-                elif record.kind == V1RunKind.DAG:
-                    actions += self._tick_dag(record)
-                elif record.kind == "schedule":
-                    actions += self._tick_schedule(record)
-            except Exception as exc:
-                # A bad spec (invalid cron, broken matrix...) fails that
-                # pipeline; it must never kill the reconcile loop.
-                self.store.transition(
-                    record.uuid, V1Statuses.FAILED,
-                    reason="PipelineError", message=str(exc)[:500])
-                actions += 1
+            actions += self._tick_created(record, [])
+        for record in self.store.list_runs(
+                statuses=[V1Statuses.QUEUED, V1Statuses.RUNNING]):
+            if record.kind in _PIPELINE_KINDS:
+                actions += self._tick_pipeline(record)
         for record in self.store.list_runs(statuses=[V1Statuses.PREEMPTED]):
             actions += self._tick_preempted(record)
         for record in self.store.list_runs(statuses=[V1Statuses.FAILED]):
@@ -181,6 +253,25 @@ class Scheduler:
         for record in self.store.list_runs(statuses=[V1Statuses.RETRYING]):
             actions += self._tick_retrying(record)
         return actions
+
+    # ------------------------------------------------- children memoization
+    def _children(self, pipeline_uuid: str) -> list[RunRecord]:
+        """Pipeline-children lookup, memoized for the current tick (the
+        DAG/tuner passes re-list the same pipeline's children up to
+        three times per tick). Every same-tick mutation path —
+        ``_spawn_trial``, the DAG/schedule submits, early-stop — must
+        call ``_invalidate_children``. Legacy mode bypasses the memo."""
+        if self.legacy_scan:
+            return self.store.list_runs(pipeline_uuid=pipeline_uuid)
+        cached = self._children_cache.get(pipeline_uuid)
+        if cached is None:
+            cached = self.store.list_runs(pipeline_uuid=pipeline_uuid)
+            self._children_cache[pipeline_uuid] = cached
+        return cached
+
+    def _invalidate_children(self, pipeline_uuid: Optional[str]) -> None:
+        if pipeline_uuid:
+            self._children_cache.pop(pipeline_uuid, None)
 
     # -------------------------------------------------------------- events
     def _events_satisfied(self, record: RunRecord) -> Optional[bool]:
@@ -412,7 +503,7 @@ class Scheduler:
     def _tick_dag(self, record: RunRecord) -> int:
         op = get_operation(record.spec)
         dag = op.component.run
-        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        children = self._children(record.uuid)
         by_name = {c.name: c for c in children}
         actions = 0
 
@@ -452,16 +543,18 @@ class Scheduler:
                     V1Statuses.SKIPPED if skip else V1Statuses.UPSTREAM_FAILED,
                     reason="UpstreamTrigger", force=True,
                 )
+                self._invalidate_children(record.uuid)
                 actions += 1
                 continue
             self.plane.submit(
                 op=child_op, project=record.project, name=cname,
                 pipeline_uuid=record.uuid, parent_uuid=record.uuid,
             )
+            self._invalidate_children(record.uuid)
             actions += 1
 
         # Pipeline completion: every declared op exists and is done.
-        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        children = self._children(record.uuid)
         declared = len(dag.operations)
         if len(children) == declared and all(c.is_done for c in children):
             failed = any(c.status in (V1Statuses.FAILED, V1Statuses.UPSTREAM_FAILED)
@@ -541,7 +634,7 @@ class Scheduler:
             or (max_runs is not None and fired >= max_runs)
             or (end_at is not None and next_at > as_utc(end_at))
         )
-        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        children = self._children(record.uuid)
         if exhausted:
             if all(c.is_done for c in children):
                 self.store.transition(record.uuid, V1Statuses.SUCCEEDED,
@@ -565,6 +658,7 @@ class Scheduler:
             pipeline_uuid=record.uuid, parent_uuid=record.uuid,
             iteration=fired,
         )
+        self._invalidate_children(record.uuid)
         state.update({"fired": fired + 1, "last_fire": next_at.isoformat()})
         meta["schedule"] = state
         self.store.update_run(record.uuid, meta=meta)
@@ -595,7 +689,7 @@ class Scheduler:
         meta = {"trial_params": params, "trial_index": index}
         if extra_meta:
             meta.update(extra_meta)
-        return self.plane.submit(
+        child = self.plane.submit(
             op=child_spec,
             project=record.project,
             name=f"{record.name or 'matrix'}-{index}",
@@ -604,13 +698,15 @@ class Scheduler:
             iteration=iteration,
             meta=meta,
         )
+        self._invalidate_children(record.uuid)
+        return child
 
     def _tick_matrix(self, record: RunRecord) -> int:
         op = get_operation(record.spec)
         matrix = op.matrix
         meta = dict(record.meta or {})
         tuner: dict[str, Any] = meta.get("tuner") or {}
-        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        children = self._children(record.uuid)
         actions = 0
 
         if record.status == V1Statuses.QUEUED:
@@ -668,6 +764,7 @@ class Scheduler:
             for child in children:
                 if not child.is_done:
                     self.plane.stop(child.uuid)
+            self._invalidate_children(record.uuid)
         # Drain phase: wait for every child, then finish.
         if not all(c.is_done for c in children):
             return 0
@@ -751,7 +848,7 @@ class Scheduler:
         tuner["pending"] = pending
         meta["tuner"] = tuner
         self.store.update_run(record.uuid, meta=meta)
-        children = self.store.list_runs(pipeline_uuid=record.uuid)
+        children = self._children(record.uuid)
         actions += self._finish_if_done(record, children, tuner.get("total", 0))
         return actions
 
@@ -808,7 +905,7 @@ class Scheduler:
                           "bracket_index": next_index})
             return self._spawn_rung(record, op, manager, tuner, meta, bracket, rung)
 
-        all_children = self.store.list_runs(pipeline_uuid=record.uuid)
+        all_children = self._children(record.uuid)
         any_ok = any(c.status == V1Statuses.SUCCEEDED for c in all_children)
         self.store.transition(
             record.uuid,

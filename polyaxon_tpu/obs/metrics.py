@@ -427,6 +427,32 @@ def store_op_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
         ("op", "scheme"))
 
 
+# sqlite statements live in the µs–ms range; the default latency layout
+# would collapse the whole control-plane story into its first bucket.
+_RUNSTORE_BUCKETS = (0.00001, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                     0.0025, 0.005, 0.01, 0.025, 0.1, 0.5, 2.5)
+
+
+def runstore_op_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_runstore_op_seconds",
+        "Control-plane run-store (sqlite) statement latency by SQL verb",
+        ("op",), buckets=_RUNSTORE_BUCKETS)
+
+
+def admission_pass_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_admission_pass_seconds",
+        "Admission controller plan() pass duration")
+
+
+def admission_divergence(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_admission_live_divergence_total",
+        "Incremental admission live-view entries that disagreed with a "
+        "periodic full rebuild (anything nonzero is a delta-feed bug)")
+
+
 def training_step_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
     return registry.histogram(
         "polyaxon_training_step_seconds",
@@ -454,6 +480,9 @@ def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     requeues_total(registry)
     retry_attempts(registry)
     store_op_hist(registry)
+    runstore_op_hist(registry)
+    admission_pass_hist(registry)
+    admission_divergence(registry)
     training_step_hist(registry)
 
 

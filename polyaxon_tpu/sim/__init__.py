@@ -1,0 +1,20 @@
+"""Fleet-scale control-plane simulator (ISSUE 8).
+
+Drives the REAL ``Scheduler`` + ``AdmissionController`` + ``Store``
+(nothing under test is mocked) through arrival traces composed from the
+workloads the repo already supports — tune sweeps, cron/interval
+schedules, DAG pipelines, serving deploys, restart/backoff churn,
+preemption storms — with only the executor/slice layer replaced by a
+synthetic agent whose placement, run-duration, and failure behavior is
+configurable and seeded.
+
+Outputs the committed ``fleet_curve.json`` (tick latency and store cost
+vs load) gated by ``budgets.json`` in CI, exactly like the PR 4
+collective audit. See docs/scheduling.md § "Fleet-scale simulation".
+"""
+
+from polyaxon_tpu.sim.executor import SyntheticExecutor
+from polyaxon_tpu.sim.fleet import FleetSim
+from polyaxon_tpu.sim.traces import TraceEvent, make_trace
+
+__all__ = ["SyntheticExecutor", "FleetSim", "TraceEvent", "make_trace"]

@@ -1,0 +1,116 @@
+"""CLI for the fleet simulator (mirrors ``python -m polyaxon_tpu.perf``).
+
+Modes:
+  python -m polyaxon_tpu.sim --quick --check     # CI gate (seconds)
+  python -m polyaxon_tpu.sim --full              # full curve (minutes)
+  python -m polyaxon_tpu.sim --update-budgets    # lock in a new baseline
+  python -m polyaxon_tpu.sim --quick --deopt     # must FAIL the gate
+  python -m polyaxon_tpu.sim --trace quick       # replay a whole trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m polyaxon_tpu.sim")
+    parser.add_argument("--quick", action="store_true",
+                        help="quick load points (CI profile)")
+    parser.add_argument("--full", action="store_true",
+                        help="full load points incl. the 10k-queued one")
+    parser.add_argument("--check", action="store_true",
+                        help="gate the measured curve against budgets.json")
+    parser.add_argument("--update-budgets", action="store_true",
+                        help="rewrite budgets.json from this run")
+    parser.add_argument("--write-curve", action="store_true",
+                        help="rewrite the committed fleet_curve.json")
+    parser.add_argument("--deopt", action="store_true",
+                        help="de-indexed/de-batched/legacy baseline "
+                             "(should fail --check)")
+    parser.add_argument("--trace", choices=["quick", "day"],
+                        help="replay a whole arrival trace instead of "
+                             "load points; asserts zero admission "
+                             "divergence")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", dest="json_out",
+                        help="write the result JSON to this path "
+                             "('' = stdout only)")
+    args = parser.parse_args(argv)
+
+    from polyaxon_tpu.sim import budgets as sim_budgets
+    from polyaxon_tpu.sim import curve as sim_curve
+
+    if args.trace:
+        from polyaxon_tpu.sim.fleet import FleetSim
+        from polyaxon_tpu.sim.traces import make_trace
+
+        sim = FleetSim(capacity=1000 if args.trace == "day" else 16,
+                       seed=args.seed, legacy_scan=args.deopt,
+                       incremental=not args.deopt, deopt=args.deopt,
+                       rebuild_ticks=25)
+        try:
+            report = sim.run_trace(
+                make_trace(args.trace, seed=args.seed),
+                max_wall=1800.0 if args.trace == "day" else 120.0)
+        finally:
+            sim.close()
+        print(json.dumps(report, indent=2))
+        if args.json_out:
+            with open(args.json_out, "w") as fh:
+                json.dump(report, fh, indent=2)
+        if report["divergence_total"]:
+            print(f"FAIL: admission live-view diverged "
+                  f"{report['divergence_total']} times", file=sys.stderr)
+            return 1
+        if not report["rebuild_checks"] and not args.deopt:
+            print("FAIL: no rebuild consistency checks ran",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    mode = "full" if args.full else "quick"
+    curve = sim_curve.build_curve(
+        mode, seed=args.seed, legacy=args.deopt, deopt=args.deopt,
+        progress=lambda msg: print(f"[sim] {msg}", file=sys.stderr))
+    print(json.dumps(curve, indent=2))
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(curve, fh, indent=2)
+
+    if args.update_budgets:
+        if args.deopt:
+            print("refusing to write budgets from a --deopt run",
+                  file=sys.stderr)
+            return 2
+        # Budget BOTH modes off one command: the quick table gates CI,
+        # the full table gates bench_controlplane full runs.
+        curves = {mode: curve}
+        other = "quick" if mode == "full" else "full"
+        curves[other] = sim_curve.build_curve(
+            other, seed=args.seed,
+            progress=lambda msg: print(f"[sim:{other}] {msg}",
+                                       file=sys.stderr))
+        path = sim_budgets.write_budgets(
+            curves, meta={"seed": args.seed})
+        print(f"budgets written: {path}", file=sys.stderr)
+    if args.write_curve:
+        path = sim_budgets.write_curve(curve)
+        print(f"curve written: {path}", file=sys.stderr)
+
+    if args.check:
+        budgets = sim_budgets.load_budgets()
+        violations = sim_budgets.check_curve(curve, budgets, mode)
+        for v in violations:
+            print(f"BUDGET VIOLATION: {v}", file=sys.stderr)
+        if violations:
+            return 1
+        print(f"fleet curve within budget ({mode}, "
+              f"{len(curve['points'])} points)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

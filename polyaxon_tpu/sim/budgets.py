@@ -1,0 +1,112 @@
+"""Fleet-curve budgets — the control-plane CI regression gate.
+
+``budgets.json`` (checked in next to this module, PR 4 pattern) holds
+per-mode, per-load-point ceilings for the fleet curve:
+
+- ``max_queries_per_tick_p50`` / ``max_rows_per_tick_p50`` — the load-
+  bearing gates. Steady-state queued points issue a DETERMINISTIC
+  number of store queries per tick (single-pass scan + incremental
+  admission ⇒ no O(depth) re-reads), so a refactor that reintroduces
+  per-status scans or per-pass live rebuilds fails CI on count, not on
+  flaky latency.
+- ``max_tick_p99_ms`` — a generous wall-clock ceiling that rides
+  along to catch order-of-magnitude regressions the counts can't see.
+
+A point present in the budget but missing from the curve is itself a
+violation (new load points must be budgeted the PR they land).
+Regenerate after an INTENTIONAL change: ``python -m polyaxon_tpu.sim
+--update-budgets``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+DEFAULT_BUDGET_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
+DEFAULT_CURVE_PATH = os.path.join(os.path.dirname(__file__),
+                                  "fleet_curve.json")
+
+# budget key -> curve key it bounds
+_LIMIT_KEYS = {
+    "max_queries_per_tick_p50": "queries_per_tick_p50",
+    "max_rows_per_tick_p50": "rows_per_tick_p50",
+    "max_tick_p99_ms": "tick_p99_ms",
+}
+
+
+def load_budgets(path: Optional[str] = None) -> dict:
+    with open(path or DEFAULT_BUDGET_PATH) as fh:
+        return json.load(fh)
+
+
+def derive_limits(point: dict) -> dict:
+    """Ceilings from a measured healthy point: tight on counts (the
+    deterministic signal), loose on latency (the flaky one). Dynamic
+    (storm) points churn, so their counts are load-dependent — they
+    gate on latency only, with extra headroom."""
+    if point.get("dynamic"):
+        return {
+            "max_tick_p99_ms": round(
+                max(point["tick_p99_ms"] * 6.0, 100.0), 1),
+        }
+    return {
+        "max_queries_per_tick_p50": point["queries_per_tick_p50"] + 2,
+        "max_rows_per_tick_p50": int(point["rows_per_tick_p50"] * 1.25) + 60,
+        "max_tick_p99_ms": round(max(point["tick_p99_ms"] * 4.0, 50.0), 1),
+    }
+
+
+def write_budgets(curves: dict[str, dict], path: Optional[str] = None,
+                  meta: Optional[dict] = None) -> str:
+    """``curves``: mode -> curve dict (from ``curve.build_curve``)."""
+    out: dict = {"_meta": dict(meta or {})}
+    for mode, curve in curves.items():
+        out[mode] = {name: derive_limits(point)
+                     for name, point in curve["points"].items()}
+    path = path or DEFAULT_BUDGET_PATH
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def check_curve(curve: dict, budgets: dict, mode: str) -> list[str]:
+    """Violations of one curve against the budget table (empty = pass)."""
+    table = budgets.get(mode)
+    if table is None:
+        return [f"no budget table for mode `{mode}`"]
+    violations = []
+    points = curve.get("points", {})
+    for name, limits in table.items():
+        point = points.get(name)
+        if point is None:
+            violations.append(
+                f"{mode}/{name}: load point missing from curve")
+            continue
+        for limit_key, curve_key in _LIMIT_KEYS.items():
+            if limit_key not in limits:
+                continue
+            measured = point.get(curve_key)
+            if measured is None:
+                violations.append(
+                    f"{mode}/{name}: curve lacks `{curve_key}`")
+            elif measured > limits[limit_key]:
+                violations.append(
+                    f"{mode}/{name}: {curve_key}={measured} exceeds "
+                    f"budget {limits[limit_key]}")
+    return violations
+
+
+def write_curve(curve: dict, path: Optional[str] = None) -> str:
+    path = path or DEFAULT_CURVE_PATH
+    with open(path, "w") as fh:
+        json.dump(curve, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_curve(path: Optional[str] = None) -> dict:
+    with open(path or DEFAULT_CURVE_PATH) as fh:
+        return json.load(fh)

@@ -1,0 +1,135 @@
+"""Standard load points → the committed ``fleet_curve.json``.
+
+Each point builds a fresh throwaway fleet at a fixed load (queue depth
+/ live-run churn), measures steady-state reconcile ticks with a clean
+metrics registry, and reports tick latency plus the store's query/row
+cost per tick. Points are ordered idle → storm so the curve reads as
+"where does the control plane knee over".
+
+Queued points run with ``capacity=0``: no starts or reaps mutate the
+fleet during the window, so the per-tick query count is DETERMINISTIC
+— which is what lets ``budgets.json`` gate on it in CI without latency
+flake (the latency ceilings ride along with generous margins).
+"""
+
+from __future__ import annotations
+
+import time
+
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.sim import traces
+from polyaxon_tpu.sim.fleet import FleetSim
+
+# name -> point spec. ``queued``: backlog of compiled QUEUED jobs.
+# ``storm``: live fleet + backlog + a 50% preemption wave mid-window.
+POINT_SPECS: dict[str, list[tuple[str, dict]]] = {
+    "full": [
+        ("idle", {"queued": 0, "ticks": 50}),
+        ("queued_100", {"queued": 100, "ticks": 40}),
+        ("queued_1k", {"queued": 1000, "ticks": 30}),
+        ("queued_10k", {"queued": 10000, "ticks": 15}),
+        ("storm", {"storm": True, "capacity": 256, "live": 256,
+                   "backlog": 2000, "ticks": 40}),
+    ],
+    "quick": [
+        ("idle", {"queued": 0, "ticks": 30}),
+        ("queued_50", {"queued": 50, "ticks": 20}),
+        ("queued_200", {"queued": 200, "ticks": 15}),
+        ("storm", {"storm": True, "capacity": 16, "live": 16,
+                   "backlog": 60, "ticks": 25}),
+    ],
+}
+
+
+def _registry_tail(point: dict) -> None:
+    """Fold the registry's store/admission latency view into the point."""
+    reg = obs_metrics.REGISTRY
+    store_hist = reg.get("polyaxon_runstore_op_seconds")
+    adm_hist = reg.get("polyaxon_admission_pass_seconds")
+    tick_hist = reg.get("polyaxon_scheduler_tick_seconds")
+    if store_hist is not None:
+        p99 = store_hist.quantile_max(0.99)
+        point["store_op_p99_ms"] = round((p99 or 0.0) * 1e3, 4)
+    if adm_hist is not None:
+        p99 = adm_hist.quantile(0.99)
+        if p99 is not None:
+            point["admission_p99_ms"] = round(p99 * 1e3, 3)
+    if tick_hist is not None:
+        p99 = tick_hist.quantile(0.99)
+        if p99 is not None:
+            point["sched_tick_p99_ms"] = round(p99 * 1e3, 3)
+
+
+def build_point(name: str, spec: dict, *, seed: int = 0,
+                legacy: bool = False, deopt: bool = False,
+                snapshot: bool = False) -> dict:
+    obs_metrics.REGISTRY.reset()
+    obs_metrics.ensure_core_metrics()
+    storm = spec.get("storm", False)
+    capacity = spec.get("capacity", 64) if storm else 0
+    sim = FleetSim(capacity=capacity, seed=seed,
+                   incremental=not legacy, legacy_scan=legacy,
+                   deopt=deopt,
+                   mean_duration=0.4 if storm else 0.05,
+                   failure_rate=0.05 if storm else 0.0)
+    try:
+        # Storm points churn (starts/reaps land in the measured ticks),
+        # so their store counts are load-dependent — the budget writer
+        # gates them on latency only (see budgets.derive_limits).
+        point: dict = {"load": name, "dynamic": bool(storm)}
+        if storm:
+            live = spec.get("live", capacity)
+            backlog = spec.get("backlog", 0)
+            sim.submit_queued_jobs(live)
+            deadline = time.monotonic() + 30
+            while (len(sim.executor.active_runs) < min(live, capacity)
+                   and time.monotonic() < deadline):
+                sim.tick()
+            sim.submit_queued_jobs(backlog)
+            # The wave: evict half the fleet, then measure the churn.
+            for uuid in sim.executor.active_runs[::2]:
+                sim.executor.preempt(uuid)
+            point["live"] = len(sim.executor.active_runs)
+            point["queued"] = backlog
+        else:
+            sim.submit_queued_jobs(spec.get("queued", 0))
+            point["live"] = 0
+            point["queued"] = spec.get("queued", 0)
+        sim.measure_ticks(spec.get("ticks", 20))
+        point.update(sim.tick_report())
+        _registry_tail(point)
+        if snapshot:
+            snap = obs_metrics.REGISTRY.snapshot()
+            point["registry"] = {
+                k: v for k, v in snap.items()
+                if k.startswith(("polyaxon_scheduler", "polyaxon_admission",
+                                 "polyaxon_runstore", "polyaxon_queue"))}
+        return point
+    finally:
+        sim.close()
+
+
+def build_curve(mode: str = "quick", *, seed: int = 0,
+                legacy: bool = False, deopt: bool = False,
+                snapshot: bool = False,
+                progress=None) -> dict:
+    points = {}
+    for name, spec in POINT_SPECS[mode]:
+        if progress:
+            progress(f"point {name} ...")
+        points[name] = build_point(name, spec, seed=seed, legacy=legacy,
+                                   deopt=deopt, snapshot=snapshot)
+        if progress:
+            progress(f"point {name}: tick p99 "
+                     f"{points[name]['tick_p99_ms']}ms, "
+                     f"{points[name]['queries_per_tick_p50']} queries/tick")
+    return {
+        "_meta": {
+            "mode": mode,
+            "seed": seed,
+            "legacy": legacy,
+            "deopt": deopt,
+            "points": [n for n, _ in POINT_SPECS[mode]],
+        },
+        "points": points,
+    }

@@ -1,0 +1,118 @@
+"""Synthetic slice executor: the ONLY component the simulator fakes.
+
+Mirrors ``agent.executor.LocalExecutor``'s store contract exactly —
+``start`` walks QUEUED → SCHEDULED → STARTING → RUNNING, ``poll`` reaps
+due gangs with the same STOPPING > preempted > exit-status precedence,
+``preempt`` marks a slice eviction — but a "gang" is just a sampled
+finish deadline and outcome, so a 1k-slice fleet runs in one process
+with zero subprocess/IO cost and every store interaction the scheduler
+sees is the real one.
+
+Determinism: all sampling comes from a seeded ``random.Random``;
+durations/failures are configurable per-instance so traces can model
+serving long-runs next to subsecond churn jobs.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import time
+
+from polyaxon_tpu.lifecycle import V1Statuses
+
+
+class SyntheticExecutor:
+    """Drop-in for ``LocalExecutor`` in the agent reconcile loop."""
+
+    def __init__(self, plane, *, mean_duration: float = 0.05,
+                 duration_jitter: float = 0.5, failure_rate: float = 0.0,
+                 seed: int = 0):
+        self.plane = plane
+        self.store = plane.store
+        self.mean_duration = mean_duration
+        self.duration_jitter = duration_jitter
+        self.failure_rate = failure_rate
+        self.rng = random.Random(seed)
+        # uuid -> [deadline, outcome, stopping, preempted]
+        self._gangs: dict[str, list] = {}
+        self._heap: list[tuple[float, str]] = []  # (deadline, uuid)
+        self.started_total = 0
+        self.reaped_total = 0
+
+    # ------------------------------------------------------------ sampling
+    def _sample_duration(self, record) -> float:
+        # Serving deploys (long-lived) are tagged by the trace generator;
+        # everything else jitters around the configured mean.
+        hint = (record.meta or {}).get("sim_duration")
+        if hint is not None:
+            return float(hint)
+        jitter = 1.0 + self.duration_jitter * (2 * self.rng.random() - 1.0)
+        return max(0.001, self.mean_duration * jitter)
+
+    def _sample_outcome(self, record) -> V1Statuses:
+        rate = (record.meta or {}).get("sim_failure_rate",
+                                       self.failure_rate)
+        if self.rng.random() < float(rate):
+            return V1Statuses.FAILED
+        return V1Statuses.SUCCEEDED
+
+    # ------------------------------------------------------- executor API
+    def start(self, run_uuid: str) -> bool:
+        record = self.store.get_run(run_uuid)
+        with self.store.transaction():
+            self.store.transition(run_uuid, V1Statuses.SCHEDULED)
+            self.store.transition(run_uuid, V1Statuses.STARTING)
+            self.store.transition(run_uuid, V1Statuses.RUNNING)
+        deadline = time.monotonic() + self._sample_duration(record)
+        self._gangs[run_uuid] = [deadline, self._sample_outcome(record),
+                                 False, False]
+        heapq.heappush(self._heap, (deadline, run_uuid))
+        self.started_total += 1
+        return True
+
+    def poll(self) -> int:
+        now = time.monotonic()
+        actions = 0
+        while self._heap and self._heap[0][0] <= now:
+            _, run_uuid = heapq.heappop(self._heap)
+            gang = self._gangs.pop(run_uuid, None)
+            if gang is None:
+                continue  # stale heap entry (stopped/preempted earlier)
+            deadline, outcome, stopping, preempted = gang
+            record = self.store.get_run(run_uuid)
+            if stopping or record.status == V1Statuses.STOPPING:
+                self.store.transition(run_uuid, V1Statuses.STOPPED)
+            elif preempted:
+                self.store.transition(
+                    run_uuid, V1Statuses.PREEMPTED,
+                    reason="SlicePreempted", force=True)
+            else:
+                self.store.transition(
+                    run_uuid, outcome,
+                    reason=("Completed" if outcome == V1Statuses.SUCCEEDED
+                            else "ProcessFailed"),
+                    message=(None if outcome == V1Statuses.SUCCEEDED
+                             else "synthetic exit 1"))
+            actions += 1
+            self.reaped_total += 1
+        return actions
+
+    def stop(self, run_uuid: str) -> None:
+        gang = self._gangs.get(run_uuid)
+        if gang is None:
+            return
+        gang[2] = True
+        heapq.heappush(self._heap, (0.0, run_uuid))  # reap next poll
+
+    def preempt(self, run_uuid: str) -> bool:
+        gang = self._gangs.get(run_uuid)
+        if gang is None:
+            return False
+        gang[3] = True
+        heapq.heappush(self._heap, (0.0, run_uuid))
+        return True
+
+    @property
+    def active_runs(self) -> list[str]:
+        return list(self._gangs)

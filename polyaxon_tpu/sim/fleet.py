@@ -1,0 +1,225 @@
+"""The fleet replayer: real control plane, synthetic slices, one loop.
+
+``FleetSim`` owns a throwaway ``ControlPlane`` home, a real ``Agent``
+whose executor is the ``SyntheticExecutor``, and the catalog (queues,
+tenant quotas) every trace assumes. It replays a trace in compressed
+wall time, measures every reconcile tick (wall seconds + store query /
+row deltas from ``Store.stats``), and exposes the same numbers the
+budget gate and bench entry point consume.
+
+Nothing under test is mocked: scheduler ticks, admission passes, and
+every store access are the production code paths.
+"""
+
+from __future__ import annotations
+
+import shutil
+import statistics
+import tempfile
+import time
+
+from polyaxon_tpu.agent import Agent
+from polyaxon_tpu.controlplane import ControlPlane
+from polyaxon_tpu.controlplane.scheduler import Scheduler
+from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.scheduling import AdmissionController
+from polyaxon_tpu.sim import traces
+from polyaxon_tpu.sim.executor import SyntheticExecutor
+
+# Synthetic workload meta hints (read by SyntheticExecutor).
+_SERVING_DURATION = 30.0  # deploys hold capacity ~forever at sim scale
+_CHURN_FAILURE_RATE = 0.7
+
+
+def percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+class FleetSim:
+    def __init__(self, home: str | None = None, *, capacity: int = 64,
+                 seed: int = 0, incremental: bool = True,
+                 legacy_scan: bool = False, deopt: bool = False,
+                 mean_duration: float = 0.05, failure_rate: float = 0.02,
+                 rebuild_ticks: int = 50):
+        self._tmp = None
+        if home is None:
+            self._tmp = tempfile.mkdtemp(prefix="polyaxon-sim-")
+            home = self._tmp
+        self.plane = ControlPlane(home)
+        self.store = self.plane.store
+        self.executor = SyntheticExecutor(
+            self.plane, mean_duration=mean_duration,
+            failure_rate=failure_rate, seed=seed)
+        self.admission = AdmissionController(
+            self.plane, incremental=incremental,
+            rebuild_ticks=rebuild_ticks)
+        self.agent = Agent(self.plane, executor=self.executor,
+                           max_concurrent=capacity,
+                           admission=self.admission)
+        self.agent.scheduler = Scheduler(self.plane,
+                                         legacy_scan=legacy_scan)
+        if deopt:
+            # The "what CI must catch" baseline: hot index dropped,
+            # same-tick write batching off (store), six-scan scheduler,
+            # full-rebuild admission — à la PR 4's --inject-reshard.
+            self.store.deoptimize()
+        for q in traces.QUEUES:
+            self.plane.upsert_queue(q["name"], priority=q["priority"],
+                                    preemptible=q["preemptible"])
+        weights = {"platform": 2.0, "research": 1.0, "serving": 4.0,
+                   "growth": 1.0}
+        for project, weight in weights.items():
+            self.plane.set_quota(project, weight=weight)
+        self._depth_gauge = obs_metrics.REGISTRY.gauge(
+            "polyaxon_queue_depth", "Queued runs per queue", ("queue",))
+        # Per-tick measurements (parallel lists).
+        self.tick_seconds: list[float] = []
+        self.tick_queries: list[int] = []
+        self.tick_rows: list[int] = []
+        self.submitted_total = 0
+
+    # ------------------------------------------------------------ submit
+    def _submit_event(self, event: traces.TraceEvent) -> None:
+        if event.kind == "storm":
+            fraction = float((event.payload or {}).get("fraction", 0.5))
+            active = self.executor.active_runs
+            for uuid in active[: int(len(active) * fraction)]:
+                self.executor.preempt(uuid)
+            return
+        record = self.plane.submit(event.spec, project=event.project)
+        hints = {}
+        if event.kind == "serving":
+            hints["sim_duration"] = _SERVING_DURATION
+        elif event.kind == "churn":
+            hints["sim_failure_rate"] = _CHURN_FAILURE_RATE
+        if hints:
+            meta = dict(record.meta or {})
+            meta.update(hints)
+            self.store.update_run(record.uuid, meta=meta)
+        self.submitted_total += 1
+
+    def submit_queued_jobs(self, n: int, *, compile: bool = True) -> None:
+        """Load-point setup: ``n`` compiled QUEUED jobs, batched writes."""
+        rng_queues = ("batch", "best-effort", None)
+        uuids = []
+        for i in range(n):
+            with self.store.transaction():
+                record = self.plane.submit(
+                    traces.job_op(queue=rng_queues[i % 3]),
+                    project=traces.PROJECTS[i % len(traces.PROJECTS)])
+                uuids.append(record.uuid)
+                if compile:
+                    self.plane.compile_run(record.uuid)
+
+    # -------------------------------------------------------------- tick
+    def tick(self) -> None:
+        """One measured reconcile pass (the real ``Agent`` loop)."""
+        stats = self.store.stats
+        q0, r0 = stats["queries"], stats["rows"]
+        t0 = time.perf_counter()
+        self.agent.reconcile_once()
+        self.tick_seconds.append(time.perf_counter() - t0)
+        self.tick_queries.append(stats["queries"] - q0)
+        self.tick_rows.append(stats["rows"] - r0)
+        self._depth_gauge.set(
+            self.store.count_runs(statuses=[V1Statuses.QUEUED]),
+            queue="fleet")
+
+    def reset_measurements(self) -> None:
+        self.tick_seconds.clear()
+        self.tick_queries.clear()
+        self.tick_rows.clear()
+
+    def tick_report(self) -> dict:
+        """Aggregate the measurement window into the curve-point shape."""
+        return {
+            "ticks": len(self.tick_seconds),
+            "tick_p50_ms": round(
+                percentile(self.tick_seconds, 0.50) * 1e3, 3),
+            "tick_p99_ms": round(
+                percentile(self.tick_seconds, 0.99) * 1e3, 3),
+            "queries_per_tick_p50": int(
+                statistics.median(self.tick_queries)
+                if self.tick_queries else 0),
+            "queries_per_tick_max": max(self.tick_queries, default=0),
+            "rows_per_tick_p50": int(
+                statistics.median(self.tick_rows)
+                if self.tick_rows else 0),
+            "rows_per_tick_max": max(self.tick_rows, default=0),
+        }
+
+    # ------------------------------------------------------------- replay
+    def run_trace(self, events: list[traces.TraceEvent], *,
+                  max_wall: float = 600.0, drain: bool = True) -> dict:
+        """Replay a trace in compressed wall time, then drain.
+
+        Each loop iteration submits every event whose offset has come
+        due and runs one measured tick — so a burst of arrivals lands
+        inside a single tick exactly like a real agent under a thundering
+        herd, and tick latency reflects it.
+        """
+        start = time.monotonic()
+        idx = 0
+        while True:
+            now = time.monotonic() - start
+            while idx < len(events) and events[idx].at <= now:
+                self._submit_event(events[idx])
+                idx += 1
+            self.tick()
+            if idx >= len(events):
+                if not drain:
+                    break
+                if self.idle():
+                    break
+            if time.monotonic() - start > max_wall:
+                break
+        return {
+            "events": idx,
+            "submitted": self.submitted_total,
+            "started": self.executor.started_total,
+            "reaped": self.executor.reaped_total,
+            "wall_seconds": round(time.monotonic() - start, 3),
+            "divergence_total": self.admission.divergence_total,
+            "rebuild_checks": self.admission.rebuild_checks,
+            **self.tick_report(),
+        }
+
+    def idle(self) -> bool:
+        """Fleet fully drained: nothing schedulable, nothing live."""
+        if self.executor.active_runs:
+            return False
+        pending = self.store.count_runs(statuses=[
+            V1Statuses.CREATED, V1Statuses.QUEUED, V1Statuses.SCHEDULED,
+            V1Statuses.STARTING, V1Statuses.RUNNING, V1Statuses.STOPPING,
+            V1Statuses.PREEMPTED, V1Statuses.RETRYING])
+        return pending == 0
+
+    def measure_ticks(self, n: int) -> dict:
+        """Measure ``n`` steady-state reconcile ticks (no arrivals)."""
+        self.reset_measurements()
+        for _ in range(n):
+            self.tick()
+        return self.tick_report()
+
+    def measure_scheduler_ticks(self, n: int) -> dict:
+        """Measure the scheduler tick ALONE (the ISSUE 8 A/B unit):
+        isolates the six-scan vs single-pass cost from admission."""
+        samples = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            self.agent.scheduler.tick()
+            samples.append(time.perf_counter() - t0)
+        return {
+            "ticks": n,
+            "sched_tick_p50_ms": round(percentile(samples, 0.50) * 1e3, 3),
+            "sched_tick_p99_ms": round(percentile(samples, 0.99) * 1e3, 3),
+        }
+
+    def close(self) -> None:
+        if self._tmp:
+            shutil.rmtree(self._tmp, ignore_errors=True)

@@ -1,0 +1,171 @@
+"""Arrival traces: seeded, compressed-time fleet workload generators.
+
+A trace is a flat list of ``TraceEvent``s — (offset seconds, workload
+kind, op spec / action payload) — composing the workloads the repo
+already supports, in the proportions a real fleet day mixes them:
+
+- ``job``      — plain one-gang training jobs across tenant projects
+- ``sweep``    — tune sweeps (``matrix`` mapping → trial fan-out)
+- ``dag``      — multi-step pipelines (chain + diamond shapes)
+- ``schedule`` — interval schedules firing child runs all day
+- ``serving``  — long-lived deploys that sit on capacity
+- ``churn``    — jobs with ``restartPolicy: onFailure`` and a high
+                 synthetic failure rate → restart/backoff churn
+- ``storm``    — a preemption storm action: evict a fraction of the
+                 executor's active gangs at that instant
+
+Events carry *specs*, not store handles — the replayer (fleet.py)
+submits through the real ``ControlPlane``. All randomness comes from
+one seeded ``random.Random`` so a trace is reproducible byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+PROJECTS = ("platform", "research", "serving", "growth")
+QUEUES = ({"name": "prod", "priority": 10, "preemptible": False},
+          {"name": "batch", "priority": 0, "preemptible": True},
+          {"name": "best-effort", "priority": -10, "preemptible": True})
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    at: float  # seconds from trace start (compressed time)
+    kind: str  # job | sweep | dag | schedule | serving | churn | storm
+    spec: dict | None = None  # operation spec for submit kinds
+    project: str = "platform"
+    payload: dict | None = None  # non-submit actions (storm fraction, ...)
+
+
+def _job_run(*, sleep: float = 0.01, restart: bool = False) -> dict:
+    env = {"restartPolicy": "onFailure"} if restart else {}
+    return {
+        "kind": "job",
+        **({"environment": env} if env else {}),
+        "container": {"command": [
+            "python", "-c", f"import time; time.sleep({sleep})"]},
+    }
+
+
+def job_op(*, queue: str | None = None, priority_class: str | None = None,
+           restart: bool = False, name: str | None = None) -> dict:
+    run = _job_run(restart=restart)
+    if priority_class:
+        run.setdefault("environment", {})["priorityClassName"] = priority_class
+    spec = {"kind": "operation", "component": {"run": run}}
+    if queue:
+        spec["queue"] = queue
+    if name:
+        spec["name"] = name
+    return spec
+
+
+def sweep_op(n_trials: int, *, queue: str | None = None) -> dict:
+    spec = {
+        "kind": "operation",
+        "matrix": {"kind": "mapping",
+                   "values": [{"lr": round(0.01 * (i + 1), 4)}
+                              for i in range(n_trials)]},
+        "component": {
+            "inputs": [{"name": "lr", "type": "float", "toEnv": "LR"}],
+            "run": _job_run(),
+        },
+    }
+    if queue:
+        spec["queue"] = queue
+    return spec
+
+
+def dag_op(shape: str = "chain") -> dict:
+    step = {"run": _job_run()}
+    if shape == "diamond":
+        ops = [
+            {"name": "a", "component": dict(step)},
+            {"name": "b", "dependencies": ["a"], "component": dict(step)},
+            {"name": "c", "dependencies": ["a"], "component": dict(step)},
+            {"name": "d", "dependencies": ["b", "c"], "component": dict(step)},
+        ]
+    else:
+        ops = [
+            {"name": "a", "component": dict(step)},
+            {"name": "b", "dependencies": ["a"], "component": dict(step)},
+            {"name": "c", "dependencies": ["b"], "component": dict(step)},
+        ]
+    return {"kind": "operation",
+            "component": {"run": {"kind": "dag", "operations": ops}}}
+
+
+def schedule_op(*, frequency: int, max_runs: int) -> dict:
+    return {
+        "kind": "operation",
+        "schedule": {"kind": "interval", "frequency": frequency,
+                     "maxRuns": max_runs},
+        "component": {"run": _job_run()},
+    }
+
+
+def serving_op(*, queue: str = "prod") -> dict:
+    # Long-lived deploy: the synthetic executor reads the duration hint
+    # stamped into meta by the replayer (see FleetSim._submit_event).
+    return job_op(queue=queue, priority_class="high", name="deploy")
+
+
+def make_trace(profile: str = "quick", *, seed: int = 0) -> list[TraceEvent]:
+    """Build a seeded arrival trace.
+
+    ``quick``: a few hundred runs over ~8s of compressed time — the CI
+    gate and smoke-test profile. ``day``: ~100k runs (counting sweep
+    trials and schedule fires) over a compressed day — the full-curve
+    profile bench_controlplane runs.
+    """
+    rng = random.Random(seed)
+    if profile == "quick":
+        horizon, jobs, sweeps, dags, serving, churn = 8.0, 120, 6, 4, 3, 30
+        sweep_width, storm_times = 8, (4.0,)
+        schedules = [(2, 3)]  # (frequency s, max_runs)
+    elif profile == "day":
+        # ~86400 fleet-seconds compressed into ~180s wall: ≈90k trial
+        # runs via sweeps + ~6k directs; sized for the 100k-run day.
+        horizon, jobs, sweeps, dags, serving, churn = 180.0, 4000, 180, 120, 40, 1500
+        sweep_width, storm_times = 500, (60.0, 120.0)
+        schedules = [(5, 30)] * 8
+    else:
+        raise ValueError(f"unknown trace profile {profile!r}")
+
+    events: list[TraceEvent] = []
+
+    def t() -> float:
+        return rng.uniform(0, horizon)
+
+    def project() -> str:
+        return rng.choice(PROJECTS)
+
+    for _ in range(jobs):
+        queue = rng.choice(("batch", "best-effort", None))
+        events.append(TraceEvent(t(), "job", job_op(queue=queue),
+                                 project()))
+    for _ in range(sweeps):
+        events.append(TraceEvent(t(), "sweep",
+                                 sweep_op(sweep_width, queue="batch"),
+                                 project()))
+    for _ in range(dags):
+        shape = rng.choice(("chain", "diamond"))
+        events.append(TraceEvent(t(), "dag", dag_op(shape), project()))
+    for freq, max_runs in schedules:
+        events.append(TraceEvent(0.0, "schedule",
+                                 schedule_op(frequency=freq,
+                                             max_runs=max_runs),
+                                 project()))
+    for _ in range(serving):
+        events.append(TraceEvent(t() * 0.3, "serving", serving_op(),
+                                 "serving"))
+    for _ in range(churn):
+        events.append(TraceEvent(t(), "churn",
+                                 job_op(queue="best-effort", restart=True),
+                                 project()))
+    for at in storm_times:
+        events.append(TraceEvent(at, "storm", None, payload={"fraction": 0.5}))
+    events.sort(key=lambda e: e.at)
+    return events

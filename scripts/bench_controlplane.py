@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Control-plane bench entry point (ISSUE 8).
+
+Wraps the fleet simulator (``polyaxon_tpu.sim``) the way
+``perf_sweep.py`` wraps the communication audit: build the standard
+load-point curve with a per-point metrics-registry snapshot, gate it
+against ``polyaxon_tpu/sim/budgets.json``, and optionally run the
+before/after A/B the PR description quotes:
+
+  # the CI-shaped run (quick points, registry snapshots, budget gate)
+  python scripts/bench_controlplane.py --check
+
+  # full curve incl. the 10k-queued point, refresh committed artifact
+  python scripts/bench_controlplane.py --mode full --write-curve
+
+  # measured A/B: legacy six-scan+rebuild vs single-pass+incremental
+  python scripts/bench_controlplane.py --ab
+
+  # whole compressed day, asserts zero admission divergence
+  python scripts/bench_controlplane.py --day
+
+The A/B measures the *scheduler tick* at the 10k-queued point (the
+ISSUE 8 acceptance unit) and the *admission pass* at 1k queued — the
+legacy admission ranker is O(n² log n) and takes minutes per pass at
+10k, which is itself the headline finding: the old control plane could
+not have survived a 10k-deep queue at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from polyaxon_tpu.sim import budgets as sim_budgets  # noqa: E402
+from polyaxon_tpu.sim import curve as sim_curve  # noqa: E402
+from polyaxon_tpu.sim.fleet import FleetSim  # noqa: E402
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr)
+
+
+def run_ab(seed: int = 0) -> dict:
+    """Before/after at the acceptance load points."""
+    from polyaxon_tpu.obs import metrics as obs_metrics
+
+    report: dict = {}
+
+    # Scheduler tick at 10k queued: legacy six-scan vs single pass.
+    for label, legacy in (("legacy", True), ("optimized", False)):
+        obs_metrics.REGISTRY.reset()
+        sim = FleetSim(capacity=0, seed=seed, legacy_scan=legacy,
+                       incremental=True)
+        try:
+            _log(f"A/B sched_tick_10k/{label}: loading 10k queued runs ...")
+            sim.submit_queued_jobs(10000)
+            report[f"sched_tick_10k_{label}"] = (
+                sim.measure_scheduler_ticks(10))
+            _log(f"A/B sched_tick_10k/{label}: "
+                 f"{report[f'sched_tick_10k_{label}']}")
+        finally:
+            sim.close()
+
+    # Full reconcile tick at 10k queued (optimized admission only: the
+    # legacy ranker cannot finish a 10k pass in CI-compatible time).
+    obs_metrics.REGISTRY.reset()
+    sim = FleetSim(capacity=0, seed=seed)
+    try:
+        _log("A/B reconcile_10k/optimized: loading 10k queued runs ...")
+        sim.submit_queued_jobs(10000)
+        report["reconcile_10k_optimized"] = sim.measure_ticks(10)
+    finally:
+        sim.close()
+
+    # Admission pass at 1k queued: legacy full-rebuild+re-sort ranker
+    # vs incremental grouped ranker.
+    for label, incremental in (("legacy", False), ("optimized", True)):
+        obs_metrics.REGISTRY.reset()
+        sim = FleetSim(capacity=0, seed=seed, incremental=incremental)
+        try:
+            _log(f"A/B admission_1k/{label}: loading 1k queued runs ...")
+            sim.submit_queued_jobs(1000)
+            report[f"admission_1k_{label}"] = sim.measure_ticks(5)
+            _log(f"A/B admission_1k/{label}: "
+                 f"tick p50 {report[f'admission_1k_{label}']['tick_p50_ms']}ms")
+        finally:
+            sim.close()
+
+    s_leg = report["sched_tick_10k_legacy"]["sched_tick_p50_ms"]
+    s_opt = report["sched_tick_10k_optimized"]["sched_tick_p50_ms"]
+    a_leg = report["admission_1k_legacy"]["tick_p50_ms"]
+    a_opt = report["admission_1k_optimized"]["tick_p50_ms"]
+    report["speedups"] = {
+        "sched_tick_10k_p50": round(s_leg / max(s_opt, 1e-9), 2),
+        "admission_tick_1k_p50": round(a_leg / max(a_opt, 1e-9), 2),
+    }
+    return report
+
+
+def run_day(seed: int = 0) -> dict:
+    from polyaxon_tpu.sim.traces import make_trace
+
+    sim = FleetSim(capacity=1000, seed=seed, rebuild_ticks=25)
+    try:
+        report = sim.run_trace(make_trace("day", seed=seed),
+                               max_wall=1800.0)
+    finally:
+        sim.close()
+    if report["divergence_total"]:
+        raise SystemExit(
+            f"FAIL: admission live-view diverged "
+            f"{report['divergence_total']} times over the sim day")
+    if not report["rebuild_checks"]:
+        raise SystemExit("FAIL: no rebuild consistency checks ran")
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", choices=["quick", "full"],
+                        default="quick")
+    parser.add_argument("--check", action="store_true")
+    parser.add_argument("--write-curve", action="store_true")
+    parser.add_argument("--deopt", action="store_true")
+    parser.add_argument("--ab", action="store_true",
+                        help="run the before/after A/B instead of a curve")
+    parser.add_argument("--day", action="store_true",
+                        help="replay the compressed 100k-run day")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", dest="json_out")
+    args = parser.parse_args(argv)
+
+    if args.ab:
+        result = run_ab(seed=args.seed)
+    elif args.day:
+        result = run_day(seed=args.seed)
+    else:
+        result = sim_curve.build_curve(
+            args.mode, seed=args.seed, legacy=args.deopt,
+            deopt=args.deopt, snapshot=True, progress=_log)
+    print(json.dumps(result, indent=2))
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(result, fh, indent=2)
+    if args.write_curve and not (args.ab or args.day):
+        # The committed artifact stays snapshot-free (diff noise).
+        slim = {"_meta": result["_meta"],
+                "points": {k: {kk: vv for kk, vv in v.items()
+                               if kk != "registry"}
+                           for k, v in result["points"].items()}}
+        path = sim_budgets.write_curve(slim)
+        _log(f"curve written: {path}")
+    if args.check and not (args.ab or args.day):
+        violations = sim_budgets.check_curve(
+            result, sim_budgets.load_budgets(), args.mode)
+        for v in violations:
+            print(f"BUDGET VIOLATION: {v}", file=sys.stderr)
+        if violations:
+            return 1
+        _log(f"within budget ({args.mode})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

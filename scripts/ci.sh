@@ -72,6 +72,19 @@ python -c "from polyaxon_tpu.obs import rules; \
 # fired-then-resolved retry-storm alert, and an attributed report.
 echo "== observability (spans / registry / rules / reports / flight)"
 python -m pytest tests/test_obs.py -q -m obs
+# Fleet-sim stage (ISSUE 8): drive the REAL scheduler + admission +
+# store through the quick load points (idle → storm, seconds not the
+# full compressed day) and gate tick cost against
+# polyaxon_tpu/sim/budgets.json — a refactor that reintroduces
+# per-status scans or per-pass live rebuilds fails HERE on the
+# deterministic per-tick query count, not at the next fleet incident.
+# The module's fast tier (trace/budget/executor classes) rides along;
+# full-curve and day-trace tests run under --full. Update budgets
+# after an INTENTIONAL change: python -m polyaxon_tpu.sim
+# --update-budgets.
+echo "== fleet sim (control-plane tick budgets)"
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --quick --check --json '' >/dev/null
+JAX_PLATFORMS=cpu python -m pytest tests/test_sim.py -q -m 'not slow'
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives
 # in the compiled HLO, and gate against polyaxon_tpu/perf/budgets.json

@@ -132,6 +132,14 @@ SMOKE_NODES = (
     "test_obs.py::TestRuleLifecycle",
     "test_obs.py::TestFlightRecorder",
     "test_obs.py::TestReportUnit",
+    # Fleet simulator: trace generation, synthetic-executor lifecycle,
+    # budget-gate logic, and the per-tick query-count regression (pure
+    # python + in-memory/tmp sqlite; the curve and day-trace runs are
+    # the ci.sh sim stage / --full).
+    "test_sim.py::TestTraces",
+    "test_sim.py::TestSyntheticExecutor",
+    "test_sim.py::TestBudgetGate",
+    "test_sim.py::TestQueryCounts",
 )
 
 
@@ -170,6 +178,12 @@ def pytest_collection_modifyitems(config, items):
             # e2e and chaos-drill timelines — its own `-m obs` stage in
             # scripts/ci.sh, and part of tier-1.
             item.add_marker(pytest.mark.obs)
+        if fname == "test_sim.py":
+            # Fleet simulator (ISSUE 8): traces, synthetic executor,
+            # budget gate, query-count regressions — its own `-m sim`
+            # stage in scripts/ci.sh; fast classes join the smoke tier
+            # via SMOKE_NODES.
+            item.add_marker(pytest.mark.sim)
     # A stale entry (renamed/deleted test) must fail collection loudly,
     # not silently shrink the default CI tier. Checked PER ENTRY: an
     # entry is stale only if its FILE was fully collected yet the node
