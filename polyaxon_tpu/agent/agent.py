@@ -289,6 +289,7 @@ class Agent:
             elif record.kind in _PIPELINE_KINDS:
                 children = self.plane.list_runs(pipeline_uuid=record.uuid)
                 if all(c.is_done for c in children):
+                    # polycheck: ignore[invariant-store-batch] -- independent per-run stop acks in a loop: each transition is atomic on its own; batching would couple unrelated runs' crash semantics
                     self.plane.store.transition(record.uuid, V1Statuses.STOPPED)
                     actions += 1
             else:

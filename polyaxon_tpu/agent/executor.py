@@ -262,11 +262,15 @@ class LocalExecutor:
         record = self.store.get_run(run_uuid)
         plan_dict = record.launch_plan
         if not plan_dict:
+            # polycheck: ignore[invariant-store-batch] -- lifecycle gates separated by gang spawn: FAILED/RUNNING mark externally observable progress and cannot batch with the scheduled hop below
             self.store.transition(run_uuid, V1Statuses.FAILED, reason="NoLaunchPlan")
             return False
         plan = V1LaunchPlan.from_dict(plan_dict)
-        self.store.transition(run_uuid, V1Statuses.SCHEDULED)
-        self.store.transition(run_uuid, V1Statuses.STARTING)
+        # One commit for the pre-spawn hop: a crash between them would
+        # strand the run in SCHEDULED with no gang to reap it.
+        with self.store.transaction():
+            self.store.transition(run_uuid, V1Statuses.SCHEDULED)
+            self.store.transition(run_uuid, V1Statuses.STARTING)
 
         gang = _Gang(run_uuid=run_uuid, plan=plan)
         # Arm the flight recorder before any span lands: the registry
@@ -465,6 +469,7 @@ class LocalExecutor:
             record = self.store.get_run(run_uuid)
             if record.status == V1Statuses.STOPPING:
                 self._finish_gang_span(gang, final="stopped")
+                # polycheck: ignore[invariant-store-batch] -- exclusive per-gang reap branches: exactly one terminal write runs per gang (the WARNING+terminal pair below batches separately)
                 self.store.transition(run_uuid, V1Statuses.STOPPED)
                 obs_flight.RECORDER.discard(run_uuid)  # operator intent
             elif gang.preempted:
@@ -480,26 +485,29 @@ class LocalExecutor:
                     status=V1Statuses.PREEMPTED.value,
                     reason="SlicePreempted")
             else:
-                if gang.warning:
-                    # Non-fatal anomaly (e.g. checkpoint fallback):
-                    # pinned as a WARNING condition so operators see it
-                    # without the run dying.
-                    self.store.transition(
-                        run_uuid, V1Statuses.WARNING,
-                        reason="CheckpointFallback",
-                        message=gang.warning[:500], force=True)
                 target = V1Statuses.SUCCEEDED if status == 0 else V1Statuses.FAILED
                 self._finish_gang_span(
                     gang, status="ok" if status == 0 else "error",
                     error=(None if status == 0 else
                            gang.thread_error or f"exit code {status}"),
                     final=target.value, exit_code=status)
-                self.store.transition(
-                    run_uuid, target,
-                    reason="Completed" if status == 0 else "ProcessFailed",
-                    message=gang.thread_error or (None if status == 0
-                                                  else f"exit code {status}"),
-                )
+                with self.store.transaction():
+                    if gang.warning:
+                        # Non-fatal anomaly (e.g. checkpoint fallback):
+                        # pinned as a WARNING condition so operators see
+                        # it without the run dying — committed with the
+                        # terminal hop so a crash between them cannot
+                        # strand the run live in WARNING.
+                        self.store.transition(
+                            run_uuid, V1Statuses.WARNING,
+                            reason="CheckpointFallback",
+                            message=gang.warning[:500], force=True)
+                    self.store.transition(
+                        run_uuid, target,
+                        reason="Completed" if status == 0 else "ProcessFailed",
+                        message=gang.thread_error or (None if status == 0
+                                                      else f"exit code {status}"),
+                    )
                 if target == V1Statuses.FAILED:
                     # The reap that declared the run dead writes its
                     # postmortem: ring of recent spans/notes, metric
@@ -559,6 +567,11 @@ class LocalExecutor:
         if gang.span is not None:
             gang.span.add_event("stop_requested")
         gang.stop_event.set()  # in-process runtime loop checks this per step
+        if gang.thread is not None and gang.thread.is_alive():
+            # Drain: the loop exits at the next step boundary; a
+            # bounded join lets its final status/checkpoint writes land
+            # before teardown (daemon threads die mid-write at exit).
+            gang.thread.join(timeout=30)
         for proc in gang.procs:
             try:
                 proc.terminate()

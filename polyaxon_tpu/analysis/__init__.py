@@ -1,0 +1,34 @@
+"""polycheck: the repo-native static-analysis gate (ISSUE 9).
+
+``python -m polyaxon_tpu.analysis --check`` runs three AST rule
+families over ``polyaxon_tpu/**`` and fails CI on any finding that is
+neither pragma'd at the site (``# polycheck: ignore[rule] -- why``)
+nor in the committed ``analysis/baseline.json`` (which only shrinks):
+
+- concurrency  — lock-order inversions, locks held across blocking
+  I/O, self-deadlocks; plus an opt-in RUNTIME lockdep shim
+  (``analysis.lockdep``) that records real acquisition orders during
+  the chaos/sim drills and fails on observed cycles.
+- hotpath      — host syncs in jitted/step code, unseeded randomness
+  and wall-clock reads in resume-relevant ``runtime/`` paths, python
+  branches on tracers.
+- invariants   — silent ``except Exception: pass`` swallows,
+  un-cataloged metric emissions, unbatched multi-write store
+  sequences, daemon threads nothing drains.
+
+See docs/static-analysis.md for the rule catalog and pragma/baseline
+semantics.
+"""
+
+from polyaxon_tpu.analysis.core import (  # noqa: F401
+    ALL_RULES,
+    BASELINE_PATH,
+    Finding,
+    RULE_FAMILIES,
+    SourceFile,
+    analyze,
+    check,
+    load_sources,
+    rule_family,
+)
+from polyaxon_tpu.analysis import lockdep  # noqa: F401

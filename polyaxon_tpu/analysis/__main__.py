@@ -1,0 +1,147 @@
+"""polycheck CLI — the CI gate.
+
+    python -m polyaxon_tpu.analysis --check            # gate (exit 1 on
+                                                       # new findings or a
+                                                       # stale baseline)
+    python -m polyaxon_tpu.analysis                    # report only
+    python -m polyaxon_tpu.analysis --json out.json    # machine-readable
+    python -m polyaxon_tpu.analysis --update-baseline  # SHRINK the baseline
+    python -m polyaxon_tpu.analysis --list-rules
+
+Gate self-tests (the ``--deopt`` / ``--inject-reshard`` pattern from the
+sim and perf gates): ``--inject-lock-inversion`` and
+``--inject-uncataloged-metric`` add a synthetic in-memory module with a
+planted violation — ``--check`` must then FAIL, and ci.sh asserts it
+does, so a refactor that quietly breaks an analyzer fails the build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from polyaxon_tpu.analysis import core
+
+# Planted-violation sources for the gate's own self-test. Virtual paths
+# sit inside the package so path-scoped rules apply.
+INJECT_LOCK_INVERSION = (
+    "polyaxon_tpu/_polycheck_injected_locks.py",
+    '''\
+import threading
+
+_alpha = threading.Lock()
+_beta = threading.Lock()
+
+
+def forward():
+    with _alpha:
+        with _beta:
+            return 1
+
+
+def backward():
+    with _beta:
+        with _alpha:
+            return 2
+''')
+
+INJECT_UNCATALOGED_METRIC = (
+    "polyaxon_tpu/_polycheck_injected_metric.py",
+    '''\
+from polyaxon_tpu.obs import metrics
+
+
+def emit():
+    metrics.REGISTRY.counter(
+        "polyaxon_not_in_the_catalog_total", "planted").inc()
+''')
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m polyaxon_tpu.analysis",
+        description="polycheck: repo-native static analysis gate")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 on new findings or stale baseline")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="write findings as JSON ('' or '-' = stdout)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="remove baseline entries that no longer match "
+                             "(never adds)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: autodetected)")
+    parser.add_argument("--inject-lock-inversion", action="store_true",
+                        help="plant a synthetic AB-BA module (gate demo)")
+    parser.add_argument("--inject-uncataloged-metric", action="store_true",
+                        help="plant a synthetic un-cataloged emission "
+                             "(gate demo)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for family, rules in core.RULE_FAMILIES.items():
+            print(f"{family}:")
+            for rule in rules:
+                print(f"  {rule}")
+        return 0
+
+    extra = []
+    if args.inject_lock_inversion:
+        extra.append(INJECT_LOCK_INVERSION)
+    if args.inject_uncataloged_metric:
+        extra.append(INJECT_UNCATALOGED_METRIC)
+
+    files = core.load_sources(root=args.root, extra_sources=extra)
+    findings = core.analyze(files)
+    result = core.check(findings)
+
+    if args.update_baseline:
+        baseline = core.load_baseline()
+        live_ids = {f.id for f in findings}
+        kept = [entry for fid, entry in sorted(baseline.items())
+                if fid in live_ids]
+        core.write_baseline(kept)
+        print(f"baseline: kept {len(kept)}, removed "
+              f"{len(baseline) - len(kept)} stale "
+              f"entr{'y' if len(baseline) - len(kept) == 1 else 'ies'}")
+        return 0
+
+    if args.json is not None:
+        payload = {
+            "new": [f.as_dict() for f in result.new],
+            "baselined": [f.as_dict() for f in result.baselined],
+            "stale_baseline": result.stale_baseline,
+            "ok": result.ok,
+        }
+        if args.json in ("", "-"):
+            json.dump(payload, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.json, "w") as fh:
+                json.dump(payload, fh, indent=2)
+
+    for f in result.new:
+        print(f.render())
+    if result.baselined:
+        print(f"[polycheck] {len(result.baselined)} baselined finding(s) "
+              "suppressed")
+    for fid in result.stale_baseline:
+        print(f"[polycheck] STALE baseline entry {fid} matches nothing — "
+              "run --update-baseline (the baseline only shrinks)")
+
+    counts: dict[str, int] = {}
+    for f in result.new:
+        counts[f.family] = counts.get(f.family, 0) + 1
+    summary = ", ".join(f"{fam}={n}" for fam, n in sorted(counts.items())) \
+        or "none"
+    print(f"[polycheck] scanned {len(files)} modules; new findings: "
+          f"{summary}")
+
+    if args.check and not result.ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,538 @@
+"""Concurrency analyzers: the static lock-acquisition graph.
+
+Extracts every ``threading.Lock/RLock/Condition`` the package creates,
+tracks which locks are held where (``with`` blocks, including locks
+reached transitively through same-module calls), and reports:
+
+- ``lock-order`` — a cycle in the global acquisition graph (AB-BA
+  inversion): two code paths that take the same pair of locks in
+  opposite orders can deadlock under the right interleaving.
+- ``lock-self-deadlock`` — a non-reentrant ``Lock`` nested inside
+  itself on one path (guaranteed deadlock, not a race).
+- ``lock-blocking-call`` — a lock held across a blocking operation:
+  ``time.sleep``/``with_retries`` (sleeps between attempts),
+  ``subprocess``, HTTP, fsspec object-store ops, thread joins, and
+  control-plane store SCANS (point lookups are exempt — they are O(1)
+  by design; scans scale with fleet size and stall every waiter).
+
+Two modeled facts close the gaps AST resolution cannot see:
+
+- calls to the control-plane store's WRITE methods acquire
+  ``Store._lock`` (``transition``/``update_run``/``transaction()``...),
+  so a thread holding another lock while writing the store gets a
+  real graph edge;
+- callbacks registered via ``add_transition_listener`` run INSIDE the
+  store lock (commit-order delivery), so locks they take — and any
+  blocking work they do — are charged against ``Store._lock``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from polyaxon_tpu.analysis.core import Finding, SourceFile, register
+
+STORE_PATH = "polyaxon_tpu/controlplane/store.py"
+STORE_LOCK_ID = f"{STORE_PATH}::Store._lock"
+
+# Control-plane store methods that take Store._lock (writes + the
+# batching context manager). Reads run on per-thread connections.
+STORE_WRITE_METHODS = frozenset({
+    "transaction", "transition", "update_run", "create_run",
+    "add_condition", "create_project", "upsert_queue", "set_quota",
+    "delete_queue", "delete_quota", "deoptimize",
+})
+# Store reads that SCAN (O(fleet)); holding an unrelated lock across
+# one stalls that lock for every waiter. Point lookups (get_run,
+# last_condition, get_queue, get_quota) are exempt by design.
+STORE_SCAN_METHODS = frozenset({
+    "list_runs", "scan_runs", "list_run_uuids", "get_runs", "count_runs",
+    "find_cached", "list_queues", "list_quotas", "list_projects",
+})
+
+LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+# (dotted-suffix, description) patterns for blocking calls.
+_BLOCKING_SUFFIXES = (
+    ("time.sleep", "time.sleep"),
+    ("subprocess.run", "subprocess"),
+    ("subprocess.Popen", "subprocess"),
+    ("subprocess.call", "subprocess"),
+    ("subprocess.check_call", "subprocess"),
+    ("subprocess.check_output", "subprocess"),
+    ("urlopen", "HTTP request"),
+    ("requests.get", "HTTP request"),
+    ("requests.post", "HTTP request"),
+    ("socket.create_connection", "socket connect"),
+)
+_BLOCKING_BARE = {"with_retries": "with_retries (sleeps between attempts)"}
+# fsspec / artifact-store ops when called on an `fs`-named receiver.
+_FS_METHODS = frozenset({
+    "cat_file", "pipe_file", "put", "get", "put_file", "get_file",
+    "download_file", "upload_file", "download_dir", "upload_dir",
+    "read_bytes", "write_bytes",
+})
+
+
+def _dotted(node: ast.AST) -> str:
+    """`a.b.c` for Attribute/Name chains, '' when dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        return ""
+    parts.reverse()
+    return ".".join(parts)
+
+
+@dataclass
+class LockDef:
+    lock_id: str
+    kind: str           # Lock | RLock | Condition
+    path: str
+    line: int
+
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    path: str
+    acquires: set[str] = field(default_factory=set)
+    calls: set[str] = field(default_factory=set)
+    blocking: list[tuple[int, str]] = field(default_factory=list)
+    # ops performed while holding a lock:
+    held_nested: list[tuple[str, str, int]] = field(default_factory=list)
+    held_calls: list[tuple[str, str, int]] = field(default_factory=list)
+    held_blocking: list[tuple[str, str, int]] = field(default_factory=list)
+    self_deadlocks: list[tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ModuleModel:
+    sf: SourceFile
+    locks: dict[tuple[str, str], LockDef] = field(default_factory=dict)
+    funcs: dict[str, FuncInfo] = field(default_factory=dict)
+    listeners: list[str] = field(default_factory=list)  # func keys
+
+
+def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        name = _dotted(value.func)
+        if name.startswith("threading."):
+            return LOCK_CTORS.get(name.split(".", 1)[1])
+        return LOCK_CTORS.get(name) if name in LOCK_CTORS else None
+    return None
+
+
+class _ModuleScanner(ast.NodeVisitor):
+    """Pass 1: find every lock definition in the module."""
+
+    def __init__(self, model: ModuleModel):
+        self.model = model
+        self.class_stack: list[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _record(self, target: ast.AST, kind: str, line: int):
+        cls = self.class_stack[-1] if self.class_stack else ""
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id in ("self", "cls") and cls:
+            key = (cls, target.attr)
+        elif isinstance(target, ast.Name):
+            key = (cls, target.id)
+        else:
+            return
+        qual = f"{key[0]}.{key[1]}" if key[0] else key[1]
+        self.model.locks[key] = LockDef(
+            lock_id=f"{self.model.sf.path}::{qual}", kind=kind,
+            path=self.model.sf.path, line=line)
+
+    def visit_Assign(self, node: ast.Assign):
+        kind = _lock_ctor_kind(node.value)
+        if kind:
+            for target in node.targets:
+                self._record(target, kind, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                self._record(node.target, kind, node.lineno)
+        self.generic_visit(node)
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Pass 2: per-function lock/call/blocking facts."""
+
+    def __init__(self, model: ModuleModel, info: FuncInfo, cls: str):
+        self.model = model
+        self.info = info
+        self.cls = cls
+        self.held: list[str] = []
+
+    # -- resolution helpers -------------------------------------------------
+    def _resolve_lock(self, expr: ast.AST) -> Optional[LockDef]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and self.cls:
+            return self.model.locks.get((self.cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.model.locks.get(("", expr.id))
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            return self.model.locks.get((expr.value.id, expr.attr))
+        return None
+
+    def _store_receiver(self, func: ast.AST) -> Optional[str]:
+        """Method name when `func` is a call on a store-shaped receiver
+        (`store`, `self.store`, `plane.store`, or `self` inside Store)."""
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = _dotted(func.value)
+        last = recv.rsplit(".", 1)[-1] if recv else ""
+        if last == "store" or (recv == "self" and self.cls == "Store"):
+            return func.attr
+        return None
+
+    def _blocking_desc(self, call: ast.Call) -> Optional[str]:
+        name = _dotted(call.func)
+        if not name:
+            return None
+        for suffix, desc in _BLOCKING_SUFFIXES:
+            if name == suffix or name.endswith("." + suffix):
+                return desc
+        bare = name.rsplit(".", 1)[-1]
+        if name in _BLOCKING_BARE or bare in _BLOCKING_BARE:
+            return _BLOCKING_BARE.get(name) or _BLOCKING_BARE[bare]
+        if isinstance(call.func, ast.Attribute):
+            recv = _dotted(call.func.value)
+            recv_last = recv.rsplit(".", 1)[-1] if recv else ""
+            if bare in _FS_METHODS and recv_last in ("fs", "store"):
+                return f"object-store op .{bare}()"
+            if bare == "join" and "thread" in recv.lower():
+                return f"thread join on {recv}"
+        method = self._store_receiver(call.func)
+        if method in STORE_SCAN_METHODS:
+            return f"control-plane store scan .{method}()"
+        return None
+
+    def _callee_key(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            if func.value.id in ("self", "cls") and self.cls:
+                return f"{self.cls}.{func.attr}"
+            return f"{func.value.id}.{func.attr}"
+        return None
+
+    # -- lock bookkeeping ---------------------------------------------------
+    def _acquire(self, lock_id: str, kind: str, line: int):
+        if lock_id in self.held:
+            if kind == "Lock":
+                self.info.self_deadlocks.append((lock_id, line))
+            return None  # reentrant: no self-edge
+        for outer in self.held:
+            self.info.held_nested.append((outer, lock_id, line))
+        self.info.acquires.add(lock_id)
+        self.held.append(lock_id)
+        return lock_id
+
+    def visit_With(self, node: ast.With):
+        acquired: list[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            lock = self._resolve_lock(expr)
+            if lock is not None:
+                got = self._acquire(lock.lock_id, lock.kind, node.lineno)
+                if got:
+                    acquired.append(got)
+            elif isinstance(expr, ast.Call):
+                method = self._store_receiver(expr.func)
+                if method in STORE_WRITE_METHODS:
+                    got = self._acquire(STORE_LOCK_ID, "RLock", node.lineno)
+                    if got:
+                        acquired.append(got)
+                self.visit(expr)  # calls inside the context expr
+        for stmt in node.body:
+            self.visit(stmt)
+        for got in reversed(acquired):
+            self.held.remove(got)
+
+    def visit_Call(self, node: ast.Call):
+        line = node.lineno
+        desc = self._blocking_desc(node)
+        if desc is not None:
+            self.info.blocking.append((line, desc))
+            for lock_id in self.held:
+                self.info.held_blocking.append((lock_id, desc, line))
+        method = self._store_receiver(node.func)
+        if method in STORE_WRITE_METHODS:
+            # A store write acquires (and releases) Store._lock here.
+            if STORE_LOCK_ID not in self.held:
+                self.info.acquires.add(STORE_LOCK_ID)
+                for outer in self.held:
+                    self.info.held_nested.append(
+                        (outer, STORE_LOCK_ID, line))
+        key = self._callee_key(node)
+        if key is not None:
+            self.info.calls.add(key)
+            for lock_id in self.held:
+                self.info.held_calls.append((lock_id, key, line))
+        self.generic_visit(node)
+
+    # Nested defs are separate execution contexts (threads/closures run
+    # later, not while the enclosing locks are held).
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda):
+        # Lambdas passed to with_retries etc. DO run at the call site;
+        # analyze their body in the current held context.
+        self.visit(node.body)
+
+
+def build_model(sf: SourceFile) -> ModuleModel:
+    model = ModuleModel(sf=sf)
+    _ModuleScanner(model).visit(sf.tree)
+
+    def scan_funcs(body, cls: str, prefix: str):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}"
+                info = FuncInfo(qualname=qual, path=sf.path)
+                scanner = _FuncScanner(model, info, cls)
+                for stmt in node.body:
+                    scanner.visit(stmt)
+                model.funcs[qual] = info
+                # nested defs become their own entries
+                scan_funcs(node.body, cls, f"{qual}.")
+            elif isinstance(node, ast.ClassDef):
+                scan_funcs(node.body, node.name, f"{node.name}.")
+
+    scan_funcs(sf.tree.body, "", "")
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "add_transition_listener" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Attribute) and \
+                    isinstance(arg.value, ast.Name) and arg.value.id == "self":
+                # registered from inside a class: find which one
+                for qual in model.funcs:
+                    if qual.endswith("." + arg.attr):
+                        model.listeners.append(qual)
+    return model
+
+
+def _propagate(model: ModuleModel) -> tuple[dict[str, set[str]],
+                                            dict[str, Optional[str]]]:
+    """Same-module transitive closure: which locks may a call to each
+    function acquire, and may it block (with an example description)."""
+    may_acquire = {q: set(i.acquires) for q, i in model.funcs.items()}
+    may_block: dict[str, Optional[str]] = {
+        q: (i.blocking[0][1] if i.blocking else None)
+        for q, i in model.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, info in model.funcs.items():
+            for callee in info.calls:
+                target = callee if callee in model.funcs else None
+                if target is None:
+                    continue
+                extra = may_acquire[target] - may_acquire[qual]
+                if extra:
+                    may_acquire[qual] |= extra
+                    changed = True
+                if may_block[qual] is None and may_block[target] is not None:
+                    may_block[qual] = (
+                        f"{may_block[target]} via {target}()")
+                    changed = True
+    return may_acquire, may_block
+
+
+def _txn_scan_exempt(lock_id: str, desc: str) -> bool:
+    """Holding Store._lock across a scan of the SAME store is the
+    transaction idiom (a consistent snapshot is the point); the rule
+    targets unrelated locks stalled behind O(fleet) reads."""
+    return lock_id == STORE_LOCK_ID and "store scan" in desc
+
+
+@register
+def analyze_concurrency(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    # Global acquisition graph: (outer, inner) -> list of (sf, line, how)
+    edges: dict[tuple[str, str], list[tuple[SourceFile, int, str]]] = {}
+
+    def add_edge(outer: str, inner: str, sf: SourceFile, line: int, how: str):
+        if outer == inner:
+            return
+        if sf.suppressed("lock-order", line):
+            return
+        edges.setdefault((outer, inner), []).append((sf, line, how))
+
+    for sf in files:
+        model = build_model(sf)
+        may_acquire, may_block = _propagate(model)
+        for qual, info in model.funcs.items():
+            for lock_id, line in info.self_deadlocks:
+                f = sf.finding(
+                    "lock-self-deadlock", line,
+                    f"non-reentrant Lock {lock_id.split('::')[-1]} "
+                    "acquired while already held on this path "
+                    "(guaranteed deadlock); use RLock or restructure",
+                    qualname=qual)
+                if f:
+                    findings.append(f)
+            for outer, inner, line in info.held_nested:
+                add_edge(outer, inner, sf, line, f"nested in {qual}")
+            for outer, callee, line in info.held_calls:
+                target = callee if callee in model.funcs else None
+                if target is None:
+                    continue
+                for inner in may_acquire[target]:
+                    add_edge(outer, inner, sf, line,
+                             f"{qual} -> {target}()")
+                blocked = may_block[target]
+                if blocked is not None and \
+                        not _txn_scan_exempt(outer, blocked):
+                    f = sf.finding(
+                        "lock-blocking-call", line,
+                        f"{outer.split('::')[-1]} held across {blocked} "
+                        f"(call chain {qual} -> {target}())",
+                        qualname=qual)
+                    if f:
+                        findings.append(f)
+            for lock_id, desc, line in info.held_blocking:
+                if _txn_scan_exempt(lock_id, desc):
+                    continue
+                f = sf.finding(
+                    "lock-blocking-call", line,
+                    f"{lock_id.split('::')[-1]} held across {desc}; "
+                    "move the blocking work outside the lock",
+                    qualname=qual)
+                if f:
+                    findings.append(f)
+        # Listener callbacks execute under the store lock.
+        for qual in model.listeners:
+            info = model.funcs.get(qual)
+            if info is None:
+                continue
+            for inner in may_acquire[qual]:
+                add_edge(STORE_LOCK_ID, inner, sf,
+                         model.sf.tree.body[0].lineno if not info.held_nested
+                         else info.held_nested[0][2],
+                         f"transition listener {qual} runs under the "
+                         "store lock")
+            blocked = may_block[qual]
+            if blocked is not None:
+                first_line = (info.blocking[0][0] if info.blocking
+                              else 1)
+                f = sf.finding(
+                    "lock-blocking-call", first_line,
+                    f"Store._lock held across {blocked}: {qual} is a "
+                    "transition listener and runs inside the store lock",
+                    qualname=qual)
+                if f:
+                    findings.append(f)
+
+    findings.extend(_cycle_findings(edges))
+    return findings
+
+
+def _cycle_findings(edges: dict[tuple[str, str],
+                                list[tuple[SourceFile, int, str]]]
+                    ) -> list[Finding]:
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    # Tarjan SCC, iterative.
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str):
+        work = [(root, iter(sorted(graph[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for node in sorted(graph):
+        if node not in index:
+            strongconnect(node)
+
+    findings = []
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        members = sorted(comp)
+        sites = []
+        anchor: Optional[tuple[SourceFile, int]] = None
+        for (a, b), occ in sorted(edges.items()):
+            if a in comp and b in comp:
+                sf, line, how = occ[0]
+                if anchor is None:
+                    anchor = (sf, line)
+                sites.append(f"{a.split('::')[-1]} -> "
+                             f"{b.split('::')[-1]} at {sf.path}:{line} "
+                             f"({how})")
+        assert anchor is not None
+        sf, line = anchor
+        findings.append(Finding(
+            rule="lock-order", path=sf.path, line=line,
+            message=("lock-order inversion: cycle through "
+                     + ", ".join(m.split("::")[-1] for m in members)
+                     + "; edges: " + "; ".join(sites)),
+            qualname="", snippet=" -> ".join(members)))
+    return findings

@@ -1,0 +1,331 @@
+"""polycheck core: findings, pragmas, baseline, and the analysis driver.
+
+The repo's correctness conventions — lock ordering, no host syncs in
+the step hot path, store writes batched in ``transaction()``, metrics
+drawn from the catalog, no silent exception swallows — are enforced
+here as AST rules over ``polyaxon_tpu/**`` instead of review folklore.
+Three pieces:
+
+- :class:`Finding` — one rule violation with a line-drift-stable id
+  (rule + path + a hash of the enclosing qualname and the offending
+  source line, not the line number).
+- pragmas — ``# polycheck: ignore[rule-id] -- reason`` on the offending
+  line (or the line above) suppresses that rule there. The reason is
+  MANDATORY: a bare ignore is itself a finding (``pragma-syntax``).
+- baseline — ``analysis/baseline.json`` lists legacy suppressions by
+  finding id. New findings fail ``--check``; a baseline entry that no
+  longer matches anything is STALE and also fails (the baseline only
+  shrinks — ``--update-baseline`` removes dead entries and never adds).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# ------------------------------------------------------------------ rules
+# family -> rule ids. Families gate baseline policy: concurrency and
+# swallow findings may NOT be baselined (fix or pragma with a reason) —
+# ISSUE 9's acceptance bar, enforced in load_baseline().
+RULE_FAMILIES = {
+    "concurrency": (
+        "lock-order",            # lock-acquisition graph has a cycle
+        "lock-self-deadlock",    # non-reentrant Lock nested with itself
+        "lock-blocking-call",    # lock held across blocking I/O / sleep
+    ),
+    "hotpath": (
+        "hotpath-host-sync",     # device sync inside jit scope/step loop
+        "hotpath-unseeded-random",  # np.random without a derived seed
+        "hotpath-wallclock",     # wall clock in a replay-relevant path
+        "hotpath-tracer-branch",  # python branch on a traced value
+    ),
+    "invariant": (
+        "invariant-swallow",     # except Exception: pass, silently
+        "invariant-metric-catalog",  # emitted metric not in the catalog
+        "invariant-store-batch",  # multi-write outside transaction()
+        "invariant-daemon-drain",  # daemon thread with no join/drain
+    ),
+    "meta": (
+        "pragma-syntax",         # malformed/unreasoned polycheck pragma
+    ),
+}
+NO_BASELINE_FAMILIES = ("concurrency",)
+NO_BASELINE_RULES = ("invariant-swallow",)
+
+ALL_RULES: dict[str, str] = {
+    rule: family for family, rules in RULE_FAMILIES.items() for rule in rules
+}
+
+
+def rule_family(rule: str) -> str:
+    return ALL_RULES.get(rule, "unknown")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    qualname: str = ""
+    snippet: str = ""
+    _seq: int = 0      # disambiguates identical snippets in one scope
+
+    @property
+    def family(self) -> str:
+        return rule_family(self.rule)
+
+    @property
+    def id(self) -> str:
+        """Stable across line drift: hashes WHAT violated (scope +
+        normalized source text), not WHERE it currently sits."""
+        norm = re.sub(r"\s+", " ", self.snippet).strip()
+        basis = f"{self.rule}|{self.path}|{self.qualname}|{norm}|{self._seq}"
+        return (f"{self.rule}:{self.path}:"
+                f"{hashlib.sha1(basis.encode()).hexdigest()[:10]}")
+
+    def render(self) -> str:
+        scope = f" [{self.qualname}]" if self.qualname else ""
+        return f"{self.path}:{self.line}: {self.rule}{scope}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"id": self.id, "rule": self.rule, "family": self.family,
+                "path": self.path, "line": self.line,
+                "qualname": self.qualname, "message": self.message}
+
+
+def finalize_sequence(findings: list[Finding]) -> list[Finding]:
+    """Assign occurrence indices so two identical offending lines in one
+    scope get distinct stable ids (ordered by line)."""
+    groups: dict[tuple, list[Finding]] = {}
+    for f in findings:
+        norm = re.sub(r"\s+", " ", f.snippet).strip()
+        groups.setdefault((f.rule, f.path, f.qualname, norm), []).append(f)
+    for group in groups.values():
+        group.sort(key=lambda f: f.line)
+        for i, f in enumerate(group):
+            f._seq = i
+    return findings
+
+
+# ---------------------------------------------------------------- pragmas
+# `# polycheck: ignore[rule-a,rule-b] -- reason text`
+PRAGMA_RE = re.compile(
+    r"#\s*polycheck:\s*ignore\[(?P<rules>[^\]]*)\]"
+    r"(?:\s*--\s*(?P<reason>\S.*))?")
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: tuple[str, ...]
+    reason: str
+
+
+def scan_pragmas(source_lines: list[str]) -> tuple[list[Pragma],
+                                                   list[tuple[int, str]]]:
+    """All pragmas in the file + syntax errors as (line, message)."""
+    pragmas, errors = [], []
+    for lineno, text in enumerate(source_lines, start=1):
+        m = PRAGMA_RE.search(text)
+        if m is None:
+            if "polycheck:" in text and "ignore" in text:
+                errors.append((lineno, "unparseable polycheck pragma "
+                               "(expected `# polycheck: ignore[rule] -- why`)"))
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",")
+                      if r.strip())
+        reason = (m.group("reason") or "").strip()
+        if not rules:
+            errors.append((lineno, "polycheck pragma names no rule"))
+            continue
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            errors.append((lineno, f"polycheck pragma names unknown "
+                           f"rule(s): {', '.join(unknown)}"))
+            continue
+        if not reason:
+            errors.append((lineno, "polycheck pragma has no reason "
+                           "(`-- why` is mandatory)"))
+            continue
+        pragmas.append(Pragma(lineno, rules, reason))
+    return pragmas, errors
+
+
+class SourceFile:
+    """One analyzed module: path (repo-relative), source, AST, pragmas."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.pragmas, self.pragma_errors = scan_pragmas(self.lines)
+        self._by_line: dict[int, Pragma] = {p.line: p for p in self.pragmas}
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """A pragma suppresses `rule` on its own line or the line below
+        (pragma-above style for lines too long to carry a trailer)."""
+        for at in (lineno, lineno - 1):
+            p = self._by_line.get(at)
+            if p is not None and rule in p.rules:
+                return True
+        return False
+
+    def finding(self, rule: str, node_or_line, message: str,
+                qualname: str = "") -> Optional[Finding]:
+        lineno = getattr(node_or_line, "lineno", node_or_line)
+        if self.suppressed(rule, lineno):
+            return None
+        return Finding(rule=rule, path=self.path, line=lineno,
+                       message=message, qualname=qualname,
+                       snippet=self.line_text(lineno))
+
+
+# --------------------------------------------------------------- baseline
+class BaselineError(Exception):
+    pass
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict[str, dict]:
+    """id -> entry. Rejects entries in the no-baseline families: a
+    concurrency or swallow finding is fixed (or pragma'd with a reason
+    at the site), never hidden in a bulk file."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    entries = {}
+    for entry in data.get("suppressions", []):
+        rule = entry.get("rule", "")
+        if rule_family(rule) in NO_BASELINE_FAMILIES or rule in NO_BASELINE_RULES:
+            raise BaselineError(
+                f"baseline entry {entry.get('id')!r} suppresses {rule!r}: "
+                f"{rule_family(rule)}-family findings must be fixed or "
+                "pragma'd at the site, not baselined")
+        if not entry.get("reason"):
+            raise BaselineError(
+                f"baseline entry {entry.get('id')!r} has no reason")
+        entries[entry["id"]] = entry
+    return entries
+
+
+def write_baseline(entries: Iterable[dict], path: str = BASELINE_PATH) -> None:
+    payload = {"version": 1,
+               "note": "Legacy suppressions only. The file only shrinks: "
+                       "--update-baseline removes dead entries and never "
+                       "adds. New violations: fix them, or pragma at the "
+                       "site with a reason.",
+               "suppressions": sorted(entries, key=lambda e: e["id"])}
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+
+
+# ----------------------------------------------------------------- driver
+Analyzer = Callable[[list[SourceFile]], list[Finding]]
+_ANALYZERS: list[Analyzer] = []
+
+
+def register(fn: Analyzer) -> Analyzer:
+    _ANALYZERS.append(fn)
+    return fn
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def package_files(root: Optional[str] = None) -> list[str]:
+    """Repo-relative paths of every analyzed module (the package tree).
+
+    The analyzer does not self-scan: ``analysis/`` sources necessarily
+    spell out rule names and pragma examples in docstrings, which would
+    read as malformed pragmas (linters don't lint their own rule docs).
+    """
+    root = root or repo_root()
+    out = []
+    pkg = os.path.join(root, "polyaxon_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+        if rel_dir == "polyaxon_tpu/analysis" or \
+                rel_dir.startswith("polyaxon_tpu/analysis/"):
+            continue
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(dirpath, name), root)
+                           .replace(os.sep, "/"))
+    return sorted(out)
+
+
+def load_sources(root: Optional[str] = None,
+                 paths: Optional[Iterable[str]] = None,
+                 extra_sources: Iterable[tuple[str, str]] = ()
+                 ) -> list[SourceFile]:
+    root = root or repo_root()
+    files = []
+    for rel in (paths if paths is not None else package_files(root)):
+        with open(os.path.join(root, rel)) as fh:
+            files.append(SourceFile(rel, fh.read()))
+    for rel, source in extra_sources:
+        files.append(SourceFile(rel, source))
+    return files
+
+
+def analyze(files: list[SourceFile]) -> list[Finding]:
+    """Run every registered analyzer over the parsed file set; pragma
+    syntax errors surface as findings too."""
+    # Import for side effect: rule modules self-register on first use.
+    from polyaxon_tpu.analysis import (concurrency, hotpath,  # noqa: F401
+                                       invariants)
+
+    findings: list[Finding] = []
+    for sf in files:
+        for lineno, message in sf.pragma_errors:
+            findings.append(Finding(
+                rule="pragma-syntax", path=sf.path, line=lineno,
+                message=message, snippet=sf.line_text(lineno)))
+    for analyzer in _ANALYZERS:
+        findings.extend(analyzer(files))
+    findings = finalize_sequence(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+@dataclass
+class CheckResult:
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale_baseline
+
+
+def check(findings: list[Finding],
+          baseline_path: str = BASELINE_PATH) -> CheckResult:
+    baseline = load_baseline(baseline_path)
+    result = CheckResult()
+    seen = set()
+    for f in findings:
+        if f.id in baseline:
+            result.baselined.append(f)
+            seen.add(f.id)
+        else:
+            result.new.append(f)
+    result.stale_baseline = sorted(set(baseline) - seen)
+    return result

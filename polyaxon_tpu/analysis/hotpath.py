@@ -1,0 +1,350 @@
+"""JAX hot-path analyzers.
+
+Four rules over the training/serving hot path:
+
+- ``hotpath-host-sync`` — a host synchronization
+  (``block_until_ready``, ``np.asarray``/``np.array`` on traced
+  values, ``.item()``, ``jax.device_get``, ``float()``/``int()`` of a
+  non-literal) inside a JIT SCOPE (a function passed to ``jax.jit`` /
+  ``shard_map`` or decorated with jit) or anywhere in
+  ``runtime/loop.py`` (the step loop: one stray sync serializes the
+  host/device overlap PR 3 bought). Deliberate sync points — emission
+  windows, final drain — carry a reasoned pragma.
+- ``hotpath-unseeded-random`` — ``np.random.*`` in ``runtime/`` that
+  does not derive from an explicit seed (``default_rng(seed)``). Resume
+  exactness requires batch i to be a pure function of ``(seed, i)``.
+- ``hotpath-wallclock`` — ``time.time()``/``datetime.now()`` in
+  ``runtime/``: wall clock read in a replay-relevant path makes a
+  resumed run diverge from the original. Monotonic/perf counters for
+  durations are fine; span timestamps carry pragmas.
+- ``hotpath-tracer-branch`` — Python ``if``/``while`` on a value
+  derived from a jitted function's arguments (a tracer): either a
+  ``TracerBoolConversionError`` at trace time or, worse, a silently
+  baked-in branch. Static attributes (``.shape``/``.ndim``/``.dtype``,
+  ``len()``, ``is None`` checks, ``isinstance``) do not taint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from polyaxon_tpu.analysis.core import Finding, SourceFile, register
+
+RUNTIME_PREFIX = "polyaxon_tpu/runtime/"
+STEP_LOOP_FILES = ("polyaxon_tpu/runtime/loop.py",)
+
+_SYNC_CALLS = {
+    "block_until_ready": "jax.block_until_ready forces a device sync",
+    "device_get": "jax.device_get copies device -> host",
+}
+_NP_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return ""
+    parts.reverse()
+    return ".".join(parts)
+
+
+# ------------------------------------------------------------- jit scopes
+def _first_func_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    return None
+
+
+def jit_scope_functions(sf: SourceFile) -> tuple[set[str], list[ast.Lambda]]:
+    """Names of module/local functions that get jitted or shard_mapped,
+    plus lambdas passed inline (their bodies are jit scopes too)."""
+    names: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        tail = fname.rsplit(".", 1)[-1] if fname else ""
+        if tail not in ("jit", "shard_map", "pjit"):
+            continue
+        arg = _first_func_arg(node)
+        if arg is None:
+            for kw in node.keywords:
+                if kw.arg in ("f", "fun"):
+                    arg = kw.value
+        if arg is None:
+            continue
+        # unwrap functools.partial(step, ...)
+        if isinstance(arg, ast.Call) and \
+                _dotted(arg.func).rsplit(".", 1)[-1] == "partial" and arg.args:
+            arg = arg.args[0]
+        if isinstance(arg, ast.Name):
+            names.add(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            names.add(_dotted(arg))
+        elif isinstance(arg, ast.Lambda):
+            lambdas.append(arg)
+    # Decorated defs: @jax.jit / @jit / @partial(jax.jit, ...)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                dname = _dotted(d)
+                tail = dname.rsplit(".", 1)[-1] if dname else ""
+                if tail in ("jit", "pjit"):
+                    names.add(node.name)
+                elif tail == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args:
+                    inner = _dotted(dec.args[0])
+                    if inner.rsplit(".", 1)[-1] in ("jit", "pjit"):
+                        names.add(node.name)
+    return names, lambdas
+
+
+def _iter_functions(sf: SourceFile):
+    """(qualname, node) for every def, including nested ones."""
+
+    def walk(body, prefix):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{node.name}", node
+                yield from walk(node.body, f"{prefix}{node.name}.")
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, f"{node.name}.")
+
+    yield from walk(sf.tree.body, "")
+
+
+# ------------------------------------------------------------- host sync
+def _is_literalish(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_literalish(node.left) and _is_literalish(node.right)
+    if isinstance(node, ast.Attribute):
+        # cfg.lr, self.learning_rate: config scalars, not arrays
+        return True
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name.startswith("math."):
+            return True  # math.ceil/floor operate on python scalars
+        tail = name.rsplit(".", 1)[-1]
+        return tail in ("len", "min", "max", "round", "getattr", "get")
+    if isinstance(node, ast.Subscript):
+        # shape[0], os.environ["X"]-style lookups
+        return True
+    return False
+
+
+def _sync_findings(sf: SourceFile, body, qualname: str) -> list[Finding]:
+    found = []
+    for node in ast.walk(body) if not isinstance(body, list) else \
+            (n for stmt in body for n in ast.walk(stmt)):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        message = None
+        if tail in _SYNC_CALLS:
+            message = _SYNC_CALLS[tail]
+        elif name in _NP_MATERIALIZE:
+            message = f"{name} materializes the array on the host"
+        elif tail == "item" and isinstance(node.func, ast.Attribute):
+            message = ".item() pulls a scalar to the host"
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in ("float", "int") and node.args and \
+                not _is_literalish(node.args[0]):
+            message = (f"{node.func.id}() on a computed value forces "
+                       "host materialization")
+        if message:
+            f = sf.finding("hotpath-host-sync", node.lineno,
+                           message + " — in the hot path; hoist it out "
+                           "or pragma the deliberate sync point",
+                           qualname=qualname)
+            if f:
+                found.append(f)
+    return found
+
+
+# ------------------------------------------------------------ tracer taint
+_UNTAINT_CALLS = {"len", "isinstance", "getattr", "hasattr", "type"}
+
+
+class _TaintTracker(ast.NodeVisitor):
+    def __init__(self, params: set[str]):
+        self.tainted = set(params)
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+                return False  # x.shape chains are static
+            if isinstance(sub, ast.Call):
+                tail = _dotted(sub.func).rsplit(".", 1)[-1]
+                if tail in _UNTAINT_CALLS:
+                    return False
+        return any(isinstance(sub, ast.Name) and sub.id in self.tainted
+                   for sub in ast.walk(node))
+
+    def visit_Assign(self, node: ast.Assign):
+        if self._expr_tainted(node.value):
+            for target in node.targets:
+                for sub in ast.walk(target):
+                    if isinstance(sub, ast.Name):
+                        self.tainted.add(sub.id)
+        self.generic_visit(node)
+
+
+def _branch_findings(sf: SourceFile, fn: ast.AST,
+                     qualname: str) -> list[Finding]:
+    params: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        # Keyword-only params stay untainted: in this codebase's
+        # shard_map/jit idiom they are static config bound via
+        # functools.partial closures (causal=, axis_name=, attn_impl=)
+        # before tracing; only positional args carry arrays.
+        for a in list(args.args) + list(args.posonlyargs):
+            params.add(a.arg)
+    tracker = _TaintTracker(params)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        tracker.visit(stmt)
+    found = []
+    for node in (n for stmt in body for n in ast.walk(stmt)):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        test = node.test
+        # `x is None` / `key in d` are static trace-time checks, and a
+        # bare-name truthiness test (`if mutable:`) is overwhelmingly a
+        # container/None check on pytree STRUCTURE (array truthiness
+        # raises immediately at trace time, so tests catch it).
+        if isinstance(test, ast.Compare) and \
+                any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                    for op in test.ops):
+            continue
+        if isinstance(test, (ast.Name, ast.Attribute)) or (
+                isinstance(test, ast.UnaryOp) and
+                isinstance(test.op, ast.Not) and
+                isinstance(test.operand, (ast.Name, ast.Attribute))):
+            continue
+        if tracker._expr_tainted(test):
+            f = sf.finding(
+                "hotpath-tracer-branch", node.lineno,
+                "python branch on a value derived from a jitted "
+                "function's arguments (a tracer): lift to jnp.where/"
+                "lax.cond or mark the argument static",
+                qualname=qualname)
+            if f:
+                found.append(f)
+    return found
+
+
+# ---------------------------------------------------------------- analyzer
+@register
+def analyze_hotpath(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        jit_names, jit_lambdas = jit_scope_functions(sf)
+        in_step_loop = sf.path in STEP_LOOP_FILES
+        for qualname, fn in _iter_functions(sf):
+            is_jit = (fn.name in jit_names or qualname in jit_names)
+            if is_jit:
+                findings.extend(_sync_findings(sf, fn.body, qualname))
+                findings.extend(_branch_findings(sf, fn, qualname))
+            elif in_step_loop:
+                # the runtime step loop is hot even unjitted, but owns
+                # its sync points — only direct statements here; nested
+                # defs are covered by their own iteration.
+                findings.extend(_sync_findings_shallow(sf, fn, qualname))
+        for lam in jit_lambdas:
+            findings.extend(_sync_findings(sf, lam.body, "<lambda>"))
+            findings.extend(_branch_findings(sf, lam, "<lambda>"))
+
+        if sf.path.startswith(RUNTIME_PREFIX):
+            findings.extend(_runtime_findings(sf))
+    return findings
+
+
+def _sync_findings_shallow(sf: SourceFile, fn, qualname) -> list[Finding]:
+    """Like _sync_findings but does not descend into nested defs (they
+    are visited as their own functions)."""
+
+    class _Shallow(ast.NodeVisitor):
+        def __init__(self):
+            self.calls: list[ast.Call] = []
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Call(self, node):
+            self.calls.append(node)
+            self.generic_visit(node)
+
+    shallow = _Shallow()
+    for stmt in fn.body:
+        shallow.visit(stmt)
+    found = []
+    for call in shallow.calls:
+        for f in _sync_findings(sf, call, qualname):
+            found.append(f)
+    # _sync_findings walks each call node fully; dedupe by line+rule
+    seen = set()
+    out = []
+    for f in found:
+        key = (f.rule, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _runtime_findings(sf: SourceFile) -> list[Finding]:
+    found = []
+    for qualname, fn in _iter_functions(sf):
+        for node in (n for stmt in fn.body for n in ast.walk(stmt)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name in ("time.time", "time.time_ns") or \
+                    name.endswith("datetime.now") or name == "datetime.now":
+                f = sf.finding(
+                    "hotpath-wallclock", node.lineno,
+                    f"{name}() in runtime/ — wall clock in a replay-"
+                    "relevant path breaks resume determinism; use the "
+                    "step index / config seed, or pragma observability "
+                    "timestamps", qualname=qualname)
+                if f:
+                    found.append(f)
+            elif name.startswith("np.random.") or \
+                    name.startswith("numpy.random."):
+                tail = name.rsplit(".", 1)[-1]
+                if tail == "default_rng" and node.args:
+                    continue  # seeded: batch i = f(seed, i) holds
+                f = sf.finding(
+                    "hotpath-unseeded-random", node.lineno,
+                    f"{name}() without an explicit seed in runtime/ "
+                    "breaks resume-exactness; derive a Generator from "
+                    "(config seed, step)", qualname=qualname)
+                if f:
+                    found.append(f)
+    # dedupe identical (rule, line)
+    seen = set()
+    out = []
+    for f in found:
+        key = (f.rule, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

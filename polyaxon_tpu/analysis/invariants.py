@@ -1,0 +1,326 @@
+"""Repo-invariant analyzers.
+
+- ``invariant-swallow`` — ``except Exception: pass`` (or bare
+  ``except``) whose handler does NOTHING: no log, no flight-recorder
+  note, no re-raise, no fallback assignment. The chaos harness proved
+  these hide real faults; a swallow must at least leave a debug line
+  or a flight note so the postmortem can see it.
+- ``invariant-metric-catalog`` — a metric emitted by literal name
+  (``registry.counter("...")`` / ``.gauge`` / ``.histogram``) that is
+  not in ``obs.metrics.catalog_metric_names()``. An un-cataloged name
+  is invisible to the alert-rule schema gate: a rule against it would
+  validate as a typo and an alert on it could never be written.
+- ``invariant-store-batch`` — a function that performs 2+ control-plane
+  store writes with no ``transaction()`` in sight (neither lexically
+  nor via a same-module caller that wraps it): each write pays its own
+  WAL fsync and a crash between them leaves partial state. Single
+  writes are fine — they are atomic on their own.
+- ``invariant-daemon-drain`` — a ``threading.Thread(daemon=True)``
+  that nothing ever joins: on interpreter exit the thread is killed
+  mid-operation (half-written file, dropped queue item). Every daemon
+  needs a drain path (``stop()``+``join``) or a reasoned pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from polyaxon_tpu.analysis.core import Finding, SourceFile, register
+
+STORE_MUTATORS = frozenset({
+    "transition", "update_run", "create_run", "add_condition",
+    "create_project", "upsert_queue", "set_quota", "delete_queue",
+    "delete_quota",
+})
+METRICS_FILE = "polyaxon_tpu/obs/metrics.py"  # defines the catalog itself
+_LOG_HINTS = ("log", "warn", "error", "debug", "info", "exception",
+              "note", "print", "add_event", "record", "inc", "observe")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return ""
+    parts.reverse()
+    return ".".join(parts)
+
+
+def _iter_functions(sf: SourceFile):
+    def walk(body, prefix):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{prefix}{node.name}", node
+                yield from walk(node.body, f"{prefix}{node.name}.")
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, f"{node.name}.")
+
+    yield from walk(sf.tree.body, "")
+
+
+# ---------------------------------------------------------------- swallow
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    names = []
+    t = handler.type
+    if isinstance(t, ast.Tuple):
+        names = [_dotted(e) for e in t.elts]
+    else:
+        names = [_dotted(t)]
+    return any(n.rsplit(".", 1)[-1] in ("Exception", "BaseException")
+               for n in names)
+
+
+def _handler_acts(handler: ast.ExceptHandler) -> bool:
+    """Does the handler DO anything observable? A log/flight/metric
+    call, a raise, a return/assignment fallback, setting state — all
+    count. Only `pass` (and docstring-style constants) does not."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue  # bare string/Ellipsis, still silent
+        if isinstance(stmt, ast.Continue):
+            continue  # loop skip with no trace is still a swallow
+        return True
+    return False
+
+
+@register
+def analyze_swallow(files: list[SourceFile]) -> list[Finding]:
+    findings = []
+    for sf in files:
+        for qualname, fn in _iter_functions(sf):
+            for node in (n for stmt in fn.body for n in ast.walk(stmt)):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node) or _handler_acts(node):
+                    continue
+                f = sf.finding(
+                    "invariant-swallow", node.lineno,
+                    "broad except swallows the error with no trace: "
+                    "log at debug, leave a flight-recorder note, or "
+                    "pragma with the reason the silence is safe",
+                    qualname=qualname)
+                if f:
+                    findings.append(f)
+        # module-level try/except too
+        for node in sf.tree.body:
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    if _is_broad(handler) and not _handler_acts(handler):
+                        f = sf.finding(
+                            "invariant-swallow", handler.lineno,
+                            "broad except swallows the error with no "
+                            "trace at module scope", qualname="<module>")
+                        if f:
+                            findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------- metric catalog
+def _catalog() -> set[str]:
+    from polyaxon_tpu.obs.metrics import catalog_metric_names
+
+    return catalog_metric_names()
+
+
+@register
+def analyze_metric_catalog(files: list[SourceFile]) -> list[Finding]:
+    findings = []
+    vocabulary: Optional[set[str]] = None
+    for sf in files:
+        if sf.path == METRICS_FILE:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("counter", "gauge", "histogram"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            recv = _dotted(node.func.value)
+            tail = recv.rsplit(".", 1)[-1].lower() if recv else ""
+            if "registry" not in tail and tail != "metrics" and \
+                    not recv.endswith("REGISTRY"):
+                continue
+            name = node.args[0].value
+            if vocabulary is None:
+                vocabulary = _catalog()
+            if name in vocabulary:
+                continue
+            f = sf.finding(
+                "invariant-metric-catalog", node.lineno,
+                f"metric {name!r} is not in catalog_metric_names(): "
+                "alert rules cannot reference it (the obs-rules schema "
+                "gate validates against the catalog). Add it to the "
+                "obs.metrics catalog/SCRAPE_TIME_METRICS",
+                qualname="")
+            if f:
+                findings.append(f)
+    return findings
+
+
+# ------------------------------------------------------------- store batch
+def _store_method(call: ast.Call) -> Optional[str]:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = _dotted(call.func.value)
+    last = recv.rsplit(".", 1)[-1] if recv else ""
+    if last == "store":
+        return call.func.attr
+    return None
+
+
+class _StoreScan(ast.NodeVisitor):
+    def __init__(self):
+        self.mutations: list[int] = []
+        self.txn_lines: list[int] = []
+        self.in_txn_depth = 0
+        self.mutations_outside_txn: list[int] = []
+        self.calls: set[str] = set()
+
+    def visit_With(self, node: ast.With):
+        is_txn = any(
+            isinstance(i.context_expr, ast.Call)
+            and _store_method(i.context_expr) == "transaction"
+            for i in node.items)
+        if is_txn:
+            self.txn_lines.append(node.lineno)
+            self.in_txn_depth += 1
+        self.generic_visit(node)
+        if is_txn:
+            self.in_txn_depth -= 1
+
+    def visit_Call(self, node: ast.Call):
+        method = _store_method(node)
+        if method in STORE_MUTATORS:
+            self.mutations.append(node.lineno)
+            if not self.in_txn_depth:
+                self.mutations_outside_txn.append(node.lineno)
+        if isinstance(node.func, ast.Name):
+            self.calls.add(node.func.id)
+        elif isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name):
+            base = node.func.value.id
+            self.calls.add(f"{node.func.attr}" if base in ("self", "cls")
+                           else f"{base}.{node.func.attr}")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs analyzed separately
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+
+@register
+def analyze_store_batch(files: list[SourceFile]) -> list[Finding]:
+    findings = []
+    for sf in files:
+        if sf.path == "polyaxon_tpu/controlplane/store.py":
+            continue  # the store's own internals ARE the batching layer
+        scans: dict[str, _StoreScan] = {}
+        nodes: dict[str, ast.AST] = {}
+        for qualname, fn in _iter_functions(sf):
+            scan = _StoreScan()
+            for stmt in fn.body:
+                scan.visit(stmt)
+            scans[qualname] = scan
+            nodes[qualname] = fn
+        # Functions (by trailing name) called from inside a transaction
+        # block somewhere in this module are covered by that batch.
+        covered: set[str] = set()
+        for qualname, scan in scans.items():
+            if scan.txn_lines:
+                covered |= {c.rsplit(".", 1)[-1] for c in scan.calls}
+        # ...transitively: callees of covered functions are covered too.
+        changed = True
+        while changed:
+            changed = False
+            for qualname, scan in scans.items():
+                if qualname.rsplit(".", 1)[-1] in covered:
+                    fresh = {c.rsplit(".", 1)[-1] for c in scan.calls}
+                    if not fresh <= covered:
+                        covered |= fresh
+                        changed = True
+        for qualname, scan in scans.items():
+            if len(scan.mutations_outside_txn) < 2:
+                continue
+            if qualname.rsplit(".", 1)[-1] in covered:
+                continue
+            f = sf.finding(
+                "invariant-store-batch", scan.mutations_outside_txn[0],
+                f"{len(scan.mutations_outside_txn)} store writes in one "
+                "function with no transaction(): each pays its own WAL "
+                "fsync and a crash between them leaves partial state — "
+                "wrap the sequence in `with store.transaction():`",
+                qualname=qualname)
+            if f:
+                findings.append(f)
+    return findings
+
+
+# ------------------------------------------------------------ daemon drain
+@register
+def analyze_daemon_drain(files: list[SourceFile]) -> list[Finding]:
+    findings = []
+    for sf in files:
+        has_join_on: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join":
+                recv = _dotted(node.func.value)
+                if recv:
+                    has_join_on.add(recv.rsplit(".", 1)[-1])
+                    has_join_on.add(recv)
+        for qualname, fn in _iter_functions(sf):
+            for node in (n for stmt in fn.body for n in ast.walk(stmt)):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func)
+                if name.rsplit(".", 1)[-1] != "Thread":
+                    continue
+                daemon = any(kw.arg == "daemon" and
+                             isinstance(kw.value, ast.Constant) and
+                             kw.value.value is True
+                             for kw in node.keywords)
+                if not daemon:
+                    continue
+                target = _assign_target_for(sf, node)
+                if target is not None and (
+                        target in has_join_on or
+                        target.rsplit(".", 1)[-1] in has_join_on):
+                    continue
+                f = sf.finding(
+                    "invariant-daemon-drain", node.lineno,
+                    "daemon thread with no join anywhere in the module: "
+                    "interpreter exit kills it mid-operation. Add a "
+                    "drain path (stop()+join, or register close on the "
+                    "ExitStack) or pragma the reason it is safe to kill",
+                    qualname=qualname)
+                if f:
+                    findings.append(f)
+    return findings
+
+
+def _assign_target_for(sf: SourceFile, call: ast.Call) -> Optional[str]:
+    """The name a Thread(...) result is bound to, if any (searched by
+    position: the Assign whose value contains this call)."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for sub in ast.walk(node.value):
+                if sub is call:
+                    target = node.targets[0]
+                    return _dotted(target) or None
+    return None

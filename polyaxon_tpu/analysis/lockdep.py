@@ -1,0 +1,249 @@
+"""Runtime lockdep: record REAL lock-acquisition orders, fail on cycles.
+
+The static graph (``analysis.concurrency``) sees lexical nesting; this
+shim sees what threads actually do. Opt-in (``POLYCHECK_LOCKDEP=1`` or
+the :func:`lockdep` context manager), it monkeypatches
+``threading.Lock``/``RLock`` so locks CREATED by ``polyaxon_tpu`` code
+(creation-site module filter — stdlib and third-party locks pass
+through untouched) record, per thread, the ordered set of locks held
+at every acquisition. Edges aggregate per creation SITE (Linux-lockdep
+style: the class of lock, not the instance), so one drill generalizes
+over every store/registry instance the suite creates. A cycle in the
+aggregated graph is an observed AB-BA inversion; the chaos/sim drills
+assert :func:`cycles` is empty after the gauntlet.
+
+Report-only by default: acquisition never blocks or raises (a lockdep
+bug must never deadlock the suite it watches); violations accumulate
+in :data:`REGISTRY` for the drill's final assertion.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+_PKG_PREFIX = "polyaxon_tpu"
+
+
+@dataclass
+class Violation:
+    cycle: tuple[str, ...]
+    edge: tuple[str, str]
+    thread: str
+
+    def render(self) -> str:
+        return (f"lock cycle {' -> '.join(self.cycle)} closed by "
+                f"{self.edge[0]} -> {self.edge[1]} on thread {self.thread}")
+
+
+class LockdepRegistry:
+    """Aggregated acquisition graph + observed violations."""
+
+    def __init__(self):
+        # a plain dict mutated under the GIL per-op; edges is
+        # append-mostly and reads happen after the drill joins threads.
+        self.edges: dict[tuple[str, str], int] = {}
+        self.violations: list[Violation] = []
+        self._held = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = []
+            self._held.stack = stack
+        return stack
+
+    def on_acquire(self, shim: "_LockShim") -> None:
+        stack = self._stack()
+        for held in stack:
+            if held.site == shim.site:
+                continue
+            edge = (held.site, shim.site)
+            first = edge not in self.edges
+            self.edges[edge] = self.edges.get(edge, 0) + 1
+            if first:
+                cycle = self._find_cycle(shim.site, held.site)
+                if cycle:
+                    self.violations.append(Violation(
+                        cycle=tuple(cycle), edge=edge,
+                        thread=threading.current_thread().name))
+        stack.append(shim)
+
+    def on_release(self, shim: "_LockShim") -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is shim:
+                del stack[i]
+                return
+
+    def _find_cycle(self, src: str, dst: str) -> Optional[list[str]]:
+        """Path src -> dst in the edge graph means the new dst -> src
+        edge closes a cycle."""
+        seen = {src}
+        path = [src]
+
+        def dfs(node: str) -> Optional[list[str]]:
+            if node == dst:
+                return list(path)
+            for (a, b) in self.edges:
+                if a == node and b not in seen:
+                    seen.add(b)
+                    path.append(b)
+                    hit = dfs(b)
+                    if hit is not None:
+                        return hit
+                    path.pop()
+            return None
+
+        hit = dfs(src)
+        if hit is not None:
+            hit.append(dst)
+        return hit
+
+    def reset(self) -> None:
+        self.edges.clear()
+        self.violations.clear()
+
+
+REGISTRY = LockdepRegistry()
+
+
+class _LockShim:
+    """Wraps a real Lock/RLock; re-entrant acquisitions of the same
+    shim do not re-record (no self-edges from RLock reentry)."""
+
+    def __init__(self, real, site: str, registry: LockdepRegistry):
+        self._real = real
+        self.site = site
+        self._registry = registry
+        self._owner_depth = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._owner_depth, "n", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            if self._depth() == 0:
+                self._registry.on_acquire(self)
+            self._owner_depth.n = self._depth() + 1
+        return got
+
+    def release(self):
+        depth = self._depth()
+        if depth <= 1:
+            self._owner_depth.n = 0
+            self._registry.on_release(self)
+        else:
+            self._owner_depth.n = depth - 1
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_installed = False
+
+
+def _creation_site() -> Optional[str]:
+    """`module:lineno` of the polyaxon_tpu frame creating the lock, or
+    None when the creator is stdlib/third-party (left uninstrumented).
+
+    Only the IMMEDIATE creator frame decides: walking further up would
+    claim every lock a third-party library (orbax's async-checkpoint
+    machinery, fsspec) builds while servicing a polyaxon_tpu call, and
+    their internal lock protocols then read as false AB-BA cycles."""
+    frame = sys._getframe(2)
+    if frame is None:
+        return None
+    mod = frame.f_globals.get("__name__", "")
+    if mod.startswith(_PKG_PREFIX) and "analysis.lockdep" not in mod:
+        return f"{mod}:{frame.f_lineno}"
+    return None
+
+
+def _make_lock(*args, **kwargs):
+    real = _REAL_LOCK(*args, **kwargs)
+    site = _creation_site()
+    if site is None:
+        return real
+    return _LockShim(real, site, REGISTRY)
+
+
+def _make_rlock(*args, **kwargs):
+    real = _REAL_RLOCK(*args, **kwargs)
+    site = _creation_site()
+    if site is None:
+        return real
+    return _LockShim(real, site, REGISTRY)
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock constructors. Locks already created
+    keep their real class — enable BEFORE building the system under
+    drill. Condition() is untouched: its wait/notify protocol manages
+    its inner lock out-of-band and would corrupt the held-stack."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+
+
+def cycles() -> list[Violation]:
+    return list(REGISTRY.violations)
+
+
+def edge_count() -> int:
+    return len(REGISTRY.edges)
+
+
+class lockdep:
+    """``with lockdep():`` — install, run the drill, uninstall. The
+    registry persists after exit so the caller can assert on cycles()."""
+
+    def __init__(self, reset: bool = True):
+        self.reset = reset
+
+    def __enter__(self):
+        if self.reset:
+            REGISTRY.reset()
+        install()
+        return REGISTRY
+
+    def __exit__(self, *exc):
+        uninstall()
+        return False
+
+
+def maybe_install_from_env() -> bool:
+    """Hook for suite entrypoints: POLYCHECK_LOCKDEP=1 turns the shim
+    on for the whole process (the chaos/sim gauntlets in CI)."""
+    if os.environ.get("POLYCHECK_LOCKDEP") == "1":
+        install()
+        return True
+    return False

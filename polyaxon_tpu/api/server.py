@@ -667,6 +667,11 @@ class ApiServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._thread is not None:
+            # Drain the serve loop so in-flight handlers finish before
+            # teardown (a daemon thread dies mid-response at exit).
+            self._thread.join(timeout=5)
+            self._thread = None
 
     def __enter__(self) -> "ApiServer":
         return self.start()

@@ -873,6 +873,7 @@ def server_cmd(host, port, with_agent, max_concurrent, heartbeat_timeout,
 
         agent = Agent(plane, slice_manager=manager,
                       max_concurrent=max_concurrent)
+        # polycheck: ignore[invariant-daemon-drain] -- foreground CLI: the agent lives exactly as long as the blocking serve_forever below; process exit is the teardown
         threading.Thread(target=agent.serve_forever, daemon=True).start()
     click.echo(f"API serving on {server.url} (home={get_home()})"
                + (" with agent" if with_agent else ""))

@@ -65,7 +65,6 @@ class ControlPlane:
             op = check_polyaxonfile(polyaxonfile, params=params, presets=presets)
         elif params or presets:
             op = check_polyaxonfile(op.to_dict(), params=params, presets=presets)
-        self.store.create_project(project)
         is_pipeline = op.matrix is not None or (
             op.component is not None and op.component.run_kind == V1RunKind.DAG
         )
@@ -91,18 +90,23 @@ class ControlPlane:
                             or {}).get("owner")
             if parent_owner:
                 meta = {**(meta or {}), "owner": parent_owner}
-        record = self.store.create_run(
-            project=project,
-            spec=op.to_dict(),
-            name=name or op.name or (op.component.name if op.component else None),
-            kind=kind,
-            params={k: p.to_dict() for k, p in (op.params or {}).items()} or None,
-            tags=tags or op.tags,
-            meta=meta,
-            parent_uuid=parent_uuid,
-            pipeline_uuid=pipeline_uuid,
-            iteration=iteration,
-        )
+        # Project row + run row land in one commit: a crash between
+        # them would leave a project with no run (or, ordered the other
+        # way, a run pointing at a missing project).
+        with self.store.transaction():
+            self.store.create_project(project)
+            record = self.store.create_run(
+                project=project,
+                spec=op.to_dict(),
+                name=name or op.name or (op.component.name if op.component else None),
+                kind=kind,
+                params={k: p.to_dict() for k, p in (op.params or {}).items()} or None,
+                tags=tags or op.tags,
+                meta=meta,
+                parent_uuid=parent_uuid,
+                pipeline_uuid=pipeline_uuid,
+                iteration=iteration,
+            )
         return record
 
     # -- compilation -------------------------------------------------------
@@ -407,11 +411,16 @@ class ControlPlane:
         record = self.store.get_run(run_uuid)
         if not record.is_done and record.status != V1Statuses.PREEMPTED:
             raise ValueError(f"Run `{run_uuid}` is not resumable from {record.status}")
-        self.store.transition(run_uuid, V1Statuses.RESUMING, force=True)
         if record.launch_plan:
-            self.store.transition(run_uuid, V1Statuses.COMPILED)
-            self.store.transition(run_uuid, V1Statuses.QUEUED)
+            # One commit for the whole requeue hop: a crash mid-chain
+            # would otherwise strand the run in RESUMING/COMPILED where
+            # neither the scheduler nor resume() would pick it back up.
+            with self.store.transaction():
+                self.store.transition(run_uuid, V1Statuses.RESUMING, force=True)
+                self.store.transition(run_uuid, V1Statuses.COMPILED)
+                self.store.transition(run_uuid, V1Statuses.QUEUED)
             return self.store.get_run(run_uuid)
+        self.store.transition(run_uuid, V1Statuses.RESUMING, force=True)
         # Stopped before compilation: compile now (resolves + queues).
         return self.compile_run(run_uuid)
 
@@ -523,22 +532,26 @@ class ControlPlane:
         consistent. ``meta["lineage_indexed"]`` marks the run so the
         request-time scan skips re-deriving it."""
         record = self.store.get_run(run_uuid)
-        for uuid, kind, label in self._upstream_edges(record):
-            try:
-                up = self.store.get_run(uuid)
-            except Exception:  # noqa: BLE001 — deleted upstream: no edge
-                continue
-            meta = dict(up.meta or {})
-            edges = list(meta.get("downstream_runs") or [])
-            entry = {"uuid": run_uuid, "kind": kind,
-                     **({"label": label} if label else {})}
-            if entry not in edges:
-                edges.append(entry)
-                meta["downstream_runs"] = edges
-                self.store.update_run(uuid, meta=meta)
-        meta = dict(record.meta or {})
-        meta["lineage_indexed"] = True
-        self.store.update_run(run_uuid, meta=meta)
+        # The mirrored edges and the indexed marker are one unit: a
+        # crash after some edge writes but before the marker would look
+        # indexed-enough to skip yet miss edges, so batch the lot.
+        with self.store.transaction():
+            for uuid, kind, label in self._upstream_edges(record):
+                try:
+                    up = self.store.get_run(uuid)
+                except KeyError:  # deleted upstream: no edge
+                    continue
+                meta = dict(up.meta or {})
+                edges = list(meta.get("downstream_runs") or [])
+                entry = {"uuid": run_uuid, "kind": kind,
+                         **({"label": label} if label else {})}
+                if entry not in edges:
+                    edges.append(entry)
+                    meta["downstream_runs"] = edges
+                    self.store.update_run(uuid, meta=meta)
+            meta = dict(record.meta or {})
+            meta["lineage_indexed"] = True
+            self.store.update_run(run_uuid, meta=meta)
 
     def lineage_graph(self, run_uuid: str) -> dict:
         """Inputs → run → outputs across runs (SURVEY §2 "Tracking":
@@ -564,7 +577,7 @@ class ControlPlane:
         for uuid, kind, label in self._upstream_edges(record, sibling_cache):
             try:
                 up = self.store.get_run(uuid)
-            except Exception:  # noqa: BLE001 — deleted upstream: drop edge
+            except KeyError:  # deleted upstream: drop edge
                 continue
             node(up)
             edges.append({"from": uuid, "to": run_uuid, "kind": kind,
@@ -579,7 +592,7 @@ class ControlPlane:
         for entry in (record.meta or {}).get("downstream_runs") or []:
             try:
                 down = self.store.get_run(entry["uuid"])
-            except Exception:  # noqa: BLE001 — deleted downstream
+            except KeyError:  # deleted downstream
                 continue
             node(down)
             edge = {"from": run_uuid, "to": down.uuid,

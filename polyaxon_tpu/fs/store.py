@@ -17,6 +17,7 @@ fsspec-backed one:
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import shutil
 import threading
@@ -380,8 +381,9 @@ def _observe_store_op(op: str, scheme: str, seconds: float) -> None:
         from polyaxon_tpu.obs import metrics as obs_metrics
 
         obs_metrics.store_op_hist().observe(seconds, op=op, scheme=scheme)
-    except Exception:  # noqa: BLE001 — observability stays passive
-        pass
+    except Exception as exc:  # observability stays passive
+        logging.getLogger(__name__).debug(
+            "store-op histogram observe failed: %s", exc)
 
 
 def _timed_store_op(op: str, fn):

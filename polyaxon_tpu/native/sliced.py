@@ -11,6 +11,7 @@ notes) and exposes a thin, typed API for the agent.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
@@ -34,6 +35,7 @@ def ensure_built() -> str:
     """Build libsliced.so if missing; return its path."""
     with _build_lock:
         if not os.path.exists(_LIB_PATH):
+            # polycheck: ignore[lock-blocking-call] -- the build mutex exists to serialize this one-shot compile; it nests no other lock and waiters need the .so anyway
             result = subprocess.run(
                 ["make", "-C", _NATIVE_DIR, "build/libsliced.so"],
                 capture_output=True, text=True,
@@ -108,8 +110,11 @@ class SlicePool:
     def __del__(self):  # best-effort; close() is the real API
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as exc:
+            # Raising in __del__ is unusable noise at interpreter
+            # teardown, but the leak is worth one debug line.
+            logging.getLogger(__name__).debug(
+                "SlicePool.__del__ close failed: %s", exc)
 
     def __enter__(self):
         return self

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import logging
 import os
 import threading
 import time
@@ -77,8 +78,9 @@ class FlightRecorder:
             with self._lock:
                 if run_uuid in self._runs:
                     self._runs[run_uuid]["baseline"] = baseline
-        except Exception:  # noqa: BLE001 — fail-open by contract
-            pass
+        except Exception as exc:  # fail-open by contract
+            logging.getLogger(__name__).debug(
+                "flight mark_start failed for %s: %s", run_uuid, exc)
 
     def record_trace(self, run_uuid: str, record: dict[str, Any]) -> None:
         """Tap for RunTracer.write: keep the span/event fields that
@@ -87,8 +89,9 @@ class FlightRecorder:
             kept = {k: record[k] for k in _SPAN_KEEP if k in record}
             with self._lock:
                 self._entry(run_uuid)["ring"].append(kept)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # fail-open by contract
+            logging.getLogger(__name__).debug(
+                "flight record_trace failed for %s: %s", run_uuid, exc)
 
     def note(self, run_uuid: str, name: str, **attrs: Any) -> None:
         """Arbitrary flight note (the runtime loop records each metrics
@@ -98,8 +101,9 @@ class FlightRecorder:
                 self._entry(run_uuid)["ring"].append({
                     "type": "note", "name": name, "time": time.time(),
                     "attributes": attrs})
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # fail-open by contract
+            logging.getLogger(__name__).debug(
+                "flight note %r failed for %s: %s", name, run_uuid, exc)
 
     # -- deltas ------------------------------------------------------------
     @staticmethod

@@ -23,6 +23,7 @@ fresh scrape exposes a stable schema before any sample lands.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 from typing import Any, Iterable, Optional
@@ -118,8 +119,9 @@ class _Metric:
         if self._on_drop is not None:
             try:
                 self._on_drop(self.name)
-            except Exception:  # noqa: BLE001 — accounting stays passive
-                pass
+            except Exception as exc:  # accounting stays passive
+                logging.getLogger(__name__).debug(
+                    "on_drop hook failed for %s: %s", self.name, exc)
 
     def clear(self) -> None:
         """Drop all label series (scrape-time gauges rebuilt from store

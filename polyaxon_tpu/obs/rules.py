@@ -502,13 +502,15 @@ class AlertEngine:
                     "value": event["value"],
                 })
                 meta["alerts"] = alerts[-8:]
-                plane.store.update_run(record.uuid, meta=meta)
-                plane.store.transition(
-                    record.uuid, record.status, reason="AlertFiring",
-                    message=f"{event['rule']}: "
-                            f"{event['description'] or event['metric']} "
-                            f"(value={event['value']})"[:500],
-                    force=True)
+                # Annotation + condition pin are one observable unit.
+                with plane.store.transaction():
+                    plane.store.update_run(record.uuid, meta=meta)
+                    plane.store.transition(
+                        record.uuid, record.status, reason="AlertFiring",
+                        message=f"{event['rule']}: "
+                                f"{event['description'] or event['metric']} "
+                                f"(value={event['value']})"[:500],
+                        force=True)
         except Exception:  # noqa: BLE001 — observability stays passive
             import logging
 

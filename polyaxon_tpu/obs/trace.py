@@ -35,6 +35,7 @@ import contextlib
 import contextvars
 import datetime as _dt
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -258,8 +259,9 @@ class RunTracer:
             from polyaxon_tpu.obs import flight as _flight
 
             _flight.RECORDER.record_trace(self.trace_id, record)
-        except Exception:  # noqa: BLE001 — the recorder is fail-open
-            pass
+        except Exception as exc:  # the recorder is fail-open
+            logging.getLogger(__name__).debug(
+                "flight-recorder trace tap failed: %s", exc)
 
     def flush(self) -> None:
         self._writer.flush()

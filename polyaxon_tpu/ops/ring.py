@@ -38,6 +38,7 @@ all other axes left to GSPMD (partial-manual sharding).
 from __future__ import annotations
 
 import functools
+import logging
 import warnings
 from typing import Optional
 
@@ -96,14 +97,17 @@ def ambient_mesh():
         mesh = mesh_lib.thread_resources.env.physical_mesh
         if mesh is not None and not mesh.empty:
             return mesh
-    except Exception:
-        pass
+    except Exception as exc:
+        logging.getLogger(__name__).debug(
+            "thread_resources mesh probe failed (jax internals moved?): %s",
+            exc)
     try:
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is not None and not mesh.empty:
             return mesh
-    except Exception:
-        pass
+    except Exception as exc:
+        logging.getLogger(__name__).debug(
+            "get_abstract_mesh probe failed: %s", exc)
     return None
 
 

@@ -11,6 +11,7 @@ than a wrong number.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 # bf16 peak matmul throughput per chip, for MFU. Keyed by substring of
@@ -59,6 +60,7 @@ def train_flops_per_token(model: str, seq: int,
                       + expert_params * mcfg.experts_per_token
                       // mcfg.n_experts)
             return 6 * active + 6 * mcfg.n_layers * seq * mcfg.dim
-    except Exception:
-        pass
+    except Exception as exc:
+        logging.getLogger(__name__).debug(
+            "flops derivation failed for %r: %s", model, exc)
     return None

@@ -230,9 +230,19 @@ class AdmissionController:
             return self._rebuild_live()
         self._passes += 1
         if not self._seeded:
+            # Seed OUTSIDE the lock: the scan is O(fleet) and every
+            # store transition's _notify blocks on _live_lock (while
+            # holding Store._lock), so holding it across list_runs
+            # stalls every writer for the whole scan. Deltas that land
+            # mid-rebuild win by uuid; drift in the other direction is
+            # healed by the periodic divergence cross-check below.
+            rebuilt = self._rebuild_live()
             with self._live_lock:
-                self._live = self._rebuild_live()
-                self._seeded = True
+                if not self._seeded:
+                    for uuid, entry in self._live.items():
+                        rebuilt[uuid] = entry
+                    self._live = rebuilt
+                    self._seeded = True
         elif self._passes % self.rebuild_ticks == 0:
             rebuilt = self._rebuild_live()
             self.rebuild_checks += 1

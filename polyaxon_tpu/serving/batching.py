@@ -563,6 +563,7 @@ class ContinuousBatchingEngine:
             # without stop() hanging on a wedged device.
             logger.warning("batching loop still draining at stop(); "
                            "waiters will be released when it exits")
+            # polycheck: ignore[invariant-daemon-drain] -- deliberately unjoined: the watcher exists so stop() does NOT hang on a wedged device; it only releases waiters
             threading.Thread(target=self._finalize_stop,
                              name="plx-batcher-finalize",
                              daemon=True).start()

@@ -740,6 +740,11 @@ class ServingServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self._thread is not None:
+            # Drain the serve loop so in-flight handlers finish before
+            # the engine (their backend) is stopped underneath them.
+            self._thread.join(timeout=5)
+            self._thread = None
         if hasattr(self.engine, "stop"):
             self.engine.stop()
 

@@ -73,6 +73,14 @@ class SyntheticExecutor:
 
     def poll(self) -> int:
         now = time.monotonic()
+        if not self._heap or self._heap[0][0] > now:
+            return 0
+        # All reaps due this tick commit as one batch (one WAL fsync
+        # instead of one per reaped gang — the sim reaps in bulk).
+        with self.store.transaction():
+            return self._reap_due(now)
+
+    def _reap_due(self, now: float) -> int:
         actions = 0
         while self._heap and self._heap[0][0] <= now:
             _, run_uuid = heapq.heappop(self._heap)

@@ -14,6 +14,7 @@ into the run's artifacts dir (which the sidecar syncs to the store).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import time
@@ -239,8 +240,9 @@ class Run:
             # Final sample so short runs still record system metrics.
             try:
                 self._emit_system_metrics(self._monitor.sample())
-            except Exception:
-                pass
+            except Exception as exc:
+                logging.getLogger(__name__).debug(
+                    "final system-metrics sample dropped: %s", exc)
         self._events.close()
         global _ACTIVE
         if _ACTIVE is self:

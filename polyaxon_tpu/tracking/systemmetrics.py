@@ -14,11 +14,14 @@ TPU metrics (SURVEY §2a note 3):
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
 
 import psutil
+
+logger = logging.getLogger(__name__)
 
 
 def host_metrics() -> dict[str, float]:
@@ -50,7 +53,8 @@ def tpu_metrics() -> dict[str, float]:
                 continue
             try:
                 stats = dev.memory_stats() or {}
-            except Exception:
+            except Exception as exc:
+                logger.debug("tpu%d memory_stats unavailable: %s", i, exc)
                 continue
             in_use = stats.get("bytes_in_use")
             limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
@@ -61,8 +65,8 @@ def tpu_metrics() -> dict[str, float]:
             peak = stats.get("peak_bytes_in_use")
             if peak is not None:
                 out[f"tpu{i}_hbm_peak_gb"] = peak / 2**30
-    except Exception:
-        pass
+    except Exception as exc:
+        logger.debug("tpu metrics sample failed: %s", exc)
     return out
 
 
@@ -106,8 +110,10 @@ def libtpu_metrics() -> dict[str, float]:
             continue
         try:
             data = tpumonitoring.get_metric(name).data()
-        except Exception:
-            continue  # snapshot unavailable right now; not fatal
+        except Exception as exc:
+            # snapshot unavailable right now; not fatal
+            logger.debug("libtpu metric %s unavailable: %s", name, exc)
+            continue
         for i, raw in enumerate(data if isinstance(data, (list, tuple))
                                 else [data]):
             try:
@@ -144,8 +150,9 @@ class SystemMetricsMonitor:
         while not self._stop.wait(self.interval):
             try:
                 self.emit(self.sample())
-            except Exception:
-                pass  # sampling must never kill the training process
+            except Exception as exc:
+                # sampling must never kill the training process
+                logger.debug("system metrics sample dropped: %s", exc)
 
     def start(self) -> None:
         if self._thread is not None:

@@ -18,6 +18,7 @@ and the executor's init-phase artifact downloads. Two primitives:
 from __future__ import annotations
 
 import hashlib
+import logging
 import time
 from typing import Any, Callable, Iterable, Optional, Type, Union
 
@@ -125,5 +126,6 @@ def _note_retry(attempt: int, exc: BaseException,
             "retry", attempt=attempt + 1,
             error=f"{type(exc).__name__}: {exc}"[:200],
             **({"key": key} if key else {}))
-    except Exception:  # noqa: BLE001 — observability stays passive
-        pass
+    except Exception as obs_exc:  # observability stays passive
+        logging.getLogger(__name__).debug(
+            "retry observability tap failed: %s", obs_exc)

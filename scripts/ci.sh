@@ -7,6 +7,28 @@
 # Pass --full for the complete suite (pre-push / nightly).
 set -e
 cd "$(dirname "$0")/.."
+# Static-analysis gate (ISSUE 9, docs/static-analysis.md): AST rules
+# over polyaxon_tpu/** — lock-order inversions, locks held across
+# blocking I/O, host syncs / wall clock / unseeded RNG in the step
+# path, store writes outside transaction(), un-cataloged metrics,
+# silent swallows, undrained daemon threads. Cheapest gate, so it runs
+# first. New findings fail here; suppressions live AT THE SITE as
+# reasoned `# polycheck: ignore[rule] -- why` pragmas (the committed
+# baseline is empty and only shrinks).
+echo "== polycheck (static analysis gate)"
+python -m polyaxon_tpu.analysis --check
+# The gate must be able to FAIL: each planted violation must flip
+# --check to exit 1 (the --deopt / --inject-reshard self-test pattern)
+# so a refactor that quietly breaks an analyzer fails the build.
+if python -m polyaxon_tpu.analysis --check --inject-lock-inversion >/dev/null 2>&1; then
+    echo "polycheck self-test FAILED: injected lock inversion passed the gate"
+    exit 1
+fi
+if python -m polyaxon_tpu.analysis --check --inject-uncataloged-metric >/dev/null 2>&1; then
+    echo "polycheck self-test FAILED: injected uncataloged metric passed the gate"
+    exit 1
+fi
+python -m pytest tests/test_analysis.py -q -m 'not slow'
 if [ "$1" = "--full" ]; then
     # Single-process full suite — the default since the XLA:CPU
     # collective-watchdog root cause was fixed and validated (two

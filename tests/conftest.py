@@ -76,7 +76,7 @@ SMOKE_MODULES = {
     "test_deploy.py", "test_connections.py", "test_fs.py", "test_cli.py",
     "test_api.py", "test_tracking.py", "test_schedules_cache.py",
     "test_joins_events.py", "test_sliced.py", "test_controlplane.py",
-    "test_utils_env.py", "test_scheduling.py",
+    "test_utils_env.py", "test_scheduling.py", "test_analysis.py",
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",
@@ -178,6 +178,13 @@ def pytest_collection_modifyitems(config, items):
             # e2e and chaos-drill timelines — its own `-m obs` stage in
             # scripts/ci.sh, and part of tier-1.
             item.add_marker(pytest.mark.obs)
+        if fname == "test_analysis.py":
+            # Static-analysis gate (ISSUE 9): golden analyzer fixtures,
+            # pragma/baseline semantics, CLI gate + injection
+            # self-tests, and the runtime lockdep drills — pure python,
+            # own `-m analysis` stage in scripts/ci.sh, whole module in
+            # the smoke tier.
+            item.add_marker(pytest.mark.analysis)
         if fname == "test_sim.py":
             # Fleet simulator (ISSUE 8): traces, synthetic executor,
             # budget gate, query-count regressions — its own `-m sim`

@@ -1,0 +1,16 @@
+"""Planted unjoined daemon thread (golden: invariant-daemon-drain).
+The joined twin is the negative control."""
+import threading
+
+
+def spawn():
+    worker = threading.Thread(target=print, daemon=True)
+    worker.start()
+    return worker
+
+
+def spawn_drained():
+    drained = threading.Thread(target=print, daemon=True)
+    drained.start()
+    drained.join(timeout=1)
+    return drained

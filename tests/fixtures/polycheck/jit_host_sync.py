@@ -1,0 +1,11 @@
+"""Planted host sync inside a jitted step (golden: hotpath-host-sync)."""
+import jax
+
+
+def step(state, batch):
+    loss = state + batch
+    host = float(loss)
+    return host
+
+
+train = jax.jit(step)

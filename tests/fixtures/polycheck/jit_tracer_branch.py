@@ -1,0 +1,18 @@
+"""Planted python branch on a tracer (golden: hotpath-tracer-branch).
+
+The `cfg is None` check below is a static trace-time branch and must
+stay silent (negative control for the Is/In exemptions).
+"""
+import jax
+
+
+def step(state, batch, cfg=None):
+    if cfg is None:
+        cfg = {}
+    delta = state - batch
+    if delta > 0:
+        return delta
+    return -delta
+
+
+train = jax.jit(step)

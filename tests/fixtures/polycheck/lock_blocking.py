@@ -1,0 +1,11 @@
+"""Planted blocking call under a lock (golden: lock-blocking-call)."""
+import threading
+import time
+
+_mutex = threading.Lock()
+
+
+def slow_update():
+    with _mutex:
+        time.sleep(0.5)
+        return 1

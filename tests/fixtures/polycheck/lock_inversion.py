@@ -1,0 +1,17 @@
+"""Planted AB-BA lock-order inversion (golden: lock-order)."""
+import threading
+
+_alpha = threading.Lock()
+_beta = threading.Lock()
+
+
+def forward():
+    with _alpha:
+        with _beta:
+            return 1
+
+
+def backward():
+    with _beta:
+        with _alpha:
+            return 2

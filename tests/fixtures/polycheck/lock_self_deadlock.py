@@ -1,0 +1,10 @@
+"""Planted non-reentrant self-nesting (golden: lock-self-deadlock)."""
+import threading
+
+_gate = threading.Lock()
+
+
+def reenter():
+    with _gate:
+        with _gate:
+            return 1

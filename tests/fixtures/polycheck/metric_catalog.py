@@ -1,0 +1,11 @@
+"""Planted un-cataloged metric emission (golden:
+invariant-metric-catalog). The second emission uses a cataloged name
+and must stay silent."""
+from polyaxon_tpu.obs import metrics
+
+
+def emit():
+    metrics.REGISTRY.counter(
+        "polycheck_fixture_not_cataloged_total", "planted").inc()
+    metrics.REGISTRY.counter(
+        "polyaxon_requeues_total", "cataloged").inc()

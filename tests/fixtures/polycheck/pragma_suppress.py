@@ -1,0 +1,28 @@
+"""Pragma semantics: reasoned suppression (above-line and trailing)
+silences the rule; a reason-less pragma is itself a finding AND does
+not suppress (golden: pragma-syntax + the unsuppressed swallow)."""
+import threading
+import time
+
+_mutex = threading.Lock()
+
+
+def quiet_sleep():
+    with _mutex:
+        # polycheck: ignore[lock-blocking-call] -- fixture: reasoned suppression on the line above
+        time.sleep(0.01)
+
+
+def trailing(risky):
+    try:
+        return risky()
+    except Exception:  # polycheck: ignore[invariant-swallow] -- fixture: reasoned trailing suppression
+        pass
+
+
+def unreasoned(risky):
+    try:
+        return risky()
+    except Exception:
+        # polycheck: ignore[invariant-swallow]
+        pass

@@ -1,0 +1,13 @@
+"""Planted wall clock + unseeded RNG under runtime/ (golden:
+hotpath-wallclock, hotpath-unseeded-random). The seeded default_rng
+draw is the negative control — batch i = f(seed, i) holds there."""
+import time
+
+import numpy as np
+
+
+def make_batch(step):
+    stamp = time.time()
+    noise = np.random.random(4)
+    good = np.random.default_rng(step).random(4)
+    return stamp, noise, good

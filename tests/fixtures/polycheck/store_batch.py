@@ -1,0 +1,13 @@
+"""Planted unbatched store writes (golden: invariant-store-batch).
+The transaction-wrapped twin is the negative control."""
+
+
+def promote(store, uuid):
+    store.transition(uuid, "scheduled")
+    store.transition(uuid, "starting")
+
+
+def promote_batched(store, uuid):
+    with store.transaction():
+        store.transition(uuid, "scheduled")
+        store.transition(uuid, "starting")

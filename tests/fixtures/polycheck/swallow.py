@@ -1,0 +1,20 @@
+"""Planted silent swallow (golden: invariant-swallow). The handler
+that logs at debug is the negative control — a trace is enough."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def quiet(risky):
+    try:
+        return risky()
+    except Exception:
+        pass
+
+
+def traced(risky):
+    try:
+        return risky()
+    except Exception:
+        logger.debug("risky failed", exc_info=True)
+        return None

@@ -1,0 +1,8 @@
+"""Transaction-scoped store scan: the sanctioned consistent-snapshot
+idiom — holding Store._lock across a scan of the SAME store must NOT
+fire lock-blocking-call (negative control for the exemption)."""
+
+
+def snapshot(store):
+    with store.transaction():
+        return store.list_runs(statuses=["running"])
