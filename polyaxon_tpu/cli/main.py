@@ -260,24 +260,9 @@ def ops_statuses(uid):
                    f"{cond.get('reason') or ''} {cond.get('message') or ''}")
 
 
-@ops.command("timeline")
-@click.option("-uid", "--uid", required=True)
-@click.option("--json", "as_json", is_flag=True,
-              help="raw span tree instead of the waterfall rendering")
-def ops_timeline(uid, as_json):
-    """Run-lifecycle waterfall (ISSUE 5): the ordered span tree —
-    compile → admission → placement → execute → runtime steps →
-    checkpoint → sidecar sync — with chaos faults and retries as
-    annotated events, so a slow or chaos-drilled run explains itself."""
-    plane = get_plane()
-    get_run_or_fail(plane, uid)
-    timeline = plane.timeline(uid)
-    if as_json:
-        click.echo(json.dumps(timeline, indent=2, default=str))
-        return
-    if not timeline["spans"]:
-        click.echo("(no lifecycle spans recorded for this run yet)")
-        return
+def _render_timeline(timeline) -> None:
+    """Span-tree waterfall shared by the run timeline and the serving
+    request timeline (both are obs.trace.build_timeline output)."""
     t0 = timeline["t0"]
     click.echo(f"trace {timeline['trace_id']}  "
                f"spans={timeline['span_count']}  "
@@ -309,6 +294,86 @@ def ops_timeline(uid, as_json):
         ev_off = ((event.get("time") or t0) - t0) * 1e3
         click.echo(f"* {event['name']} +{ev_off:.1f}ms"
                    f"{fmt_attrs(event.get('attributes'))}")
+
+
+@ops.command("timeline")
+@click.option("-uid", "--uid", required=True)
+@click.option("--json", "as_json", is_flag=True,
+              help="raw span tree instead of the waterfall rendering")
+def ops_timeline(uid, as_json):
+    """Run-lifecycle waterfall (ISSUE 5): the ordered span tree —
+    compile → admission → placement → execute → runtime steps →
+    checkpoint → sidecar sync — with chaos faults and retries as
+    annotated events, so a slow or chaos-drilled run explains itself."""
+    plane = get_plane()
+    get_run_or_fail(plane, uid)
+    timeline = plane.timeline(uid)
+    if as_json:
+        click.echo(json.dumps(timeline, indent=2, default=str))
+        return
+    if not timeline["spans"]:
+        click.echo("(no lifecycle spans recorded for this run yet)")
+        return
+    _render_timeline(timeline)
+
+
+@ops.command("request-timeline")
+@click.option("--url", default="http://127.0.0.1:8080",
+              help="serving server base URL")
+@click.option("-id", "--id", "request_id", default=None,
+              help="request id (a generate response's request_ids, or "
+                   "pick one from the listing this prints when omitted)")
+@click.option("--json", "as_json", is_flag=True,
+              help="raw payload instead of the rendered waterfall")
+def ops_request_timeline(url, request_id, as_json):
+    """Per-request serving waterfall (ISSUE 10): one request's span
+    tree — queue_wait → prefill (chunk events) → decode (first_token /
+    spec_round / eviction events) — fetched from a live serving
+    server's bounded trace ring, with the phase/TTFT summary on top.
+    Without --id, lists the ring's recent requests instead."""
+    import urllib.error
+    import urllib.request
+
+    base = url.rstrip("/")
+    target = (f"{base}/requests/{request_id}/timeline"
+              if request_id else f"{base}/requests")
+    try:
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        detail = exc.read().decode(errors="replace")
+        try:
+            detail = json.loads(detail).get("error", detail)
+        except (json.JSONDecodeError, AttributeError):
+            pass
+        raise click.ClickException(f"HTTP {exc.code} from {target}: {detail}")
+    except (urllib.error.URLError, OSError) as exc:
+        raise click.ClickException(f"cannot reach {target}: {exc}")
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+        return
+    if request_id is None:
+        requests = payload.get("requests") or []
+        if not requests:
+            click.echo("(no traced requests in the ring yet)")
+            return
+        for row in requests:
+            state = row.get("phase") or (
+                "done" if row.get("done") else "pending")
+            click.echo(f"{row['request_id']}  {row.get('class') or '-':<10} "
+                       f"{state:<10} {row.get('status') or ''}"
+                       + (f"  [{row['error']}]" if row.get("error") else ""))
+        return
+    summary = payload.get("summary") or {}
+    if summary:
+        phases = " ".join(f"{name}={ms}ms" for name, ms
+                          in (summary.get("phases_ms") or {}).items())
+        click.echo(f"request {summary.get('request_id')}  "
+                   f"class={summary.get('class')}  "
+                   f"status={summary.get('status')}  "
+                   f"ttft={summary.get('ttft_ms')}ms  "
+                   f"tokens={summary.get('tokens_out')}  {phases}")
+    _render_timeline(payload)
 
 
 @ops.command("report")

@@ -4,10 +4,12 @@
 plane that reads them: declarative alert rules with SLO burn-rate
 support (``obs.rules``), per-run performance attribution reports
 (``obs.analyze``), and the failure flight recorder that gives every
-dead run a postmortem (``obs.flight``). See docs/observability.md for
-the span model, metric catalog, rule schema, and report reference."""
+dead run a postmortem (``obs.flight``), plus per-request serving span
+trees in a bounded ring (``obs.reqtrace``, ISSUE 10). See
+docs/observability.md for the span model, metric catalog, rule schema,
+and report reference, and docs/serving.md for request observability."""
 
-from polyaxon_tpu.obs import analyze, flight, metrics, rules, trace
+from polyaxon_tpu.obs import analyze, flight, metrics, reqtrace, rules, trace
 from polyaxon_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -29,6 +31,7 @@ __all__ = [
     "analyze",
     "flight",
     "metrics",
+    "reqtrace",
     "rules",
     "trace",
     "Counter",

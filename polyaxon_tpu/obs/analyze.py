@@ -202,6 +202,49 @@ def analyze_timeline(timeline: dict[str, Any]) -> dict[str, Any]:
     }
 
 
+def request_phases(timeline: dict[str, Any]) -> dict[str, Any]:
+    """Phase decomposition of ONE serving-request timeline
+    (obs.reqtrace span tree): queue-wait / prefill / decode
+    milliseconds, TTFT (request start → the decode phase's
+    ``first_token`` event), tokens out, and the per-phase event tallies
+    (chunks streamed, speculative rounds, requeues/evictions). Pure
+    function of the timeline dict — GET /requests/{id}/timeline
+    attaches it as ``summary`` and ``plx ops request-timeline`` prints
+    it above the waterfall."""
+    spans = list(walk_spans(timeline.get("spans") or []))
+    root = next((s for s in spans if (s.get("name") or "") == "request"),
+                None)
+    phases_ms: dict[str, float] = {}
+    events: dict[str, int] = {}
+    ttft_ms = None
+    t0 = root.get("start") if root is not None else None
+    for span in spans:
+        name = span.get("name") or ""
+        if name != "request":
+            phases_ms[name] = (phases_ms.get(name, 0.0)
+                               + float(span.get("duration_ms") or 0.0))
+        for event in span.get("events") or []:
+            ev = event.get("name") or ""
+            events[ev] = events.get(ev, 0) + 1
+            if (ev == "first_token" and ttft_ms is None and t0 is not None
+                    and event.get("time") is not None):
+                ttft_ms = (float(event["time"]) - float(t0)) * 1e3
+    attrs = (root.get("attributes") or {}) if root is not None else {}
+    return {
+        "request_id": timeline.get("trace_id"),
+        "class": attrs.get("class"),
+        "status": root.get("status") if root is not None else None,
+        "wall_clock_ms": round(float(timeline.get("duration_ms") or 0.0), 3),
+        "phases_ms": {name: round(ms, 3)
+                      for name, ms in sorted(phases_ms.items())},
+        "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
+        "tokens_out": attrs.get("tokens_out"),
+        "events": events,
+        **({"error": root.get("error")}
+           if root is not None and root.get("error") else {}),
+    }
+
+
 def analyze_run_dir(run_dir: str) -> dict[str, Any]:
     """Report straight from a run's artifacts dir (bench/perf_sweep use
     this without a control plane)."""

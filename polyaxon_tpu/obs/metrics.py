@@ -473,6 +473,105 @@ def serving_request_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
         "Serving request latency, submit to retire")
 
 
+# Per-token/engine-tick latencies sit an order of magnitude under the
+# request-level layout: sub-ms decode steps through second-scale stalls.
+_SERVING_TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                          0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def serving_ttft_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    """Time-to-first-token (submit → first emitted token), the
+    interactive-SLO number, labeled by request class (`batch` until
+    ROADMAP item 1 lands the per-class policy). The 0.5 bound anchors
+    the serving-ttft-slo-burn rule's `le`."""
+    return registry.histogram(
+        "polyaxon_serving_ttft_seconds",
+        "Time to first token (submit to first emitted token) by "
+        "request class",
+        ("class",))
+
+
+def serving_tpot_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_serving_tpot_seconds",
+        "Time per output token after the first (decode cadence) by "
+        "request class",
+        ("class",), buckets=_SERVING_TOKEN_BUCKETS)
+
+
+def serving_queue_wait_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_serving_queue_wait_seconds",
+        "Pending-queue wait (submit to admission dequeue) by request "
+        "class",
+        ("class",))
+
+
+def serving_rejected_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_rejected_total",
+        "Requests shed before admission (queue_full = 503 + "
+        "Retry-After, shutdown = submit after stop)",
+        ("reason",))
+
+
+def serving_admissions_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_admissions_total",
+        "Slot-admission outcomes (admitted / deferred = paged "
+        "backpressure requeue / failed = admission prefill error)",
+        ("outcome",))
+
+
+def serving_evictions_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_evictions_total",
+        "Live rows evicted mid-generation (pool_exhausted = paged KV "
+        "pool ran dry)",
+        ("reason",))
+
+
+def serving_tick_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_serving_engine_tick_seconds",
+        "Continuous-batching engine loop iteration duration (admission "
+        "+ prefill chunk + decode step)",
+        buckets=_SERVING_TOKEN_BUCKETS)
+
+
+def serving_batch_slots(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_batch_slots",
+        "Engine batch composition per tick (decode = live rows, "
+        "prefill = chunked-prefill reservations, free)",
+        ("state",))
+
+
+def serving_kv_pages(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_kv_pages",
+        "Paged-KV pool pages by state (used / free; free includes "
+        "retired-but-resident prefix pages)",
+        ("state",))
+
+
+def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
+    """Pre-register the serving families (idempotent) so a serving
+    /metrics scrape exposes the full SLO schema before traffic lands —
+    and so :func:`catalog_metric_names` sees one source of truth."""
+    serving_queue_depth(registry)
+    serving_request_hist(registry)
+    serving_ttft_hist(registry)
+    serving_tpot_hist(registry)
+    serving_queue_wait_hist(registry)
+    serving_rejected_total(registry)
+    serving_admissions_total(registry)
+    serving_evictions_total(registry)
+    serving_tick_hist(registry)
+    serving_batch_slots(registry)
+    serving_kv_pages(registry)
+
+
 def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     """Pre-register the documented families (idempotent) so /metrics
     exposes a stable schema — including at least one histogram — even
@@ -506,8 +605,7 @@ def catalog_metric_names() -> set[str]:
     a typo'd name would never fire; CI fails it instead)."""
     scratch = MetricsRegistry()
     ensure_core_metrics(scratch)
-    serving_queue_depth(scratch)
-    serving_request_hist(scratch)
+    ensure_serving_metrics(scratch)
     names = set(scratch._metrics)
     names.update(SCRAPE_TIME_METRICS)
     names.add(DROPPED_LABELS_METRIC)

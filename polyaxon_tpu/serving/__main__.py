@@ -49,6 +49,10 @@ def main() -> int:
     parser.add_argument("--max-pending", type=int, default=None,
                         help="(continuous) pending-queue cap; saturated "
                              "generate requests answer 503 + Retry-After")
+    parser.add_argument("--no-request-tracing", action="store_true",
+                        help="(continuous) disable per-request span "
+                             "timelines (GET /requests/{id}/timeline); "
+                             "the TTFT/TPOT SLO histograms keep flowing")
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -72,7 +76,8 @@ def main() -> int:
                        draft_checkpoint=args.draft_checkpoint,
                        spec_k=args.spec_k, lora_alpha=args.lora_alpha,
                        prefill_chunk=args.prefill_chunk,
-                       max_pending=args.max_pending) as s:
+                       max_pending=args.max_pending,
+                       request_tracing=not args.no_request_tracing) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

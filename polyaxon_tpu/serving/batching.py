@@ -39,6 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import reqtrace
+
 logger = logging.getLogger(__name__)
 
 
@@ -83,6 +86,14 @@ class _Request:
     # Stamped at submit; the retire path feeds submit→done wall time
     # into the unified registry's serving-latency histogram (ISSUE 5).
     submitted_at: float = field(default_factory=time.time)
+    # Per-request observability (ISSUE 10): the id doubles as the trace
+    # id; `klass` labels the SLO histograms (one class, `batch`, until
+    # ROADMAP item 1 lands the per-class policy); `first_token_at`
+    # anchors TTFT at emission and TPOT at retirement.
+    id: str = field(default_factory=reqtrace.new_request_id)
+    klass: str = "batch"
+    trace: Optional[reqtrace.RequestTrace] = None
+    first_token_at: Optional[float] = None
 
     def wait(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -102,7 +113,9 @@ class ContinuousBatchingEngine:
                  max_len: Optional[int] = None, kv: str = "dense",
                  page_size: int = 16, kv_pages: Optional[int] = None,
                  draft=None, prefill_chunk: Optional[int] = None,
-                 max_pending: Optional[int] = None):
+                 max_pending: Optional[int] = None,
+                 request_tracing: bool = True,
+                 trace_capacity: int = reqtrace.DEFAULT_RING_CAPACITY):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
@@ -260,6 +273,15 @@ class ContinuousBatchingEngine:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self.max_pending = max_pending
         self._queue: collections.deque[_Request] = collections.deque()
+        # Per-request observability (ISSUE 10): span trees in a bounded
+        # ring behind GET /requests/{id}/timeline, shed-load accounting
+        # for /v1/stats. Tracing defaults on — the parity check in
+        # tests/test_serving.py holds its overhead within 5% — and
+        # `request_tracing=False` turns span recording off while the
+        # SLO histograms (TTFT/TPOT/queue-wait) keep flowing.
+        self.request_tracing = bool(request_tracing)
+        self._ring = reqtrace.TimelineRing(trace_capacity)
+        self._rejected: dict[str, int] = {}
         self._cv = threading.Condition()
         self._stopped = False
         self._served = 0
@@ -469,10 +491,17 @@ class ContinuousBatchingEngine:
                     f"+ {max_new_tokens} new) but the pool holds "
                     f"{capacity}; raise --kv-pages or shorten the request")
 
+    def _reject(self, reason: str) -> None:
+        """Shed-load accounting: QueueFull 503s and post-stop submits
+        must not vanish — the counter is THE load-shedding signal on
+        /metrics and the dashboard (ISSUE 10 satellite)."""
+        self._rejected[reason] = self._rejected.get(reason, 0) + 1
+        obs_metrics.serving_rejected_total().inc(reason=reason)
+
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                top_p: float = 1.0, top_k: int = 0,
-               eos_tokens=None) -> _Request:
+               eos_tokens=None, klass: str = "batch") -> _Request:
         self._validate(tokens, max_new_tokens)
         validate_sampling(top_p, top_k)
         eos = frozenset(int(t) for t in (eos_tokens or ()))
@@ -483,12 +512,23 @@ class ContinuousBatchingEngine:
                 "argmax); send temperature=0 or serve without "
                 "--draft-model for sampling")
         req = _Request(list(tokens), max_new_tokens, float(temperature),
-                       int(seed), float(top_p), int(top_k), eos)
+                       int(seed), float(top_p), int(top_k), eos,
+                       klass=str(klass) or "batch")
+        if self.request_tracing:
+            # Built BEFORE the lock (span allocation off the critical
+            # section); ringed only AFTER a successful enqueue so
+            # rejected requests never occupy ring capacity.
+            req.trace = reqtrace.RequestTrace(
+                req.id, req.klass, prompt_len=len(req.tokens),
+                max_new=int(max_new_tokens))
+            req.trace.start_phase("queue_wait")
         with self._cv:
             if self._stopped:
+                self._reject("shutdown")
                 raise RuntimeError("engine stopped")
             if (self.max_pending is not None
                     and len(self._queue) >= self.max_pending):
+                self._reject("queue_full")
                 # Retry-After scales with how much decode work sits
                 # ahead of the caller: ~one hint-second per queued
                 # request per slot, floored at 1.
@@ -497,10 +537,10 @@ class ContinuousBatchingEngine:
                     f"{self.max_pending}); retry later",
                     retry_after=max(1, len(self._queue) // max(self.slots, 1)))
             self._queue.append(req)
-            from polyaxon_tpu.obs import metrics as obs_metrics
-
             obs_metrics.serving_queue_depth().set(len(self._queue))
             self._cv.notify()
+        if req.trace is not None:
+            self._ring.add(req.trace)
         return req
 
     def cancel(self, req: _Request) -> None:
@@ -512,25 +552,45 @@ class ContinuousBatchingEngine:
                 self._queue.remove(req)
                 if not req.done.is_set():
                     req.error = "cancelled"
+                    self._finish_trace(req)
                     req.done.set()
             except ValueError:
                 pass  # live in a slot (or done): the loop retires it
+
+    def submit_all(self, token_rows: list[list[int]], max_new_tokens: int,
+                   temperature: float = 0.0, seed: int = 0,
+                   top_p: float = 1.0, top_k: int = 0,
+                   eos_tokens=None, klass: str = "batch") -> list[_Request]:
+        """Submit a batch atomically-ish: validate every row before
+        submitting ANY (same no-wasted-work contract as the static
+        engine — a bad row must not leave its siblings generating
+        discarded output), and if a mid-batch submit is shed
+        (QueueFull/stop) cancel the rows already queued before
+        re-raising — the caller sees all-or-nothing."""
+        for row in token_rows:
+            self._validate(row, max_new_tokens)
+        reqs: list[_Request] = []
+        try:
+            for i, row in enumerate(token_rows):
+                reqs.append(self.submit(
+                    row, max_new_tokens, temperature, seed + i,
+                    top_p, top_k, eos_tokens=eos_tokens, klass=klass))
+        except Exception:
+            for r in reqs:
+                self.cancel(r)
+            raise
+        return reqs
 
     def generate(self, token_rows: list[list[int]], max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
                  top_p: float = 1.0, top_k: int = 0,
                  timeout: Optional[float] = None,
-                 eos_tokens=None) -> list[list[int]]:
+                 eos_tokens=None, klass: str = "batch") -> list[list[int]]:
         if not token_rows:
             return []
-        # Validate the whole batch before submitting ANY row — same
-        # no-wasted-work contract as the static engine: a bad row must
-        # not leave its siblings generating discarded output.
-        for row in token_rows:
-            self._validate(row, max_new_tokens)
-        reqs = [self.submit(row, max_new_tokens, temperature, seed + i,
-                            top_p, top_k, eos_tokens=eos_tokens)
-                for i, row in enumerate(token_rows)]
+        reqs = self.submit_all(token_rows, max_new_tokens, temperature,
+                               seed, top_p, top_k, eos_tokens=eos_tokens,
+                               klass=klass)
         try:
             return [r.wait(timeout=timeout) for r in reqs]
         except TimeoutError:
@@ -549,6 +609,7 @@ class ContinuousBatchingEngine:
             for req in list(self._queue) + self._slot_req + pending:
                 if req is not None and not req.done.is_set():
                     req.error = "engine stopped"
+                    self._finish_trace(req)
                     req.done.set()
 
     def stop(self) -> None:
@@ -590,6 +651,7 @@ class ContinuousBatchingEngine:
             del self._prefilling[b]
             if not req.done.is_set():
                 req.error = f"engine failed: {err}"
+                self._finish_trace(req)
                 req.done.set()
         with self._cv:
             self._stopped = True
@@ -597,6 +659,7 @@ class ContinuousBatchingEngine:
                 req = self._queue.popleft()
                 if not req.done.is_set():
                     req.error = f"engine failed: {err}"
+                    self._finish_trace(req)
                     req.done.set()
 
     def _admit(self) -> None:
@@ -615,10 +678,16 @@ class ContinuousBatchingEngine:
                 if (self._pool is not None and not
                         self._pool.can_admit(len(self._queue[0].tokens),
                                              self._queue[0].tokens)):
+                    head = self._queue[0]
+                    if head.trace is not None:
+                        # One annotation per engine tick while blocked
+                        # (the per-span event cap bounds a long wait):
+                        # answers "why is my request stuck in
+                        # queue_wait" from the timeline alone.
+                        head.trace.event("kv_backpressure",
+                                         pages_free=self._pool.free_pages)
                     break
                 req = self._queue.popleft()
-                from polyaxon_tpu.obs import metrics as obs_metrics
-
                 obs_metrics.serving_queue_depth().set(len(self._queue))
             if self._pool is not None and not self._pool.admit(
                     b, len(req.tokens), req.tokens):
@@ -626,9 +695,19 @@ class ContinuousBatchingEngine:
                 # head (FIFO preserved) and wait for retirements —
                 # running without pages would stream scratch-page
                 # garbage.
+                obs_metrics.serving_admissions_total().inc(
+                    outcome="deferred")
+                if req.trace is not None:
+                    req.trace.event("requeue", reason="kv_pages")
                 with self._cv:
                     self._queue.appendleft(req)
                 break
+            # Dequeued for real: close the queue_wait phase and feed
+            # the SLO histogram (submit → admission dequeue).
+            obs_metrics.serving_queue_wait_hist().observe(
+                time.time() - req.submitted_at, **{"class": req.klass})
+            if req.trace is not None:
+                req.trace.end_phase(slot=b)
             try:
                 pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
                     req.tokens)
@@ -637,6 +716,11 @@ class ContinuousBatchingEngine:
                     # Long prompt: reserve the slot and stream the
                     # prompt in chunks across loop iterations instead
                     # of blocking the pool on one monolithic prefill.
+                    if req.trace is not None:
+                        req.trace.start_phase(
+                            "prefill", mode="chunked",
+                            prompt_tokens=len(prefill_tokens),
+                            chunk=self.prefill_chunk)
                     row_t = self._family_mod.cb_init_cache(
                         self.cfg, 1, self.max_len)
                     row_d = (self._draft_family.cb_init_cache(
@@ -647,6 +731,10 @@ class ContinuousBatchingEngine:
                         row_t, row_d, pos0, tok0]
                     continue
                 if prefill_tokens:
+                    if req.trace is not None:
+                        req.trace.start_phase(
+                            "prefill", mode="monolithic",
+                            prompt_tokens=len(prefill_tokens))
                     row = jnp.asarray([prefill_tokens], jnp.int32)
                     fn = self._compiled_prefill(len(prefill_tokens))
                     if self._pool is not None:
@@ -672,7 +760,10 @@ class ContinuousBatchingEngine:
                     # prefix keys registered for content the prefill
                     # never wrote.
                     self._pool.release(b, invalidate_prefix=True)
+                obs_metrics.serving_admissions_total().inc(
+                    outcome="failed")
                 req.error = f"{type(exc).__name__}: {exc}"
+                self._finish_trace(req)
                 req.done.set()
                 # Persistent device breakage surfaces in the admission
                 # prefill just as readily as in the decode step — count
@@ -681,6 +772,16 @@ class ContinuousBatchingEngine:
                 # (_count_request_failure has the counting rules).
                 if not self._count_request_failure(exc):
                     return
+
+    def request_timeline(self, request_id: str) -> Optional[dict]:
+        """Assembled span tree for one recent request (None = unknown
+        id or already evicted from the ring) — the payload behind
+        ``GET /requests/{id}/timeline``."""
+        return self._ring.timeline(request_id)
+
+    def recent_requests(self) -> list[dict]:
+        """Ring summaries, most recent first — ``GET /requests``."""
+        return self._ring.summaries()
 
     def health(self) -> dict:
         """Liveness + load view for /healthz: queue depth and slot
@@ -716,6 +817,11 @@ class ContinuousBatchingEngine:
             "requests_served": self._served,
             "tokens_generated": self._tokens_out,
             "step_failures": self._step_failures,
+            # Shed-load accounting (ISSUE 10): per-reason totals of
+            # requests refused before admission.
+            "rejected": dict(self._rejected),
+            "request_tracing": self.request_tracing,
+            "traced_requests": len(self._ring),
             "stopped": self._stopped,
             "kv": self.kv,
             **({"draft_model": self.draft[0],
@@ -741,6 +847,11 @@ class ContinuousBatchingEngine:
         """Mark a slot live for decode — the ONE place slot state is
         initialized (monolithic admission and chunked-prefill
         completion both land here)."""
+        obs_metrics.serving_admissions_total().inc(outcome="admitted")
+        if req.trace is not None:
+            # Closes the prefill phase when one ran (1-token prompts
+            # go straight from queue_wait to decode).
+            req.trace.start_phase("decode", slot=b, pos0=int(pos0))
         self._slot_req[b] = req
         self._pos[b] = pos0
         self._cur[b] = tok0
@@ -781,6 +892,7 @@ class ContinuousBatchingEngine:
                 del self._prefilling[b]
                 if not req.done.is_set():
                     req.error = "cancelled"
+                    self._finish_trace(req)
                     req.done.set()
                 continue
             if advanced and not all_slots:
@@ -800,13 +912,19 @@ class ContinuousBatchingEngine:
                         self._draft_params, row_d, tokens, p0)
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 del self._prefilling[b]
+                obs_metrics.serving_admissions_total().inc(
+                    outcome="failed")
                 req.error = f"{type(exc).__name__}: {exc}"
+                self._finish_trace(req)
                 req.done.set()
                 if not self._count_request_failure(exc):
                     return False
                 continue
             advanced = True
             state[2] = i + c
+            if req.trace is not None:
+                req.trace.event("chunk", pos=i,
+                                of=int(len(pending)))
             if state[2] >= len(pending):
                 # Caught up: insert the finished row(s) and go live.
                 del self._prefilling[b]
@@ -896,11 +1014,39 @@ class ContinuousBatchingEngine:
                 # replaced wholesale at the next admission).
                 fresh = fresh[:hit + 1]
             req.out.extend(fresh)
+            if fresh:
+                if req.first_token_at is None:
+                    self._observe_first_token(req)
+                if req.trace is not None:
+                    req.trace.event("spec_round", accepted=n,
+                                    emitted=len(fresh))
             self._pos[b] += n
             self._cur[b] = int(cur_nxt[b])
             if len(req.out) >= req.max_new or hit is not None:
                 self._retire(b)
         return True
+
+    def _observe_first_token(self, req: _Request) -> None:
+        """Stamp first-token emission: TTFT (submit → first token, so
+        queue wait and prefill both count — that is the number a client
+        feels) plus the timeline annotation."""
+        req.first_token_at = time.time()
+        obs_metrics.serving_ttft_hist().observe(
+            req.first_token_at - req.submitted_at,
+            **{"class": req.klass})
+        if req.trace is not None:
+            req.trace.event("first_token")
+
+    def _finish_trace(self, req: _Request) -> None:
+        """Close a request's span tree (idempotent — retire and the
+        failure paths may both reach it)."""
+        if req.trace is None:
+            return
+        if req.error:
+            req.trace.finish(status="error", error=req.error,
+                             tokens_out=len(req.out))
+        else:
+            req.trace.finish(tokens_out=len(req.out))
 
     def _retire(self, b: int) -> None:
         req = self._slot_req[b]
@@ -917,11 +1063,18 @@ class ContinuousBatchingEngine:
             if not req.error:  # count only successfully-served requests
                 self._served += 1
                 self._tokens_out += len(req.out)
-            from polyaxon_tpu.obs import metrics as obs_metrics
-
+            now = time.time()
             obs_metrics.serving_request_hist().observe(
-                time.time() - req.submitted_at)
+                now - req.submitted_at)
+            if (not req.error and req.first_token_at is not None
+                    and len(req.out) >= 2):
+                # TPOT = steady-state decode cadence: the first token
+                # (prefill-dominated, already TTFT's job) is excluded.
+                obs_metrics.serving_tpot_hist().observe(
+                    (now - req.first_token_at) / (len(req.out) - 1),
+                    **{"class": req.klass})
             obs_metrics.serving_queue_depth().set(len(self._queue))
+            self._finish_trace(req)
             req.done.set()
 
     def _loop(self) -> None:
@@ -933,72 +1086,107 @@ class ContinuousBatchingEngine:
                     self._cv.wait()
                 if self._stopped:
                     return
-            for b in range(self.slots):  # drop cancelled live requests
-                req = self._slot_req[b]
-                if req is not None and req.cancelled:
-                    self._retire(b)
-            self._admit()
-            if self._stopped:  # _admit may fail-fast mid-pass
+            # Idle waiting above is excluded from the tick duration:
+            # the histogram measures work per iteration (admission +
+            # prefill chunk + decode step), not queue quiet time.
+            t0 = time.time()
+            if not self._tick():
                 return
-            self._queue_depth_peak = max(self._queue_depth_peak,
-                                         len(self._queue))
+            self._observe_tick(time.time() - t0)
+
+    def _observe_tick(self, dt: float) -> None:
+        """Engine-tick telemetry: iteration duration plus the batch
+        composition and KV-page gauges a dashboard needs to say WHY
+        throughput looks the way it does (decode-bound vs
+        prefill-bound vs page-starved)."""
+        obs_metrics.serving_tick_hist().observe(dt)
+        decode = sum(1 for r in self._slot_req if r is not None)
+        prefill = len(self._prefilling)
+        slots = obs_metrics.serving_batch_slots()
+        slots.set(decode, state="decode")
+        slots.set(prefill, state="prefill")
+        slots.set(max(self.slots - decode - prefill, 0), state="free")
+        if self._pool is not None:
+            util = self._pool.utilization()
+            pages = obs_metrics.serving_kv_pages()
+            pages.set(util["used"], state="used")
+            pages.set(util["free"], state="free")
+
+    def _tick(self) -> bool:
+        """One engine iteration: drop cancellations, admit, advance
+        chunked prefills, run one decode step or speculative round.
+        Returns False when fail-fast stopped the engine (the loop
+        exits); True otherwise — including idle iterations."""
+        for b in range(self.slots):  # drop cancelled live requests
+            req = self._slot_req[b]
+            if req is not None and req.cancelled:
+                self._retire(b)
+        self._admit()
+        if self._stopped:  # _admit may fail-fast mid-pass
+            return False
+        self._queue_depth_peak = max(self._queue_depth_peak,
+                                     len(self._queue))
+        live = sum(1 for r in self._slot_req if r is not None)
+        if self._prefilling:
+            # Idle pool → advance every reservation (a cold-start
+            # burst must not serialize one slot at a time).
+            if not self._advance_prefill(all_slots=(live == 0)):
+                return False  # fail-fast stopped the engine
             live = sum(1 for r in self._slot_req if r is not None)
-            if self._prefilling:
-                # Idle pool → advance every reservation (a cold-start
-                # burst must not serialize one slot at a time).
-                if not self._advance_prefill(all_slots=(live == 0)):
-                    return  # fail-fast stopped the engine
-                live = sum(1 for r in self._slot_req if r is not None)
-            if live == 0:
+        if live == 0:
+            return True
+        self._steps_total += 1
+        self._live_slot_steps += live
+        if self.draft is not None:
+            return self._spec_iteration()
+        try:
+            keys = jnp.stack([
+                jax.random.fold_in(self._keys[b],
+                                   len(r.out) if (r := self._slot_req[b])
+                                   else 0)
+                for b in range(self.slots)])
+            filtered = any(
+                r is not None and (r.top_p < 1.0 or r.top_k > 0)
+                for r in self._slot_req)
+            step_fn = (self._step_filtered if filtered
+                       else self._step_plain)
+            tables = (jnp.asarray(self._pool.tables)
+                      if self._pool is not None else None)
+            nxt, self._cache = step_fn(
+                self.params, self._cache,
+                jnp.asarray(self._cur), jnp.asarray(self._pos),
+                keys, jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps), jnp.asarray(self._top_ks),
+                tables)
+            nxt = np.asarray(nxt)
+        except Exception as exc:  # noqa: BLE001 — fail live requests
+            return self._handle_step_failure(exc, "decode step")
+        self._consec_step_failures = 0
+        for b in range(self.slots):
+            req = self._slot_req[b]
+            if req is None:
                 continue
-            self._steps_total += 1
-            self._live_slot_steps += live
-            if self.draft is not None:
-                if not self._spec_iteration():
-                    return  # fail-fast stopped the engine
-                continue
-            try:
-                keys = jnp.stack([
-                    jax.random.fold_in(self._keys[b],
-                                       len(r.out) if (r := self._slot_req[b])
-                                       else 0)
-                    for b in range(self.slots)])
-                filtered = any(
-                    r is not None and (r.top_p < 1.0 or r.top_k > 0)
-                    for r in self._slot_req)
-                step_fn = (self._step_filtered if filtered
-                           else self._step_plain)
-                tables = (jnp.asarray(self._pool.tables)
-                          if self._pool is not None else None)
-                nxt, self._cache = step_fn(
-                    self.params, self._cache,
-                    jnp.asarray(self._cur), jnp.asarray(self._pos),
-                    keys, jnp.asarray(self._temps),
-                    jnp.asarray(self._top_ps), jnp.asarray(self._top_ks),
-                    tables)
-                nxt = np.asarray(nxt)
-            except Exception as exc:  # noqa: BLE001 — fail live requests
-                if not self._handle_step_failure(exc, "decode step"):
-                    return
-                continue
-            self._consec_step_failures = 0
-            for b in range(self.slots):
-                req = self._slot_req[b]
-                if req is None:
-                    continue
-                req.out.append(int(nxt[b]))
-                self._pos[b] += 1
-                self._cur[b] = int(nxt[b])
-                if len(req.out) >= req.max_new or int(nxt[b]) in req.eos:
-                    self._retire(b)
-                elif (self._pool is not None
-                      and not self._pool.ensure(b, int(self._pos[b]))):
-                    # An oversubscribed pool ran dry mid-generation:
-                    # fail THIS row loudly (its output so far is
-                    # surfaced in the error path) rather than let it
-                    # scribble over a neighbour's pages.
-                    req.error = (
-                        "kv page pool exhausted mid-generation "
-                        f"(pos {int(self._pos[b])}); raise --kv-pages "
-                        "or lower concurrency")
-                    self._retire(b)
+            req.out.append(int(nxt[b]))
+            if req.first_token_at is None:
+                self._observe_first_token(req)
+            self._pos[b] += 1
+            self._cur[b] = int(nxt[b])
+            if len(req.out) >= req.max_new or int(nxt[b]) in req.eos:
+                self._retire(b)
+            elif (self._pool is not None
+                  and not self._pool.ensure(b, int(self._pos[b]))):
+                # An oversubscribed pool ran dry mid-generation:
+                # fail THIS row loudly (its output so far is
+                # surfaced in the error path) rather than let it
+                # scribble over a neighbour's pages.
+                obs_metrics.serving_evictions_total().inc(
+                    reason="pool_exhausted")
+                if req.trace is not None:
+                    req.trace.event("evicted", reason="pool_exhausted",
+                                    pos=int(self._pos[b]))
+                req.error = (
+                    "kv page pool exhausted mid-generation "
+                    f"(pos {int(self._pos[b])}); raise --kv-pages "
+                    "or lower concurrency")
+                self._retire(b)
+        return True

@@ -68,6 +68,17 @@ class PagePool:
     def pages_for(self, length: int) -> int:
         return -(-max(length, 1) // self.page_size)
 
+    def utilization(self) -> dict:
+        """Pool occupancy in the user's units (usable pages — the
+        scratch page is internal): the engine-tick gauges and /v1/stats
+        both read this one snapshot. `free` counts allocatable pages,
+        so retired-but-resident prefix-cache pages land there."""
+        total = self.n_pages - 1
+        free = self.free_pages
+        used = max(total - free, 0)
+        return {"total": total, "used": used, "free": free,
+                "fraction": round(used / total, 4) if total else 0.0}
+
     def _shareable(self, length: int, tokens) -> int:
         if not (self.prefix_cache and tokens is not None):
             return 0

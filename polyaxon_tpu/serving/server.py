@@ -39,6 +39,7 @@ from __future__ import annotations
 import functools
 import json
 import logging
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
@@ -422,6 +423,11 @@ async function refresh() {
     s.queued != null ? tile("queued", s.queued) : "",
     s.decode_steps != null ? tile("decode steps", s.decode_steps) : "",
     s.step_failures ? tile("step failures", s.step_failures) : "",
+    s.rejected && Object.keys(s.rejected).length
+      ? tile("rejected (shed)", Object.values(s.rejected)
+          .reduce((a, b) => a + b, 0)) : "",
+    s.traced_requests != null
+      ? tile("traced requests", s.traced_requests) : "",
     s.kv_pages_total != null
       ? tile("kv pages free", `${s.kv_pages_free} / ${s.kv_pages_total}`) : "",
     s.kv_prefix_hits != null
@@ -467,12 +473,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"status": "ok", "model": self.engine.model})
         if self.path == "/metrics":
             # Prometheus scrape backed by the unified registry
-            # (obs.metrics): serving queue depth + request latency
-            # histogram, plus whatever else this process recorded.
+            # (obs.metrics): the full serving SLO schema (TTFT/TPOT/
+            # queue-wait, shed-load and admission counters, engine-tick
+            # gauges) is pre-registered so scrapers see every family
+            # before traffic lands, plus whatever else this process
+            # recorded.
             from polyaxon_tpu.obs import metrics as obs_metrics
 
-            obs_metrics.serving_queue_depth()
-            obs_metrics.serving_request_hist()
+            obs_metrics.ensure_serving_metrics()
             body = obs_metrics.REGISTRY.render().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -493,6 +501,36 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"models": [self.engine.model]})
         if self.path == "/v1/stats":
             return self._json(self.engine.stats())
+        if self.path == "/requests":
+            # Ring summaries, most recent first. Only the continuous
+            # engine traces requests; the static engine 404s rather
+            # than pretending an empty ring is a real answer.
+            if not hasattr(self.engine, "recent_requests"):
+                return self._json(
+                    {"error": "request timelines require "
+                              "--batching continuous"}, status=404)
+            return self._json({"requests": self.engine.recent_requests()})
+        m = re.match(r"^/requests/([0-9a-f]{1,64})/timeline$", self.path)
+        if m is not None:
+            if not hasattr(self.engine, "request_timeline"):
+                return self._json(
+                    {"error": "request timelines require "
+                              "--batching continuous"}, status=404)
+            timeline = self.engine.request_timeline(m.group(1))
+            if timeline is None:
+                return self._json(
+                    {"error": f"unknown or evicted request "
+                              f"`{m.group(1)}` (the trace ring keeps "
+                              "the most recent requests only)"},
+                    status=404)
+            from polyaxon_tpu.obs.analyze import request_phases
+
+            # Phase decomposition (queue-wait/prefill/decode ms, TTFT,
+            # tokens) rides along so `plx ops request-timeline` and
+            # humans with curl both get the numbers without walking
+            # the span tree themselves.
+            timeline["summary"] = request_phases(timeline)
+            return self._json(timeline)
         if self.path in ("/", "/ui"):
             body = STATS_PAGE.encode()
             self.send_response(200)
@@ -530,10 +568,31 @@ class _Handler(BaseHTTPRequestHandler):
                                    for t in eos_tokens)):
                     raise ValueError(
                         "`eos_tokens` must be a list of token ids")
+            # Request class labels the per-class SLO histograms
+            # (TTFT/TPOT/queue-wait); one `batch` class until ROADMAP
+            # item 1 lands the per-class admission policy. Bounded so a
+            # client can't mint unbounded label cardinality.
+            klass = req.get("class", "batch")
+            if (not isinstance(klass, str) or not klass
+                    or len(klass) > 64):
+                raise ValueError(
+                    "`class` must be a non-empty string of at most "
+                    "64 chars")
             if req.get("stream"):
                 return self._stream_generate(tokens, max_new, temperature,
                                              seed, top_p, top_k,
-                                             eos_tokens=eos_tokens)
+                                             eos_tokens=eos_tokens,
+                                             klass=klass)
+            if hasattr(self.engine, "submit_all"):
+                # Continuous engine: keep the request handles so the
+                # response carries ids the caller can feed straight to
+                # GET /requests/{id}/timeline.
+                reqs = self.engine.submit_all(
+                    tokens, max_new, temperature, seed, top_p, top_k,
+                    eos_tokens=eos_tokens, klass=klass)
+                out = [r.wait() for r in reqs]
+                return self._json({"tokens": out,
+                                   "request_ids": [r.id for r in reqs]})
             out = self.engine.generate(
                 tokens, max_new_tokens=max_new,
                 temperature=temperature, seed=seed,
@@ -560,7 +619,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_generate(self, token_rows, max_new: int, temperature: float,
                          seed: int, top_p: float = 1.0,
-                         top_k: int = 0, eos_tokens=None) -> None:
+                         top_k: int = 0, eos_tokens=None,
+                         klass: str = "batch") -> None:
         """SSE token streaming. With the continuous engine, per-token
         events flow as rows decode (the handler polls each request's
         growing output — appends are GIL-atomic); the static engine
@@ -581,11 +641,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         reqs = []
         try:
-            if hasattr(self.engine, "submit"):
-                reqs = [self.engine.submit(row, max_new, temperature,
-                                           seed + i, top_p, top_k,
-                                           eos_tokens=eos_tokens)
-                        for i, row in enumerate(token_rows)]
+            if hasattr(self.engine, "submit_all"):
+                reqs = self.engine.submit_all(
+                    token_rows, max_new, temperature, seed, top_p, top_k,
+                    eos_tokens=eos_tokens, klass=klass)
                 emitted = [0] * len(reqs)
                 while True:
                     progressed = False
@@ -604,14 +663,16 @@ class _Handler(BaseHTTPRequestHandler):
                 if failed:
                     return self._sse({"error": failed[0]}, event="error")
                 out = [r.out for r in reqs]
-            else:
-                out = self.engine.generate(
-                    token_rows, max_new_tokens=max_new,
-                    temperature=temperature, seed=seed,
-                    top_p=top_p, top_k=top_k, eos_tokens=eos_tokens)
-                for i, row in enumerate(out):
-                    for tok in row:
-                        self._sse({"index": i, "token": tok})
+                return self._sse(
+                    {"tokens": out,
+                     "request_ids": [r.id for r in reqs]}, event="done")
+            out = self.engine.generate(
+                token_rows, max_new_tokens=max_new,
+                temperature=temperature, seed=seed,
+                top_p=top_p, top_k=top_k, eos_tokens=eos_tokens)
+            for i, row in enumerate(out):
+                for tok in row:
+                    self._sse({"index": i, "token": tok})
             self._sse({"tokens": out}, event="done")
         except (BrokenPipeError, ConnectionResetError):
             # Client went away mid-stream: stop burning slots on output
@@ -647,7 +708,8 @@ class ServingServer:
                  draft_checkpoint: Optional[str] = None, spec_k: int = 4,
                  lora_alpha: float = 16.0,
                  prefill_chunk: Optional[int] = None,
-                 max_pending: Optional[int] = None):
+                 max_pending: Optional[int] = None,
+                 request_tracing: bool = True):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -700,7 +762,8 @@ class ServingServer:
             self.engine = ContinuousBatchingEngine(
                 model, cfg, params, slots=slots, kv=kv,
                 page_size=page_size, kv_pages=kv_pages, draft=draft,
-                prefill_chunk=prefill_chunk, max_pending=max_pending)
+                prefill_chunk=prefill_chunk, max_pending=max_pending,
+                request_tracing=request_tracing)
         elif batching == "static":
             if prefill_chunk is not None:
                 raise ValueError(

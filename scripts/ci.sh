@@ -94,6 +94,15 @@ python -c "from polyaxon_tpu.obs import rules; \
 # fired-then-resolved retry-storm alert, and an attributed report.
 echo "== observability (spans / registry / rules / reports / flight)"
 python -m pytest tests/test_obs.py -q -m obs
+# Serving-request observability drill (ISSUE 10): concurrent streams
+# against a real continuous server must leave queue→prefill→decode
+# span timelines behind /requests/{id}/timeline, per-class TTFT/TPOT
+# series on a line-parsed /metrics scrape, and shed-load accounting;
+# the TTFT burn-rule fire→resolve episode rides the obs run above
+# (TestServingObsDrill). The tracing-overhead parity check (on vs off
+# within 5%) is slow-marked and runs under --full.
+echo "== serving observability (request timelines / SLO series)"
+python -m pytest "tests/test_serving.py::TestRequestObservability" -q
 # Fleet-sim stage (ISSUE 8): drive the REAL scheduler + admission +
 # store through the quick load points (idle → storm, seconds not the
 # full compressed day) and gate tick cost against

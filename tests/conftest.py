@@ -132,6 +132,10 @@ SMOKE_NODES = (
     "test_obs.py::TestRuleLifecycle",
     "test_obs.py::TestFlightRecorder",
     "test_obs.py::TestReportUnit",
+    # Per-request serving observability (ISSUE 10): the span/ring/
+    # summary scaffolding is pure python; the engine-driven burn drill
+    # and the HTTP e2e run in the ci.sh obs stage and the full tier.
+    "test_obs.py::TestRequestTraceUnit",
     # Fleet simulator: trace generation, synthetic-executor lifecycle,
     # budget-gate logic, and the per-tick query-count regression (pure
     # python + in-memory/tmp sqlite; the curve and day-trace runs are

@@ -21,6 +21,7 @@ from polyaxon_tpu.lifecycle import V1Statuses
 from polyaxon_tpu.obs import analyze as obs_analyze
 from polyaxon_tpu.obs import flight as obs_flight
 from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import reqtrace
 from polyaxon_tpu.obs import rules as obs_rules
 from polyaxon_tpu.obs import trace as obs_trace
 
@@ -1217,3 +1218,185 @@ class TestGauntletClosesTheLoop:
         assert report["annotations"]["requeues"] == {"RestartPolicy": 1}
         assert report["alerts"], "the fired alert rides the report"
         assert final.retries == 1
+
+
+# ===================================================== request traces (IS 10)
+class TestRequestTraceUnit:
+    """Serving-request span scaffolding (obs/reqtrace.py): phase tree
+    shape, event-cap accounting, finish idempotence, the bounded ring,
+    and the request_phases summary math — all pure python (smoke
+    tier)."""
+
+    def test_phase_tree_assembles_into_a_timeline(self):
+        trace = reqtrace.RequestTrace("ab12cd34", "interactive",
+                                      prompt_len=4, max_new=8)
+        trace.start_phase("queue_wait")
+        trace.event("kv_backpressure", pages_free=0)
+        trace.end_phase(slot=1)
+        trace.start_phase("prefill", mode="chunked")
+        trace.event("chunk", pos=4, of=2)
+        trace.start_phase("decode")  # implicitly closes prefill
+        trace.event("first_token")
+        trace.finish(tokens_out=8)
+
+        ring = reqtrace.TimelineRing(capacity=4)
+        ring.add(trace)
+        timeline = ring.timeline("ab12cd34")
+        assert timeline["trace_id"] == "ab12cd34"
+        (root,) = timeline["spans"]
+        assert root["name"] == "request"
+        assert root["attributes"]["class"] == "interactive"
+        assert root["attributes"]["tokens_out"] == 8
+        children = [c["name"] for c in root["children"]]
+        assert children == ["queue_wait", "prefill", "decode"]
+        # start_phase closed prefill when decode opened: no overlap.
+        prefill, decode = root["children"][1], root["children"][2]
+        assert prefill["end"] is not None
+        assert prefill["end"] <= decode["start"]
+        # Events landed on the phase that was current when they fired.
+        assert [e["name"] for e in root["children"][0]["events"]] == [
+            "kv_backpressure"]
+        assert [e["name"] for e in decode["events"]] == ["first_token"]
+
+        summary = obs_analyze.request_phases(timeline)
+        assert summary["request_id"] == "ab12cd34"
+        assert summary["class"] == "interactive"
+        assert summary["status"] == "ok"
+        assert set(summary["phases_ms"]) == {"queue_wait", "prefill",
+                                             "decode"}
+        assert all(ms >= 0 for ms in summary["phases_ms"].values())
+        assert summary["events"] == {"kv_backpressure": 1, "chunk": 1,
+                                     "first_token": 1}
+        assert summary["ttft_ms"] is not None and summary["ttft_ms"] >= 0
+        assert summary["tokens_out"] == 8
+        assert summary["wall_clock_ms"] >= max(
+            summary["phases_ms"].values())
+
+    def test_event_cap_counts_drops_instead_of_growing(self):
+        trace = reqtrace.RequestTrace("ffff0000")
+        trace.start_phase("decode")
+        for i in range(reqtrace.MAX_EVENTS_PER_SPAN + 5):
+            trace.event("spec_round", round=i)
+        trace.finish()
+        (record,) = [r for r in trace.records() if r["name"] == "decode"]
+        assert len(record["events"]) == reqtrace.MAX_EVENTS_PER_SPAN
+        assert record["attributes"]["events_dropped"] == 5
+
+    def test_finish_is_idempotent_and_first_verdict_wins(self):
+        trace = reqtrace.RequestTrace("0a0b0c0d")
+        trace.start_phase("decode")
+        trace.finish(status="error", error="x" * 1000)
+        trace.finish(status="ok")  # the racing retire path loses
+        summary = trace.summary()
+        assert summary["status"] == "error" and summary["done"] is True
+        assert len(summary["error"]) == 500  # truncated, not unbounded
+        assert summary["phase"] is None
+        # A finished trace accepts no new phases (mutators never raise).
+        assert trace.start_phase("late") is None
+        trace.end_phase()  # no-op
+
+    def test_ring_is_bounded_and_reports_evictions(self):
+        ring = reqtrace.TimelineRing(capacity=3)
+        for i in range(5):
+            ring.add(reqtrace.RequestTrace(f"req{i:04d}", "batch"))
+        assert len(ring) == 3 and ring.evicted == 2
+        assert ring.get("req0000") is None
+        assert ring.timeline("req0001") is None  # evicted → unqueryable
+        assert [s["request_id"] for s in ring.summaries()] == [
+            "req0004", "req0003", "req0002"]  # most recent first
+        with pytest.raises(ValueError, match="capacity"):
+            reqtrace.TimelineRing(capacity=0)
+
+    def test_open_request_snapshots_without_closing(self):
+        """An in-flight request must be queryable mid-decode: records()
+        snapshots open spans with end=now but leaves the live spans
+        open."""
+        trace = reqtrace.RequestTrace("11223344")
+        trace.start_phase("decode")
+        timeline = obs_trace.build_timeline(trace.records(),
+                                            trace_id="11223344")
+        (root,) = timeline["spans"]
+        assert root["end"] is not None  # snapshot closed a COPY
+        assert trace.root.end is None   # the live span stays open
+        assert trace.summary()["phase"] == "decode"
+        summary = obs_analyze.request_phases(timeline)
+        assert summary["status"] == "ok" and "decode" in summary["phases_ms"]
+
+
+class TestServingObsDrill:
+    """ISSUE 10 acceptance: the COMMITTED serving-ttft-slo-burn rule
+    (obs/rules.json), evaluated against the global registry the engine
+    records into, fires under induced queue saturation and resolves
+    once the window slides past the bad epoch — the same
+    fire→hysteresis→resolve episode the training alerts get, driven by
+    real engine traffic rather than synthetic observes."""
+
+    def test_ttft_burn_fires_under_saturation_then_resolves(self):
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+
+        (rule,) = [r for r in obs_rules.check_ruleset()
+                   if r.id == "serving-ttft-slo-burn"]
+        clock = _FakeClock()
+        alert_engine = obs_rules.AlertEngine(
+            [rule], registry=obs_metrics.REGISTRY, clock=clock)
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32)
+        try:
+            prompt = [5, 6, 7, 8, 9, 10]
+            # Warm the prefill/decode programs BEFORE the baseline
+            # snapshot: the compile-dominated TTFT lands outside the
+            # window the rule evaluates.
+            engine.submit(prompt, 2).wait(timeout=600)
+            alert_engine.evaluate()  # baseline bucket-count snapshot
+
+            # Saturate: one slot, a decode step slowed to ~60ms, ten
+            # queued requests — TTFT for most of the queue blows past
+            # the 500ms objective on queue wait alone.
+            real_plain = engine._step_plain
+            real_filtered = engine._step_filtered
+
+            def slow(step):
+                def wrapped(*args, **kwargs):
+                    time.sleep(0.06)
+                    return step(*args, **kwargs)
+                return wrapped
+
+            engine._step_plain = slow(real_plain)
+            engine._step_filtered = slow(real_filtered)
+            reqs = [engine.submit(prompt, 3) for _ in range(10)]
+            for req in reqs:
+                req.wait(timeout=600)
+
+            clock.now += 30
+            (fired,) = alert_engine.evaluate()
+            assert fired["event"] == "fired"
+            assert fired["rule"] == "serving-ttft-slo-burn"
+            assert fired["value"] > 6.0  # burning faster than `factor`
+            assert alert_engine.active()
+
+            # Saturation clears: full-speed steps, sequential traffic
+            # (zero queue wait), warm programs → sub-objective TTFTs.
+            engine._step_plain = real_plain
+            engine._step_filtered = real_filtered
+            for _ in range(12):
+                engine.submit(prompt, 2).wait(timeout=600)
+
+            # 65s on: the window's left edge slides past the saturated
+            # epoch; only healthy traffic remains → clear (not yet
+            # resolved: resolve_after hysteresis).
+            clock.now += 65
+            assert alert_engine.evaluate() == []
+            assert alert_engine.active()
+            # Clear held past resolve_after → resolved.
+            clock.now += 35
+            (resolved,) = alert_engine.evaluate()
+            assert resolved["event"] == "resolved"
+            assert resolved["rule"] == "serving-ttft-slo-burn"
+            assert alert_engine.active() == []
+            assert [e["event"] for e in alert_engine.history] == [
+                "fired", "resolved"]
+        finally:
+            engine.stop()

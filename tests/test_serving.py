@@ -1267,3 +1267,234 @@ class TestLmLogitsChunked:
         assert got.shape == (2, 5, V)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-2, rtol=1e-2)
+
+
+# ===================================================== request obs (ISSUE 10)
+class TestRequestObservability:
+    """ISSUE 10 e2e: concurrent streams against a real continuous
+    server leave queue_wait→prefill→decode span timelines behind
+    `/requests/{id}/timeline`, per-class SLO series on a line-parsed
+    `/metrics` scrape, and shed-load accounting when admission says
+    no."""
+
+    _SAMPLE_RE = None  # compiled lazily in _parse_metrics
+
+    @pytest.fixture(scope="class")
+    def obs_server(self):
+        with ServingServer("llama_tiny", seed=0, batching="continuous",
+                           slots=2, prefill_chunk=4) as s:
+            yield s
+
+    @staticmethod
+    def _timeline(url, request_id):
+        with urllib.request.urlopen(
+                f"{url}/requests/{request_id}/timeline", timeout=30) as r:
+            return json.load(r)
+
+    @staticmethod
+    def _parse_metrics(url):
+        """Strict 0.0.4 line parse: ({name: type}, {sample: value});
+        an unparseable exposition line fails the test, not just the
+        missing-series assertion."""
+        import re
+
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        sample_re = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+            r' ([-+0-9.eE]+|\+Inf|-Inf|NaN)$')
+        types, samples = {}, {}
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, mtype = line.split(" ")
+                types[name] = mtype
+            elif not line.startswith("#"):
+                match = sample_re.match(line)
+                assert match, f"unparseable exposition line: {line!r}"
+                samples[match.group(1) + (match.group(2) or "")] = float(
+                    match.group(3))
+        return types, samples
+
+    def test_concurrent_streams_leave_phase_timelines(self, obs_server):
+        import threading
+
+        rows = [[5, 6, 7, 8, 9, 10], [9, 8, 7, 6, 5, 4], [1, 2, 3, 4, 5, 6]]
+        results: dict[int, list] = {}
+        errs: list[Exception] = []
+
+        def worker(i):
+            try:
+                results[i] = TestStreaming._stream(
+                    obs_server.url,
+                    {"tokens": [rows[i]], "max_new_tokens": 6,
+                     "class": "interactive"})
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(rows))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not errs, errs
+
+        for i in range(len(rows)):
+            done = [p for name, p in results[i] if name == "done"]
+            assert len(done) == 1
+            assert len(done[0]["tokens"][0]) == 6
+            (rid,) = done[0]["request_ids"]
+            payload = self._timeline(obs_server.url, rid)
+            assert payload["trace_id"] == rid
+            (root,) = payload["spans"]
+            assert root["name"] == "request"
+            phases = [c["name"] for c in root["children"]]
+            assert phases[0] == "queue_wait" and phases[-1] == "decode"
+            assert "prefill" in phases
+
+            summary = payload["summary"]
+            assert summary["request_id"] == rid
+            assert summary["class"] == "interactive"
+            assert summary["status"] == "ok"
+            assert summary["tokens_out"] == 6
+            assert summary["events"].get("first_token") == 1
+            # 6-token prompt through a 4-token chunked prefill streams
+            # at least one chunk.
+            assert summary["events"].get("chunk", 0) >= 1
+            assert summary["ttft_ms"] is not None and summary["ttft_ms"] > 0
+            assert set(summary["phases_ms"]) >= {"queue_wait", "prefill",
+                                                 "decode"}
+
+    def test_metrics_scrape_has_per_class_slo_series(self, obs_server):
+        _post(obs_server.url, {"tokens": [[5, 6, 7], [7, 6, 5]],
+                               "max_new_tokens": 5, "class": "scrape"})
+        types, samples = self._parse_metrics(obs_server.url)
+        for name in ("polyaxon_serving_ttft_seconds",
+                     "polyaxon_serving_tpot_seconds",
+                     "polyaxon_serving_queue_wait_seconds",
+                     "polyaxon_serving_engine_tick_seconds"):
+            assert types[name] == "histogram", name
+        assert types["polyaxon_serving_rejected_total"] == "counter"
+        assert types["polyaxon_serving_batch_slots"] == "gauge"
+        # Both rows of the labeled request landed in every SLO family.
+        for stem in ("ttft", "tpot", "queue_wait"):
+            key = (f'polyaxon_serving_{stem}_seconds_count'
+                   '{class="scrape"}')
+            assert samples.get(key, 0) >= 2, key
+        assert samples['polyaxon_serving_engine_tick_seconds_count'] > 0
+        assert ('polyaxon_serving_admissions_total{outcome="admitted"}'
+                in samples)
+        # Tick telemetry gauges expose the batch composition states.
+        for state in ("decode", "prefill", "free"):
+            assert (f'polyaxon_serving_batch_slots{{state="{state}"}}'
+                    in samples), state
+
+    def test_requests_listing_and_unknown_id_404(self, obs_server):
+        out = _post(obs_server.url, {"tokens": [[4, 5, 6]],
+                                     "max_new_tokens": 3})
+        (rid,) = out["request_ids"]
+        with urllib.request.urlopen(obs_server.url + "/requests",
+                                    timeout=30) as r:
+            listing = json.load(r)["requests"]
+        mine = [row for row in listing if row["request_id"] == rid]
+        assert mine and mine[0]["class"] == "batch"
+        assert mine[0]["done"] is True and mine[0]["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._timeline(obs_server.url, "deadbeef" * 2)
+        assert err.value.code == 404
+        assert "unknown or evicted" in json.load(err.value)["error"]
+
+    def test_static_engine_has_no_timelines(self, server):
+        for path in ("/requests", "/requests/deadbeef/timeline"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(server.url + path, timeout=30)
+            assert err.value.code == 404
+            assert "continuous" in json.load(err.value)["error"]
+
+    def test_shed_load_is_accounted(self):
+        """queue_full and shutdown rejections land in the labeled
+        rejected counter AND stats()["rejected"]; a rejected request
+        never occupies timeline-ring capacity."""
+        import time
+
+        from polyaxon_tpu.obs import metrics as obs_metrics
+        from polyaxon_tpu.serving.batching import (ContinuousBatchingEngine,
+                                                   QueueFull)
+
+        cfg, params = load_params("llama_tiny", seed=0)
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          max_pending=1)
+        rejected = obs_metrics.serving_rejected_total()
+        base_full = rejected.value(reason="queue_full")
+        base_stop = rejected.value(reason="shutdown")
+        try:
+            real_plain = engine._step_plain
+
+            def slow_step(*args, **kwargs):
+                time.sleep(0.05)
+                return real_plain(*args, **kwargs)
+
+            engine._step_plain = slow_step
+            accepted = [engine.submit([1, 2, 3], 8)]
+            with pytest.raises(QueueFull) as err:
+                for _ in range(4):  # 1-deep queue: full within a few
+                    accepted.append(engine.submit([1, 2, 3], 8))
+            assert err.value.retry_after >= 1
+            for req in accepted:
+                req.wait(timeout=600)
+            stats = engine.stats()
+            assert stats["rejected"]["queue_full"] >= 1
+            assert rejected.value(reason="queue_full") > base_full
+            # Ring holds exactly the accepted requests.
+            assert stats["traced_requests"] == len(accepted)
+        finally:
+            engine.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            engine.submit([1, 2, 3], 4)
+        assert engine.stats()["rejected"]["shutdown"] >= 1
+        assert rejected.value(reason="shutdown") > base_stop
+
+
+@pytest.mark.slow
+class TestTracingOverhead:
+    """ISSUE 10 acceptance: request tracing ON vs OFF must cost <= 5%
+    throughput on the same workload (min-of-3 wall clock; a small
+    absolute allowance absorbs scheduler jitter on the CPU-tiny
+    model)."""
+
+    def test_tracing_overhead_within_five_percent(self):
+        import time
+
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        cfg, params = load_params("llama_tiny", seed=0)
+
+        def best_wall(tracing):
+            engine = ContinuousBatchingEngine(
+                "llama_tiny", cfg, params, slots=4, max_len=64,
+                request_tracing=tracing)
+            try:
+                engine.submit([7] * 8, 4).wait(timeout=600)  # warm
+                best = None
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    reqs = [engine.submit([7] * 8, 24) for _ in range(16)]
+                    for req in reqs:
+                        req.wait(timeout=600)
+                    wall = time.perf_counter() - t0
+                    best = wall if best is None else min(best, wall)
+                assert engine.stats()["traced_requests"] == (
+                    49 if tracing else 0)
+                return best
+            finally:
+                engine.stop()
+
+        untraced = best_wall(False)
+        traced = best_wall(True)
+        assert traced <= untraced * 1.05 + 0.025, (
+            f"tracing overhead: {traced:.3f}s traced vs "
+            f"{untraced:.3f}s untraced")
