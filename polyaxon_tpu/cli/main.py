@@ -368,11 +368,14 @@ def ops_request_timeline(url, request_id, as_json):
     if summary:
         phases = " ".join(f"{name}={ms}ms" for name, ms
                           in (summary.get("phases_ms") or {}).items())
+        cached = summary.get("prefix_cached_tokens")
         click.echo(f"request {summary.get('request_id')}  "
                    f"class={summary.get('class')}  "
                    f"status={summary.get('status')}  "
                    f"ttft={summary.get('ttft_ms')}ms  "
-                   f"tokens={summary.get('tokens_out')}  {phases}")
+                   f"tokens={summary.get('tokens_out')}"
+                   + (f"  prefix_cached={cached}" if cached else "")
+                   + f"  {phases}")
     _render_timeline(payload)
 
 

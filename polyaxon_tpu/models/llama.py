@@ -894,6 +894,96 @@ def paged_insert_prefill(cache: dict, k_all: jax.Array, v_all: jax.Array,
     }
 
 
+def suffix_attn_step(cfg, layer: dict, x: jax.Array, k_prefix: jax.Array,
+                     v_prefix: jax.Array, positions: jax.Array,
+                     valid: jax.Array):
+    """One attention sublayer for a prefill SUFFIX [B, S] whose prefix
+    KV already exists (radix-cache hit): queries at absolute positions
+    ``positions`` attend [prefix; suffix]. ``k_prefix``/``v_prefix``
+    [B, Mpad, KV, Hd] were written by a completed prefill, so they are
+    already roped at their absolute positions — only the suffix K gets
+    roped here. ``valid`` [B, 1, S, Mpad+S] masks prefix padding and
+    keeps the suffix causal. Returns (x, k_suffix, v_suffix)."""
+    from polyaxon_tpu.ops.attention import repeat_kv
+
+    dt = cfg.dtype
+    B, S = positions.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = H // KV
+    scaling = getattr(cfg, "rope_scaling", None)
+
+    h = _norm(cfg, x, layer["attn_norm"])
+    q = (h @ _w(layer["wq"], dt)).reshape(B, S, H, Hd)
+    k = (h @ _w(layer["wk"], dt)).reshape(B, S, KV, Hd)
+    v = (h @ _w(layer["wv"], dt)).reshape(B, S, KV, Hd)
+    q = _rope(q, positions, cfg.rope_theta, scaling)
+    k = _rope(k, positions, cfg.rope_theta, scaling)
+    keys = repeat_kv(jnp.concatenate([k_prefix, k], axis=1), n_rep)
+    vals = repeat_kv(jnp.concatenate([v_prefix, v], axis=1), n_rep)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32)
+    s = s * (Hd ** -0.5)
+    s = jnp.where(valid, s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+    return x + attn.reshape(B, S, H * Hd) @ _w(layer["wo"], dt), k, v
+
+
+def _suffix_mask(S: int, m_pad: int, m: jax.Array) -> jax.Array:
+    """[1, 1, S, m_pad+S] validity for a suffix prefill: prefix column
+    j is real iff j < m (traced scalar — the gather pads to whole
+    pages), suffix columns are causal."""
+    pref_ok = jnp.broadcast_to(
+        jnp.arange(m_pad, dtype=jnp.int32)[None, :] < m, (S, m_pad))
+    tri = jnp.tril(jnp.ones((S, S), bool))
+    return jnp.concatenate([pref_ok, tri], axis=1)[None, None]
+
+
+def paged_prefill_suffix_kv(cfg: LlamaConfig, params: dict,
+                            suffix: jax.Array, k_prefix: jax.Array,
+                            v_prefix: jax.Array, m: jax.Array):
+    """Prefill only the NOVEL tail of a prompt whose first ``m`` tokens
+    hit the radix prefix cache: ``suffix`` [1, S] holds the token ids at
+    absolute positions m..m+S-1, ``k_prefix``/``v_prefix`` [L, Mpad, KV,
+    Hd] are the matched pages gathered in chain order (Mpad = whole
+    pages ≥ m; columns past m are masked, not read). Returns (k_suf,
+    v_suf) [L, S, KV, Hd] for ``paged_insert_suffix`` — compute is
+    O(S·(m+S)) instead of the full O(P²) recompute."""
+    dt = cfg.dtype
+    B, S = suffix.shape
+    m_pad = k_prefix.shape[1]
+    positions = jnp.broadcast_to(
+        m + jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    valid = _suffix_mask(S, m_pad, m)
+    x = _embed(cfg, params, suffix, dt)
+
+    def layer_step(x, inputs):
+        layer, kp, vp = inputs
+        x, k, v = suffix_attn_step(
+            cfg, layer, x, kp[None], vp[None], positions, valid)
+        x = _mlp(cfg, x, layer)
+        return x, (k, v)
+
+    _, (k_all, v_all) = jax.lax.scan(
+        layer_step, x, (params["layers"], k_prefix, v_prefix))
+    return k_all[:, 0], v_all[:, 0]  # [L, S, KV, Hd]
+
+
+def paged_insert_suffix(cache: dict, k_suf: jax.Array, v_suf: jax.Array,
+                        page_ids: jax.Array, start: jax.Array,
+                        page_size: int) -> dict:
+    """Scatter suffix KV ([L, S, KV, Hd]) into the row's pages at
+    absolute positions start..start+S-1 (``start`` traced int32 — the
+    cached-token count varies per admission without recompiling)."""
+    S = k_suf.shape[1]
+    t = start + jnp.arange(S)
+    pidx = jnp.maximum(page_ids[t // page_size], 0)
+    off = t % page_size
+    return {
+        "k": cache["k"].at[:, pidx, off].set(k_suf),
+        "v": cache["v"].at[:, pidx, off].set(v_suf),
+    }
+
+
 def generate(
     cfg: LlamaConfig,
     params: dict,

@@ -721,14 +721,49 @@ def paged_prefill_kv(cfg: MoEConfig, params: dict, prompt: jax.Array):
     return k_all[:, 0], v_all[:, 0]
 
 
+def paged_prefill_suffix_kv(cfg: MoEConfig, params: dict,
+                            suffix: jax.Array, k_prefix: jax.Array,
+                            v_prefix: jax.Array, m: jax.Array):
+    """Suffix-only prefill after a radix prefix-cache hit (llama's
+    ``suffix_attn_step`` with the expert FFN in the MLP slot): computes
+    KV only for the S novel tokens at absolute positions m..m+S-1,
+    attending the matched prefix pages. Routing sees the suffix tokens
+    as its dispatch group with no-drop capacity."""
+    from polyaxon_tpu.models.llama import _suffix_mask, suffix_attn_step
+
+    _check_decodable(cfg)
+    dt = cfg.dtype
+    B, S = suffix.shape
+    m_pad = k_prefix.shape[1]
+    positions = jnp.broadcast_to(
+        m + jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    valid = _suffix_mask(S, m_pad, m)
+    x = _embed_rows(params["embed"], suffix, dt)
+
+    def layer_step(x, inputs):
+        layer, kp, vp = inputs
+        x, k, v = suffix_attn_step(
+            cfg, layer, x, kp[None], vp[None], positions, valid)
+        h = rms_norm(x, layer["moe_norm"], cfg.norm_eps)
+        moe_out, _ = moe_block(cfg, h, layer["router"], layer["w_gate"],
+                               layer["w_up"], layer["w_down"],
+                               min_capacity=B * S)
+        return x + moe_out, (k, v)
+
+    _, (k_all, v_all) = jax.lax.scan(
+        layer_step, x, (params["layers"], k_prefix, v_prefix))
+    return k_all[:, 0], v_all[:, 0]
+
+
 # Continuous-batching hooks: admission/validation semantics are the
 # llama decoder-only ones; cache init/prefill are moe's own; the paged
-# insert is pure indexing shared verbatim.
+# inserts are pure indexing shared verbatim.
 from polyaxon_tpu.models.llama import (  # noqa: E402  (re-exported hooks)
     cb_admission,
     cb_validate,
     insert_cache_row,
     paged_insert_prefill,
+    paged_insert_suffix,
 )
 
 

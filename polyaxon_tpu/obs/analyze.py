@@ -239,6 +239,9 @@ def request_phases(timeline: dict[str, Any]) -> dict[str, Any]:
                       for name, ms in sorted(phases_ms.items())},
         "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
         "tokens_out": attrs.get("tokens_out"),
+        # Radix prefix reuse (paged kv): prefill tokens served from the
+        # cache instead of recomputed for THIS request.
+        "prefix_cached_tokens": attrs.get("prefix_cached_tokens"),
         "events": events,
         **({"error": root.get("error")}
            if root is not None and root.get("error") else {}),

@@ -555,6 +555,46 @@ def serving_kv_pages(registry: MetricsRegistry = REGISTRY) -> Gauge:
         ("state",))
 
 
+def serving_prefix_hits_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_prefix_hits_total",
+        "Radix prefix-cache admission outcomes (full = whole prefill "
+        "served from cache / partial = some pages matched, incl. "
+        "copy-on-write forks / miss = no shareable prefix matched)",
+        ("outcome",))
+
+
+def serving_prefix_cached_tokens(
+        registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_prefix_cached_tokens",
+        "Prefill tokens served from the radix prefix cache instead of "
+        "recomputed (the cross-request KV-reuse dividend)")
+
+
+def serving_prefix_hit_rate(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_prefix_hit_rate",
+        "Rolling fraction of prefill tokens served from the radix "
+        "prefix cache (last 64 prefill admissions; unset until the "
+        "window has enough samples, so cold starts cannot page)")
+
+
+def serving_radix_nodes(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_radix_nodes",
+        "Radix prefix-tree node count (one node per shared page run)")
+
+
+def serving_radix_pages(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_radix_pages",
+        "Radix-tree-owned KV pages by state (referenced = also held by "
+        "a live slot, resident = retired but shareable until LRU "
+        "eviction reclaims them)",
+        ("state",))
+
+
 def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     """Pre-register the serving families (idempotent) so a serving
     /metrics scrape exposes the full SLO schema before traffic lands —
@@ -570,6 +610,11 @@ def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     serving_tick_hist(registry)
     serving_batch_slots(registry)
     serving_kv_pages(registry)
+    serving_prefix_hits_total(registry)
+    serving_prefix_cached_tokens(registry)
+    serving_prefix_hit_rate(registry)
+    serving_radix_nodes(registry)
+    serving_radix_pages(registry)
 
 
 def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:

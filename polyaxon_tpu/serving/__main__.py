@@ -32,6 +32,11 @@ def main() -> int:
                              "shared page pool + block tables")
     parser.add_argument("--kv-page-size", type=int, default=16)
     parser.add_argument("--kv-pages", type=int, default=None)
+    parser.add_argument("--no-prefix-cache", action="store_true",
+                        help="(paged kv) disable radix-tree prefix "
+                             "sharing: every admission recomputes its "
+                             "full prefill (the A/B baseline for "
+                             "bench_serve.py's cached-token numbers)")
     parser.add_argument("--draft-model", default=None,
                         help="speculative-decoding draft (both engines; "
                              "lossless for greedy requests; the "
@@ -72,6 +77,7 @@ def main() -> int:
                        mesh_axes=mesh_axes, quantize=args.quantize,
                        kv=args.kv, page_size=args.kv_page_size,
                        kv_pages=args.kv_pages,
+                       prefix_cache=not args.no_prefix_cache,
                        draft_model=args.draft_model,
                        draft_checkpoint=args.draft_checkpoint,
                        spec_k=args.spec_k, lora_alpha=args.lora_alpha,

@@ -94,6 +94,12 @@ class _Request:
     klass: str = "batch"
     trace: Optional[reqtrace.RequestTrace] = None
     first_token_at: Optional[float] = None
+    # Cache-aware admission bookkeeping (paged + radix prefix cache):
+    # `admit_skips` counts how many times a younger request was
+    # admitted past this one (the starvation bound); the cached-token
+    # count lands on the request trace at finish.
+    admit_skips: int = 0
+    prefix_cached_tokens: int = 0
 
     def wait(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -112,6 +118,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model: str, cfg, params, *, slots: int = 4,
                  max_len: Optional[int] = None, kv: str = "dense",
                  page_size: int = 16, kv_pages: Optional[int] = None,
+                 prefix_cache: bool = True,
                  draft=None, prefill_chunk: Optional[int] = None,
                  max_pending: Optional[int] = None,
                  request_tracing: bool = True,
@@ -203,7 +210,8 @@ class ContinuousBatchingEngine:
 
             if kv_pages is None:
                 self._pool = PagePool.dense_equivalent(
-                    slots, self.max_len, page_size)
+                    slots, self.max_len, page_size,
+                    prefix_cache=prefix_cache)
             else:
                 # kv_pages counts USABLE pages (what /v1/stats reports
                 # as kv_pages_total); the scratch page is internal —
@@ -212,7 +220,8 @@ class ContinuousBatchingEngine:
                     raise ValueError(
                         f"kv_pages must be >= 1, got {kv_pages}")
                 self._pool = PagePool(slots, self.max_len, page_size,
-                                      kv_pages + 1)
+                                      kv_pages + 1,
+                                      prefix_cache=prefix_cache)
             self._cache = family.paged_init_cache(
                 cfg, self._pool.n_pages, page_size)
         else:
@@ -363,6 +372,56 @@ class ContinuousBatchingEngine:
         self._insert = (None if kv == "paged" else
                         jax.jit(family.insert_cache_row,
                                 donate_argnums=(0,)))
+
+        # Radix prefix reuse (paged only): one jitted page duplicator
+        # for copy-on-write forks (src/dst are traced scalars — every
+        # fork shares ONE executable), and an lru-bounded suffix
+        # prefill per (suffix length, prefix-page count) that computes
+        # KV only for the tokens the radix cache did NOT match. The
+        # cached-token count `m` is traced, so requests with different
+        # match depths but equal shapes share the program.
+        self._copy_page = None
+        self._suffix_prefill = None
+        if kv == "paged":
+            def copy_page(cache, src, dst):
+                return {name: arr.at[:, dst].set(arr[:, src])
+                        for name, arr in cache.items()}
+
+            self._copy_page = jax.jit(copy_page, donate_argnums=(0,))
+            if hasattr(family, "paged_prefill_suffix_kv"):
+                ps = page_size
+
+                @lru_cache(maxsize=16)
+                def compiled_suffix_prefill(slen: int, n_pref: int):
+                    def run(params, suffix, cache, page_ids, m):
+                        pref = jnp.maximum(page_ids[:n_pref], 0)
+                        kp = cache["k"][:, pref]
+                        kp = kp.reshape(kp.shape[0], n_pref * ps,
+                                        *kp.shape[3:])
+                        vp = cache["v"][:, pref]
+                        vp = vp.reshape(vp.shape[0], n_pref * ps,
+                                        *vp.shape[3:])
+                        k_suf, v_suf = family.paged_prefill_suffix_kv(
+                            cfg, params, suffix, kp, vp, m)
+                        return family.paged_insert_suffix(
+                            cache, k_suf, v_suf, page_ids, m, ps)
+
+                    return jax.jit(run, donate_argnums=(2,))
+
+                self._suffix_prefill = compiled_suffix_prefill
+        # Cache-aware admission: scan a bounded window of the pending
+        # queue and admit the admissible request with the hottest
+        # matched prefix; a request overtaken `_admit_skip_cap` times
+        # becomes a barrier (bounded starvation, same shape as the
+        # scheduler's aging rule). Rolling per-admission hit window
+        # feeds the polyaxon_serving_prefix_hit_rate gauge — unset
+        # until it holds enough samples, so cold starts cannot page.
+        self._admit_window = 32
+        self._admit_skip_cap = 16
+        self._prefill_tokens_total = 0
+        self._prefill_tokens_skipped = 0
+        self._hit_window: collections.deque = collections.deque(maxlen=64)
+        self._hit_window_min = 8
 
         if draft is not None:
             draft_family, draft_cfg = self._draft_family, self._draft_cfg
@@ -662,46 +721,104 @@ class ContinuousBatchingEngine:
                     self._finish_trace(req)
                     req.done.set()
 
+    def _pick_next_locked(self) -> Optional[_Request]:
+        """Choose the next request to admit (caller holds ``_cv``).
+        Dense: strict FIFO. Paged: scan a bounded window of the queue
+        and pick the admissible request whose radix-matched prefix is
+        hottest (most cached tokens) — admitting it FIRST keeps its
+        shared pages referenced and maximizes prefill skipped; strict
+        `>` keeps FIFO order among equal scores. Starvation bound:
+        every request a younger one overtakes ages by one skip, and a
+        request at the skip cap becomes a barrier — the scan stops at
+        it, so nothing younger can pass again (if it fits, its
+        infinite score wins outright). None = nothing in the window
+        fits the pool right now (backpressure)."""
+        if self._pool is None:
+            return self._queue.popleft()
+        best_i, best_score = None, -1.0
+        for i in range(min(len(self._queue), self._admit_window)):
+            req = self._queue[i]
+            barrier = req.admit_skips >= self._admit_skip_cap
+            if self._pool.can_admit(len(req.tokens), req.tokens):
+                score = (float("inf") if barrier else
+                         float(self._pool.peek_matched_tokens(
+                             len(req.tokens), req.tokens)))
+                if score > best_score:
+                    best_i, best_score = i, score
+            if barrier:
+                break
+        if best_i is None:
+            return None
+        for i in range(best_i):
+            self._queue[i].admit_skips += 1
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
+
+    def _note_prefix_outcome(self, req: _Request, res,
+                             prefill_len: int) -> int:
+        """Per-admission radix-reuse accounting: counters, the rolling
+        hit-rate gauge, and the request's cached-token stamp. Returns
+        the prefill tokens to skip."""
+        skip = min(res.matched_tokens, prefill_len)
+        req.prefix_cached_tokens = skip
+        outcome = ("full" if skip >= prefill_len
+                   else "partial" if skip > 0 else "miss")
+        obs_metrics.serving_prefix_hits_total().inc(outcome=outcome)
+        if skip:
+            obs_metrics.serving_prefix_cached_tokens().inc(skip)
+        self._prefill_tokens_total += prefill_len
+        self._prefill_tokens_skipped += skip
+        self._hit_window.append((skip, prefill_len))
+        if len(self._hit_window) >= self._hit_window_min:
+            denom = sum(p for _, p in self._hit_window)
+            if denom:
+                obs_metrics.serving_prefix_hit_rate().set(
+                    sum(s for s, _ in self._hit_window) / denom)
+        if res.cow is not None and req.trace is not None:
+            req.trace.event("cow_fork", src=int(res.cow[0]),
+                            dst=int(res.cow[1]))
+        return skip
+
     def _admit(self) -> None:
         for b in range(self.slots):
             if self._slot_req[b] is not None or b in self._prefilling:
                 continue
-            # Pop under the lock: cancel() mutates the queue from HTTP
-            # threads, and an unsynchronized popleft can race it empty.
+            # Pick under the lock: cancel() mutates the queue from HTTP
+            # threads, and an unsynchronized pop can race it empty.
             with self._cv:
                 if not self._queue:
                     break
-                # Paged backpressure: admission is FIFO — if the head
-                # request's pages don't fit the pool right now, wait
-                # for retirements to free pages instead of skipping it
-                # (skipping would starve long prompts behind short).
-                if (self._pool is not None and not
-                        self._pool.can_admit(len(self._queue[0].tokens),
-                                             self._queue[0].tokens)):
+                req = self._pick_next_locked()
+                if req is None:
+                    # Paged backpressure: nothing in the scan window
+                    # fits the pool right now — wait for retirements
+                    # to free pages. One head annotation per engine
+                    # tick while blocked (the per-span event cap
+                    # bounds a long wait): answers "why is my request
+                    # stuck in queue_wait" from the timeline alone.
                     head = self._queue[0]
                     if head.trace is not None:
-                        # One annotation per engine tick while blocked
-                        # (the per-span event cap bounds a long wait):
-                        # answers "why is my request stuck in
-                        # queue_wait" from the timeline alone.
                         head.trace.event("kv_backpressure",
                                          pages_free=self._pool.free_pages)
                     break
-                req = self._queue.popleft()
                 obs_metrics.serving_queue_depth().set(len(self._queue))
-            if self._pool is not None and not self._pool.admit(
-                    b, len(req.tokens), req.tokens):
-                # can_admit raced/drifted: put the request back at the
-                # head (FIFO preserved) and wait for retirements —
-                # running without pages would stream scratch-page
-                # garbage.
-                obs_metrics.serving_admissions_total().inc(
-                    outcome="deferred")
-                if req.trace is not None:
-                    req.trace.event("requeue", reason="kv_pages")
-                with self._cv:
-                    self._queue.appendleft(req)
-                break
+            admit_res = None
+            if self._pool is not None:
+                admit_res = self._pool.admit(b, len(req.tokens),
+                                             req.tokens)
+                if not admit_res:
+                    # can_admit raced/drifted: put the request back at
+                    # the head (FIFO preserved) and wait for
+                    # retirements — running without pages would stream
+                    # scratch-page garbage.
+                    obs_metrics.serving_admissions_total().inc(
+                        outcome="deferred")
+                    if req.trace is not None:
+                        req.trace.event("requeue", reason="kv_pages")
+                    with self._cv:
+                        self._queue.appendleft(req)
+                    break
             # Dequeued for real: close the queue_wait phase and feed
             # the SLO histogram (submit → admission dequeue).
             obs_metrics.serving_queue_wait_hist().observe(
@@ -711,6 +828,17 @@ class ContinuousBatchingEngine:
             try:
                 pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
                     req.tokens)
+                skip = 0
+                if admit_res is not None:
+                    skip = self._note_prefix_outcome(
+                        req, admit_res, len(prefill_tokens or ()))
+                    if admit_res.cow is not None:
+                        # Fork the partially-shared page ONCE on
+                        # device; the suffix prefill then writes only
+                        # the divergent tokens into the private copy.
+                        src, dst = admit_res.cow
+                        self._cache = self._copy_page(
+                            self._cache, jnp.int32(src), jnp.int32(dst))
                 if (prefill_tokens and self.prefill_chunk is not None
                         and len(prefill_tokens) > self.prefill_chunk):
                     # Long prompt: reserve the slot and stream the
@@ -731,28 +859,64 @@ class ContinuousBatchingEngine:
                         row_t, row_d, pos0, tok0]
                     continue
                 if prefill_tokens:
-                    if req.trace is not None:
-                        req.trace.start_phase(
-                            "prefill", mode="monolithic",
-                            prompt_tokens=len(prefill_tokens))
-                    row = jnp.asarray([prefill_tokens], jnp.int32)
-                    fn = self._compiled_prefill(len(prefill_tokens))
-                    if self._pool is not None:
+                    if skip >= len(prefill_tokens):
+                        # Whole prefill served from the radix cache:
+                        # every page is already written — no program
+                        # runs at all, decode starts immediately.
+                        if req.trace is not None:
+                            req.trace.start_phase(
+                                "prefill", mode="cached",
+                                prompt_tokens=len(prefill_tokens),
+                                cached_tokens=skip)
+                    elif skip > 0 and self._suffix_prefill is not None:
+                        # Partial hit: compute KV only for the novel
+                        # suffix, attending the matched prefix pages
+                        # gathered from the pool — O(S·P) instead of
+                        # the full O(P²) recompute.
+                        if req.trace is not None:
+                            req.trace.start_phase(
+                                "prefill", mode="suffix",
+                                prompt_tokens=len(prefill_tokens),
+                                cached_tokens=skip)
+                        suffix = prefill_tokens[skip:]
+                        n_pref = -(-skip // self._pool.page_size)
+                        fn = self._suffix_prefill(len(suffix), n_pref)
                         self._cache = fn(
-                            self.params, row, self._cache,
-                            jnp.asarray(self._pool.padded_row(b)))
+                            self.params,
+                            jnp.asarray([suffix], jnp.int32),
+                            self._cache,
+                            jnp.asarray(self._pool.padded_row(b)),
+                            jnp.int32(skip))
                     else:
-                        row_cache = fn(self.params, row)
-                        self._cache = self._insert(
-                            self._cache, row_cache, jnp.int32(b))
-                    if self.draft is not None:
-                        # The draft's cache row prefills the same
-                        # prompt prefix; its first query (cur at pos)
-                        # writes position pos inside the round.
-                        draft_row = self._compiled_draft_prefill(
-                            len(prefill_tokens))(self._draft_params, row)
-                        self._draft_cache = self._draft_insert(
-                            self._draft_cache, draft_row, jnp.int32(b))
+                        if req.trace is not None:
+                            req.trace.start_phase(
+                                "prefill", mode="monolithic",
+                                prompt_tokens=len(prefill_tokens))
+                        row = jnp.asarray([prefill_tokens], jnp.int32)
+                        fn = self._compiled_prefill(len(prefill_tokens))
+                        if self._pool is not None:
+                            self._cache = fn(
+                                self.params, row, self._cache,
+                                jnp.asarray(self._pool.padded_row(b)))
+                        else:
+                            row_cache = fn(self.params, row)
+                            self._cache = self._insert(
+                                self._cache, row_cache, jnp.int32(b))
+                if prefill_tokens and self.draft is not None:
+                    # The draft's cache row prefills the same prompt
+                    # prefix; its first query (cur at pos) writes
+                    # position pos inside the round. (Drafts require
+                    # kv='dense', so the radix skip never applies —
+                    # `row` was built by the monolithic branch.)
+                    draft_row = self._compiled_draft_prefill(
+                        len(prefill_tokens))(self._draft_params, row)
+                    self._draft_cache = self._draft_insert(
+                        self._draft_cache, draft_row, jnp.int32(b))
+                if self._pool is not None:
+                    # The prefill (or full cache hit) really wrote the
+                    # pages this admission registered: the fresh radix
+                    # leaf survives the slot from here on.
+                    self._pool.commit_prefix(b)
                 self._go_live(b, req, pos0, tok0)
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 if self._pool is not None:
@@ -839,7 +1003,22 @@ class ContinuousBatchingEngine:
                 "kv_pages_free": self._pool.free_pages,
                 "kv_page_size": self._pool.page_size,
                 "kv_prefix_hits": self._pool.prefix_hits,
-                "kv_prefix_misses": self._pool.prefix_misses}
+                "kv_prefix_misses": self._pool.prefix_misses,
+                # Radix prefix-reuse dividend: prefill tokens the
+                # engine did NOT recompute, plus the tree's live shape
+                # and the chaos-path invariant check (non-zero means a
+                # refcount/CoW accounting bug — bench and CI fail it).
+                "prefill_tokens_total": self._prefill_tokens_total,
+                "prefill_tokens_skipped": self._prefill_tokens_skipped,
+                "kv_prefix_hit_rate": (
+                    round(self._prefill_tokens_skipped
+                          / self._prefill_tokens_total, 4)
+                    if self._prefill_tokens_total else None),
+                "kv_cow_forks": self._pool.cow_forks,
+                "kv_prefix_evictions": self._pool.prefix_evictions,
+                "kv_radix": self._pool.radix_stats(),
+                "kv_invariant_violations": len(
+                    self._pool.check_invariants())}
                if self._pool is not None else {}),
         }
 
@@ -1044,9 +1223,11 @@ class ContinuousBatchingEngine:
             return
         if req.error:
             req.trace.finish(status="error", error=req.error,
-                             tokens_out=len(req.out))
+                             tokens_out=len(req.out),
+                             prefix_cached_tokens=req.prefix_cached_tokens)
         else:
-            req.trace.finish(tokens_out=len(req.out))
+            req.trace.finish(tokens_out=len(req.out),
+                             prefix_cached_tokens=req.prefix_cached_tokens)
 
     def _retire(self, b: int) -> None:
         req = self._slot_req[b]
@@ -1111,6 +1292,11 @@ class ContinuousBatchingEngine:
             pages = obs_metrics.serving_kv_pages()
             pages.set(util["used"], state="used")
             pages.set(util["free"], state="free")
+            radix = self._pool.radix_stats()
+            obs_metrics.serving_radix_nodes().set(radix["nodes"])
+            rpages = obs_metrics.serving_radix_pages()
+            rpages.set(radix["referenced"], state="referenced")
+            rpages.set(radix["resident"], state="resident")
 
     def _tick(self) -> bool:
         """One engine iteration: drop cancellations, admit, advance

@@ -435,6 +435,12 @@ async function refresh() {
           ? (s.kv_prefix_hits / (s.kv_prefix_hits + s.kv_prefix_misses))
               .toFixed(2)
           : "–") : "",
+    s.prefill_tokens_skipped != null
+      ? tile("prefill tokens cached",
+          `${s.prefill_tokens_skipped} / ${s.prefill_tokens_total}`) : "",
+    s.kv_radix != null
+      ? tile("radix pages (ref/resident)",
+          `${s.kv_radix.referenced} / ${s.kv_radix.resident}`) : "",
   ];
   document.getElementById("tiles").innerHTML = tiles.join("");
 }
@@ -704,6 +710,7 @@ class ServingServer:
                  mesh_axes: Optional[dict] = None,
                  quantize: Optional[str] = None, kv: str = "dense",
                  page_size: int = 16, kv_pages: Optional[int] = None,
+                 prefix_cache: bool = True,
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None, spec_k: int = 4,
                  lora_alpha: float = 16.0,
@@ -761,7 +768,8 @@ class ServingServer:
 
             self.engine = ContinuousBatchingEngine(
                 model, cfg, params, slots=slots, kv=kv,
-                page_size=page_size, kv_pages=kv_pages, draft=draft,
+                page_size=page_size, kv_pages=kv_pages,
+                prefix_cache=prefix_cache, draft=draft,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 request_tracing=request_tracing)
         elif batching == "static":

@@ -9,7 +9,12 @@ Writes bench_serve_results.json at the repo root.
 
 Usage: python scripts/bench_serve.py [--model llama3_1b] [--clients 8]
        [--requests 32] [--max-new 64] [--slots 8] [--quick]
+       [--workload mixed|shared-prefix|conversation-tree]
+       [--configs paged,paged-nocache] [--check-prefix]
 CPU smoke: JAX_PLATFORMS=cpu ... --model llama_tiny --quick
+Radix A/B (ISSUE 11): the paged vs paged-nocache rows + the top-level
+`prefix_ab` block record prefill tokens skipped, hit rate, and the
+interactive p50-TTFT dividend per workload.
 """
 
 from __future__ import annotations
@@ -31,7 +36,7 @@ apply_jax_platforms_override()
 
 
 def drive(url: str, prompts: list[list[int]], max_new: int,
-          clients: int) -> dict:
+          clients: int, klass: str = "interactive") -> dict:
     """Fan the prompts over `clients` threads; returns latency stats."""
     lat: list[float] = []
     errors: list[str] = []
@@ -45,7 +50,7 @@ def drive(url: str, prompts: list[list[int]], max_new: int,
                     return
                 i, prompt = queue.pop()
             body = json.dumps({"tokens": [prompt], "max_new_tokens": max_new,
-                               "seed": i}).encode()
+                               "seed": i, "class": klass}).encode()
             req = urllib.request.Request(
                 url + "/v1/generate", method="POST", data=body,
                 headers={"Content-Type": "application/json"})
@@ -83,6 +88,34 @@ def _stats(url: str) -> dict:
     return json.load(urllib.request.urlopen(url + "/v1/stats", timeout=10))
 
 
+def _timeline_ttft_p50_ms(url: str, n: int):
+    """Exact p50 TTFT (ms) over the last `n` requests, read from their
+    span timelines — the SLO histograms answer the same question but
+    at bucket resolution, too coarse for a CPU-scale A/B delta."""
+    try:
+        recent = json.load(urllib.request.urlopen(url + "/requests",
+                                                  timeout=10))
+    except Exception:  # noqa: BLE001 — static engine / tracing off
+        return None
+    ttfts = []
+    for row in (recent.get("requests") or recent or [])[:n]:
+        rid = row.get("request_id") if isinstance(row, dict) else None
+        if not rid:
+            continue
+        try:
+            tl = json.load(urllib.request.urlopen(
+                f"{url}/requests/{rid}/timeline", timeout=10))
+        except Exception:  # noqa: BLE001 — evicted from the ring
+            continue
+        ttft = (tl.get("summary") or {}).get("ttft_ms")
+        if ttft is not None:
+            ttfts.append(float(ttft))
+    if not ttfts:
+        return None
+    ttfts.sort()
+    return round(ttfts[len(ttfts) // 2], 3)
+
+
 def _slo_percentiles() -> dict:
     """Per-class TTFT/TPOT p50/p99 straight from the in-process
     registry (ServingServer shares this process): the trajectory
@@ -118,6 +151,11 @@ def run_config(name: str, model: str, prompts, max_new, clients,
         seen: dict[int, list[int]] = {}
         for p in prompts:
             seen.setdefault(len(p), p)
+        # Twice: the first pass populates the radix tree and compiles
+        # the monolithic prefills; the SECOND pass re-admits against a
+        # warm tree and compiles the suffix-prefill programs the timed
+        # window will actually run (per distinct suffix length).
+        drive(s.url, list(seen.values()), max_new, clients=2)
         drive(s.url, list(seen.values()), max_new, clients=2)
         # The warm-up polluted the SLO histograms (compile-dominated
         # TTFTs): reset so the per-class percentiles describe the
@@ -128,6 +166,7 @@ def run_config(name: str, model: str, prompts, max_new, clients,
         result = drive(s.url, prompts, max_new, clients)
         after = _stats(s.url)
         slo_by_class = _slo_percentiles()
+        ttft_exact = _timeline_ttft_p50_ms(s.url, len(prompts))
     # Timed-window deltas (the raw gauges are lifetime counters).
     occupancy = None
     dsteps = (after.get("decode_steps") or 0) - (before.get("decode_steps") or 0)
@@ -142,6 +181,7 @@ def run_config(name: str, model: str, prompts, max_new, clients,
                round(result["tokens_per_sec"] / jax.device_count(), 2)
                if result["tokens_per_sec"] is not None else None),
            "slo_by_class": slo_by_class,
+           "ttft_p50_ms": ttft_exact,
            "rejected": after.get("rejected") or {}}
     if after.get("spec_rounds") is not None:
         row["spec_tokens_per_round"] = after.get("spec_tokens_per_round")
@@ -150,10 +190,78 @@ def run_config(name: str, model: str, prompts, max_new, clients,
                                  - before["kv_prefix_hits"])
         row["kv_prefix_misses"] = (after["kv_prefix_misses"]
                                    - before["kv_prefix_misses"])
+    if after.get("prefill_tokens_total") is not None:
+        # Radix prefix-reuse dividend over the TIMED window only.
+        total = (after["prefill_tokens_total"]
+                 - (before.get("prefill_tokens_total") or 0))
+        skipped = (after["prefill_tokens_skipped"]
+                   - (before.get("prefill_tokens_skipped") or 0))
+        row["prefill_tokens_total"] = total
+        row["prefill_tokens_skipped"] = skipped
+        row["prefix_hit_rate"] = (round(skipped / total, 4)
+                                  if total else None)
+        row["kv_cow_forks"] = (after.get("kv_cow_forks") or 0) - (
+            before.get("kv_cow_forks") or 0)
+        row["kv_prefix_evictions"] = (
+            (after.get("kv_prefix_evictions") or 0)
+            - (before.get("kv_prefix_evictions") or 0))
+        # Headroom: free pages INCLUDE resident-but-unreferenced radix
+        # pages (reclaimable on demand) — the cache costs no capacity.
+        radix = after.get("kv_radix") or {}
+        row["kv_pages_total"] = after.get("kv_pages_total")
+        row["kv_pages_free"] = after.get("kv_pages_free")
+        row["kv_pages_headroom_reclaimable"] = max(
+            (radix.get("resident") or 0) - (radix.get("referenced") or 0), 0)
+        row["kv_invariant_violations"] = after.get("kv_invariant_violations")
     print(f"  {name}: {result['tokens_per_sec']} tok/s, "
           f"p50 {result['latency_p50_s']}s, "
           f"occupancy {row['avg_occupancy']}", flush=True)
     return row
+
+
+def make_prompts(workload: str, requests: int, prompt_len: int,
+                 rng) -> list[list[int]]:
+    """The three serving mixes the radix cache is judged against.
+
+    - ``mixed``: half the requests share one system prompt, half are
+      cold — the honest production blend.
+    - ``shared-prefix``: EVERY request is system-prompt + short user
+      turn — the workload prefix caching exists for (the acceptance
+      trace: >= 40% of prefill tokens skipped).
+    - ``conversation-tree``: multi-turn chats forking from shared
+      histories at non-page-aligned points — exercises radix splits
+      and copy-on-write forks, not just whole-page adoption.
+    """
+    if workload == "mixed":
+        sys_prefix = [rng.randrange(100) for _ in range(prompt_len // 2)]
+        prompts = []
+        for i in range(requests):
+            tail_len = rng.randrange(4, max(prompt_len // 2, 5))
+            tail = [rng.randrange(100) for _ in range(tail_len)]
+            prompts.append((sys_prefix + tail) if i % 2 == 0 else
+                           ([rng.randrange(100) for _ in range(8)] + tail))
+        return prompts
+    if workload == "shared-prefix":
+        sys_prefix = [rng.randrange(100)
+                      for _ in range(max(prompt_len * 3 // 4, 8))]
+        return [sys_prefix + [rng.randrange(100) for _ in range(
+                    rng.randrange(4, max(prompt_len // 4, 5)))]
+                for _ in range(requests)]
+    if workload == "conversation-tree":
+        # A branching tree of token blocks; each request's prompt is a
+        # root→node path (a chat history). Block length is NOT a page
+        # multiple, so sibling branches diverge mid-page.
+        block = max(prompt_len // 8, 3)
+        paths = [[rng.randrange(100) for _ in range(block * 2)]]  # root
+        prompts: list[list[int]] = []
+        while len(prompts) < requests:
+            parent = paths[rng.randrange(len(paths))]
+            child = parent + [rng.randrange(100) for _ in range(block)]
+            if len(child) <= prompt_len * 2:
+                paths.append(child)
+            prompts.append(list(child))
+        return prompts
+    raise ValueError(f"unknown workload {workload!r}")
 
 
 def main() -> int:
@@ -164,12 +272,27 @@ def main() -> int:
     parser.add_argument("--max-new", type=int, default=64)
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--prompt-len", type=int, default=48)
+    parser.add_argument("--workload", default="mixed",
+                        choices=["mixed", "shared-prefix",
+                                 "conversation-tree"],
+                        help="prompt mix (see make_prompts)")
+    parser.add_argument("--kv-page-size", type=int, default=16)
+    parser.add_argument("--configs", default=None,
+                        help="comma list to restrict the configs run, "
+                             "e.g. 'paged,paged-nocache'")
     parser.add_argument("--draft", default=None,
                         help="also bench continuous speculative with "
                              "this draft model (vocab must match)")
     parser.add_argument("--spec-k", type=int, default=4)
     parser.add_argument("--quick", action="store_true",
                         help="tiny load (CPU smoke of the harness)")
+    parser.add_argument("--check-prefix", action="store_true",
+                        help="CI gate: exit 1 unless the paged config "
+                             "saw prefix_hit_rate > 0 with zero "
+                             "refcount-invariant violations")
+    parser.add_argument("--out", default=None,
+                        help="result path (default: repo-root "
+                             "bench_serve_results.json)")
     args = parser.parse_args()
     if args.quick:
         args.clients, args.requests, args.max_new = 3, 6, 8
@@ -179,20 +302,20 @@ def main() -> int:
     import jax
 
     rng = random.Random(0)
-    # Mixed lengths with a shared "system prompt" prefix on half the
-    # requests — the workload prefix caching exists for.
-    sys_prefix = [rng.randrange(100) for _ in range(args.prompt_len // 2)]
-    prompts = []
-    for i in range(args.requests):
-        tail_len = rng.randrange(4, max(args.prompt_len // 2, 5))
-        tail = [rng.randrange(100) for _ in range(tail_len)]
-        prompts.append((sys_prefix + tail) if i % 2 == 0 else
-                       ([rng.randrange(100) for _ in range(8)] + tail))
+    prompts = make_prompts(args.workload, args.requests, args.prompt_len,
+                           rng)
 
     configs = [
         ("dense", dict(slots=args.slots)),
-        ("paged", dict(slots=args.slots, kv="paged")),
+        ("paged", dict(slots=args.slots, kv="paged",
+                       page_size=args.kv_page_size)),
+        # The A/B baseline: same pool, radix sharing off — every
+        # admission recomputes its full prefill.
+        ("paged-nocache", dict(slots=args.slots, kv="paged",
+                               page_size=args.kv_page_size,
+                               prefix_cache=False)),
         ("paged-int8", dict(slots=args.slots, kv="paged",
+                            page_size=args.kv_page_size,
                             quantize="int8")),
     ]
     if args.draft:
@@ -201,18 +324,48 @@ def main() -> int:
         # already greedy (no temperature), so the same workload runs.
         configs.append(("dense-spec", dict(
             slots=args.slots, draft_model=args.draft, spec_k=args.spec_k)))
+    if args.configs:
+        wanted = {name.strip() for name in args.configs.split(",")}
+        unknown = wanted - {name for name, _ in configs}
+        if unknown:
+            parser.error(f"unknown configs: {sorted(unknown)}")
+        configs = [(n, kw) for n, kw in configs if n in wanted]
     results = [run_config(name, args.model, prompts, args.max_new,
                           args.clients, **kw)
                for name, kw in configs]
+    by_name = {r["name"]: r for r in results}
     out = {
         "backend": jax.devices()[0].platform,
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
         "model": args.model,
+        "workload": args.workload,
         "load": {"clients": args.clients, "requests": args.requests,
-                 "max_new": args.max_new, "slots": args.slots},
+                 "max_new": args.max_new, "slots": args.slots,
+                 "prompt_len": args.prompt_len,
+                 "kv_page_size": args.kv_page_size},
         "results": results,
     }
-    path = os.path.join(REPO, "bench_serve_results.json")
+    # The acceptance A/B: radix sharing on vs off, same pool, same
+    # workload — skip fraction and the interactive-TTFT dividend.
+    cached, nocache = by_name.get("paged"), by_name.get("paged-nocache")
+    if cached is not None and nocache is not None:
+        # Exact per-request TTFT from the span timelines; the bucketed
+        # histogram percentiles ride along in each row's slo_by_class.
+        t_on, t_off = cached.get("ttft_p50_ms"), nocache.get("ttft_p50_ms")
+        out["prefix_ab"] = {
+            "workload": args.workload,
+            "prefix_hit_rate": cached.get("prefix_hit_rate"),
+            "prefill_tokens_skipped": cached.get("prefill_tokens_skipped"),
+            "ttft_p50_ms_cached": t_on,
+            "ttft_p50_ms_nocache": t_off,
+            "ttft_p50_improvement": (
+                round(1.0 - t_on / t_off, 4)
+                if t_on is not None and t_off else None),
+        }
+        print(f"prefix A/B ({args.workload}): hit_rate "
+              f"{out['prefix_ab']['prefix_hit_rate']}, ttft p50 "
+              f"{t_on}ms cached vs {t_off}ms nocache", flush=True)
+    path = args.out or os.path.join(REPO, "bench_serve_results.json")
     with open(path, "w") as fh:
         json.dump(out, fh, indent=2)
     print(f"wrote {path}")
@@ -222,6 +375,23 @@ def main() -> int:
         print(f"ERROR: configs with failed requests: {incomplete} "
               "(see errors in the JSON)", file=sys.stderr)
         return 1
+    if args.check_prefix:
+        paged = by_name.get("paged")
+        if paged is None:
+            print("ERROR: --check-prefix needs the 'paged' config",
+                  file=sys.stderr)
+            return 1
+        rate = paged.get("prefix_hit_rate") or 0.0
+        violations = paged.get("kv_invariant_violations")
+        if not rate > 0:
+            print(f"ERROR: prefix_hit_rate {rate} — the radix cache "
+                  "served nothing", file=sys.stderr)
+            return 1
+        if violations != 0:
+            print(f"ERROR: {violations} page refcount invariant "
+                  "violations after the run", file=sys.stderr)
+            return 1
+        print(f"prefix check ok: hit_rate {rate}, invariants clean")
     return 0
 
 

@@ -103,6 +103,18 @@ python -m pytest tests/test_obs.py -q -m obs
 # within 5%) is slow-marked and runs under --full.
 echo "== serving observability (request timelines / SLO series)"
 python -m pytest "tests/test_serving.py::TestRequestObservability" -q
+# Radix prefix-cache smoke (ISSUE 11): the real server under the
+# shared-system-prompt mix, paged vs paged-nocache. --check-prefix
+# fails the build unless the radix tree actually served prefill tokens
+# (prefix_hit_rate > 0) AND the page refcount/CoW invariants came out
+# clean after the run (kv_invariant_violations == 0) — a leak or
+# double-free in the fork/evict/release lifecycle fails HERE, not as
+# pool exhaustion hours into a soak.
+echo "== radix prefix-cache smoke (hit rate + refcount invariants)"
+JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
+    --quick --workload shared-prefix --slots 2 --kv-page-size 8 \
+    --configs paged,paged-nocache --check-prefix \
+    --out /tmp/bench_serve_smoke.json
 # Fleet-sim stage (ISSUE 8): drive the REAL scheduler + admission +
 # store through the quick load points (idle → storm, seconds not the
 # full compressed day) and gate tick cost against

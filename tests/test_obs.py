@@ -1400,3 +1400,50 @@ class TestServingObsDrill:
                 "fired", "resolved"]
         finally:
             engine.stop()
+
+    def test_prefix_hit_collapse_fires_then_resolves(self):
+        """ISSUE 11: the COMMITTED serving-prefix-hit-collapse rule
+        fires when the radix hit-rate gauge collapses below 10%, holds
+        through hysteresis, and resolves once the cache re-warms — and
+        an UNSET gauge (cold start, before the engine has served its
+        minimum admission window) never breaches a `<` rule."""
+        (rule,) = [r for r in obs_rules.check_ruleset()
+                   if r.id == "serving-prefix-hit-collapse"]
+
+        # Cold start: the gauge does not exist yet → no breach. This is
+        # why the engine only sets it after _hit_window_min admissions.
+        cold = obs_rules.AlertEngine(
+            [rule], registry=obs_metrics.MetricsRegistry(),
+            clock=_FakeClock())
+        assert cold.evaluate() == []
+        assert cold.active() == []
+
+        clock = _FakeClock()
+        alert_engine = obs_rules.AlertEngine(
+            [rule], registry=obs_metrics.REGISTRY, clock=clock)
+        obs_metrics.ensure_serving_metrics()
+        gauge = obs_metrics.serving_prefix_hit_rate()
+
+        gauge.set(0.62)  # healthy: most prefill tokens served cached
+        assert alert_engine.evaluate() == []
+        clock.now += 30
+
+        gauge.set(0.02)  # collapse: tree invalidated / workload shift
+        assert alert_engine.evaluate() == []  # pending, `for` = 5s
+        clock.now += 6
+        (fired,) = alert_engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "serving-prefix-hit-collapse"
+        assert fired["value"] < 0.1
+        assert alert_engine.active()
+
+        gauge.set(0.55)  # the cache re-warmed
+        assert alert_engine.evaluate() == []  # clear; hysteresis holds
+        assert alert_engine.active()
+        clock.now += 20  # past resolve_after = 15s
+        (resolved,) = alert_engine.evaluate()
+        assert resolved["event"] == "resolved"
+        assert resolved["rule"] == "serving-prefix-hit-collapse"
+        assert alert_engine.active() == []
+        assert [e["event"] for e in alert_engine.history] == [
+            "fired", "resolved"]

@@ -396,3 +396,266 @@ class TestPrefixCache:
         assert pool.prefix_hits == 2
 
 
+class TestRadixPrefixSharing:
+    """The radix-tree prefix index: copy-on-write forks at mid-page
+    divergence, refcount/eviction invariants under the chaos paths
+    (fork-then-release, failed admission, whole-tree invalidation),
+    and cache-aware admission ordering."""
+
+    def test_cow_fork_at_mid_page_divergence(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        b = [1, 2, 3, 4, 5, 6, 77, 88, 99, 100]  # diverges INSIDE page 1
+        assert pool.admit(0, 10, a)
+        res = pool.admit(1, 10, b)
+        assert res is not None
+        # Page 0 fully matched; tokens 4,5 of page 1 match → CoW fork.
+        assert res.matched_pages == 1
+        assert res.matched_tokens == 6
+        assert res.cow is not None
+        src, dst = res.cow
+        assert src == int(pool.tables[0][1]) and dst == int(pool.tables[1][1])
+        assert src != dst  # the fork got its own private copy
+        assert int(pool.tables[0][0]) == int(pool.tables[1][0])
+        assert pool.cow_forks == 1
+        assert pool.check_invariants() == []
+
+    def test_fork_then_release_leaks_nothing(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        a = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        b = [1, 2, 3, 4, 5, 6, 77, 88, 99, 100]
+        assert pool.admit(0, 10, a)
+        assert pool.admit(1, 10, b)
+        pool.release(0)
+        assert pool.check_invariants() == []
+        pool.release(1)
+        assert pool.check_invariants() == []
+        # 3 chain pages (a's two + b's forked branch) stay resident but
+        # reclaimable; both private decode pages went back to the free
+        # list — every usable page is allocatable again.
+        assert pool.free_pages == 8
+        assert pool.radix_stats()["pages"] == 3
+        # And both branches re-hit their own content.
+        assert pool.admit(0, 10, a)
+        assert pool.admit(1, 10, b)
+        assert pool.check_invariants() == []
+
+    def test_eviction_of_live_referenced_page_impossible(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=5)
+        a = list(range(10))  # 3 pages, 2 in the tree — slot 0 LIVE
+        assert pool.admit(0, 10, a)
+        distinct = [50, 51, 52, 53, 54, 55]  # needs 2 fresh pages
+        # Only 1 page is truly free and the tree pages are referenced
+        # by slot 0: nothing may be evicted from under it.
+        assert not pool.can_admit(6, distinct)
+        assert not pool.admit(1, 6, distinct)
+        assert pool.check_invariants() == []
+        assert (pool.tables[0][:3] >= 1).all()  # row untouched
+        pool.release(0)  # now resident → evictable
+        bigger = list(range(50, 60))  # 3 pages: must evict a resident
+        assert pool.admit(1, 10, bigger)
+        assert pool.prefix_evictions >= 1
+        assert pool.check_invariants() == []
+
+    def test_invalidate_prefix_cache_drops_whole_tree(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        a = list(range(10))
+        assert pool.admit(0, 10, a)
+        pool.release(0)
+        assert pool.radix_stats()["pages"] == 2
+        pool.invalidate_prefix_cache()
+        assert pool.radix_stats() == {"nodes": 0, "pages": 0,
+                                      "referenced": 0, "resident": 0}
+        assert pool.free_pages == 8
+        assert pool.check_invariants() == []
+        assert pool.admit(0, 10, a)
+        assert pool.prefix_hits == 0  # nothing survived
+
+    def test_invalidate_with_live_rows_keeps_allocations(self):
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        a = list(range(10))
+        assert pool.admit(0, 10, a)  # LIVE while the tree is dropped
+        pool.invalidate_prefix_cache()
+        assert pool.check_invariants() == []
+        assert (pool.tables[0][:3] >= 1).all()
+        assert pool.admit(1, 10, a)
+        assert pool.prefix_hits == 0  # shareability gone, pages intact
+        pool.release(0)
+        pool.release(1)
+        assert pool.check_invariants() == []
+
+    def test_failed_prefill_detaches_only_the_fresh_leaf(self):
+        """Mid-prefill failure/requeue chaos: invalidating slot 1's
+        admission must forget ONLY the chain pages it registered —
+        the prefix it adopted from slot 0 keeps serving hits."""
+        pool = PagePool(slots=2, max_len=32, page_size=4, n_pages=9)
+        a = list(range(10))            # chain: pages 0..1 (tokens 0..7)
+        b = list(range(8)) + list(range(200, 206))  # extends a's chain
+        assert pool.admit(0, 10, a)
+        res = pool.admit(1, 14, b)
+        assert res is not None and res.matched_pages == 2
+        pool.release(1, invalidate_prefix=True)  # prefill never ran
+        assert pool.check_invariants() == []
+        # a's chain still matches; b's extension is gone.
+        assert pool.peek_matched_tokens(14, b) == 8
+        res2 = pool.admit(1, 14, b)
+        assert res2 is not None and res2.matched_pages == 2
+        assert pool.check_invariants() == []
+
+    def test_commit_prefix_makes_leaf_durable(self):
+        pool = PagePool(slots=1, max_len=32, page_size=4, n_pages=9)
+        a = list(range(10))
+        assert pool.admit(0, 10, a)
+        pool.commit_prefix(0)  # prefill completed
+        # invalidate_prefix on release is now a no-op for the leaf.
+        pool.release(0, invalidate_prefix=True)
+        assert pool.admit(0, 10, a)
+        assert pool.prefix_hits == 2
+        assert pool.check_invariants() == []
+
+    def test_engine_cow_parity_with_dense(self):
+        """Two prompts diverging mid-page: the forked request's tokens
+        must match the dense engine exactly (the CoW copy + suffix
+        prefill reconstruct the same KV), with zero invariant
+        violations afterwards."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        p1 = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+        p2 = [3, 1, 4, 1, 5, 9, 7, 7, 5, 3]  # diverges at index 6
+        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=1, max_len=32)
+        try:
+            want1 = dense.generate([p1], max_new_tokens=5, timeout=300)
+            want2 = dense.generate([p2], max_new_tokens=5, timeout=300)
+        finally:
+            dense.stop()
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          kv="paged", page_size=4)
+        try:
+            got1 = engine.generate([p1], max_new_tokens=5, timeout=300)
+            got2 = engine.generate([p2], max_new_tokens=5, timeout=300)
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        assert got1 == want1 and got2 == want2
+        assert stats["kv_cow_forks"] >= 1
+        assert stats["prefill_tokens_skipped"] > 0
+        assert stats["kv_invariant_violations"] == 0
+
+    def test_engine_full_prefill_cache_hit(self):
+        """A prompt whose whole prefill sits in the tree (a previous
+        longer prompt wrote it) runs NO prefill program and still
+        decodes the dense engine's tokens."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        a = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7]  # chain: 12 tokens
+        b = a[:13]  # prefill = a[:12] — fully inside a's chain
+        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=1, max_len=32)
+        try:
+            want = dense.generate([b], max_new_tokens=5, timeout=300)
+        finally:
+            dense.stop()
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          kv="paged", page_size=4)
+        try:
+            engine.generate([a], max_new_tokens=5, timeout=300)
+            got = engine.generate([b], max_new_tokens=5, timeout=300)
+            stats = engine.stats()
+            timeline = engine.request_timeline(
+                engine.recent_requests()[0]["request_id"])
+        finally:
+            engine.stop()
+        assert got == want
+        # Second admission skipped its entire 12-token prefill.
+        assert stats["prefill_tokens_skipped"] >= 12
+        assert stats["kv_invariant_violations"] == 0
+        from polyaxon_tpu.obs import analyze
+
+        summary = analyze.request_phases(timeline)
+        assert summary["prefix_cached_tokens"] == 12
+
+    def test_prefix_cache_off_disables_sharing(self):
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          kv="paged", page_size=4,
+                                          prefix_cache=False)
+        try:
+            first = engine.generate([prompt], max_new_tokens=4, timeout=300)
+            second = engine.generate([prompt], max_new_tokens=4, timeout=300)
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        assert first == second
+        assert stats["kv_prefix_hits"] == 0
+        assert stats["prefill_tokens_skipped"] == 0
+        assert stats["kv_invariant_violations"] == 0
+
+    def test_cache_aware_admission_prefers_hot_prefix(self):
+        """Among admissible pending requests the one with the hottest
+        matched prefix is admitted first; overtaken requests age, and
+        a request at the skip cap becomes a barrier nothing younger
+        passes."""
+        from polyaxon_tpu.serving.batching import _Request
+
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          kv="paged", page_size=4)
+        engine.stop()  # drive _pick_next_locked deterministically
+        pool = engine._pool
+        hot = list(range(12))
+        assert pool.admit(0, 12, hot)
+        pool.release(0)  # hot's chain is resident in the tree
+        cold = list(range(100, 112))
+        r_cold = _Request(list(cold), 4, 0.0, 0)
+        r_hot = _Request(list(hot), 4, 0.0, 0)
+        engine._queue.extend([r_cold, r_hot])
+        with engine._cv:
+            assert engine._pick_next_locked() is r_hot
+        assert r_cold.admit_skips == 1  # the overtaken request aged
+        engine._queue.clear()
+        # Barrier: a starved request terminates the scan and wins.
+        r_starved = _Request(list(cold), 4, 0.0, 0)
+        r_starved.admit_skips = engine._admit_skip_cap
+        r_hot2 = _Request(list(hot), 4, 0.0, 0)
+        engine._queue.extend([r_starved, r_hot2])
+        with engine._cv:
+            assert engine._pick_next_locked() is r_starved
+
+    def test_moe_prefix_reuse_matches_dense(self):
+        """The MoE family's suffix prefill (expert FFN over the novel
+        tokens only): sequential shared-prefix prompts keep greedy
+        parity with its dense engine."""
+        from polyaxon_tpu.models import moe
+
+        cfg = dataclasses.replace(moe.CONFIGS["moe_tiny"],
+                                  dtype=jnp.float32)
+        params = moe.init(cfg, jax.random.key(0))["params"]
+        prompt = [5, 6, 7, 1, 2, 3, 4, 9, 8, 2]
+        dense = ContinuousBatchingEngine("moe_tiny", cfg, params,
+                                         slots=1, max_len=32)
+        try:
+            want = dense.generate([prompt], max_new_tokens=5, timeout=300)
+        finally:
+            dense.stop()
+        paged = ContinuousBatchingEngine("moe_tiny", cfg, params,
+                                         slots=1, max_len=32,
+                                         kv="paged", page_size=4)
+        try:
+            first = paged.generate([prompt], max_new_tokens=5, timeout=300)
+            second = paged.generate([prompt], max_new_tokens=5, timeout=300)
+            stats = paged.stats()
+        finally:
+            paged.stop()
+        assert first == want and second == want
+        assert stats["prefill_tokens_skipped"] > 0
+        assert stats["kv_invariant_violations"] == 0
+
+
