@@ -763,6 +763,13 @@ def main() -> int:
         "compile_time_s": round(result.compile_time_s, 3),
         "device_kind": record["device_kind"],
         **({"fallback": fallback} if fallback else {}),
+        # Collective-overlap measurement of this config's train step
+        # (ISSUE 12): every non-smoke multi-chip record carries the
+        # hidden fraction of its collective time, so a sweep point's
+        # tokens/sec regression can be attributed to de-overlapped
+        # collectives without a separate audit run.
+        "overlap_snapshot": _overlap_snapshot(
+            model, seq, batch, n_chips, args.smoke),
         # Unified-registry snapshot (obs.metrics): the run's training-
         # step histogram and any store/retry counters ride into every
         # bench record, so perf_sweep points carry their own latency
@@ -773,6 +780,34 @@ def main() -> int:
         "perf_report": _perf_report(trace_dir, cleanup=trace_dir_tmp),
     }))
     return 0
+
+
+def _overlap_snapshot(model, seq, batch, n_chips, smoke):
+    """Overlap measurement of THIS bench config's train-step program:
+    a compile-only re-lower through perf.audit on the live devices,
+    censused and window-measured from the compiled HLO. Skipped where
+    it can't mean anything (smoke's correctness-gate config; a single
+    chip has no collectives to hide); any failure degrades to an error
+    dict — the bench JSON contract outranks the snapshot."""
+    if smoke:
+        return {"skipped": "smoke run"}
+    if n_chips < 2:
+        return {"skipped": "single chip: no collectives"}
+    try:
+        from polyaxon_tpu.perf import audit as perf_audit
+
+        point = perf_audit.AuditPoint(
+            "bench-fsdp", {"dp": 1, "fsdp": n_chips}, model=model,
+            seq_len=seq, global_batch=batch * n_chips)
+        rep = perf_audit.audit_point(point)
+        return {"axes": rep["axes"],
+                "overlap_ratio": rep["overlap_ratio"],
+                "overlap": rep["overlap"],
+                "counts": rep["counts"],
+                "backend": rep["backend"],
+                "compile_s": rep["compile_s"]}
+    except Exception as exc:  # noqa: BLE001 — degrade, don't erase
+        return {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
 
 def _registry_snapshot():

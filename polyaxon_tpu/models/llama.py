@@ -96,6 +96,12 @@ class LlamaConfig:
     # >1 splits the layer stack into that many ppermute-chained stages.
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
+    # Double-buffered schedule (parallel/pipeline.py): each tick's
+    # stage→stage ppermute carries the PREVIOUS tick's output, so the
+    # hop overlaps stage compute. Per-microbatch outputs are identical
+    # to the single-buffered schedule; the knob exists for parity
+    # drills and as an escape hatch.
+    pipeline_double_buffer: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -326,7 +332,8 @@ def _pipelined_layers(cfg: LlamaConfig, body, layer_params, x: jax.Array) -> jax
 
     return pipeline_forward(
         mesh, stage_fn, stacked, x,
-        n_microbatches=cfg.pipeline_microbatches)
+        n_microbatches=cfg.pipeline_microbatches,
+        double_buffer=cfg.pipeline_double_buffer)
 
 
 def segment_starts(segment_ids: jax.Array) -> jax.Array:
@@ -970,13 +977,27 @@ def paged_prefill_suffix_kv(cfg: LlamaConfig, params: dict,
 
 def paged_insert_suffix(cache: dict, k_suf: jax.Array, v_suf: jax.Array,
                         page_ids: jax.Array, start: jax.Array,
-                        page_size: int) -> dict:
+                        page_size: int,
+                        real_len: Optional[jax.Array] = None) -> dict:
     """Scatter suffix KV ([L, S, KV, Hd]) into the row's pages at
     absolute positions start..start+S-1 (``start`` traced int32 — the
-    cached-token count varies per admission without recompiling)."""
+    cached-token count varies per admission without recompiling).
+
+    ``real_len`` (traced int32) supports BUCKETED suffixes: positions
+    at or past it are padding whose KV is garbage — they are routed to
+    scratch page 0 (never allocated, never read; serving/paged.py), so
+    a padded suffix writes exactly the same real pages as the unpadded
+    one. Without it every position is real (the pre-bucketing shape).
+    The page lookup clips explicitly: a padded tail can index past the
+    row's block table, and the gather's implicit clamp would otherwise
+    land on the table's LAST entry — a real page."""
     S = k_suf.shape[1]
-    t = start + jnp.arange(S)
-    pidx = jnp.maximum(page_ids[t // page_size], 0)
+    idx = jnp.arange(S)
+    t = start + idx
+    slot = jnp.minimum(t // page_size, page_ids.shape[0] - 1)
+    pidx = jnp.maximum(page_ids[slot], 0)
+    if real_len is not None:
+        pidx = jnp.where(idx < real_len, pidx, 0)
     off = t % page_size
     return {
         "k": cache["k"].at[:, pidx, off].set(k_suf),

@@ -595,6 +595,32 @@ def serving_radix_pages(registry: MetricsRegistry = REGISTRY) -> Gauge:
         ("state",))
 
 
+def perf_overlap_ratio(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_perf_overlap_ratio",
+        "Collective-overlap ratio per audited schedule (hidden fraction "
+        "of total estimated collective time in the compiled step; from "
+        "the AOT TPU overlap audit, `perf --audit`)",
+        ("schedule",))
+
+
+def perf_async_collectives_total(
+        registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_perf_async_collectives_total",
+        "Async-scheduled collective transfers censused in the compiled "
+        "step per audited schedule, by collective kind",
+        ("schedule", "kind"))
+
+
+def ensure_perf_metrics(registry: MetricsRegistry = REGISTRY) -> None:
+    """Pre-register the perf-audit families (idempotent) — populated by
+    ``python -m polyaxon_tpu.perf --audit`` after an AOT overlap
+    measurement, and budgeted by the ``overlap-regression`` rule."""
+    perf_overlap_ratio(registry)
+    perf_async_collectives_total(registry)
+
+
 def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     """Pre-register the serving families (idempotent) so a serving
     /metrics scrape exposes the full SLO schema before traffic lands —
@@ -651,6 +677,7 @@ def catalog_metric_names() -> set[str]:
     scratch = MetricsRegistry()
     ensure_core_metrics(scratch)
     ensure_serving_metrics(scratch)
+    ensure_perf_metrics(scratch)
     names = set(scratch._metrics)
     names.update(SCRAPE_TIME_METRICS)
     names.add(DROPPED_LABELS_METRIC)

@@ -1,0 +1,70 @@
+"""Collective-overlap scheduling: pin XLA's latency-hiding knobs.
+
+The MFU headroom case (ISSUE 12 / ROADMAP item 5): the communication
+audit counts every collective, but the step time depends on whether
+their latency is *hidden* behind independent compute. On TPU that is
+the latency-hiding scheduler's job — it hoists async collective issues
+away from their consumers so the transfer flies under compute (GSPMD
+§3.4). The knobs default on in current libtpu builds, but "default"
+is not "pinned": a toolchain bump that flips one silently costs a
+multiple. This module pins them in both places they can act:
+
+- **per-compile** (`latency_hiding_options()`): TPU compiler options
+  passed to ``lowered.compile(compiler_options=...)``. This is what the
+  AOT overlap audit (``perf --audit``) compiles with, so the budgeted
+  ``overlap_ratio`` floors measure exactly the pinned configuration.
+  ``serialize=True`` is the deopt twin: it forces the scheduler OFF,
+  which demonstrably flips the budget gate (the ``--inject-serialize``
+  self-test in ci.sh).
+- **per-process** (`pin_runtime_flags()`): the same flags appended to
+  ``LIBTPU_INIT_ARGS`` before backend init, via ``utils/env.py``'s
+  append-only/never-override idiom. NEVER via ``XLA_FLAGS``: XLA
+  CHECK-aborts the whole process on unknown flags there, and a
+  CPU-only jaxlib does not parse the ``xla_tpu_*`` family.
+
+Empirical note (v5e:2x4 topology, jax 0.4.37): with the scheduler on,
+the fsdp train step's all-gathers get issued early with real compute
+windows; with it off the same annotated ops sit immediately before
+their consumers — the window-based ratio in ``perf/hlo.py`` is what
+separates the two, not the async-op count (which can even be *higher*
+in the serialized schedule).
+"""
+
+from __future__ import annotations
+
+# Per-compile TPU compiler options (string values: the compiler-options
+# API takes textual flag values). Keep this dict and
+# utils.env.TPU_OVERLAP_INIT_ARGS in lockstep — one is the per-compile
+# spelling, the other the process-wide one.
+LATENCY_HIDING_COMPILER_OPTIONS: dict[str, str] = {
+    "xla_tpu_enable_latency_hiding_scheduler": "true",
+    "xla_enable_async_all_gather": "true",
+    "xla_tpu_enable_async_collective_fusion": "true",
+}
+
+# The deopt: force collectives to schedule synchronously. Used by
+# `perf --audit --inject-serialize` to prove the overlap gate can fail.
+SERIALIZE_COMPILER_OPTIONS: dict[str, str] = {
+    "xla_tpu_enable_latency_hiding_scheduler": "false",
+    "xla_enable_async_all_gather": "false",
+    "xla_tpu_enable_async_collective_fusion": "false",
+}
+
+
+def latency_hiding_options(serialize: bool = False) -> dict[str, str]:
+    """The TPU ``compiler_options`` dict for overlap-pinned compiles
+    (``serialize=True`` = the forced-sync deopt)."""
+    return dict(SERIALIZE_COMPILER_OPTIONS if serialize
+                else LATENCY_HIDING_COMPILER_OPTIONS)
+
+
+def pin_runtime_flags() -> bool:
+    """Pin the overlap scheduler for THIS process's TPU runtime.
+
+    Call before first backend touch (the runtime entrypoint does, next
+    to ``cpu_mesh_xla_flags``). No-op (returns False) on hosts without
+    libtpu, and never overrides flags an operator already set.
+    """
+    from polyaxon_tpu.utils.env import tpu_overlap_libtpu_args
+
+    return tpu_overlap_libtpu_args()

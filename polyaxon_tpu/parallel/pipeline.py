@@ -16,6 +16,16 @@ The whole schedule is differentiable (scan + ppermute + where), so the
 backward pass runs the pipeline in reverse automatically. Collectives
 stay inside shard_map over {pp} only — dp/fsdp/tp axes remain in GSPMD
 auto mode and compose (partial manual sharding).
+
+``double_buffer=True`` (ISSUE 12) decouples the stage→stage hop from
+the compute that feeds it: the carry holds (arrived, to_send), each
+tick permutes LAST tick's output while stage_fn runs on what arrived
+two ticks ago, so within a tick the ppermute and the stage compute
+have no data dependency and the scheduler can fly the transfer under
+the matmuls. Stage s then sees microbatch m at tick m + 2s (vs m + s
+single-buffered): one extra warmup tick per stage boundary buys the
+overlap window. Per-microbatch outputs are IDENTICAL — the schedule
+shifts ticks, not values — which the parity test asserts.
 """
 
 from __future__ import annotations
@@ -36,28 +46,52 @@ def spmd_pipeline(
     microbatches: jax.Array,  # [n_micro, mb, ...] (stage-0 inputs, replicated)
     *,
     axis_name: str = "pp",
+    double_buffer: bool = False,
 ) -> jax.Array:
     """Run the pipeline INSIDE shard_map; returns [n_micro, mb, ...]
     stage outputs, valid on the LAST stage (callers psum-select)."""
     n_stages = compat.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
-    total_ticks = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-
-    def tick(carry, t):
-        inject = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
-        x_in = jnp.where(stage == 0, inject, carry)
-        out = stage_fn(local_params, x_in)
-        nxt = jax.lax.ppermute(out, axis_name, perm)
-        return nxt, out
-
     zero = jnp.zeros_like(microbatches[0])
-    _, outs = jax.lax.scan(tick, zero, jnp.arange(total_ticks))
-    # Last stage's outputs for ticks [n_stages-1, total) are microbatches
-    # [0, n_micro); earlier ticks are warmup bubble.
-    return jax.lax.slice_in_dim(outs, n_stages - 1, total_ticks, axis=0)
+
+    def inject_at(t):
+        return jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+
+    if double_buffer:
+        # (arrived, to_send): permute LAST tick's output while compute
+        # runs on the activation that arrived two ticks ago — no data
+        # dependency between the two inside a tick, so the transfer can
+        # hide under stage compute. Stage s sees microbatch m at tick
+        # m + 2s; warmup bubble is 2(S-1) ticks.
+        total_ticks = n_micro + 2 * (n_stages - 1)
+
+        def tick(carry, t):
+            arrived, to_send = carry
+            incoming = jax.lax.ppermute(to_send, axis_name, perm)
+            x_in = jnp.where(stage == 0, inject_at(t), arrived)
+            out = stage_fn(local_params, x_in)
+            return (incoming, out), out
+
+        _, outs = jax.lax.scan(
+            tick, (zero, zero), jnp.arange(total_ticks))
+        first_valid = 2 * (n_stages - 1)
+    else:
+        total_ticks = n_micro + n_stages - 1
+
+        def tick(carry, t):
+            x_in = jnp.where(stage == 0, inject_at(t), carry)
+            out = stage_fn(local_params, x_in)
+            nxt = jax.lax.ppermute(out, axis_name, perm)
+            return nxt, out
+
+        _, outs = jax.lax.scan(tick, zero, jnp.arange(total_ticks))
+        first_valid = n_stages - 1
+    # Last stage's outputs for ticks [first_valid, total) are
+    # microbatches [0, n_micro); earlier ticks are warmup bubble.
+    return jax.lax.slice_in_dim(outs, first_valid, total_ticks, axis=0)
 
 
 def pipeline_forward(
@@ -68,6 +102,7 @@ def pipeline_forward(
     *,
     n_microbatches: int,
     axis_name: str = "pp",
+    double_buffer: bool = False,
 ) -> jax.Array:
     """jit-land wrapper: shards params over pp, microbatches x, runs the
     schedule, and returns last-stage outputs re-assembled to [B, ...].
@@ -104,7 +139,7 @@ def pipeline_forward(
         local = jax.tree.map(lambda a: a[0], local_params)
         outs = spmd_pipeline(
             stage_fn, local, x_micro.astype(compute_dtype),
-            axis_name=axis_name)
+            axis_name=axis_name, double_buffer=double_buffer)
         return outs[None]  # [1(stage), n_micro, mb, ...]
 
     fn = compat.shard_map(

@@ -6,6 +6,20 @@ report artifact (``collective_audit.json``). ``--check`` gates against
 the committed budgets (the ci.sh audit stage); ``--update-budgets``
 regenerates them after an intentional sharding change; ``--aot-probe``
 runs the topology-only TPU compile probe instead.
+
+``--audit`` (ISSUE 12) is the OVERLAP audit: compile every schedule
+point against a TPU topology description with the latency-hiding
+scheduler pinned (``parallel/overlap.py``), measure the per-schedule
+collective ``overlap_ratio`` (``perf/hlo.py``), and — with ``--check``
+— gate it against the ``min_overlap_ratio`` floors in budgets.json.
+``--inject-serialize`` compiles with the scheduler forced OFF, which
+demonstrably flips the gate (the ci.sh self-test). Exit codes under
+``--audit --check``: 0 in budget, 1 floor violation, 3 the probe
+itself failed (no workable topology — infra, not a regression).
+
+``--json PATH`` writes the machine-readable artifact either mode
+(``-`` = stdout, for ``scripts/perf_sweep.py`` and the simulator to
+ingest without re-parsing the table).
 """
 
 from __future__ import annotations
@@ -26,6 +40,87 @@ def _force_cpu_mesh(n: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def _write_artifact(artifact: dict, path: str) -> None:
+    if path == "-":
+        json.dump(artifact, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return
+    with open(path, "w") as fh:
+        json.dump(artifact, fh, indent=2)
+        fh.write("\n")
+    print(f"# wrote {path}", file=sys.stderr)
+
+
+def _publish_overlap_metrics(reports: list[dict]) -> None:
+    """Set the perf gauges/counters in THIS process's registry so a
+    co-resident /metrics endpoint (the control-plane API) exposes the
+    measurement the ``overlap-regression`` rule watches."""
+    from polyaxon_tpu.obs import metrics
+
+    metrics.ensure_perf_metrics()
+    for rep in reports:
+        name = rep["name"]
+        metrics.perf_overlap_ratio().set(
+            float(rep["overlap_ratio"]), schedule=name)
+        for kind, n in rep["overlap"].get("async_by_kind", {}).items():
+            metrics.perf_async_collectives_total().inc(
+                int(n), schedule=name, kind=kind)
+
+
+def _overlap_audit_main(args) -> int:
+    from polyaxon_tpu.perf import aot, audit, budgets
+
+    points = None
+    if args.schedules:
+        points = [audit.point_by_name(s.strip()).name
+                  for s in args.schedules.split(",") if s.strip()]
+    result = aot.run_overlap_audit(
+        points=points, serialize=args.inject_serialize,
+        timeout_s=args.aot_timeout or aot.PROBE_TIMEOUT_S)
+    if not result.get("ok"):
+        print("# overlap audit: no workable TPU topology "
+              f"({json.dumps(result.get('topologies', {}))[:300]})",
+              file=sys.stderr)
+        # Under --check, distinguish "could not measure" (infra) from
+        # "measured below floor" (regression): ci.sh treats 3 as a
+        # skipped gate on hosts without the TPU compiler, 1 as red.
+        return 3 if args.check else 1
+    reports = result.get("reports", [])
+
+    print(f"{'schedule':<12} {'overlap':>8} {'async':>6} {'sync':>6} "
+          f"{'coll us':>9} {'hidden us':>10}   topology={result['topology']}"
+          + ("  [SERIALIZED]" if args.inject_serialize else ""))
+    for r in reports:
+        o = r["overlap"]
+        print(f"{r['name']:<12} {r['overlap_ratio']:>8.4f} "
+              f"{o['n_async_collectives']:>6} {o['n_sync_collectives']:>6} "
+              f"{o['coll_time_us']:>9.3f} {o['hidden_time_us']:>10.3f}")
+    for pname, err in sorted(result.get("point_errors", {}).items()):
+        print(f"{pname:<12} ERROR {err}", file=sys.stderr)
+
+    _publish_overlap_metrics(reports)
+    if args.json:
+        _write_artifact({"overlap_audit": result}, args.json)
+
+    if args.update_budgets:
+        if args.inject_serialize:
+            print("refusing to bake serialized-deopt floors into budgets",
+                  file=sys.stderr)
+            return 2
+        path = budgets.write_overlap_floors(reports, result["topology"])
+        print(f"# wrote {path}", file=sys.stderr)
+        return 0
+
+    if args.check:
+        violations = budgets.check_overlap(reports, only=points)
+        if violations:
+            for v in violations:
+                print(f"OVERLAP BUDGET VIOLATION: {v}", file=sys.stderr)
+            return 1
+        print("# overlap budgets OK", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m polyaxon_tpu.perf",
@@ -39,11 +134,22 @@ def main(argv=None) -> int:
     parser.add_argument("--update-budgets", action="store_true",
                         help="regenerate polyaxon_tpu/perf/budgets.json "
                              "from this run")
-    parser.add_argument("--json", default="collective_audit.json",
-                        help="report artifact path ('' = don't write)")
+    parser.add_argument("--json", default=None,
+                        help="report artifact path ('' = don't write, "
+                             "'-' = stdout; default collective_audit.json, "
+                             "or overlap_audit.json under --audit)")
     parser.add_argument("--inject-reshard", action="store_true",
                         help="deliberately replicate the batch inside the "
                              "step (demonstrates the gate failing)")
+    parser.add_argument("--audit", action="store_true",
+                        help="AOT TPU overlap audit: compile the schedule "
+                             "points against a TPU topology with the "
+                             "latency-hiding scheduler pinned and gate the "
+                             "measured overlap_ratio (--check)")
+    parser.add_argument("--inject-serialize", action="store_true",
+                        help="compile the overlap audit with the scheduler "
+                             "forced OFF (demonstrates the overlap gate "
+                             "failing)")
     parser.add_argument("--ops", action="store_true",
                         help="include the per-instruction op list in the "
                              "JSON artifact (large)")
@@ -61,6 +167,12 @@ def main(argv=None) -> int:
     parser.add_argument("--devices", type=int, default=8,
                         help="virtual CPU mesh size (default 8)")
     args = parser.parse_args(argv)
+    if args.json is None:
+        args.json = "overlap_audit.json" if args.audit \
+            else "collective_audit.json"
+
+    if args.audit:
+        return _overlap_audit_main(args)
 
     if args.aot_probe:
         from polyaxon_tpu.perf import aot
@@ -109,10 +221,7 @@ def main(argv=None) -> int:
         uly = next((r for r in reports if r["name"] == "ulysses-cp"), None)
         if ring and uly:
             artifact["ring_vs_ulysses"] = audit.diff_reports(ring, uly)
-        with open(args.json, "w") as fh:
-            json.dump(artifact, fh, indent=2)
-            fh.write("\n")
-        print(f"# wrote {args.json}", file=sys.stderr)
+        _write_artifact(artifact, args.json)
 
     if args.update_budgets:
         if args.inject_reshard:

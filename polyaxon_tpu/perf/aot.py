@@ -43,10 +43,30 @@ PROBE_TIMEOUT_S = 300.0
 TOPOLOGY_CANDIDATES = ("v5e:2x4", "v4:2x2x1")
 
 # Flash fwd tile candidates from the staged sweep (VERDICT r4 item 3),
-# probed at llama_200m attention shapes.
-FLASH_TILES = ((512, 512), (1024, 1024))
+# probed at llama_200m attention shapes. (256, 256) is the safety
+# floor: if the bigger tiles blow VMEM on some topology, the pick
+# table still records a workable choice.
+FLASH_TILES = ((512, 512), (1024, 1024), (256, 256))
 
 _CHILD_FLAG = "--_probe-child"
+
+
+def flash_pick(tiles: dict) -> Optional[dict]:
+    """The per-topology tile pick from a probe's candidate records: the
+    largest (block_q, block_k) Mosaic actually compiled — compilation
+    IS the VMEM-fit evidence (a tile set that doesn't fit fails with
+    RESOURCE_EXHAUSTED at compile, not at run time). Committed to
+    ``perf/flash_tiles.json`` and consulted by ``ops/flash.py``."""
+    best = None
+    for tag, rec in tiles.items():
+        if not rec.get("compiled"):
+            continue
+        bq, bk = (int(p) for p in tag.split("x"))
+        if best is None or bq * bk > best[0] * best[1]:
+            best = (bq, bk)
+    if best is None:
+        return None
+    return {"block_q": best[0], "block_k": best[1]}
 
 
 def _flash_vmem_stage(topology, entry: dict) -> None:
@@ -88,6 +108,7 @@ def _flash_vmem_stage(topology, entry: dict) -> None:
             tiles[tag] = {"compiled": False,
                           "error": f"{type(exc).__name__}: "
                                    f"{str(exc)[:300]}"}
+    entry["flash_tile_pick"] = flash_pick(tiles)
 
 
 def _child_main(argv: list[str]) -> int:
@@ -116,6 +137,87 @@ def _child_main(argv: list[str]) -> int:
                                        "unknown") if devices else None
     except Exception as exc:
         entry["error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        print(json.dumps(entry))
+        return 0
+
+    if "--pipeline-drill" in argv:
+        # Pipeline-overlap drill (ISSUE 12): compile the double-buffered
+        # toy pipeline against the topology with the latency-hiding
+        # scheduler pinned and measure whether the stage→stage
+        # ppermutes actually hide under stage compute. Value parity
+        # between the schedules is CPU-testable and asserted in
+        # tests/test_perf_audit.py; THIS measures the TPU schedule.
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from polyaxon_tpu.parallel import overlap
+        from polyaxon_tpu.parallel.pipeline import pipeline_forward
+        from polyaxon_tpu.perf import hlo as hlo_mod
+
+        options = overlap.latency_hiding_options(
+            serialize="--serialize" in argv)
+        n = len(devices)
+        mesh = Mesh(np.array(devices).reshape(n), ("pp",))
+        d = 1024  # permute payload [mb, d]; hideable fraction ∝ d
+        stacked = jax.ShapeDtypeStruct((n, 1, d, d), jnp.bfloat16)
+        x = jax.ShapeDtypeStruct((4 * n, d), jnp.bfloat16)
+
+        def stage_fn(local, h):
+            out, _ = jax.lax.scan(
+                lambda h, w: (jnp.tanh(h @ w), None), h, local["w"])
+            return out
+
+        entry["pipeline_drill"] = drill = {}
+        for tag, db in (("double", True), ("single", False)):
+            try:
+                compiled = jax.jit(
+                    lambda p, t, db=db: pipeline_forward(
+                        mesh, stage_fn, {"w": p}, t,
+                        n_microbatches=4, double_buffer=db)
+                ).lower(stacked, x).compile(compiler_options=dict(options))
+                ops = hlo_mod.parse_collectives(
+                    compiled.as_text(), n_devices=n)
+                perm = [o for o in ops if o.kind == "collective-permute"]
+                drill[tag] = {
+                    "overlap": hlo_mod.summarize_overlap(ops),
+                    "n_permutes": len(perm),
+                    "permute_max_overlap": max(
+                        (o.overlap_ratio for o in perm), default=0.0),
+                }
+                entry["ok"] = True
+            except Exception as exc:
+                drill[tag] = {"error": f"{type(exc).__name__}: "
+                                       f"{str(exc)[:300]}"}
+        print(json.dumps(entry))
+        return 0
+
+    if "--overlap-audit" in argv:
+        # Overlap-audit mode (ISSUE 12): compile the listed schedule
+        # points with the latency-hiding scheduler pinned (or forcibly
+        # serialized — the gate's deopt) and report their measured
+        # overlap. Skips the matmul/flash stages: one subprocess, one
+        # topology, all points, so the CI stage pays libtpu init once.
+        from polyaxon_tpu.parallel import overlap
+        from polyaxon_tpu.perf import audit
+
+        serialize = "--serialize" in argv
+        options = overlap.latency_hiding_options(serialize=serialize)
+        points = [s for s in
+                  argv[argv.index("--overlap-audit") + 1].split(",") if s]
+        reports: dict = {}
+        entry["overlap_audit"] = reports
+        entry["serialized"] = serialize
+        for point_name in points:
+            try:
+                reports[point_name] = audit.audit_point_aot(
+                    audit.point_by_name(point_name), topology_name=name,
+                    compiler_options=options)
+                entry["ok"] = True
+            except Exception as exc:
+                reports[point_name] = {
+                    "error": f"{type(exc).__name__}: {str(exc)[:300]}"}
         print(json.dumps(entry))
         return 0
 
@@ -227,6 +329,61 @@ def run_probe(timeout_s: float = PROBE_TIMEOUT_S,
         if entry.get("ok") and train_step_points:
             # One topology with full evidence is the artifact's job;
             # don't spend another compile window on the control.
+            break
+    return out
+
+
+def run_overlap_audit(points: Optional[list[str]] = None,
+                      serialize: bool = False,
+                      timeout_s: float = PROBE_TIMEOUT_S) -> dict:
+    """Compile the standard schedule points against the first workable
+    TPU topology with the overlap scheduler pinned (``serialize=True``
+    = the forced-sync deopt) and return their overlap-annotated audit
+    reports. Same containment contract as :func:`run_probe`: each
+    candidate runs in its own strictly-timeouted subprocess, so a
+    wedged libtpu init costs a timeout entry, never a hung CI stage."""
+    from polyaxon_tpu.perf import audit
+
+    names = ",".join(points if points
+                     else [p.name for p in audit.STANDARD_POINTS])
+    out: dict = {"ok": False, "serialized": serialize, "topologies": {}}
+    for name in TOPOLOGY_CANDIDATES:
+        args = ["--topology", name, "--overlap-audit", names]
+        if serialize:
+            args.append("--serialize")
+        entry = _run_child(args, timeout_s)
+        out["topologies"][name] = entry
+        if entry.get("ok"):
+            out["ok"] = True
+            out["topology"] = name
+            audit_map = entry.get("overlap_audit", {})
+            out["reports"] = [r for r in audit_map.values()
+                              if "error" not in r]
+            errors = {k: r["error"] for k, r in audit_map.items()
+                      if "error" in r}
+            if errors:
+                out["point_errors"] = errors
+            break
+    return out
+
+
+def run_pipeline_drill(serialize: bool = False,
+                       timeout_s: float = PROBE_TIMEOUT_S) -> dict:
+    """Compile the double-buffered (and single-buffered control) toy
+    pipeline against the first workable TPU topology and report the
+    measured collective-permute overlap (same containment contract as
+    :func:`run_probe`)."""
+    out: dict = {"ok": False, "topologies": {}}
+    for name in TOPOLOGY_CANDIDATES:
+        args = ["--topology", name, "--pipeline-drill"]
+        if serialize:
+            args.append("--serialize")
+        entry = _run_child(args, timeout_s)
+        out["topologies"][name] = entry
+        if entry.get("ok"):
+            out["ok"] = True
+            out["topology"] = name
+            out["pipeline_drill"] = entry.get("pipeline_drill", {})
             break
     return out
 

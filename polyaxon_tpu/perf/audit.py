@@ -133,7 +133,14 @@ def audit_point(
     ops = hlo_lib.parse_collectives(hlo_text, n_devices=mesh.devices.size)
     report = point.describe()
     report.update(hlo_lib.summarize_collectives(ops))
+    overlap = hlo_lib.summarize_overlap(ops)
     report.update({
+        # XLA:CPU emits only sync collectives (no async encoding on
+        # that backend), so these rows carry overlap_ratio 0 on the CI
+        # mesh; the overlap *budget* is enforced on the AOT TPU
+        # topology path only (perf --audit --check).
+        "overlap": overlap,
+        "overlap_ratio": overlap["overlap_ratio"],
         "n_devices": int(mesh.devices.size),
         "backend": devices[0].platform,
         "compile_s": round(time.perf_counter() - t0, 1),
@@ -145,7 +152,8 @@ def audit_point(
 
 
 def audit_point_aot(point: AuditPoint, topology_name: str = "v5e:2x4",
-                    keep_hlo: bool = False) -> dict[str, Any]:
+                    keep_hlo: bool = False,
+                    compiler_options: Optional[dict] = None) -> dict[str, Any]:
     """The audit against a TPU *topology description* — no live device.
 
     Nothing can execute, so the train state is fully abstract:
@@ -211,19 +219,27 @@ def audit_point_aot(point: AuditPoint, topology_name: str = "v5e:2x4",
         batch = {"tokens": jax.ShapeDtypeStruct(
             (point.global_batch, point.seq_len), jnp.int32,
             sharding=NamedSharding(mesh, batch_spec(mesh, rules, ndim=2)))}
-        compiled = train_step.lower(state, batch, rng_aval).compile()
+        lowered = train_step.lower(state, batch, rng_aval)
+        if compiler_options:
+            compiled = lowered.compile(compiler_options=dict(compiler_options))
+        else:
+            compiled = lowered.compile()
     hlo_text = compiled.as_text()
 
     ops = hlo_lib.parse_collectives(hlo_text, n_devices=mesh.devices.size)
     report = point.describe()
     report.update(hlo_lib.summarize_collectives(ops))
+    overlap = hlo_lib.summarize_overlap(ops)
     report.update({
+        "overlap": overlap,
+        "overlap_ratio": overlap["overlap_ratio"],
         "n_devices": int(mesh.devices.size),
         "backend": "tpu-topology",
         "topology": topology_name,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "hlo_chars": len(hlo_text),
         "compile_s": round(time.perf_counter() - t0, 1),
+        "compiler_options": dict(compiler_options or {}),
     })
     try:
         mem = compiled.memory_analysis()

@@ -26,6 +26,12 @@ DEFAULT_BUDGET_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
 # not fail CI, a doubled all-to-all volume should.
 BYTES_TOLERANCE = 0.25
 
+# Overlap floors are set at measured * margin: the measured ratio is a
+# model output (perf/hlo.py time constants), so small scheduler
+# reorderings jitter it; the serialize deopt drops it to ~0, which a
+# 0.8 margin still catches by an order of magnitude.
+OVERLAP_FLOOR_MARGIN = 0.8
+
 
 def load_budgets(path: Optional[str] = None) -> dict:
     with open(path or DEFAULT_BUDGET_PATH) as fh:
@@ -36,6 +42,13 @@ def write_budgets(reports: list[dict], path: Optional[str] = None,
                   meta: Optional[dict] = None) -> str:
     out = {"_meta": dict(meta or {})}
     out["_meta"].setdefault("bytes_tolerance", BYTES_TOLERANCE)
+    # Regenerating the CPU census must not drop the overlap floors —
+    # they are measured on a different backend (the AOT TPU path) by
+    # `--audit --update-budgets` and live in the same file.
+    try:
+        out["_overlap"] = load_budgets(path)["_overlap"]
+    except (OSError, KeyError, ValueError):
+        pass
     for rep in reports:
         out[rep["name"]] = {
             "counts": rep["counts"],
@@ -100,4 +113,73 @@ def check_reports(reports: list[dict],
     out: list[str] = []
     for rep in reports:
         out.extend(check_report(rep, budgets))
+    return out
+
+
+def write_overlap_floors(reports: list[dict], topology: str,
+                         path: Optional[str] = None) -> str:
+    """Merge measured overlap ratios (times :data:`OVERLAP_FLOOR_MARGIN`)
+    into ``budgets.json`` as its ``_overlap`` section — the census
+    entries are untouched (they are CPU-mesh ground truth; the floors
+    are AOT TPU-topology evidence)."""
+    path = path or DEFAULT_BUDGET_PATH
+    try:
+        data = load_budgets(path)
+    except OSError:
+        data = {}
+    data["_overlap"] = {
+        "topology": topology,
+        "floor_margin": OVERLAP_FLOOR_MARGIN,
+        "min_overlap_ratio": {
+            rep["name"]: round(
+                rep["overlap_ratio"] * OVERLAP_FLOOR_MARGIN, 4)
+            for rep in reports},
+    }
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def check_overlap(reports: list[dict],
+                  budgets: Optional[dict] = None,
+                  path: Optional[str] = None,
+                  only: Optional[list[str]] = None) -> list[str]:
+    """Violations of the per-schedule ``min_overlap_ratio`` floors.
+
+    Mirrors :func:`check_report`'s coverage posture: a schedule the
+    audit produced but the floors don't cover — or a floored schedule
+    the audit skipped — is itself a violation, so the gate can't
+    silently stop watching a schedule. ``only`` restricts the coverage
+    check to an explicitly-requested subset (``--schedules``): asking
+    for one schedule must not read as the others having vanished."""
+    if budgets is None:
+        budgets = load_budgets(path)
+    section = budgets.get("_overlap")
+    if not section:
+        return ["no _overlap floors in budgets.json (run `python -m "
+                "polyaxon_tpu.perf --audit --update-budgets` and commit)"]
+    floors = section.get("min_overlap_ratio", {})
+    by_name = {rep.get("name"): rep for rep in reports}
+    out: list[str] = []
+    for name, floor in sorted(floors.items()):
+        if only is not None and name not in only:
+            continue
+        rep = by_name.get(name)
+        if rep is None:
+            out.append(
+                f"{name}: overlap floor {floor} is budgeted but the audit "
+                f"produced no report for it")
+            continue
+        got = rep.get("overlap_ratio", 0.0)
+        if got < floor:
+            out.append(
+                f"{name}: overlap_ratio {got} below floor {floor} — "
+                f"collectives are no longer hidden (latency-hiding "
+                f"scheduler knob regression?)")
+    for name in sorted(by_name):
+        if name not in floors:
+            out.append(
+                f"{name}: no overlap floor budgeted (run --audit "
+                f"--update-budgets and commit budgets.json)")
     return out

@@ -35,9 +35,15 @@ def main() -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
         force=True,
     )
+    from polyaxon_tpu.parallel import overlap
     from polyaxon_tpu.utils import apply_jax_platforms_override
 
     apply_jax_platforms_override()
+    # Pin the latency-hiding scheduler before the backend initializes
+    # (bootstrap.initialize below) so collective overlap — and with it
+    # the budgeted overlap_ratio floors — cannot silently regress with
+    # a libtpu default flip. No-op off-TPU (parallel/overlap.py).
+    overlap.pin_runtime_flags()
     spec_json = os.environ.get(ENV_JAXJOB_SPEC)
     if not spec_json:
         print(f"{ENV_JAXJOB_SPEC} is not set", file=sys.stderr)

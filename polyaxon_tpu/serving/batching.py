@@ -55,6 +55,19 @@ class QueueFull(RuntimeError):
         self.retry_after = max(int(retry_after), 1)
 
 
+def bucket_suffix_len(n: int, floor: int = 8) -> int:
+    """Padded length for a radix-suffix prefill of ``n`` novel tokens:
+    the next power of two, floored at ``floor``. Suffix lengths are
+    arbitrary (prompt length minus whatever prefix the radix cache
+    matched), so compiling per exact length would accumulate one
+    executable per distinct length; bucketing bounds the compile count
+    to O(log max_suffix) per prefix-page count, and the padded tail is
+    masked to the scratch page at insert (paged_insert_suffix)."""
+    if n < 1:
+        raise ValueError(f"suffix length must be >= 1, got {n}")
+    return max(floor, 1 << (n - 1).bit_length())
+
+
 def validate_sampling(top_p: float, top_k: int) -> None:
     """Shared request-sampling validation (HTTP handler AND direct
     engine callers): out-of-range knobs must raise, not silently
@@ -376,10 +389,13 @@ class ContinuousBatchingEngine:
         # Radix prefix reuse (paged only): one jitted page duplicator
         # for copy-on-write forks (src/dst are traced scalars — every
         # fork shares ONE executable), and an lru-bounded suffix
-        # prefill per (suffix length, prefix-page count) that computes
-        # KV only for the tokens the radix cache did NOT match. The
-        # cached-token count `m` is traced, so requests with different
-        # match depths but equal shapes share the program.
+        # prefill per (BUCKETED suffix length, prefix-page count) that
+        # computes KV only for the tokens the radix cache did NOT
+        # match. The cached-token count `m` and the real (pre-padding)
+        # suffix length are traced, so requests with different match
+        # depths but equal bucketed shapes share the program — at most
+        # O(log max_suffix) compiles per prefix-page count instead of
+        # one per distinct suffix length (bucket_suffix_len).
         self._copy_page = None
         self._suffix_prefill = None
         if kv == "paged":
@@ -393,7 +409,7 @@ class ContinuousBatchingEngine:
 
                 @lru_cache(maxsize=16)
                 def compiled_suffix_prefill(slen: int, n_pref: int):
-                    def run(params, suffix, cache, page_ids, m):
+                    def run(params, suffix, cache, page_ids, m, real_len):
                         pref = jnp.maximum(page_ids[:n_pref], 0)
                         kp = cache["k"][:, pref]
                         kp = kp.reshape(kp.shape[0], n_pref * ps,
@@ -403,8 +419,15 @@ class ContinuousBatchingEngine:
                                         *vp.shape[3:])
                         k_suf, v_suf = family.paged_prefill_suffix_kv(
                             cfg, params, suffix, kp, vp, m)
+                        # Padded tail positions (>= real_len) carry
+                        # garbage KV; the insert routes them to the
+                        # scratch page. Real positions are unaffected:
+                        # causality already masks padded KEYS from
+                        # real queries (padding sits after every real
+                        # position), so no extra attention mask.
                         return family.paged_insert_suffix(
-                            cache, k_suf, v_suf, page_ids, m, ps)
+                            cache, k_suf, v_suf, page_ids, m, ps,
+                            real_len)
 
                     return jax.jit(run, donate_argnums=(2,))
 
@@ -880,13 +903,17 @@ class ContinuousBatchingEngine:
                                 cached_tokens=skip)
                         suffix = prefill_tokens[skip:]
                         n_pref = -(-skip // self._pool.page_size)
-                        fn = self._suffix_prefill(len(suffix), n_pref)
+                        bucket = bucket_suffix_len(len(suffix))
+                        padded = np.zeros(bucket, np.int32)
+                        padded[:len(suffix)] = suffix
+                        fn = self._suffix_prefill(bucket, n_pref)
                         self._cache = fn(
                             self.params,
-                            jnp.asarray([suffix], jnp.int32),
+                            jnp.asarray([padded], jnp.int32),
                             self._cache,
                             jnp.asarray(self._pool.padded_row(b)),
-                            jnp.int32(skip))
+                            jnp.int32(skip),
+                            jnp.int32(len(suffix)))
                     else:
                         if req.trace is not None:
                             req.trace.start_phase(

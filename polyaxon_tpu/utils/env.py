@@ -59,6 +59,52 @@ def _jaxlib_knows_collective_watchdog() -> bool:
     return parts >= (0, 5)
 
 
+# Latency-hiding scheduler pins for TPU runtimes (parallel/overlap.py
+# owns the rationale and the per-compile compiler_options twin). These
+# are libtpu flags: they go through LIBTPU_INIT_ARGS, NEVER XLA_FLAGS —
+# XLA:CPU CHECK-aborts the whole process on any unknown XLA_FLAGS entry,
+# and a CPU-only jaxlib does not know the xla_tpu_* family.
+TPU_OVERLAP_INIT_ARGS: tuple[str, ...] = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+)
+
+
+def tpu_overlap_libtpu_args() -> bool:
+    """Pin the collective-overlap scheduler flags into
+    ``LIBTPU_INIT_ARGS``. Must run BEFORE the TPU backend initializes.
+
+    Same contract as :func:`cpu_mesh_xla_flags`: append-only, never
+    overriding an operator's explicit setting (skip any flag whose key
+    is already present), and gated on the runtime actually shipping
+    libtpu (metadata probe only, no backend init) so a CPU-only image
+    is untouched. Returns whether anything was pinned.
+    """
+    if not _libtpu_available():
+        return False
+    args = os.environ.get("LIBTPU_INIT_ARGS", "").split()
+    appended = False
+    for flag in TPU_OVERLAP_INIT_ARGS:
+        key = flag.split("=", 1)[0]
+        if not any(a.split("=", 1)[0] == key for a in args):
+            args.append(flag)
+            appended = True
+    os.environ["LIBTPU_INIT_ARGS"] = " ".join(args)
+    return appended
+
+
+def _libtpu_available() -> bool:
+    """Whether a libtpu wheel is importable (metadata-only probe)."""
+    try:
+        import importlib.util
+
+        return any(importlib.util.find_spec(name) is not None
+                   for name in ("libtpu", "libtpu_nightly"))
+    except Exception:  # noqa: BLE001 — unknown packaging: don't pin
+        return False
+
+
 def apply_jax_platforms_override() -> None:
     """Honor ``JAX_PLATFORMS`` even where a sitecustomize hook (e.g. the
     axon TPU-emulator plugin) pinned ``jax_platforms`` before our code

@@ -140,6 +140,32 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_sim.py -q -m 'not slow'
 echo "== communication audit (collective budgets)"
 python -m polyaxon_tpu.perf --check --json ''
 python -m pytest tests/test_perf_audit.py -q -m 'not slow'
+# Overlap-budget stage (ISSUE 12): compile the standard schedules
+# against a TPU topology description with the latency-hiding scheduler
+# pinned, measure each schedule's collective overlap_ratio from the
+# scheduled HLO, and gate against the _overlap floors in
+# perf/budgets.json — a knob/scheduler regression that re-serializes
+# the fsdp all-gathers fails CI here, not at the next MFU measurement.
+# Exit 3 = the probe itself found no workable topology (no TPU
+# compiler on this host): recorded as a skip, not a red build. Update
+# floors after an INTENTIONAL schedule change:
+# python -m polyaxon_tpu.perf --audit --update-budgets.
+echo "== overlap budget (async-collective latency hiding)"
+overlap_rc=0
+python -m polyaxon_tpu.perf --audit --check --json '' || overlap_rc=$?
+if [ "$overlap_rc" -eq 3 ]; then
+    echo "overlap budget: SKIPPED (no workable TPU topology on this host)"
+elif [ "$overlap_rc" -ne 0 ]; then
+    exit "$overlap_rc"
+else
+    # The gate must be able to FAIL: forcing the scheduler OFF must
+    # flip --check to exit 1 (one schedule keeps the self-test cheap).
+    if python -m polyaxon_tpu.perf --audit --check --schedules fsdp \
+        --inject-serialize --json '' >/dev/null 2>&1; then
+        echo "overlap self-test FAILED: serialized compile passed the gate"
+        exit 1
+    fi
+fi
 echo "== native ASan/UBSan"
 make -C native sanitize
 printf 'ADD a 4x4 0\nREQ r 2x2 0 0\nTICK 0 30\nQUIT\n' | ./native/build/sliced_san >/dev/null

@@ -216,11 +216,14 @@ def moe_dispatch_sweep(platform: str, steps: int) -> int:
     return 0
 
 
-def run_audit_artifacts() -> None:
+def run_audit_artifacts():
     """The communication-audit companion artifacts for a sweep round
-    (ISSUE 4): the CPU-mesh collective census per schedule and the AOT
-    topology-only TPU evidence. Each runs as its own subprocess with a
-    bounded budget — a hung audit costs its timeout, not the sweep."""
+    (ISSUE 4): the CPU-mesh collective census per schedule, the AOT
+    topology-only TPU evidence, and the overlap audit (ISSUE 12). Each
+    runs as its own subprocess with a bounded budget — a hung audit
+    costs its timeout, not the sweep. Returns the ingested overlap
+    summary (or None) so the sweep record carries per-schedule
+    overlap_ratio alongside the throughput points."""
     for name, cmd, budget_s in (
         ("collective audit (CPU mesh)",
          [sys.executable, "-m", "polyaxon_tpu.perf",
@@ -228,6 +231,9 @@ def run_audit_artifacts() -> None:
         ("AOT topology audit (TPU, no device)",
          [sys.executable, "-m", "polyaxon_tpu.perf", "--aot-probe",
           "--aot-train-step", "ulysses-cp,ring-cp"], 1500),
+        ("overlap audit (latency-hiding scheduler)",
+         [sys.executable, "-m", "polyaxon_tpu.perf", "--audit",
+          "--json", os.path.join(REPO, "overlap_audit.json")], 900),
     ):
         print(f"→ {name} ...", flush=True)
         try:
@@ -239,6 +245,41 @@ def run_audit_artifacts() -> None:
         except (subprocess.TimeoutExpired, OSError) as exc:
             print(f"  audit step failed: {type(exc).__name__} "
                   f"(sweep continues)", flush=True)
+    return _load_overlap_summary()
+
+
+def _load_overlap_summary():
+    """Structured ingestion of the overlap artifact the audit step just
+    wrote — the `{"overlap_audit": {ok, topology, reports}}` contract of
+    `python -m polyaxon_tpu.perf --audit --json <path>` — so the sweep
+    record carries per-schedule overlap numbers without re-parsing the
+    human-facing table text."""
+    path = os.path.join(REPO, "overlap_audit.json")
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    audit = payload.get("overlap_audit")
+    if not isinstance(audit, dict):
+        return None
+    if not audit.get("ok"):
+        # The probe found no workable TPU topology on this host: record
+        # the skip (with the per-topology errors) instead of nothing,
+        # so a sweep round without overlap numbers is distinguishable
+        # from one where the audit was never requested.
+        return {"ok": False, "topologies": audit.get("topologies", {})}
+    reports = audit.get("reports", [])
+    summary = {
+        "ok": True,
+        "topology": audit.get("topology"),
+        "overlap_ratio": {r["name"]: r["overlap_ratio"] for r in reports},
+        "async_by_kind": {r["name"]: r["overlap"].get("async_by_kind", {})
+                          for r in reports},
+    }
+    for name, ratio in sorted(summary["overlap_ratio"].items()):
+        print(f"  overlap[{name}] = {ratio:.4f}", flush=True)
+    return summary
 
 
 def main() -> int:
@@ -272,13 +313,15 @@ def main() -> int:
                              "census (collective_audit.json) and the AOT "
                              "topology-only TPU evidence incl. train-step "
                              "collective reports + flash VMEM fits "
-                             "(aot_probe_results.json) — both run in "
-                             "isolated subprocesses and never block the "
-                             "sweep points")
+                             "(aot_probe_results.json), plus the overlap "
+                             "audit (overlap_audit.json, ingested into "
+                             "this sweep's record as per-schedule "
+                             "overlap_ratio) — all run in isolated "
+                             "subprocesses and never block the sweep "
+                             "points")
     args = parser.parse_args()
 
-    if args.audit:
-        run_audit_artifacts()
+    overlap_summary = run_audit_artifacts() if args.audit else None
 
     if args.moe:
         return moe_dispatch_sweep(args.moe_platform,
@@ -356,9 +399,11 @@ def main() -> int:
         # --resume exists for exactly that situation.
         ok = [r for r in results if r.get("value")]
         ok.sort(key=lambda r: -r["value"])
+        payload = {"results": results, "best": ok[0] if ok else None}
+        if overlap_summary is not None:
+            payload["overlap_audit"] = overlap_summary
         with open(out_path, "w") as fh:
-            json.dump({"results": results, "best": ok[0] if ok else None},
-                      fh, indent=2)
+            json.dump(payload, fh, indent=2)
         return ok
 
     results = []

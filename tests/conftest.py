@@ -101,8 +101,12 @@ SMOKE_NODES = (
     "test_serving.py::TestQuantizeInLoop",
     "test_serving.py::TestLmLogitsChunked::test_pad_path",
     "test_ops.py::TestFlash::test_auto_blocks_pick",
+    "test_ops.py::TestFlash::test_auto_blocks_committed_pick_table",
     "test_paged.py::TestPagedEngine::test_matches_dense_engine_greedy",
     "test_paged.py::TestPrefixCache::test_shared_prompt_pages_reused",
+    # Suffix-bucket rounding math (ISSUE 12 satellite): pure python —
+    # the compiling engine drill stays tier-1 only.
+    "test_paged.py::TestSuffixBucketUnit",
     "test_speculative.py::TestSpeculative::test_lossless_vs_plain_greedy",
     "test_speculative.py::TestContinuousSpeculative::"
     "test_lossless_and_ragged_budgets",
@@ -117,6 +121,11 @@ SMOKE_NODES = (
     # the ci.sh audit stage / --full).
     "test_perf_audit.py::TestHloParse",
     "test_perf_audit.py::TestBudgetGate",
+    # Overlap measurement (ISSUE 12): hand-computed window/ratio
+    # fixtures + the overlap-floor gate (pure python — the compiling
+    # pipeline-parity and AOT drills stay tier-1 / audit-stage).
+    "test_perf_audit.py::TestOverlapParse",
+    "test_perf_audit.py::TestOverlapBudgetGate",
     # Observability: span model + registry + timeline assembly, plus
     # the analysis plane (ISSUE 6) — quantile goldens, cardinality cap,
     # rule schema + fire/hysteresis/resolve lifecycle, flight-recorder

@@ -579,6 +579,61 @@ class TestRuleLifecycle:
         assert engine.evaluate() == []
         assert engine.active() == []
 
+    def test_overlap_regression_fires_then_resolves(self):
+        """ISSUE 12: the COMMITTED overlap-regression rule fires when a
+        `perf --audit` publishes an fsdp overlap ratio below the
+        budgets.json floor, holds through hysteresis, and resolves once
+        a re-measurement recovers — and the gauge being UNSET (no audit
+        has run in this process) never breaches, so serving hosts that
+        never compile the training schedules stay silent."""
+        (rule,) = [r for r in obs_rules.check_ruleset()
+                   if r.id == "overlap-regression"]
+        # The rule's floor mirrors budgets.json — drift between the two
+        # would let the alert disagree with the CI gate.
+        from polyaxon_tpu.perf import budgets as perf_budgets
+        floors = perf_budgets.load_budgets()["_overlap"]["min_overlap_ratio"]
+        assert rule.value == floors["fsdp"]
+        assert rule.labels == {"schedule": "fsdp"}
+
+        # Cold start: registered but never set → no data → no breach.
+        registry = obs_metrics.MetricsRegistry()
+        obs_metrics.ensure_perf_metrics(registry)
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([rule], registry=registry,
+                                       clock=clock)
+        assert engine.evaluate() == []
+        assert engine.active() == []
+
+        gauge = obs_metrics.perf_overlap_ratio(registry)
+        # A different schedule's measurement must not satisfy (or
+        # breach) the fsdp-labeled rule.
+        gauge.set(0.0, schedule="dp")
+        assert engine.evaluate() == []
+
+        gauge.set(0.0444, schedule="fsdp")  # healthy measured ratio
+        assert engine.evaluate() == []
+        clock.now += 30
+
+        gauge.set(0.0, schedule="fsdp")  # scheduler deopt: serialized
+        assert engine.evaluate() == []  # pending, `for` = 5s
+        clock.now += 6
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "overlap-regression"
+        assert fired["value"] < rule.value
+        assert engine.active()
+
+        gauge.set(0.0444, schedule="fsdp")  # knob restored, re-audited
+        assert engine.evaluate() == []  # clear; hysteresis holds
+        assert engine.active()
+        clock.now += 20  # past resolve_after = 15s
+        (resolved,) = engine.evaluate()
+        assert resolved["event"] == "resolved"
+        assert resolved["rule"] == "overlap-regression"
+        assert engine.active() == []
+        assert [e["event"] for e in engine.history] == [
+            "fired", "resolved"]
+
 
 # ============================================================ flight recorder
 class TestFlightRecorder:

@@ -198,6 +198,35 @@ class TestFlash:
         oq, ok_ = auto_blocks(1536, 1536, 128)
         assert 1536 % oq == 0 and 1536 % ok_ == 0
 
+    def test_auto_blocks_committed_pick_table(self):
+        """ISSUE 12: device kinds probed by the AOT topology sweep use
+        the committed compile-validated pick, still screened by the
+        budget and seq-tiling rules; unknown kinds fall back to the
+        heuristic unchanged."""
+        import json
+
+        from polyaxon_tpu.ops.flash import (FLASH_TILES_PATH, _tile_bytes,
+                                            auto_blocks)
+
+        table = {k: v for k, v in
+                 json.load(open(FLASH_TILES_PATH)).items()
+                 if not k.startswith("_")}
+        assert table, "flash_tiles.json must commit at least one pick"
+        for kind, pick in table.items():
+            bq, bk = pick["block_q"], pick["block_k"]
+            # Picks were validated by a real Mosaic compile at the
+            # probe shapes (head_dim 64); the budget screen must agree.
+            assert _tile_bytes(bq, bk, 64) <= 48 * 2**20
+            got = auto_blocks(4096, 4096, 64, device_kind=kind)
+            assert got == (min(bq, 4096), min(bk, 4096))
+            # A seq the pick doesn't tile falls through to the
+            # heuristic rather than forcing a non-dividing block.
+            oq, ok_ = auto_blocks(1536, 1536, 64, device_kind=kind)
+            assert 1536 % oq == 0 and 1536 % ok_ == 0
+        # Unknown kind == no kind: identical heuristic answer.
+        assert auto_blocks(2048, 2048, 64, device_kind="TPU v9000") \
+            == auto_blocks(2048, 2048, 64)
+
     def test_auto_blocks_matches_reference(self):
         q, k, v = _qkv()
         ref = xla_attention(q, k, v, causal=True)

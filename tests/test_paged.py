@@ -659,3 +659,72 @@ class TestRadixPrefixSharing:
         assert stats["kv_invariant_violations"] == 0
 
 
+
+
+class TestSuffixBucketUnit:
+    """Pure bucketing math (smoke tier): padded suffix lengths are
+    powers of two with a floor, so the distinct-executable count per
+    prefix-page count is O(log max_suffix)."""
+
+    def test_power_of_two_with_floor(self):
+        from polyaxon_tpu.serving.batching import bucket_suffix_len
+
+        assert bucket_suffix_len(1) == 8
+        assert bucket_suffix_len(8) == 8
+        assert bucket_suffix_len(9) == 16
+        assert bucket_suffix_len(16) == 16
+        assert bucket_suffix_len(17) == 32
+        assert bucket_suffix_len(1000) == 1024
+        with pytest.raises(ValueError, match="suffix length"):
+            bucket_suffix_len(0)
+
+    def test_bucket_count_is_logarithmic(self):
+        from polyaxon_tpu.serving.batching import bucket_suffix_len
+
+        buckets = {bucket_suffix_len(n) for n in range(1, 1025)}
+        assert buckets == {8, 16, 32, 64, 128, 256, 512, 1024}
+
+
+class TestSuffixBucketing:
+    def test_varied_suffix_lengths_bound_compiles_with_parity(self):
+        """Shared-prefix prompts with DISTINCT suffix lengths: the
+        suffix-prefill executable count is the bucket count (here 4
+        lengths → 2 buckets, observed via the lru cache_info), and the
+        masked padding changes no tokens vs the dense engine."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        base = [3, 1, 4, 1, 5, 9, 2, 6]  # exactly 2 prefix pages
+        # Distinct first tokens → divergence at the page boundary →
+        # every request skips exactly the 2 base pages (one n_pref).
+        # Prefill excludes the prompt's LAST token (fed at decode), so
+        # these give prefill-suffix lengths 1, 3, 7, 9.
+        suffixes = [[11, 30], [12, 13, 14, 30],
+                    [15, 16, 17, 18, 13, 14, 15, 30],
+                    [19, 20, 21, 22, 23, 24, 25, 26, 27, 30]]
+        prompts = [base + s for s in suffixes]
+        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=1, max_len=32)
+        try:
+            want = [dense.generate([p], max_new_tokens=4, timeout=300)
+                    for p in prompts]
+        finally:
+            dense.stop()
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=32,
+                                          kv="paged", page_size=4)
+        try:
+            # Warmup writes the base chain; its own prefill is
+            # monolithic (nothing cached yet) — not a suffix compile.
+            engine.generate([base + [10]], max_new_tokens=4, timeout=300)
+            got = [engine.generate([p], max_new_tokens=4, timeout=300)
+                   for p in prompts]
+            info = engine._suffix_prefill.cache_info()
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        assert got == want
+        # Suffix lengths 1, 3, 7, 9 land in buckets {8, 16}: two
+        # executables serve all four requests.
+        assert info.misses == 2
+        assert info.hits == 2
+        assert stats["kv_invariant_violations"] == 0

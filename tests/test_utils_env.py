@@ -72,3 +72,56 @@ class TestCpuMeshXlaFlags:
     def test_unrelated_flags_preserved(self):
         flags = self._flags("--xla_dump_to=/tmp/d")
         assert "--xla_dump_to=/tmp/d" in flags
+
+
+class TestTpuOverlapLibtpuArgs:
+    """Same append-only contract as the XLA flags above, but for
+    LIBTPU_INIT_ARGS (parallel/overlap.py's env-var twin): these are
+    xla_tpu_* flags, and putting them in XLA_FLAGS CHECK-aborts a
+    CPU-only jaxlib, so the helper must only ever touch
+    LIBTPU_INIT_ARGS — and never when no libtpu wheel is present."""
+
+    def _args(self, initial=None, available=True):
+        from polyaxon_tpu.utils import env as env_mod
+
+        env = {} if initial is None else {"LIBTPU_INIT_ARGS": initial}
+        with mock.patch.dict(os.environ, env, clear=False), \
+                mock.patch.object(env_mod, "_libtpu_available",
+                                  return_value=available):
+            if initial is None:
+                os.environ.pop("LIBTPU_INIT_ARGS", None)
+            pinned = env_mod.tpu_overlap_libtpu_args()
+            return pinned, os.environ.get("LIBTPU_INIT_ARGS", "").split()
+
+    def test_pins_all_overlap_flags(self):
+        from polyaxon_tpu.utils.env import TPU_OVERLAP_INIT_ARGS
+
+        pinned, args = self._args()
+        assert pinned
+        for flag in TPU_OVERLAP_INIT_ARGS:
+            assert flag in args
+
+    def test_operator_setting_wins(self):
+        pinned, args = self._args(
+            "--xla_tpu_enable_latency_hiding_scheduler=false")
+        schedulers = [a for a in args
+                      if a.startswith("--xla_tpu_enable_latency_hiding")]
+        assert schedulers == [
+            "--xla_tpu_enable_latency_hiding_scheduler=false"]
+        assert pinned  # the OTHER flags still appended
+
+    def test_unrelated_args_preserved(self):
+        _, args = self._args("--some_operator_flag=7")
+        assert "--some_operator_flag=7" in args
+
+    def test_idempotent(self):
+        _, first = self._args()
+        pinned_again, second = self._args(" ".join(first))
+        assert second == first
+        assert not pinned_again
+
+    def test_no_libtpu_touches_nothing(self):
+        pinned, args = self._args(available=False)
+        assert not pinned and args == []
+        pinned, args = self._args("--keep=1", available=False)
+        assert not pinned and args == ["--keep=1"]
