@@ -517,6 +517,12 @@ class _Handler(BaseHTTPRequestHandler):
             # phase, step-time trend + anomaly flags, fault annotations
             # per phase. Backs `plx ops report`.
             return self._json(plane.report(uuid))
+        if action == "verify":
+            # Telemetry-oracle verdicts (obs.oracle) scoped to this
+            # run: committed invariants judged against its timeline,
+            # report, the registry, and alert state. Backs
+            # `plx ops verify`.
+            return self._json(plane.verify(uuid))
         if action == "metrics":
             names = query.get("names")
             return self._json(plane.streams.get_metrics(uuid, names))

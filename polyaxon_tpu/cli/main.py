@@ -424,20 +424,74 @@ def ops_report(uid, as_json):
                    f"fired on this run")
 
 
+@ops.command("verify")
+@click.option("-uid", "--uid", default=None,
+              help="scope the run-surface invariants to one run "
+                   "(fleet-wide when omitted)")
+@click.option("--json", "as_json", is_flag=True)
+def ops_verify(uid, as_json):
+    """Telemetry-oracle verdicts (ISSUE 13): the committed invariant
+    set (obs/oracle.json) judged against the plane's end state — run
+    terminal statuses, phase accounting, metric/SLO predicates, loss
+    continuity, and unresolved alerts — with the offending
+    run/series/alert attached as evidence. Exits nonzero on any
+    failed invariant."""
+    plane = get_plane()
+    if uid is not None:
+        get_run_or_fail(plane, uid)
+    result = plane.verify(uid)
+    if as_json:
+        click.echo(json.dumps(result, indent=2, default=str))
+    else:
+        for verdict in result["verdicts"]:
+            marker = {"pass": "ok  ", "skip": "skip",
+                      "fail": "FAIL"}[verdict["verdict"]]
+            line = f"  [{marker}] {verdict['invariant']}"
+            if verdict["verdict"] != "pass":
+                line += ("  "
+                         + json.dumps(verdict["evidence"],
+                                      default=str)[:160])
+            click.echo(line)
+        counts = result["counts"]
+        click.echo(f"verdicts: {counts['pass']} pass / "
+                   f"{counts['fail']} fail / {counts['skip']} skip")
+    if not result["passed"]:
+        raise SystemExit(1)
+
+
 @ops.command("alerts")
 @click.option("--json", "as_json", is_flag=True)
 @click.option("--all", "show_all", is_flag=True,
               help="every rule's state, not just firing alerts")
-def ops_alerts(as_json, show_all):
+@click.option("--since", default=None, metavar="WINDOW",
+              help="bound history to the last WINDOW (e.g. 15m, 2h)")
+@click.option("--limit", default=None, type=int, metavar="N",
+              help="at most N most-recent history events")
+def ops_alerts(as_json, show_all, since, limit):
     """Alert-rule state over the live registry (ISSUE 6): the committed
     ruleset (obs/rules.json) evaluated now — firing alerts first, then
-    (with --all) every rule's current value vs its threshold."""
+    (with --all) every rule's current value vs its threshold. History
+    (fired/resolved transitions) is bounded by --since/--limit."""
+    import time as _time
+
     from polyaxon_tpu.obs import rules as obs_rules
 
     plane = get_plane()
     engine = obs_rules.default_engine()
     engine.evaluate(plane=plane)
     payload = engine.to_json()
+    if since is not None:
+        try:
+            horizon = _time.time() - obs_rules.parse_window(
+                since, field_name="--since")
+        except obs_rules.RuleError as exc:
+            raise click.UsageError(str(exc))
+        payload["history"] = [e for e in payload["history"]
+                              if float(e.get("at") or 0) >= horizon]
+    if limit is not None:
+        if limit < 0:
+            raise click.UsageError("--limit must be >= 0")
+        payload["history"] = payload["history"][-limit:] if limit else []
     if as_json:
         click.echo(json.dumps(payload, indent=2, default=str))
         return
@@ -452,6 +506,11 @@ def ops_alerts(as_json, show_all):
             click.echo(f"  {rule['state']:<9} {rule['rule']:<24} "
                        f"{rule['metric']} value={rule['value']} "
                        f"threshold={rule['threshold']}")
+    if since is not None or limit is not None:
+        click.echo(f"history ({len(payload['history'])} event(s)):")
+        for event in payload["history"]:
+            click.echo(f"  {event.get('event'):<9} {event.get('rule')}"
+                       f"  at={event.get('at')}")
 
 
 @ops.command("logs")

@@ -473,6 +473,18 @@ class ControlPlane:
         report["alerts"] = (record.meta or {}).get("alerts") or []
         return report
 
+    def verify(self, run_uuid: Optional[str] = None) -> dict:
+        """Telemetry-oracle verdicts (obs.oracle): the committed
+        invariant set judged against this plane's end state —
+        scoped to one run when ``run_uuid`` is given, fleet-wide
+        otherwise. Backs ``GET .../runs/<uuid>/verify`` and
+        ``plx ops verify``."""
+        from polyaxon_tpu.obs.oracle import verify_plane
+
+        if run_uuid is not None:
+            self.store.get_run(run_uuid)  # 404s unknown uuids
+        return verify_plane(self, run_uuid=run_uuid)
+
     # -- cross-run lineage -------------------------------------------------
     def _upstream_edges(
         self, record: RunRecord,

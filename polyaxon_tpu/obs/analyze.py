@@ -125,13 +125,18 @@ def analyze_timeline(timeline: dict[str, Any]) -> dict[str, Any]:
             credit("input_wait", wait_ms, 0)
             credit("step", duration - wait_ms)
             if attrs.get("step_time_ms") is not None:
-                step_windows.append({
+                window = {
                     "from_step": attrs.get("from_step"),
                     "to_step": attrs.get("to_step"),
                     "steps": steps,
                     "step_time_ms": float(attrs["step_time_ms"]),
                     "input_wait_ms": float(attrs.get("input_wait_ms") or 0.0),
-                })
+                }
+                # The oracle's loss-continuity invariant reads the loss
+                # each window ended at, when the loop recorded one.
+                if attrs.get("loss") is not None:
+                    window["loss"] = float(attrs["loss"])
+                step_windows.append(window)
             continue
         phase = _LEAF_PHASES.get(name)
         if phase is not None:

@@ -106,40 +106,16 @@ class FlightRecorder:
                 "flight note %r failed for %s: %s", name, run_uuid, exc)
 
     # -- deltas ------------------------------------------------------------
-    @staticmethod
-    def _series_delta(now: Any, then: Any):
-        if isinstance(now, dict):  # histogram series
-            base = then if isinstance(then, dict) else {"count": 0, "sum": 0.0}
-            d_count = now["count"] - base.get("count", 0)
-            if d_count <= 0:
-                return None
-            return {"count": d_count,
-                    "sum": round(now["sum"] - base.get("sum", 0.0), 6)}
-        delta = float(now) - float(then or 0.0)
-        return delta if delta != 0.0 else None
-
     def metric_deltas(self, run_uuid: str) -> dict[str, Any]:
         """Registry movement since ``mark_start``: changed series only
         (counters/gauges as value deltas, histograms as count/sum
-        deltas). Without a baseline the current snapshot is returned
-        whole, flagged as absolute."""
+        deltas — :func:`obs.metrics.snapshot_delta`). Without a
+        baseline the current snapshot is returned whole, flagged as
+        absolute."""
         with self._lock:
             slot = self._runs.get(run_uuid)
             baseline = slot.get("baseline") if slot else None
-        snapshot = self.registry.snapshot()
-        if baseline is None:
-            return {"absolute": True, "snapshot": snapshot}
-        deltas: dict[str, Any] = {}
-        for name, family in snapshot.items():
-            base_series = (baseline.get(name) or {}).get("series") or {}
-            changed = {}
-            for key, sample in family["series"].items():
-                delta = self._series_delta(sample, base_series.get(key))
-                if delta is not None:
-                    changed[key] = delta
-            if changed:
-                deltas[name] = {"type": family["type"], "series": changed}
-        return {"absolute": False, "deltas": deltas}
+        return self.registry.snapshot_delta(baseline)
 
     # -- dump --------------------------------------------------------------
     @staticmethod

@@ -148,6 +148,7 @@ class _Metric:
         with self._lock:
             return {
                 "type": self.type,
+                "labels": list(self.labelnames),
                 "series": {",".join(k) if k else "": self._snap_sample(v)
                            for k, v in self._series.items()},
             }
@@ -385,6 +386,50 @@ class MetricsRegistry:
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         return {m.name: m.snapshot() for m in metrics}
+
+    def snapshot_delta(self, baseline: Optional[dict]) -> dict:
+        """Registry movement since ``baseline`` (a prior
+        :meth:`snapshot`): see :func:`snapshot_delta` for the shape.
+        The flight recorder's per-run metric deltas and the telemetry
+        oracle's delta-mode invariants both read this."""
+        return snapshot_delta(self.snapshot(), baseline)
+
+
+def series_delta(now: Any, then: Any):
+    """Movement of one snapshot series sample: counters/gauges as value
+    deltas, histogram samples as count/sum deltas. ``None`` when the
+    series did not move (so callers can report changed series only)."""
+    if isinstance(now, dict):  # histogram series
+        base = then if isinstance(then, dict) else {"count": 0, "sum": 0.0}
+        d_count = now["count"] - base.get("count", 0)
+        if d_count <= 0:
+            return None
+        return {"count": d_count,
+                "sum": round(now["sum"] - base.get("sum", 0.0), 6)}
+    delta = float(now) - float(then or 0.0)
+    return delta if delta != 0.0 else None
+
+
+def snapshot_delta(snapshot: dict, baseline: Optional[dict]) -> dict:
+    """Pure delta between two registry snapshots: changed series only.
+    Without a baseline the snapshot is returned whole, flagged as
+    absolute — consumers (postmortems, oracle evidence) can always tell
+    which semantics they are reading."""
+    if baseline is None:
+        return {"absolute": True, "snapshot": snapshot}
+    deltas: dict[str, Any] = {}
+    for name, family in snapshot.items():
+        base_series = (baseline.get(name) or {}).get("series") or {}
+        changed = {}
+        for key, sample in family["series"].items():
+            delta = series_delta(sample, base_series.get(key))
+            if delta is not None:
+                changed[key] = delta
+        if changed:
+            deltas[name] = {"type": family["type"],
+                            "labels": family.get("labels") or [],
+                            "series": changed}
+    return {"absolute": False, "deltas": deltas}
 
 
 # The process-global default registry every subsystem records into.
@@ -641,6 +686,33 @@ def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     serving_prefix_hit_rate(registry)
     serving_radix_nodes(registry)
     serving_radix_pages(registry)
+    serving_trace_dumps_total(registry)
+
+
+def alert_history_evictions(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_alert_history_evictions_total",
+        "Fired/resolved alert transitions evicted from the bounded "
+        "alert-engine history ring (oldest-out past the cap) — nonzero "
+        "means `plx ops alerts` history is no longer the full episode "
+        "record")
+
+
+def oracle_verdicts_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_oracle_verdicts_total",
+        "Telemetry-oracle invariant verdicts by outcome "
+        "(pass / fail / skip) across every evaluation surface "
+        "(plx ops verify, GET .../verify, the sim gauntlet)",
+        ("verdict",))
+
+
+def serving_trace_dumps_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_trace_dumps_total",
+        "Request-timeline ring dumps written at engine shutdown "
+        "(ok / failed) — the serving counterpart of postmortem.json",
+        ("outcome",))
 
 
 def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
@@ -656,6 +728,8 @@ def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     admission_pass_hist(registry)
     admission_divergence(registry)
     training_step_hist(registry)
+    alert_history_evictions(registry)
+    oracle_verdicts_total(registry)
 
 
 # Families registered at scrape time (api/server.py) rather than by an

@@ -202,3 +202,59 @@ class TimelineRing:
         if trace is None:
             return None
         return build_timeline(trace.records(), trace_id=request_id)
+
+    def to_dump(self) -> dict[str, Any]:
+        """The whole ring as plain data (oldest first), the serving
+        mirror of a training run's ``postmortem.json``: summaries for
+        the listing view plus full span records per request so
+        ``build_timeline`` — and ``sim.replay`` — can reconstruct any
+        request after the process is gone."""
+        with self._lock:
+            traces = list(self._traces.values())
+            evicted = self.evicted
+        return {
+            "dumped_at": time.time(),
+            "capacity": self.capacity,
+            "evicted": evicted,
+            "requests": [{
+                "summary": t.summary(),
+                "records": t.records(),
+            } for t in traces],
+        }
+
+
+TRACE_DUMP_FILE = "request-timelines.json"
+
+
+def dump_ring(ring: TimelineRing, path: str) -> str:
+    """Persist a ring dump atomically (tmp + replace, the postmortem
+    idiom). A directory path gets :data:`TRACE_DUMP_FILE` appended.
+    Raises on I/O failure — the caller owns fail-open policy."""
+    import json
+
+    if os.path.isdir(path) or path.endswith(os.sep):
+        path = os.path.join(path, TRACE_DUMP_FILE)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(ring.to_dump(), fh, indent=2, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def read_ring_dump(path: str) -> Optional[dict[str, Any]]:
+    """Load a persisted ring dump (None when absent/corrupt — same
+    posture as ``flight.read_postmortem``)."""
+    import json
+
+    if os.path.isdir(path):
+        path = os.path.join(path, TRACE_DUMP_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None

@@ -273,6 +273,15 @@ class AlertEngine:
             if rule.kind in ("rate", "slo_burn_rate")}
         self.history: deque = deque(maxlen=self.HISTORY)
 
+    def _append_history(self, event: dict) -> None:
+        """Bounded append: a transition pushed past the ring cap evicts
+        the oldest one, counted into a catalogued metric so a truncated
+        episode record is visible on /metrics, not silent."""
+        if (self.history.maxlen is not None
+                and len(self.history) >= self.history.maxlen):
+            obs_metrics.alert_history_evictions(self.registry).inc()
+        self.history.append(event)
+
     # -- observations ------------------------------------------------------
     def _counter_total(self, rule: Rule) -> Optional[float]:
         metric = self.registry.get(rule.metric)
@@ -452,7 +461,7 @@ class AlertEngine:
                 state.resolved_at = None
                 event = {"event": "fired", "at": now, **state.to_json(),
                          "annotate_runs": rule.annotate_runs}
-                self.history.append(event)
+                self._append_history(event)
                 return event
             return None
         if state.state == "pending":
@@ -467,7 +476,7 @@ class AlertEngine:
                 state.pending_since = state.clear_since = None
                 event = {"event": "resolved", "at": now, **state.to_json(),
                          "annotate_runs": rule.annotate_runs}
-                self.history.append(event)
+                self._append_history(event)
                 return event
         return None
 

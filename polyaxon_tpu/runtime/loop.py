@@ -410,7 +410,7 @@ def _run_jaxjob(
                             "to_step": step,
                             "steps": steps_since_emit,
                             **{k: round(vals[k], 3) for k in
-                               ("step_time_ms", "input_wait_ms")
+                               ("step_time_ms", "input_wait_ms", "loss")
                                if k in vals},
                         })
                 steps_since_emit = 0

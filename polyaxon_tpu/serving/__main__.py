@@ -58,6 +58,11 @@ def main() -> int:
                         help="(continuous) disable per-request span "
                              "timelines (GET /requests/{id}/timeline); "
                              "the TTFT/TPOT SLO histograms keep flowing")
+    parser.add_argument("--trace-dump", default=None, metavar="PATH",
+                        help="(continuous) persist the request-timeline "
+                             "ring to PATH on engine shutdown (the "
+                             "serving mirror of postmortem.json; "
+                             "sim.replay can turn it into a trace)")
     args = parser.parse_args()
     mesh_axes = None
     if args.mesh:
@@ -83,7 +88,8 @@ def main() -> int:
                        spec_k=args.spec_k, lora_alpha=args.lora_alpha,
                        prefill_chunk=args.prefill_chunk,
                        max_pending=args.max_pending,
-                       request_tracing=not args.no_request_tracing) as s:
+                       request_tracing=not args.no_request_tracing,
+                       trace_dump_path=args.trace_dump) as s:
         print(f"serving {args.model} at {s.url}", flush=True)
         try:
             while True:

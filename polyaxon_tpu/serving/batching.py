@@ -135,7 +135,8 @@ class ContinuousBatchingEngine:
                  draft=None, prefill_chunk: Optional[int] = None,
                  max_pending: Optional[int] = None,
                  request_tracing: bool = True,
-                 trace_capacity: int = reqtrace.DEFAULT_RING_CAPACITY):
+                 trace_capacity: int = reqtrace.DEFAULT_RING_CAPACITY,
+                 trace_dump_path: Optional[str] = None):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
@@ -303,6 +304,9 @@ class ContinuousBatchingEngine:
         # SLO histograms (TTFT/TPOT/queue-wait) keep flowing.
         self.request_tracing = bool(request_tracing)
         self._ring = reqtrace.TimelineRing(trace_capacity)
+        # ISSUE 13: where to persist the ring at shutdown (None = the
+        # ring dies with the process, the pre-13 behavior).
+        self.trace_dump_path = trace_dump_path
         self._rejected: dict[str, int] = {}
         self._cv = threading.Condition()
         self._stopped = False
@@ -693,6 +697,24 @@ class ContinuousBatchingEngine:
                     req.error = "engine stopped"
                     self._finish_trace(req)
                     req.done.set()
+        self._dump_ring()
+
+    def _dump_ring(self) -> None:
+        """Persist the request-timeline ring at shutdown (ISSUE 13):
+        the serving mirror of the flight recorder's postmortem, so
+        request evidence survives process exit and sim.replay can turn
+        it into an arrival trace. Fail-open — a dump failure must not
+        turn a clean stop into a crash; both outcomes are counted."""
+        if not self.trace_dump_path or not self.request_tracing:
+            return
+        try:
+            path = reqtrace.dump_ring(self._ring, self.trace_dump_path)
+            obs_metrics.serving_trace_dumps_total().inc(outcome="ok")
+            logger.info("request-timeline ring dumped to %s", path)
+        except Exception:
+            obs_metrics.serving_trace_dumps_total().inc(outcome="error")
+            logger.warning("request-timeline ring dump to %s failed",
+                           self.trace_dump_path, exc_info=True)
 
     def stop(self) -> None:
         with self._cv:

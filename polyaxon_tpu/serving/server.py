@@ -716,7 +716,8 @@ class ServingServer:
                  lora_alpha: float = 16.0,
                  prefill_chunk: Optional[int] = None,
                  max_pending: Optional[int] = None,
-                 request_tracing: bool = True):
+                 request_tracing: bool = True,
+                 trace_dump_path: Optional[str] = None):
         self.mesh = None
         if mesh_axes:
             from polyaxon_tpu.parallel import build_mesh
@@ -771,7 +772,8 @@ class ServingServer:
                 page_size=page_size, kv_pages=kv_pages,
                 prefix_cache=prefix_cache, draft=draft,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
-                request_tracing=request_tracing)
+                request_tracing=request_tracing,
+                trace_dump_path=trace_dump_path)
         elif batching == "static":
             if prefill_chunk is not None:
                 raise ValueError(

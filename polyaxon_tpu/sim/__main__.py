@@ -6,6 +6,9 @@ Modes:
   python -m polyaxon_tpu.sim --update-budgets    # lock in a new baseline
   python -m polyaxon_tpu.sim --quick --deopt     # must FAIL the gate
   python -m polyaxon_tpu.sim --trace quick       # replay a whole trace
+  python -m polyaxon_tpu.sim --gauntlet          # oracle-judged episode
+  python -m polyaxon_tpu.sim --gauntlet --inject stuck-requeue  # must FAIL
+  python -m polyaxon_tpu.sim --replay sim/scenarios/preemption-storm.json
 """
 
 from __future__ import annotations
@@ -34,11 +37,48 @@ def main(argv=None) -> int:
                         help="replay a whole arrival trace instead of "
                              "load points; asserts zero admission "
                              "divergence")
+    parser.add_argument("--gauntlet", action="store_true",
+                        help="run the oracle-judged mini-gauntlet "
+                             "(sim/gauntlet.py); exit reflects verdicts")
+    parser.add_argument("--inject", default=None, metavar="DEOPT",
+                        help="(--gauntlet) apply a named deopt, e.g. "
+                             "stuck-requeue; the run should then FAIL")
+    parser.add_argument("--serving", action="store_true",
+                        help="(--gauntlet) include the real-engine "
+                             "serving segment (needs jax)")
+    parser.add_argument("--replay", default=None, metavar="SCENARIO",
+                        help="replay a committed incident scenario "
+                             "(sim/scenarios/*.json) judged by the "
+                             "oracle; exit reflects verdicts")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--json", dest="json_out",
                         help="write the result JSON to this path "
                              "('' = stdout only)")
     args = parser.parse_args(argv)
+
+    if args.gauntlet:
+        from polyaxon_tpu.sim import gauntlet
+
+        gauntlet_argv = ["--seed", str(args.seed or gauntlet.GAUNTLET_SEED)]
+        if args.inject:
+            gauntlet_argv += ["--inject", args.inject]
+        if args.serving:
+            gauntlet_argv += ["--serving"]
+        return gauntlet.main(gauntlet_argv)
+
+    if args.replay:
+        from polyaxon_tpu.sim import replay as sim_replay
+
+        result = sim_replay.replay_scenario(args.replay, seed=args.seed)
+        print(json.dumps(result, indent=2, default=str))
+        if args.json_out:
+            with open(args.json_out, "w") as fh:
+                json.dump(result, fh, indent=2, default=str)
+        if not result["oracle"]["passed"]:
+            print("FAIL: oracle invariants failed on replay",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     from polyaxon_tpu.sim import budgets as sim_budgets
     from polyaxon_tpu.sim import curve as sim_curve

@@ -1,0 +1,247 @@
+"""The mini-gauntlet (ISSUE 13): one compressed fleet episode whose
+pass criteria are ONLY telemetry-oracle verdicts.
+
+A fixed-seed :class:`FleetSim` replays a composed scenario — a
+low-priority training job, preemptible tune churn (sweep + restart
+jobs), mixed-class serving traffic, a mid-episode preemption storm,
+and a chaos plan stalling scheduler ticks — while a fresh
+``AlertEngine`` (the committed ruleset) watches every few ticks. At
+the end nothing asserts on internals: the episode's telemetry is
+bundled (:class:`obs.oracle.TelemetryBundle`) and judged against the
+committed invariant set (``obs/oracle.json``). The stage passes iff
+no invariant fails AND the load-bearing pair — ``all-runs-terminal``
+and ``zero-unresolved-alerts`` — actually evaluated (a gauntlet whose
+anchor invariants skip proved nothing).
+
+The alert engine's injectable clock is fast-forwarded once the fleet
+drains so rate/burn windows that the storm legitimately tripped can
+empty and resolve — the fire-then-resolve arc lands in ``history``
+(oracle evidence) instead of leaving a stale FIRING state that only
+reflects the compressed timescale.
+
+``--inject stuck-requeue`` is the self-test that the oracle CAN fail:
+it suppresses the scheduler's preempted-run requeue path, so the
+storm's victims sit PREEMPTED forever, the drain times out, and the
+``all-runs-terminal`` invariant must flip the exit code — proving the
+gauntlet's green is load-bearing, not decorative.
+
+An optional real-serving segment (``--serving``) runs mixed-class
+traffic through an actual ``ContinuousBatchingEngine`` (llama_tiny)
+and dumps its request-timeline ring on stop, feeding the serving SLO
+invariant real TTFT samples; CI keeps it off to stay CPU-cheap.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import tempfile
+import time
+from typing import Any, Optional
+
+from polyaxon_tpu import chaos
+from polyaxon_tpu.sim import traces
+from polyaxon_tpu.sim.traces import TraceEvent, job_op, serving_op, sweep_op
+
+logger = logging.getLogger(__name__)
+
+GAUNTLET_SEED = 7
+HORIZON = 6.0
+INJECTS = ("stuck-requeue",)
+# The invariants a green gauntlet must have actually judged (verdict
+# `pass`, not `skip`): terminal end state and a clean alert board are
+# the whole point of the episode.
+REQUIRED_INVARIANTS = ("all-runs-terminal", "zero-unresolved-alerts")
+
+_CHAOS_PLAN = json.dumps({
+    "seed": GAUNTLET_SEED,
+    "faults": [
+        {"seam": "tick", "op": "skip", "at": 5, "times": 2},
+        {"seam": "tick", "op": "skip", "at": 40, "times": 1},
+    ],
+})
+
+
+def build_gauntlet_trace(seed: int = GAUNTLET_SEED) -> list[TraceEvent]:
+    """The composed episode, deterministic in ``seed``: serving deploys
+    anchor capacity early (the storm's guaranteed victims alongside the
+    train job), a low-priority train job and a tune sweep land on the
+    preemptible batch queue, restart churn hammers best-effort, a
+    half-fleet preemption storm hits mid-episode."""
+    import random
+
+    rng = random.Random(seed)
+    events: list[TraceEvent] = [
+        TraceEvent(0.0, "serving", serving_op(), "serving"),
+        TraceEvent(0.1, "serving", serving_op(), "serving"),
+        TraceEvent(0.2, "job",
+                   job_op(queue="batch", name="train-lowpri"),
+                   "research"),
+        TraceEvent(0.5, "sweep", sweep_op(8, queue="batch"), "research"),
+    ]
+    for _ in range(12):
+        events.append(TraceEvent(
+            round(rng.uniform(0.2, HORIZON), 6), "churn",
+            job_op(queue="best-effort", restart=True),
+            rng.choice(traces.PROJECTS)))
+    for _ in range(30):
+        queue = rng.choice(("batch", "best-effort", None))
+        events.append(TraceEvent(
+            round(rng.uniform(0.0, HORIZON), 6), "job", job_op(queue=queue),
+            rng.choice(traces.PROJECTS)))
+    events.append(TraceEvent(3.0, "storm", None,
+                             payload={"fraction": 0.5}))
+    events.sort(key=lambda e: (e.at, e.kind, e.project))
+    return events
+
+
+def _serving_segment(dump_dir: str) -> Optional[str]:
+    """Mixed-class traffic through a REAL continuous-batching engine,
+    ring dumped on stop. Returns the dump path (None when the serving
+    stack is unavailable — the gauntlet core does not depend on jax)."""
+    import os
+
+    try:
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+    except Exception:
+        logger.warning("serving stack unavailable; gauntlet runs "
+                       "without the serving segment", exc_info=True)
+        return None
+    dump_path = os.path.join(dump_dir, "request-timelines.json")
+    cfg, params = load_params("llama_tiny", seed=0)
+    engine = ContinuousBatchingEngine(
+        "llama_tiny", cfg, params, slots=2,
+        trace_dump_path=dump_path)
+    try:
+        rows = [[(i * 7 + j) % cfg.vocab_size for j in range(6)]
+                for i in range(6)]
+        for i, klass in enumerate(("interactive", "batch", "best-effort",
+                                   "interactive", "batch", "interactive")):
+            engine.generate([rows[i]], max_new_tokens=4, klass=klass)
+    finally:
+        engine.stop()
+    return dump_path if os.path.exists(dump_path) else None
+
+
+def run_gauntlet(*, seed: int = GAUNTLET_SEED,
+                 inject: Optional[str] = None, serving: bool = False,
+                 max_wall: float = 60.0,
+                 oracle_source: Any = None) -> dict:
+    """One gauntlet episode → ``{passed, oracle, sim, ...}``.
+
+    ``inject`` applies a named deopt before the episode (see
+    :data:`INJECTS`); the caller asserts the oracle catches it."""
+    from polyaxon_tpu.obs import metrics as obs_metrics
+    from polyaxon_tpu.obs import oracle as obs_oracle
+    from polyaxon_tpu.obs import rules as obs_rules
+    from polyaxon_tpu.sim.fleet import FleetSim
+
+    if inject is not None and inject not in INJECTS:
+        raise ValueError(f"unknown inject {inject!r} (one of {INJECTS})")
+    invariants = obs_oracle.load_invariants(oracle_source)
+    events = build_gauntlet_trace(seed)
+
+    sim = FleetSim(seed=seed, capacity=24)
+    # A storm that preempts nothing proves nothing: deploys submitted
+    # at t=0 go live within the first ticks and are still running at
+    # t=3.0, so the storm always has victims.
+    clock_skew = [0.0]
+    engine = obs_rules.AlertEngine(
+        obs_rules.load_ruleset(),
+        clock=lambda: time.time() + clock_skew[0])
+    if inject == "stuck-requeue":
+        # The oracle-can-fail self-test: preempted runs never requeue,
+        # the storm's victims sit PREEMPTED past the drain timeout, and
+        # all-runs-terminal MUST flip the episode to failure.
+        sim.agent.scheduler._tick_preempted = lambda record: 0
+        max_wall = min(max_wall, 20.0)
+    chaos.install(chaos.ChaosPlan.load(_CHAOS_PLAN))
+    baseline = obs_metrics.REGISTRY.snapshot()
+    serving_dump: Optional[str] = None
+    try:
+        orig_tick = sim.tick
+
+        def tick_with_alerts() -> None:
+            orig_tick()
+            if len(sim.tick_seconds) % 5 == 0:
+                engine.evaluate(plane=sim.plane)
+
+        sim.tick = tick_with_alerts
+        sim_result = sim.run_trace(events, max_wall=max_wall)
+        if serving:
+            with tempfile.TemporaryDirectory(
+                    prefix="plx-gauntlet-") as tmp:
+                serving_dump = _serving_segment(tmp)
+                if serving_dump is not None:
+                    from polyaxon_tpu.obs import reqtrace
+
+                    dump = reqtrace.read_ring_dump(serving_dump)
+                    serving_dump = (f"{len((dump or {}).get('requests', []))}"
+                                    " request timelines dumped")
+        # The storm's rate windows (requeue-storm et al) see the burst
+        # for their full window length; the fleet is drained, so jump
+        # the engine clock past every window and let firings resolve —
+        # the fire→resolve episode is the history the oracle inspects.
+        clock_skew[0] = 600.0
+        engine.evaluate(plane=sim.plane)
+        bundle = obs_oracle.TelemetryBundle.from_plane(
+            sim.plane, engine=engine, baseline=baseline)
+        verdicts = obs_oracle.evaluate(invariants, bundle)
+    finally:
+        chaos.uninstall()
+        sim.close()
+    oracle_result = obs_oracle.summarize(verdicts)
+    by_id = {v["invariant"]: v["verdict"] for v in verdicts}
+    anchors_held = all(by_id.get(i) == "pass" for i in REQUIRED_INVARIANTS)
+    return {
+        "passed": oracle_result["passed"] and anchors_held,
+        "anchors": {i: by_id.get(i, "missing")
+                    for i in REQUIRED_INVARIANTS},
+        "inject": inject,
+        "trace_events": len(events),
+        "serving_segment": serving_dump,
+        "sim": sim_result,
+        "oracle": oracle_result,
+    }
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Mini-gauntlet: composed fleet episode judged "
+                    "exclusively by the telemetry oracle")
+    parser.add_argument("--seed", type=int, default=GAUNTLET_SEED)
+    parser.add_argument("--inject", choices=INJECTS, default=None,
+                        help="apply a named deopt; the run is EXPECTED "
+                             "to fail (exit flips accordingly only in "
+                             "the caller — this exits nonzero on fail)")
+    parser.add_argument("--serving", action="store_true",
+                        help="include the real-engine serving segment "
+                             "(needs jax; slower)")
+    parser.add_argument("--max-wall", type=float, default=60.0)
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    result = run_gauntlet(seed=args.seed, inject=args.inject,
+                          serving=args.serving, max_wall=args.max_wall)
+    if args.as_json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        counts = result["oracle"]["counts"]
+        print(f"mini-gauntlet: {result['trace_events']} events, "
+              f"{result['sim']['reaped']} runs reaped in "
+              f"{result['sim']['wall_seconds']}s")
+        for v in result["oracle"]["verdicts"]:
+            marker = {"pass": "ok  ", "skip": "skip", "fail": "FAIL"}
+            detail = ("" if v["verdict"] == "pass"
+                      else f"  {json.dumps(v['evidence'], default=str)[:160]}")
+            print(f"  [{marker[v['verdict']]}] {v['invariant']}{detail}")
+        print(f"verdicts: {counts['pass']} pass / {counts['fail']} fail "
+              f"/ {counts['skip']} skip; anchors: {result['anchors']}")
+        print("GAUNTLET " + ("PASSED" if result["passed"] else "FAILED"))
+    return 0 if result["passed"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via ci.sh
+    raise SystemExit(main())

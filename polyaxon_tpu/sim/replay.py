@@ -1,0 +1,226 @@
+"""Incident replay (ISSUE 13): captured evidence becomes a committed
+regression scenario.
+
+The flight recorder writes ``postmortem.json`` when a run dies and the
+serving engine now dumps its request-timeline ring at shutdown
+(``obs.reqtrace.dump_ring``) — both are write-only evidence until this
+adapter turns them into :mod:`sim.traces` arrival traces that replay
+through the REAL scheduler/admission/store (``FleetSim``) and are
+judged by the telemetry oracle (``obs.oracle``), closing ROADMAP item
+4's loop: every real incident can be committed under
+``sim/scenarios/`` as a permanent regression.
+
+Determinism is the contract: conversion is pure arithmetic over the
+captured dump (timestamps rebased to t=0 and compressed into a fixed
+horizon; no wall clock, no randomness beyond the scenario's own
+seed), so the same scenario file yields byte-identical trace JSON
+(:func:`trace_to_json`) and identical oracle verdicts across runs.
+
+Scenario file shape::
+
+    {
+      "name": "preemption-storm",
+      "description": "...",
+      "source_kind": "postmortem" | "ring",
+      "postmortem": {...}   # flight.dump payload  (source_kind one of)
+      "ring": {...},        # reqtrace ring dump
+      "horizon": 6.0,       # compressed seconds the incident maps onto
+      "background": {"jobs": 40, "churn": 10, "seed": 13}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Any, Optional
+
+from polyaxon_tpu.sim import traces
+from polyaxon_tpu.sim.traces import TraceEvent, job_op
+
+DEFAULT_HORIZON = 6.0
+
+# Serving request class → scheduler queue, for ring-dump replays.
+_CLASS_QUEUE = {"interactive": "prod", "batch": "batch",
+                "best-effort": "best-effort"}
+
+# Ring annotations that mark a disruption worth replaying as a
+# preemption event (chaos.* matches by prefix).
+_DISRUPTION_EVENTS = ("requeue", "preempted", "retry")
+
+
+def _record_time(record: dict) -> Optional[float]:
+    for key in ("start", "time"):
+        value = record.get(key)
+        if isinstance(value, (int, float)):
+            return float(value)
+    return None
+
+
+def _rebaser(times: list[float], horizon: float):
+    """start-of-incident → 0, end → ``horizon``; an instantaneous
+    incident (or none) maps everything to 0."""
+    if not times:
+        return lambda t: 0.0
+    t0, t1 = min(times), max(times)
+    span = t1 - t0
+    if span <= 0:
+        return lambda t: 0.0
+    scale = horizon / span
+    return lambda t: round(min(max(t - t0, 0.0) * scale, horizon), 6)
+
+
+def trace_from_postmortem(pm: dict, *, horizon: float = DEFAULT_HORIZON,
+                          project: str = "platform") -> list[TraceEvent]:
+    """A flight-recorder dump as an arrival trace: the incident run is
+    resubmitted at t=0 (with restart churn when it died restartable),
+    and every requeue/retry/chaos annotation in its ring replays as a
+    preemption storm at its rebased offset — so the disruption pattern
+    that killed the original run hits the replay fleet in the same
+    relative rhythm."""
+    ring = pm.get("ring") or []
+    times = [t for t in (_record_time(r) for r in ring) if t is not None]
+    rebase = _rebaser(times, horizon)
+    uuid = str(pm.get("run_uuid") or "incident")
+    status = str(pm.get("status") or "").lower()
+    restarts = status in ("failed", "preempted", "retrying")
+    events = [TraceEvent(
+        0.0, "churn" if restarts else "job",
+        job_op(queue="best-effort", restart=restarts,
+               name=f"replay-{uuid[:8]}"),
+        project)]
+    storm_offsets: set[float] = set()
+    for record in ring:
+        t = _record_time(record)
+        if t is None:
+            continue
+        for event in record.get("events") or []:
+            name = str(event.get("name") or "")
+            if name in _DISRUPTION_EVENTS or name.startswith("chaos."):
+                offset = rebase(t)
+                if offset in storm_offsets:
+                    continue
+                storm_offsets.add(offset)
+                events.append(TraceEvent(offset, "storm", None,
+                                         payload={"fraction": 0.5,
+                                                  "source": name}))
+    events.sort(key=lambda e: (e.at, e.kind))
+    return events
+
+
+def trace_from_ring_dump(dump: dict, *, horizon: float = DEFAULT_HORIZON,
+                         project: str = "serving") -> list[TraceEvent]:
+    """A serving request-timeline ring as an arrival trace: each
+    captured request arrives at its rebased submit offset as a short
+    job on the queue its class maps to, so the mixed-class arrival
+    pattern (and any burst that overloaded admission) replays against
+    the real queues."""
+    requests = dump.get("requests") or []
+    starts = []
+    for req in requests:
+        start = (req.get("summary") or {}).get("start")
+        if isinstance(start, (int, float)):
+            starts.append(float(start))
+    rebase = _rebaser(starts, horizon)
+    events: list[TraceEvent] = []
+    for req in requests:
+        summary = req.get("summary") or {}
+        start = summary.get("start")
+        if not isinstance(start, (int, float)):
+            continue
+        klass = str(summary.get("class") or "batch")
+        queue = _CLASS_QUEUE.get(klass, "batch")
+        rid = str(summary.get("request_id") or "req")
+        events.append(TraceEvent(
+            rebase(float(start)), "job",
+            job_op(queue=queue, name=f"req-{rid[:8]}"),
+            project))
+    events.sort(key=lambda e: (e.at, e.kind))
+    return events
+
+
+# ----------------------------------------------------------- scenarios
+def load_scenario(source: Any) -> dict:
+    if isinstance(source, str):
+        with open(source) as fh:
+            source = json.load(fh)
+    if not isinstance(source, dict):
+        raise ValueError("scenario must be a JSON object")
+    kind = source.get("source_kind")
+    if kind not in ("postmortem", "ring"):
+        raise ValueError(f"scenario source_kind must be postmortem|ring, "
+                         f"got {kind!r}")
+    if kind not in source:
+        raise ValueError(f"scenario is missing its {kind!r} payload")
+    return source
+
+
+def scenario_trace(scenario: dict) -> list[TraceEvent]:
+    """Scenario file → full arrival trace: the incident-derived events
+    plus the scenario's seeded background fill (so the replay exercises
+    contention, not an empty fleet). Pure function of the scenario."""
+    horizon = float(scenario.get("horizon", DEFAULT_HORIZON))
+    if scenario["source_kind"] == "postmortem":
+        events = trace_from_postmortem(scenario["postmortem"],
+                                       horizon=horizon)
+    else:
+        events = trace_from_ring_dump(scenario["ring"], horizon=horizon)
+    background = scenario.get("background") or {}
+    rng = random.Random(int(background.get("seed", 0)))
+    for _ in range(int(background.get("jobs", 0))):
+        queue = rng.choice(("batch", "best-effort", None))
+        events.append(TraceEvent(round(rng.uniform(0, horizon), 6), "job",
+                                 job_op(queue=queue),
+                                 rng.choice(traces.PROJECTS)))
+    for _ in range(int(background.get("churn", 0))):
+        events.append(TraceEvent(round(rng.uniform(0, horizon), 6), "churn",
+                                 job_op(queue="best-effort", restart=True),
+                                 rng.choice(traces.PROJECTS)))
+    events.sort(key=lambda e: (e.at, e.kind, e.project))
+    return events
+
+
+def trace_to_json(events: list[TraceEvent]) -> str:
+    """Canonical bytes for a trace — the determinism witness the
+    round-trip test compares (sorted keys, no whitespace, offsets
+    rounded where they were built)."""
+    rows = [{"at": event.at, "kind": event.kind, "spec": event.spec,
+             "project": event.project, "payload": event.payload}
+            for event in events]
+    return json.dumps(rows, sort_keys=True, separators=(",", ":"))
+
+
+def replay_scenario(source: Any, *, seed: int = 0, max_wall: float = 120.0,
+                    capacity: int = 24,
+                    oracle_source: Any = None) -> dict:
+    """Replay one scenario through the real control plane and judge
+    the end state with the oracle. A fresh ``AlertEngine`` (committed
+    ruleset) watches the replay so the alerts_resolved invariant sees
+    this episode's firings, not ambient process state."""
+    from polyaxon_tpu.obs import metrics as obs_metrics
+    from polyaxon_tpu.obs import oracle as obs_oracle
+    from polyaxon_tpu.obs import rules as obs_rules
+    from polyaxon_tpu.sim.fleet import FleetSim
+
+    scenario = load_scenario(source)
+    events = scenario_trace(scenario)
+    invariants = obs_oracle.load_invariants(oracle_source)
+    sim = FleetSim(seed=seed, capacity=capacity)
+    engine = obs_rules.AlertEngine(obs_rules.load_ruleset())
+    baseline = obs_metrics.REGISTRY.snapshot()
+    try:
+        sim_result = sim.run_trace(events, max_wall=max_wall)
+        engine.evaluate(plane=sim.plane)
+        bundle = obs_oracle.TelemetryBundle.from_plane(
+            sim.plane, engine=engine, baseline=baseline)
+        oracle_result = obs_oracle.summarize(
+            obs_oracle.evaluate(invariants, bundle))
+    finally:
+        sim.close()
+    return {
+        "scenario": scenario.get("name"),
+        "source_kind": scenario["source_kind"],
+        "trace_events": len(events),
+        "sim": sim_result,
+        "oracle": oracle_result,
+    }

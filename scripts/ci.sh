@@ -85,6 +85,14 @@ python -m pytest tests/test_prefetch.py -q
 echo "== obs rules (schema-validate the committed ruleset)"
 python -c "from polyaxon_tpu.obs import rules; \
     raise SystemExit(rules._main(['--check']))"
+# Telemetry-oracle schema gate (ISSUE 13): the committed invariant set
+# (polyaxon_tpu/obs/oracle.json) must load clean — unknown kinds/ops,
+# metric names outside the registry catalog, duplicate ids, bad
+# quantiles/objectives all fail HERE, not as an invariant that
+# silently never judges anything.
+echo "== obs oracle (schema-validate the committed invariant set)"
+python -c "from polyaxon_tpu.obs import oracle; \
+    raise SystemExit(oracle._main(['--check']))"
 # Observability stage: span/registry/timeline invariants plus the
 # analysis plane (ISSUE 6) — alert-rule fire→hysteresis→resolve
 # lifecycle, histogram_quantile goldens, label-cardinality cap,
@@ -93,7 +101,7 @@ python -c "from polyaxon_tpu.obs import rules; \
 # wall clock, and a chaos gauntlet that leaves a postmortem.json, a
 # fired-then-resolved retry-storm alert, and an attributed report.
 echo "== observability (spans / registry / rules / reports / flight)"
-python -m pytest tests/test_obs.py -q -m obs
+python -m pytest tests/test_obs.py tests/test_oracle.py -q -m obs
 # Serving-request observability drill (ISSUE 10): concurrent streams
 # against a real continuous server must leave queue→prefill→decode
 # span timelines behind /requests/{id}/timeline, per-class TTFT/TPOT
@@ -128,6 +136,28 @@ JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
 echo "== fleet sim (control-plane tick budgets)"
 JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --quick --check --json '' >/dev/null
 JAX_PLATFORMS=cpu python -m pytest tests/test_sim.py -q -m 'not slow'
+# Mini-gauntlet (ISSUE 13): a compressed composed episode — low-prio
+# train + preemptible tune churn + serving deploys + a preemption
+# storm + a chaos plan — through the REAL scheduler/admission/store,
+# judged EXCLUSIVELY by telemetry-oracle verdicts (obs/oracle.json):
+# all runs terminal, phase accounting closes, zero unresolved alerts.
+echo "== mini-gauntlet (oracle-judged fleet episode)"
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --gauntlet
+# The oracle must be able to FAIL: suppressing the scheduler's
+# preempted-run requeue path strands the storm's victims in PREEMPTED,
+# and the all-runs-terminal invariant must flip the stage to exit 1.
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --gauntlet \
+    --inject stuck-requeue >/dev/null 2>&1; then
+    echo "gauntlet self-test FAILED: stuck requeues passed the oracle"
+    exit 1
+fi
+# Incident replay (ISSUE 13): the committed preemption-storm
+# postmortem converts deterministically into an arrival trace and
+# replays through the real control plane; the oracle must see every
+# run terminal and a clean alert board at the end.
+echo "== incident replay (committed scenario, oracle-judged)"
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim \
+    --replay polyaxon_tpu/sim/scenarios/preemption-storm.json >/dev/null
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives
 # in the compiled HLO, and gate against polyaxon_tpu/perf/budgets.json

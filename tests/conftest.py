@@ -77,6 +77,7 @@ SMOKE_MODULES = {
     "test_api.py", "test_tracking.py", "test_schedules_cache.py",
     "test_joins_events.py", "test_sliced.py", "test_controlplane.py",
     "test_utils_env.py", "test_scheduling.py", "test_analysis.py",
+    "test_oracle.py",
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",
@@ -190,6 +191,13 @@ def pytest_collection_modifyitems(config, items):
             # Observability: span/registry/timeline invariants + the
             # e2e and chaos-drill timelines — its own `-m obs` stage in
             # scripts/ci.sh, and part of tier-1.
+            item.add_marker(pytest.mark.obs)
+        if fname == "test_oracle.py":
+            # Telemetry oracle + incident replay (ISSUE 13): invariant
+            # goldens, rules-interplay, ring-dump round-trip, replay
+            # determinism — rides the `-m obs` stage and is a smoke
+            # module (the two-drain replay round-trip test carries the
+            # `sim` marker on top for the sim-focused slice).
             item.add_marker(pytest.mark.obs)
         if fname == "test_analysis.py":
             # Static-analysis gate (ISSUE 9): golden analyzer fixtures,

@@ -1,0 +1,660 @@
+"""Telemetry oracle + incident replay (ISSUE 13).
+
+Invariant-kind goldens over hand-built telemetry bundles (exact
+verdict/evidence asserts), the schema gate, the fire-then-resolve
+interplay with the alert engine (including history-eviction
+accounting), registry snapshot deltas, the serving ring dump
+round-trip, replay determinism (same postmortem → byte-identical
+trace → identical verdicts across two full control-plane runs), and
+the ``plx ops verify`` / ``ControlPlane.verify`` surfaces.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import oracle as obs_oracle
+from polyaxon_tpu.obs import reqtrace
+from polyaxon_tpu.obs import rules as obs_rules
+from polyaxon_tpu.obs.oracle import (
+    Invariant,
+    OracleError,
+    TelemetryBundle,
+)
+from polyaxon_tpu.sim import replay as sim_replay
+
+SCENARIO = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "polyaxon_tpu", "sim", "scenarios", "preemption-storm.json")
+
+
+def _inv(**kw) -> Invariant:
+    kw.setdefault("id", "t")
+    return Invariant.from_dict(kw)
+
+
+def _run(status="succeeded", uuid="u1", kind="job") -> dict:
+    return {"uuid": uuid, "status": status, "kind": kind,
+            "project": "platform", "name": None}
+
+
+def _one(invariant, bundle) -> dict:
+    verdicts = obs_oracle.evaluate([invariant], bundle)
+    assert len(verdicts) == 1
+    return verdicts[0]
+
+
+# ================================================================= schema
+class TestInvariantSchema:
+    def test_committed_set_validates_and_covers_all_kinds(self):
+        invariants = obs_oracle.check_invariants()
+        ids = [i.id for i in invariants]
+        assert len(ids) == len(set(ids))
+        assert "all-runs-terminal" in ids
+        assert "zero-unresolved-alerts" in ids
+        assert {i.kind for i in invariants} == set(obs_oracle.KINDS)
+
+    @pytest.mark.parametrize("bad,match", [
+        ({"invariants": [{"id": "x", "kind": "nope"}]}, "unknown kind"),
+        ({"invariants": [{"kind": "run_terminal"}]}, "string `id`"),
+        ({"invariants": [{"id": "x", "kind": "metric",
+                          "metric": "polyaxon_runs", "value": 1,
+                          "op": "!="}]}, "unknown op"),
+        ({"invariants": [{"id": "x", "kind": "metric",
+                          "metric": "polyaxon_runs"}]}, "needs a `value`"),
+        ({"invariants": [{"id": "x", "kind": "metric",
+                          "metric": "polyaxon_runs", "value": 1,
+                          "quantile": 1.5}]}, "outside"),
+        ({"invariants": [{"id": "x", "kind": "slo",
+                          "metric": "polyaxon_scheduler_tick_seconds",
+                          "le": 1.0}]}, "needs `le` and `objective`"),
+        ({"invariants": [{"id": "x", "kind": "slo",
+                          "metric": "polyaxon_scheduler_tick_seconds",
+                          "le": 1.0, "objective": 0.0}]}, "objective"),
+        ({"invariants": [{"id": "x", "kind": "run_terminal",
+                          "allow": ["definitely-not-a-status"]}]},
+         "unknown statuses"),
+        ({"invariants": [{"id": "x", "kind": "run_terminal",
+                          "missing": "explode"}]}, "missing policy"),
+    ])
+    def test_malformed_invariants_raise(self, bad, match):
+        with pytest.raises(OracleError, match=match):
+            obs_oracle.load_invariants(bad)
+
+    def test_duplicate_ids_raise(self):
+        with pytest.raises(OracleError, match="duplicate"):
+            obs_oracle.load_invariants({"invariants": [
+                {"id": "x", "kind": "run_terminal"},
+                {"id": "x", "kind": "alerts_resolved"}]})
+
+    def test_unknown_metric_fails_the_gate(self):
+        with pytest.raises(OracleError, match="unknown metric"):
+            obs_oracle.load_invariants({"invariants": [
+                {"id": "x", "kind": "metric",
+                 "metric": "polyaxon_made_up_total", "value": 0}]})
+
+    def test_check_cli_exit_codes(self, tmp_path):
+        assert obs_oracle._main(["--check"]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"invariants": [
+            {"id": "x", "kind": "metric",
+             "metric": "polyaxon_made_up_total", "value": 0}]}))
+        assert obs_oracle._main(["--check", str(bad)]) == 1
+
+
+# =========================================================== run_terminal
+class TestRunTerminal:
+    def test_all_terminal_passes_with_status_census(self):
+        bundle = TelemetryBundle(runs=[_run("succeeded"),
+                                       _run("failed", "u2")])
+        v = _one(_inv(kind="run_terminal"), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["status_counts"] == {"succeeded": 1,
+                                                  "failed": 1}
+
+    def test_stuck_run_fails_with_offender_attached(self):
+        bundle = TelemetryBundle(runs=[_run("succeeded"),
+                                       _run("queued", "u2")])
+        v = _one(_inv(kind="run_terminal"), bundle)
+        assert v["verdict"] == "fail"
+        assert v["evidence"]["offending_runs"] == [
+            {"uuid": "u2", "status": "queued", "kind": "job",
+             "project": "platform"}]
+
+    def test_forbid_list_trumps_allow(self):
+        bundle = TelemetryBundle(runs=[_run("failed")])
+        v = _one(_inv(kind="run_terminal", forbid=["failed"]), bundle)
+        assert v["verdict"] == "fail"
+
+    def test_allow_list_narrows_terminal(self):
+        bundle = TelemetryBundle(runs=[_run("failed")])
+        v = _one(_inv(kind="run_terminal", allow=["succeeded"]), bundle)
+        assert v["verdict"] == "fail"
+
+    def test_missing_policy(self):
+        empty = TelemetryBundle()
+        assert _one(_inv(kind="run_terminal"), empty)["verdict"] == "skip"
+        assert _one(_inv(kind="run_terminal", missing="fail"),
+                    empty)["verdict"] == "fail"
+
+
+# =========================================================== phase_budget
+class TestPhaseBudget:
+    @staticmethod
+    def _bundle(wall, phase_sum):
+        return TelemetryBundle(reports={"u1": {
+            "wall_clock_ms": wall, "phase_sum_ms": phase_sum,
+            "phases": {"step": {"ms": phase_sum}}}})
+
+    def test_accounting_within_tolerance_passes(self):
+        v = _one(_inv(kind="phase_budget", tolerance=0.35),
+                 self._bundle(1000.0, 900.0))
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["reports_judged"] == 1
+
+    def test_lost_time_fails_with_ratio_evidence(self):
+        v = _one(_inv(kind="phase_budget", tolerance=0.35),
+                 self._bundle(1000.0, 500.0))
+        assert v["verdict"] == "fail"
+        assert v["evidence"]["offending_reports"][0]["ratio"] == 0.5
+
+    def test_no_reports_skips(self):
+        v = _one(_inv(kind="phase_budget"), TelemetryBundle())
+        assert v["verdict"] == "skip"
+
+
+# ================================================================= metric
+class TestMetricPredicates:
+    @pytest.fixture()
+    def reg(self):
+        return obs_metrics.MetricsRegistry()
+
+    def test_value_mode_with_label_selector(self, reg):
+        obs_metrics.admission_outcomes(reg).inc(3, outcome="rejected")
+        obs_metrics.admission_outcomes(reg).inc(9, outcome="admitted")
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="metric",
+                      metric="polyaxon_admission_outcomes_total",
+                      labels={"outcome": "rejected"}, op="<=", value=5),
+                 bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["observed"] == 3.0
+
+    def test_missing_zero_treats_absent_series_as_zero(self, reg):
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="metric",
+                      metric="polyaxon_admission_live_divergence_total",
+                      op="<=", value=0, missing="zero"), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["observed"] == 0.0
+
+    def test_missing_skip_and_fail_policies(self, reg):
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        spec = dict(kind="metric", metric="polyaxon_requeues_total",
+                    op="<=", value=0)
+        assert _one(_inv(**spec), bundle)["verdict"] == "skip"
+        assert _one(_inv(**spec, missing="fail"),
+                    bundle)["verdict"] == "fail"
+
+    def test_delta_mode_judges_movement_not_absolutes(self, reg):
+        counter = obs_metrics.requeues_total(reg)
+        counter.inc(100, reason="preempted")
+        baseline = reg.snapshot()
+        counter.inc(2, reason="preempted")
+        bundle = TelemetryBundle(snapshot=reg.snapshot(),
+                                 baseline=baseline)
+        v = _one(_inv(kind="metric", metric="polyaxon_requeues_total",
+                      labels={"reason": "preempted"}, mode="delta",
+                      op="<=", value=5), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["observed"] == 2.0
+
+    def test_delta_mode_without_baseline_skips(self, reg):
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="metric", metric="polyaxon_requeues_total",
+                      mode="delta", op="<=", value=5), bundle)
+        assert v["verdict"] == "skip"
+
+    def test_quantile_golden_interpolates_in_bucket(self, reg):
+        hist = obs_metrics.scheduler_tick_hist(reg)
+        for _ in range(4):
+            hist.observe(0.002)  # all land in the (0.001, 0.0025] bucket
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="metric",
+                      metric="polyaxon_scheduler_tick_seconds",
+                      quantile=0.5, op="<=", value=0.0025), bundle)
+        assert v["verdict"] == "pass"
+        # rank 2 of 4 inside [0.001, 0.0025): 0.001 + 0.0015 * 2/4
+        assert v["evidence"]["observed"] == pytest.approx(0.00175)
+
+    def test_threshold_flips_on_op(self, reg):
+        obs_metrics.retry_attempts(reg).inc(7)
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        spec = dict(kind="metric", metric="polyaxon_retry_attempts_total",
+                    value=5)
+        assert _one(_inv(**spec, op="<="), bundle)["verdict"] == "fail"
+        assert _one(_inv(**spec, op=">"), bundle)["verdict"] == "pass"
+
+
+# ======================================================== loss_continuity
+class TestLossContinuity:
+    @staticmethod
+    def _bundle(windows, restores=0):
+        return TelemetryBundle(reports={"u1": {
+            "steps": {"windows": windows},
+            "phases": ({"restore": {"ms": 1.0, "count": restores}}
+                       if restores else {})}})
+
+    def test_contiguous_windows_pass(self):
+        bundle = self._bundle([
+            {"from_step": 1, "to_step": 50, "loss": 2.5},
+            {"from_step": 51, "to_step": 100, "loss": 2.3}])
+        v = _one(_inv(kind="loss_continuity"), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["runs_judged"] == 1
+
+    def test_skipped_steps_fail_with_both_windows_attached(self):
+        bundle = self._bundle([
+            {"from_step": 1, "to_step": 50},
+            {"from_step": 61, "to_step": 100}], restores=1)
+        v = _one(_inv(kind="loss_continuity"), bundle)
+        assert v["verdict"] == "fail"
+        disc = v["evidence"]["discontinuities"][0]
+        assert disc["problem"] == "skipped 10 step(s)"
+        assert disc["window"]["to_step"] == 50
+        assert disc["next_window"]["from_step"] == 61
+        assert disc["restores"] == 1
+
+    def test_max_gap_steps_allows_bounded_gaps(self):
+        bundle = self._bundle([
+            {"from_step": 1, "to_step": 50},
+            {"from_step": 61, "to_step": 100}])
+        v = _one(_inv(kind="loss_continuity", max_gap_steps=10), bundle)
+        assert v["verdict"] == "pass"
+
+    def test_loss_jump_across_boundary_fails(self):
+        bundle = self._bundle([
+            {"from_step": 1, "to_step": 50, "loss": 2.5},
+            {"from_step": 51, "to_step": 100, "loss": 9.0}])
+        v = _one(_inv(kind="loss_continuity", max_loss_jump=1.0), bundle)
+        assert v["verdict"] == "fail"
+        assert "loss jumped" in (
+            v["evidence"]["discontinuities"][0]["problem"])
+
+    def test_single_window_skips(self):
+        bundle = self._bundle([{"from_step": 1, "to_step": 50}])
+        assert _one(_inv(kind="loss_continuity"),
+                    bundle)["verdict"] == "skip"
+
+
+# ======================================================== alerts_resolved
+class TestAlertsResolved:
+    def test_firing_alert_fails_with_alert_attached(self):
+        bundle = TelemetryBundle(alerts={
+            "alerts": [{"rule": "retry-storm", "severity": "page"}],
+            "rules": [], "history": []})
+        v = _one(_inv(kind="alerts_resolved"), bundle)
+        assert v["verdict"] == "fail"
+        assert v["evidence"]["unresolved_alerts"][0]["rule"] == "retry-storm"
+
+    def test_allowlisted_firing_alert_passes(self):
+        bundle = TelemetryBundle(alerts={
+            "alerts": [{"rule": "retry-storm"}], "rules": [],
+            "history": []})
+        v = _one(_inv(kind="alerts_resolved", allow=["retry-storm"]),
+                 bundle)
+        assert v["verdict"] == "pass"
+
+    def test_resolved_history_passes_and_counts_the_episode(self):
+        bundle = TelemetryBundle(alerts={
+            "alerts": [], "rules": [],
+            "history": [{"event": "fired", "rule": "r"},
+                        {"event": "resolved", "rule": "r"}]})
+        v = _one(_inv(kind="alerts_resolved"), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["fired_total"] == 1
+        assert v["evidence"]["resolved_total"] == 1
+
+
+# ==================================================================== slo
+class TestSlo:
+    @pytest.fixture()
+    def reg(self):
+        return obs_metrics.MetricsRegistry()
+
+    def test_objective_met_passes_with_good_total_evidence(self, reg):
+        hist = obs_metrics.serving_ttft_hist(reg)
+        for _ in range(19):
+            hist.observe(0.1, **{"class": "interactive"})
+        hist.observe(9.0, **{"class": "interactive"})
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="slo", metric="polyaxon_serving_ttft_seconds",
+                      labels={"class": "interactive"}, le=2.5,
+                      objective=0.95), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"] == {
+            "metric": "polyaxon_serving_ttft_seconds",
+            "labels": {"class": "interactive"}, "le": 2.5,
+            "objective": 0.95, "good": 19, "total": 20, "ratio": 0.95}
+
+    def test_objective_missed_fails(self, reg):
+        hist = obs_metrics.serving_ttft_hist(reg)
+        hist.observe(0.1, **{"class": "interactive"})
+        hist.observe(9.0, **{"class": "interactive"})
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="slo", metric="polyaxon_serving_ttft_seconds",
+                      labels={"class": "interactive"}, le=2.5,
+                      objective=0.95), bundle)
+        assert v["verdict"] == "fail"
+
+    def test_le_must_be_a_bucket_bound(self, reg):
+        obs_metrics.serving_ttft_hist(reg).observe(
+            0.1, **{"class": "interactive"})
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="slo", metric="polyaxon_serving_ttft_seconds",
+                      le=3.14159, objective=0.5), bundle)
+        assert v["verdict"] == "skip"
+        assert "not a bucket bound" in v["evidence"]["missing"]
+
+    def test_no_observations_skips(self, reg):
+        obs_metrics.ensure_serving_metrics(reg)
+        bundle = TelemetryBundle(snapshot=reg.snapshot())
+        v = _one(_inv(kind="slo", metric="polyaxon_serving_ttft_seconds",
+                      le=2.5, objective=0.5), bundle)
+        assert v["verdict"] == "skip"
+
+
+# ========================================================= snapshot_delta
+class TestSnapshotDelta:
+    def test_counter_gauge_histogram_deltas(self):
+        reg = obs_metrics.MetricsRegistry()
+        counter = obs_metrics.requeues_total(reg)
+        gauge = reg.gauge("polyaxon_queue_depth", "", ("queue",))
+        hist = obs_metrics.scheduler_tick_hist(reg)
+        counter.inc(5, reason="preempted")
+        gauge.set(10, queue="prod")
+        hist.observe(0.01)
+        baseline = reg.snapshot()
+        counter.inc(2, reason="preempted")
+        gauge.set(4, queue="prod")
+        hist.observe(0.02)
+        hist.observe(0.03)
+        delta = reg.snapshot_delta(baseline)
+        assert delta["absolute"] is False
+        deltas = delta["deltas"]
+        assert deltas["polyaxon_requeues_total"]["series"] == {
+            "preempted": 2.0}
+        assert deltas["polyaxon_queue_depth"]["series"] == {"prod": -6.0}
+        hd = deltas["polyaxon_scheduler_tick_seconds"]["series"][""]
+        assert hd["count"] == 2
+        assert hd["sum"] == pytest.approx(0.05)
+
+    def test_unchanged_series_are_omitted(self):
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.requeues_total(reg).inc(5, reason="preempted")
+        baseline = reg.snapshot()
+        delta = reg.snapshot_delta(baseline)
+        assert delta == {"absolute": False, "deltas": {}}
+
+    def test_no_baseline_returns_absolute_snapshot(self):
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.requeues_total(reg).inc(1, reason="x")
+        delta = reg.snapshot_delta(None)
+        assert delta["absolute"] is True
+        assert "polyaxon_requeues_total" in delta["snapshot"]
+
+
+# ==================================================== rules.py interplay
+class _FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+class TestOracleRulesInterplay:
+    """The zero-unresolved-alerts invariant against a REAL AlertEngine
+    driving its fire→hysteresis→resolve state machine."""
+
+    @staticmethod
+    def _engine(reg, clock):
+        rule = obs_rules.Rule.from_dict({
+            "id": "queue-deep", "kind": "threshold",
+            "metric": "polyaxon_queue_depth", "op": ">", "value": 100,
+            "for": "0s", "description": "t"})
+        return obs_rules.AlertEngine([rule], registry=reg, clock=clock)
+
+    def test_fire_then_resolve_arc(self):
+        reg = obs_metrics.MetricsRegistry()
+        clock = _FakeClock()
+        engine = self._engine(reg, clock)
+        gauge = reg.gauge("polyaxon_queue_depth", "", ("queue",))
+        inv = _inv(kind="alerts_resolved")
+
+        gauge.set(500, queue="fleet")
+        clock.now += 1
+        engine.evaluate()
+        v = _one(inv, TelemetryBundle(alerts=engine.to_json()))
+        assert v["verdict"] == "fail"
+        assert (v["evidence"]["unresolved_alerts"][0]["rule"]
+                == "queue-deep")
+
+        gauge.set(0, queue="fleet")
+        for _ in range(5):  # ride out clear hysteresis
+            clock.now += 60
+            engine.evaluate()
+        v = _one(inv, TelemetryBundle(alerts=engine.to_json()))
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["fired_total"] == 1
+        assert v["evidence"]["resolved_total"] == 1
+
+    def test_history_eviction_is_counted_in_catalogued_metric(self):
+        import collections
+
+        reg = obs_metrics.MetricsRegistry()
+        engine = self._engine(reg, _FakeClock())
+        engine.history = collections.deque(maxlen=2)
+        for i in range(5):
+            engine._append_history({"event": "fired", "i": i})
+        assert len(engine.history) == 2
+        snap = reg.snapshot()["polyaxon_alert_history_evictions_total"]
+        assert snap["series"][""] == 3
+        assert ("polyaxon_alert_history_evictions_total"
+                in obs_metrics.catalog_metric_names())
+
+
+# ============================================================== ring dump
+class TestRingDump:
+    @staticmethod
+    def _ring(n=3):
+        ring = reqtrace.TimelineRing(capacity=8)
+        for i, klass in zip(range(n), ("interactive", "batch",
+                                       "best-effort")):
+            trace = reqtrace.RequestTrace(f"req{i:04d}", klass=klass)
+            trace.start_phase("queue_wait")
+            trace.start_phase("decode")
+            trace.finish("ok")
+            ring.add(trace)
+        return ring
+
+    def test_dump_round_trip(self, tmp_path):
+        ring = self._ring()
+        path = reqtrace.dump_ring(ring, str(tmp_path))
+        assert os.path.basename(path) == reqtrace.TRACE_DUMP_FILE
+        dump = reqtrace.read_ring_dump(str(tmp_path))
+        assert dump["capacity"] == 8
+        assert dump["evicted"] == 0
+        assert [r["summary"]["request_id"] for r in dump["requests"]] == [
+            "req0000", "req0001", "req0002"]
+        # Full span records survive: build_timeline can reconstruct.
+        from polyaxon_tpu.obs.trace import build_timeline
+
+        timeline = build_timeline(dump["requests"][0]["records"],
+                                  trace_id="req0000")
+        assert timeline["spans"][0]["name"] == "request"
+
+    def test_missing_or_corrupt_dump_reads_as_none(self, tmp_path):
+        assert reqtrace.read_ring_dump(str(tmp_path / "nope.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert reqtrace.read_ring_dump(str(bad)) is None
+
+    def test_ring_dump_replays_by_class_queue(self, tmp_path):
+        path = reqtrace.dump_ring(self._ring(), str(tmp_path / "r.json"))
+        dump = reqtrace.read_ring_dump(path)
+        events = sim_replay.trace_from_ring_dump(dump, horizon=4.0)
+        assert len(events) == 3
+        queues = {e.spec["name"]: e.spec.get("queue") for e in events}
+        assert queues == {"req-req0000": "prod", "req-req0001": "batch",
+                          "req-req0002": "best-effort"}
+        assert all(0.0 <= e.at <= 4.0 for e in events)
+
+    def test_engine_stop_dumps_ring(self, tmp_path, monkeypatch):
+        """The batching engine's shutdown hook persists the ring and
+        counts the dump — without standing up a device loop (the dump
+        path is independent of the model)."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine.__new__(ContinuousBatchingEngine)
+        engine.request_tracing = True
+        engine.trace_dump_path = str(tmp_path / "ring.json")
+        engine._ring = self._ring()
+        engine._dump_ring()
+        dump = reqtrace.read_ring_dump(engine.trace_dump_path)
+        assert len(dump["requests"]) == 3
+        snap = obs_metrics.REGISTRY.snapshot().get(
+            "polyaxon_serving_trace_dumps_total")
+        assert snap["series"].get("ok", 0) >= 1
+
+
+# ================================================================= replay
+class TestReplayDeterminism:
+    def test_postmortem_conversion_is_byte_identical(self):
+        scenario = sim_replay.load_scenario(SCENARIO)
+        one = sim_replay.trace_to_json(sim_replay.scenario_trace(scenario))
+        two = sim_replay.trace_to_json(
+            sim_replay.scenario_trace(copy.deepcopy(scenario)))
+        assert one == two
+
+    def test_committed_scenario_shape(self):
+        scenario = sim_replay.load_scenario(SCENARIO)
+        events = sim_replay.scenario_trace(scenario)
+        kinds = {e.kind for e in events}
+        assert "storm" in kinds  # the double-preemption replays
+        assert sum(1 for e in events if e.kind == "storm") == 2
+        incident = [e for e in events
+                    if (e.spec or {}).get("name", "").startswith("replay-")]
+        assert len(incident) == 1 and incident[0].at == 0.0
+
+    def test_rebase_pins_incident_into_horizon(self):
+        pm = {"run_uuid": "abc", "status": "failed", "ring": [
+            {"type": "span", "name": "execute", "start": 5000.0,
+             "events": [{"name": "requeue"}]},
+            {"type": "span", "name": "execute", "start": 5100.0,
+             "events": [{"name": "requeue"}]}]}
+        events = sim_replay.trace_from_postmortem(pm, horizon=2.0)
+        storms = [e.at for e in events if e.kind == "storm"]
+        assert storms == [0.0, 2.0]
+
+    def test_malformed_scenarios_raise(self):
+        with pytest.raises(ValueError, match="source_kind"):
+            sim_replay.load_scenario({"name": "x"})
+        with pytest.raises(ValueError, match="missing"):
+            sim_replay.load_scenario({"source_kind": "ring"})
+
+    @pytest.mark.sim
+    def test_same_scenario_same_verdicts_across_two_runs(self, tmp_path):
+        """Full round trip: the committed postmortem replays through
+        the REAL control plane twice — via the actual `--replay` CLI,
+        each run in its own process so the oracle judges THAT replay's
+        registry, not whatever ambient metrics this pytest process
+        accumulated — and returns the same verdict per invariant both
+        times (timings differ; judgments must not). Background trimmed
+        to keep two full drains fast."""
+        import subprocess
+        import sys
+
+        scenario = sim_replay.load_scenario(SCENARIO)
+        scenario["background"] = {"jobs": 8, "churn": 3, "seed": 13}
+        spath = tmp_path / "scenario.json"
+        spath.write_text(json.dumps(scenario))
+        results = []
+        for i in range(2):
+            out = tmp_path / f"replay{i}.json"
+            proc = subprocess.run(
+                [sys.executable, "-m", "polyaxon_tpu.sim", "--replay",
+                 str(spath), "--json", str(out)],
+                capture_output=True, text=True, timeout=300,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            results.append(json.loads(out.read_text()))
+        verdicts = [[(v["invariant"], v["verdict"])
+                     for v in r["oracle"]["verdicts"]] for r in results]
+        assert verdicts[0] == verdicts[1]
+        assert all(r["oracle"]["passed"] for r in results), verdicts[0]
+        by_id = dict(verdicts[0])
+        assert by_id["all-runs-terminal"] == "pass"
+        assert by_id["zero-unresolved-alerts"] == "pass"
+
+
+# ============================================================== gauntlet
+class TestGauntletUnit:
+    def test_trace_is_deterministic_and_composed(self):
+        from polyaxon_tpu.sim import gauntlet
+
+        one = gauntlet.build_gauntlet_trace(seed=7)
+        two = gauntlet.build_gauntlet_trace(seed=7)
+        assert sim_replay.trace_to_json(one) == sim_replay.trace_to_json(two)
+        kinds = {e.kind for e in one}
+        assert {"serving", "job", "sweep", "churn", "storm"} <= kinds
+
+    def test_unknown_inject_rejected(self):
+        from polyaxon_tpu.sim import gauntlet
+
+        with pytest.raises(ValueError, match="unknown inject"):
+            gauntlet.run_gauntlet(inject="made-up")
+
+
+# ======================================================== verify surfaces
+class TestVerifySurfaces:
+    def test_plane_verify_fleet_and_per_run(self, tmp_path):
+        from polyaxon_tpu.controlplane import ControlPlane
+        from polyaxon_tpu.sim.traces import job_op
+
+        plane = ControlPlane(str(tmp_path / "home"))
+        record = plane.submit(job_op(), project="default")
+        result = plane.verify()
+        assert result["passed"] is False  # a CREATED run is not terminal
+        by_id = {v["invariant"]: v for v in result["verdicts"]}
+        offenders = by_id["all-runs-terminal"]["evidence"]["offending_runs"]
+        assert offenders[0]["uuid"] == record.uuid
+        scoped = plane.verify(record.uuid)
+        assert scoped["run_uuid"] == record.uuid
+        with pytest.raises(KeyError):
+            plane.verify("no-such-uuid")
+
+    def test_cli_ops_verify_and_alert_bounds(self, tmp_path, monkeypatch):
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        monkeypatch.setenv("POLYAXON_TPU_HOME", str(tmp_path / "home"))
+        runner = CliRunner()
+        result = runner.invoke(cli, ["ops", "verify", "--json"])
+        assert result.exit_code in (0, 1)
+        payload = json.loads(result.output)
+        assert {v["invariant"] for v in payload["verdicts"]} >= {
+            "all-runs-terminal", "zero-unresolved-alerts"}
+
+        result = runner.invoke(cli, ["ops", "alerts", "--json",
+                                     "--since", "15m", "--limit", "5"])
+        assert result.exit_code == 0, result.output
+        payload = json.loads(result.output)
+        assert len(payload["history"]) <= 5
+
+        result = runner.invoke(cli, ["ops", "alerts", "--since", "2 eons"])
+        assert result.exit_code != 0
