@@ -225,8 +225,38 @@ class Agent:
                 self.slices.heartbeat(uuid)
             for uuid, kinds in self.slices.tick().items():
                 if "PREEMPTED" in kinds and uuid in self.executor.active_runs:
+                    # Elastic gangs resize in place (shrink to the
+                    # surviving topology) instead of dying; only when
+                    # the resize channel refuses (budget exhausted,
+                    # non-elastic job) does the kill path run.
+                    if self.executor.request_resize(
+                            uuid, "shrink", reason="SliceLost"):
+                        actions += 1
+                        continue
                     self.executor.preempt(uuid)
                     actions += 1
+            # Capacity-return notification: offer a grow to every gang
+            # training shrunk. The controller dedups (one pending resize
+            # at a time) and the prewarm path validates the target mesh,
+            # so a spurious offer is a no-op, not a hazard.
+            for uuid in self.executor.shrunk_elastic_runs():
+                record = self.plane.get_run(uuid)
+                plan = record.launch_plan or {}
+                topology = (plan.get("resources") or {}).get("topology")
+                if topology and self.slices.capacity_available(topology):
+                    if self.executor.request_resize(
+                            uuid, "grow", reason="CapacityReturned"):
+                        # Re-pin the pool placement at the full
+                        # topology (partial regrow). A pool-side
+                        # rejection is a non-event: resize_placement
+                        # rolls back and the prewarm path still gates
+                        # the actual mesh change.
+                        info = sched_info(record)
+                        self.slices.resize_placement(
+                            uuid, topology,
+                            priority=gang_priority(info.queue_priority,
+                                                   info.priority))
+                        actions += 1
             # Release pool chips for runs the executor no longer owns.
             active = set(self.executor.active_runs)
             for uuid in self.slices.tracked_runs():

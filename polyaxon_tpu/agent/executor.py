@@ -34,6 +34,7 @@ from polyaxon_tpu.controlplane.service import ControlPlane
 from polyaxon_tpu.lifecycle import V1Statuses
 from polyaxon_tpu.obs import flight as obs_flight
 from polyaxon_tpu.obs import trace as obs_trace
+from polyaxon_tpu.runtime import elastic as elastic_mod
 
 
 class InitTimeoutError(RuntimeError):
@@ -77,6 +78,11 @@ class _Gang:
     # POLYAXON_TRACE_PARENT, the in-process runtime via a passed tracer.
     tracer: Optional[obs_trace.RunTracer] = None
     span: Optional[obs_trace.Span] = None
+    # Elastic resize channel (runtime.elastic): present only for
+    # in-process jaxjob gangs whose checkpointing makes a cross-mesh
+    # restore possible; slice loss files a shrink here instead of a kill.
+    elastic: Optional[elastic_mod.ElasticController] = None
+    failed_resizes_dumped: int = 0  # postmortems already written
 
 
 class LocalExecutor:
@@ -291,6 +297,7 @@ class LocalExecutor:
                 init_span.set(phases=[p.kind for p in plan.init])
                 self._run_init_phases(plan)
             if self.in_process and self._can_run_in_process(plan):
+                gang.elastic = self._make_elastic(plan)
                 gang.thread = threading.Thread(
                     target=self._run_in_process, args=(gang,), daemon=True
                 )
@@ -377,6 +384,53 @@ class LocalExecutor:
             and ENV_JAXJOB_SPEC in plan.processes[0].env
         )
 
+    def _make_elastic(self, plan: V1LaunchPlan) -> Optional[
+            elastic_mod.ElasticController]:
+        """A resize channel for gangs that can actually survive one:
+        jaxjob with checkpointing + restore-on-start (the segment
+        boundary is a forced save and a cross-mesh restore)."""
+        from polyaxon_tpu.polyflow.runs import V1JAXJob
+
+        try:
+            job = V1JAXJob.from_dict(
+                json.loads(plan.processes[0].env[ENV_JAXJOB_SPEC]))
+        except (KeyError, ValueError):
+            return None
+        if not elastic_mod.elastic_capable(job):
+            return None
+        try:
+            prior = ((self.store.get_run(plan.run_uuid).meta or {})
+                     .get("elastic") or {}).get("attempts")
+        except KeyError:
+            prior = None
+        return elastic_mod.ElasticController(plan.run_uuid,
+                                             prior_attempts=prior)
+
+    def request_resize(self, run_uuid: str, direction: str, *,
+                       reason: str = "",
+                       target_devices: Optional[int] = None) -> bool:
+        """File a resize against a live elastic gang. False means the
+        gang cannot resize (no channel, budget exhausted, already
+        resizing, dead thread) — callers fall back to :meth:`preempt`."""
+        gang = self._gangs.get(run_uuid)
+        if (gang is None or gang.elastic is None or gang.preempted
+                or gang.thread is None or not gang.thread.is_alive()):
+            return False
+        granted = gang.elastic.request(direction, reason=reason,
+                                       target_devices=target_devices)
+        if granted and gang.span is not None:
+            gang.span.add_event("resize_requested", direction=direction,
+                                reason=reason)
+        return granted
+
+    def shrunk_elastic_runs(self) -> list[str]:
+        """Live gangs currently training on a shrunk mesh — the set the
+        agent offers a grow to when slice capacity returns."""
+        return [uuid for uuid, gang in self._gangs.items()
+                if gang.elastic is not None and gang.elastic.shrunk
+                and not gang.preempted
+                and gang.thread is not None and gang.thread.is_alive()]
+
     def _run_in_process(self, gang: _Gang) -> None:
         from polyaxon_tpu.polyflow.runs import V1JAXJob
         from polyaxon_tpu.runtime.loop import run_jaxjob
@@ -398,17 +452,41 @@ class LocalExecutor:
             # Chaos gang seam for the in-process fast path: a thread
             # has no pid to SIGKILL, so a due kill-fault raises inside
             # the step loop — the same abrupt member death, observed
-            # through the same FAILED reap.
+            # through the same FAILED reap. `preempted` stops the loop
+            # too: an in-process gang has no process to kill, so the
+            # preempt signal must reach the step loop itself.
             fault_plan = chaos.active_plan()
             if fault_plan is not None:
                 fault_plan.maybe_kill_gang(plan.run_uuid, ckpt_dir)
-            return gang.stop_event.is_set()
+                if gang.elastic is not None and not gang.elastic.resizing:
+                    # Slice-loss seam, consulted per step so the drill
+                    # is deterministic against checkpoint counts: "kill"
+                    # files a shrink (denied → budget exhausted → plain
+                    # preemption), "restore" files a grow. NOT consulted
+                    # mid-resize: the request would be denied and the
+                    # fired fault swallowed — the next step retries.
+                    op = fault_plan.slice_loss_due(plan.run_uuid, ckpt_dir)
+                    if op == "kill":
+                        if not gang.elastic.request(
+                                "shrink", reason="ChaosSliceLoss"):
+                            gang.preempted = True
+                    elif op == "restore":
+                        gang.elastic.request(
+                            "grow", reason="ChaosCapacityReturned")
+            return gang.stop_event.is_set() or gang.preempted
 
         try:
             tracking.log_status(V1Statuses.RUNNING)
-            result = run_jaxjob(job, artifacts_dir=plan.artifacts_dir,
-                                on_metrics=tracking.log_metrics_cb(),
-                                should_stop=should_stop, tracer=tracer)
+            if gang.elastic is not None:
+                result = elastic_mod.run_elastic(
+                    job, controller=gang.elastic,
+                    artifacts_dir=plan.artifacts_dir,
+                    on_metrics=tracking.log_metrics_cb(),
+                    should_stop=should_stop, tracer=tracer)
+            else:
+                result = run_jaxjob(job, artifacts_dir=plan.artifacts_dir,
+                                    on_metrics=tracking.log_metrics_cb(),
+                                    should_stop=should_stop, tracer=tracer)
             if result.restore_skipped_steps:
                 gang.warning = (
                     f"restored checkpoint step {result.restored_from_step} "
@@ -426,8 +504,17 @@ class LocalExecutor:
             )
             if gang.stop_event.is_set():
                 tracking.log_status(V1Statuses.STOPPED, reason="StopRequested")
+            elif gang.preempted:
+                pass  # the poll reap owns the PREEMPTED transition
             else:
                 tracking.log_succeeded()
+        except elastic_mod.ResizeAborted as exc:
+            # A shrink that could not prewarm (or whose budget ran out)
+            # degrades to the EXISTING preemption path: the poll reap
+            # transitions PREEMPTED and the scheduler backoff-requeues.
+            gang.preempted = True
+            with open(os.path.join(plan.artifacts_dir, "logs", "main-0.log"), "a") as fh:
+                fh.write(f"elastic resize aborted: {exc}\n")
         except Exception as exc:
             gang.thread_error = f"{type(exc).__name__}: {exc}"
             with open(os.path.join(plan.artifacts_dir, "logs", "main-0.log"), "a") as fh:
@@ -460,12 +547,32 @@ class LocalExecutor:
                         live[0].kill()
                     except OSError:
                         pass
+            # Chaos slice-loss seam for gangs WITHOUT a resize channel
+            # (subprocess, or checkpointing off): losing a slice is a
+            # plain preemption — the pre-elastic behavior, kept as the
+            # degradation floor. Elastic gangs consult the seam from
+            # their own step loop (deterministic against checkpoints).
+            for run_uuid, gang in list(self._gangs.items()):
+                if gang.elastic is not None:
+                    continue
+                ckpt_dir = os.path.join(gang.plan.artifacts_dir,
+                                        "checkpoints")
+                if fault_plan.slice_loss_due(run_uuid, ckpt_dir) == "kill":
+                    self.preempt(run_uuid)
         actions = 0
+        for run_uuid, gang in list(self._gangs.items()):
+            # Mirror the resize audit into meta["elastic"] on every poll
+            # while the gang is LIVE: the scheduler's resizing-hold and
+            # the ops surfaces read the store, not the controller.
+            self._flush_elastic(run_uuid, gang)
         for run_uuid, gang in list(self._gangs.items()):
             status = self._gang_status(gang)
             if status is None:
                 continue
             del self._gangs[run_uuid]
+            # Final audit flush: the thread may have finished an attempt
+            # between the live flush above and its exit.
+            self._flush_elastic(run_uuid, gang)
             record = self.store.get_run(run_uuid)
             if record.status == V1Statuses.STOPPING:
                 self._finish_gang_span(gang, final="stopped")
@@ -521,6 +628,36 @@ class LocalExecutor:
                     obs_flight.RECORDER.discard(run_uuid)
             actions += 1
         return actions
+
+    def _flush_elastic(self, run_uuid: str, gang: _Gang) -> None:
+        """Write the controller's audit into ``meta["elastic"]`` when it
+        changed, and dump a postmortem for every newly FAILED resize
+        attempt — a failed resize is evidence worth keeping on disk even
+        when the run survives it (grow failures don't kill the run)."""
+        if gang.elastic is None:
+            return
+        snap = gang.elastic.snapshot(consume_dirty=True)
+        if snap is None:
+            return
+        try:
+            record = self.store.get_run(run_uuid)
+        except KeyError:
+            return
+        meta = dict(record.meta or {})
+        meta["elastic"] = snap
+        self.store.update_run(run_uuid, meta=meta)
+        failed = sum(1 for a in snap["attempts"]
+                     if a["outcome"] == "failed")
+        if failed > gang.failed_resizes_dumped:
+            gang.failed_resizes_dumped = failed
+            last = next(a for a in reversed(snap["attempts"])
+                        if a["outcome"] == "failed")
+            obs_flight.RECORDER.dump(
+                run_uuid, gang.plan.artifacts_dir,
+                status=V1Statuses.RUNNING.value, reason="ResizeFailed",
+                message=(f"{last['direction']} {last['from_devices']}→"
+                         f"{last['to_devices']} devices: "
+                         f"{last.get('error', '')}")[:500])
 
     def _gang_status(self, gang: _Gang) -> Optional[int]:
         """None while running; else first nonzero exit code of the gang.

@@ -22,6 +22,13 @@ from polyaxon_tpu.native import SlicePool, SlicedError
 logger = logging.getLogger(__name__)
 
 
+def _chips_of(topology: str) -> int:
+    n = 1
+    for d in topology.lower().split("x"):
+        n *= int(d)
+    return n
+
+
 class SliceManager:
     def __init__(
         self,
@@ -78,6 +85,55 @@ class SliceManager:
             self._gangs[run_uuid] = gang_id
         return self.pool.gang(gang_id).state
 
+    def resize_placement(self, run_uuid: str, topology: str, *,
+                         priority: Optional[int] = None,
+                         max_restarts: int = 0,
+                         preemptible: bool = False) -> str:
+        """Partial vacate / regrow (elastic gangs — ISSUE 14): re-place
+        a LIVE gang at a different topology without the all-or-nothing
+        preempted→requeue round trip. The current subgrid is released
+        and the new one requested in its place; a grow that does not
+        place *immediately* (``unplaceable`` OR parked ``pending`` in
+        the pool queue) restores the old placement, so the still-running
+        gang never trains on chips it no longer holds — a queued resize
+        would let the pool hand its working set to someone else."""
+        if not topology:
+            return "running"
+        try:
+            placed = self.placement(run_uuid)
+        except SlicedError:  # gang erased pool-side (e.g. slice removed)
+            placed = None
+        old_topology = placed.topology if placed is not None else None
+        self.release(run_uuid)
+        state = self.ensure_placed(run_uuid, topology, priority=priority,
+                                   max_restarts=max_restarts,
+                                   preemptible=preemptible)
+        if state != "running" and old_topology:
+            # Roll back: drop the failed/queued request and re-pin the
+            # old footprint — its chips were just freed, so the
+            # original placement always fits again.
+            self.release(run_uuid)
+            self.ensure_placed(run_uuid, old_topology, priority=priority,
+                               max_restarts=max_restarts,
+                               preemptible=preemptible)
+        return state
+
+    def capacity_available(self, topology: str) -> bool:
+        """Capacity-return notification: True when some registered
+        slice has enough free chips for ``topology`` right now — the
+        signal the agent polls to grow shrunk elastic gangs back. Free
+        chips are necessary, not sufficient (ICI contiguity is decided
+        by the pool), so callers must treat a later placement rejection
+        as a non-event."""
+        need = _chips_of(topology)
+        for name, _topo, _pre in self._slices:
+            try:
+                if self.pool.free_chips(name) >= need:
+                    return True
+            except SlicedError:
+                continue
+        return False
+
     def placement(self, run_uuid: str):
         gang_id = self._gangs.get(run_uuid)
         return self.pool.gang(gang_id) if gang_id is not None else None
@@ -96,15 +152,9 @@ class SliceManager:
     def stats(self) -> dict:
         """Pool state for the API/dashboard: per-slice capacity and the
         gangs currently placed (the operator view of the C++ pool)."""
-        def chips_of(topology: str) -> int:
-            n = 1
-            for d in topology.lower().split("x"):
-                n *= int(d)
-            return n
-
         slices = []
         for name, topology, preemptible in self._slices:
-            total = chips_of(topology)
+            total = _chips_of(topology)
             try:
                 free = self.pool.free_chips(name)
             except SlicedError:  # removed from the pool since init

@@ -13,7 +13,13 @@ one of four seams the orchestration spine crosses on every run:
 - ``checkpoint`` — corrupt the LATEST checkpoint step's bytes on disk
                    right before a restore, so the fallback path runs;
 - ``tick``       — swallow the Nth scheduler tick (a stalled control
-                   plane), proving ticks are idempotent.
+                   plane), proving ticks are idempotent;
+- ``slice-loss`` — elastic gangs (ISSUE 14): op ``kill`` takes a slice
+                   away mid-train (an elastic gang files a *shrink*
+                   resize; a non-elastic gang is preempted), op
+                   ``restore`` returns the capacity (files a *grow*).
+                   ``min_checkpoints`` gates like the gang seam, and a
+                   ``restore`` is only eligible after a ``kill`` fired.
 
 Activation: tests call :func:`polyaxon_tpu.chaos.install`; operators
 point ``POLYAXON_TPU_CHAOS_PLAN`` at a JSON file (or inline JSON) or
@@ -29,7 +35,9 @@ Plan JSON::
        "config": {"error": "transient"}},
       {"seam": "gang", "op": "kill", "config": {"min_checkpoints": 2}},
       {"seam": "checkpoint", "op": "corrupt_latest"},
-      {"seam": "tick", "op": "skip", "at": 3}
+      {"seam": "tick", "op": "skip", "at": 3},
+      {"seam": "slice-loss", "op": "kill", "config": {"min_checkpoints": 2}},
+      {"seam": "slice-loss", "op": "restore", "config": {"min_checkpoints": 4}}
     ]}
 
 ``at`` is 1-based over MATCHING events; ``times`` consecutive events
@@ -166,6 +174,34 @@ class ChaosPlan:
         if self.gang_kill_due(run_uuid, ckpt_dir):
             raise ChaosKill(
                 f"chaos: gang member of run {run_uuid} killed by fault plan")
+
+    def slice_loss_due(self, run_uuid: str, ckpt_dir: str) -> Optional[str]:
+        """Return ``"kill"`` or ``"restore"`` when a slice-loss fault is
+        due for this run (once per fault budget), else None.
+
+        ``min_checkpoints`` gates each fault on the run having persisted
+        that many checkpoint steps — a resize needs something to restore
+        — and a ``restore`` (capacity returned → grow) is only eligible
+        after a ``kill`` has fired, so a plan cannot regrow a gang it
+        never shrank. Ineligible events are not counted (the
+        ``gang_kill_due`` rule)."""
+        pending = [f for f in self.faults
+                   if f.seam == "slice-loss" and not f.exhausted]
+        if not pending:
+            return None
+        killed = any(f.seam == "slice-loss" and f.op == "kill" and f.fired
+                     for f in self.faults)
+        for fault in pending:
+            op = "kill" if fault.op == "*" else fault.op
+            if op == "restore" and not killed:
+                continue
+            need = int(fault.config.get("min_checkpoints", 0))
+            if need and _checkpoint_steps(ckpt_dir) < need:
+                continue  # not an eligible event yet: don't count it
+            if self.fire("slice-loss", op, detail=run_uuid) is not None:
+                return op
+            return None
+        return None
 
     def maybe_stall_init(self, phase_kind: str) -> float:
         """Stall seam for executor init phases; returns seconds slept."""

@@ -377,6 +377,12 @@ class Scheduler:
         not consume a retry unless the spec says so — TPU-native rule).
         The requeue goes through the backoff gate so a flapping spot
         slice cannot hot-loop preempt→requeue→preempt."""
+        # A run mid-resize is NOT a requeue candidate: the elastic
+        # executor is shrinking/regrowing it in place (runtime.elastic)
+        # and will either resume it RUNNING or clear the flag before the
+        # PREEMPTED fallback reap — requeueing now would double-run it.
+        if ((record.meta or {}).get("elastic") or {}).get("resizing"):
+            return 0
         op = get_operation(record.spec)
         term = op.termination or (op.component.termination if op.component else None)
         counts = bool(term and term.preemption_counts_as_retry)

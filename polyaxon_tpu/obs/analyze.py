@@ -37,7 +37,7 @@ from typing import Any, Iterator, Optional
 # compute vs input wait; anything not covered lands in `other`.
 PHASE_ORDER = ("compile", "queue_wait", "scheduling", "init", "jit_compile",
                "restore", "step", "input_wait", "checkpoint", "eval",
-               "requeue_wait", "sync", "other")
+               "resize", "requeue_wait", "sync", "other")
 
 # Span names that are containers (frames around children), not phases.
 _CONTAINER_SPANS = {"execute", "runtime"}
@@ -45,7 +45,8 @@ _CONTAINER_SPANS = {"execute", "runtime"}
 _LEAF_PHASES = {"compile": "compile", "admission": "scheduling",
                 "placement": "scheduling", "init": "init",
                 "jit_compile": "jit_compile", "restore": "restore",
-                "checkpoint": "checkpoint", "eval": "eval", "sync": "sync"}
+                "checkpoint": "checkpoint", "eval": "eval", "sync": "sync",
+                "resize": "resize"}
 
 MAD_K = 3.5          # deviation threshold, in robust sigmas
 MAD_SCALE = 1.4826   # MAD → sigma under normality

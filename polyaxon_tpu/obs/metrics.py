@@ -707,6 +707,20 @@ def oracle_verdicts_total(registry: MetricsRegistry = REGISTRY) -> Counter:
         ("verdict",))
 
 
+def elastic_resizes_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_elastic_resizes_total",
+        "Elastic gang resize attempts by direction (shrink / grow) and "
+        "outcome (ok / failed) — runtime.elastic",
+        ("direction", "outcome"))
+
+
+def elastic_resize_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_elastic_resize_seconds",
+        "Wall seconds per elastic resize attempt (prewarm + commit)")
+
+
 def serving_trace_dumps_total(registry: MetricsRegistry = REGISTRY) -> Counter:
     return registry.counter(
         "polyaxon_serving_trace_dumps_total",
@@ -730,6 +744,8 @@ def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     training_step_hist(registry)
     alert_history_evictions(registry)
     oracle_verdicts_total(registry)
+    elastic_resizes_total(registry)
+    elastic_resize_hist(registry)
 
 
 # Families registered at scrape time (api/server.py) rather than by an

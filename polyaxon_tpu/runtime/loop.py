@@ -100,6 +100,7 @@ def run_jaxjob(
     artifacts_dir: Optional[str] = None,
     on_metrics: Optional[MetricsCallback] = None,
     devices: Optional[list] = None,
+    mesh_axes: Optional[dict[str, int]] = None,
     should_stop: Optional[Callable[[], bool]] = None,
     tracer: Optional[obs_trace.RunTracer] = None,
 ) -> TrainResult:
@@ -129,6 +130,7 @@ def run_jaxjob(
             compile_cache.resolve_cache_dir(cfg.compile_cache_dir)):
         return _run_jaxjob(job, cfg, artifacts_dir=artifacts_dir,
                            on_metrics=on_metrics, devices=devices,
+                           mesh_axes=mesh_axes,
                            should_stop=should_stop, tracer=tracer,
                            close_tracer=close_tracer)
 
@@ -141,10 +143,15 @@ def _run_jaxjob(
     on_metrics: Optional[MetricsCallback],
     devices: Optional[list],
     should_stop: Optional[Callable[[], bool]],
+    mesh_axes: Optional[dict[str, int]] = None,
     tracer: Optional[obs_trace.RunTracer] = None,
     close_tracer: bool = False,
 ) -> TrainResult:
-    mesh = build_mesh(job.mesh, job.get_topology(), devices=devices)
+    # An explicit `mesh_axes` overrides the spec's resolved axes — the
+    # elastic resize path compiles the SAME job for a shrunk/regrown
+    # device subset whose axis product no longer matches the spec.
+    mesh = build_mesh(job.mesh, job.get_topology(), devices=devices,
+                      axes=mesh_axes)
     rules = rules_for_mesh(mesh)
     logger.info("mesh axes=%s devices=%d", dict(zip(mesh.axis_names, mesh.devices.shape)),
                 mesh.devices.size)
@@ -337,7 +344,12 @@ def _run_jaxjob(
         t_emit = time.perf_counter()
         # polycheck: ignore[hotpath-wallclock] -- observability timestamp: span wall-clock twin of t_emit; never feeds training state or replay
         t_emit_wall = time.time()  # wall twin of t_emit for step spans
-        steps_since_emit = 0
+        # The warm-up step above consumed batch `start_step` and
+        # advanced the state — it is a REAL training step, so the first
+        # emission window starts at 1, making step windows contiguous
+        # from `start_step` across restore/resize segment boundaries
+        # (the oracle's loss_continuity invariant reads these windows).
+        steps_since_emit = 1
         emitted_compile = False
         wait_window = 0.0  # host seconds blocked on data, per emission
         wait_total = 0.0   # ... over all timed steps
@@ -476,6 +488,35 @@ def _run_jaxjob(
                     on_metrics(max(int(state["step"]) - 1, 0), last_eval)
             final_metrics.update(last_eval)
         final_step = int(state["step"])
+
+        # Flush the partial un-emitted window (an early stop — resize,
+        # preemption, stop request — lands between emissions): without
+        # this span the last `steps_since_emit` trained steps would be
+        # a gap in the step-window stream and loss_continuity could not
+        # hold across a resize boundary.
+        if tracer is not None and steps_since_emit:
+            window = time.perf_counter() - t_emit
+            flush_to = final_step - 1
+            attrs = {
+                "from_step": flush_to - steps_since_emit + 1,
+                "to_step": flush_to,
+                "steps": steps_since_emit,
+            }
+            if window > 0:
+                attrs["step_time_ms"] = round(
+                    1e3 * window / steps_since_emit, 3)
+                attrs["input_wait_ms"] = round(
+                    1e3 * wait_window / steps_since_emit, 3)
+                obs_metrics.training_step_hist().observe(
+                    window / steps_since_emit)
+            if "loss" in final_metrics:
+                attrs["loss"] = round(final_metrics["loss"], 3)
+            tracer.record_completed(
+                # polycheck: ignore[hotpath-wallclock] -- observability timestamp: one span end after the loop has exited
+                "step", start=t_emit_wall, end=time.time(),
+                parent_id=(run_span.span_id if run_span is not None
+                           else None),
+                attributes=attrs)
 
         if ckpt:
             with _span(tracer, "checkpoint", step=final_step, final=True):

@@ -20,6 +20,7 @@ import random
 import time
 
 from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import metrics as obs_metrics
 
 
 class SyntheticExecutor:
@@ -27,18 +28,25 @@ class SyntheticExecutor:
 
     def __init__(self, plane, *, mean_duration: float = 0.05,
                  duration_jitter: float = 0.5, failure_rate: float = 0.0,
-                 seed: int = 0):
+                 seed: int = 0, resize_duration: float = 0.05):
         self.plane = plane
         self.store = plane.store
         self.mean_duration = mean_duration
         self.duration_jitter = duration_jitter
         self.failure_rate = failure_rate
+        self.resize_duration = resize_duration
+        # stuck-resize inject (sim.gauntlet): completions suppressed,
+        # the meta `resizing` flag never clears, and the oracle's
+        # all-runs-terminal invariant must flip the gate.
+        self.suppress_resize_completion = False
         self.rng = random.Random(seed)
-        # uuid -> [deadline, outcome, stopping, preempted]
+        # uuid -> [deadline, outcome, stopping, preempted, elastic|None]
         self._gangs: dict[str, list] = {}
         self._heap: list[tuple[float, str]] = []  # (deadline, uuid)
+        self._resizes: list[tuple[float, str, str]] = []  # (due, uuid, dir)
         self.started_total = 0
         self.reaped_total = 0
+        self.resized_total = 0
 
     # ------------------------------------------------------------ sampling
     def _sample_duration(self, record) -> float:
@@ -66,32 +74,123 @@ class SyntheticExecutor:
             self.store.transition(run_uuid, V1Statuses.RUNNING)
         deadline = time.monotonic() + self._sample_duration(record)
         self._gangs[run_uuid] = [deadline, self._sample_outcome(record),
-                                 False, False]
+                                 False, False, None]
         heapq.heappush(self._heap, (deadline, run_uuid))
         self.started_total += 1
         return True
 
+    # -------------------------------------------------------- elastic resize
+    def request_resize(self, run_uuid: str, direction: str, *,
+                       reason: str = "",
+                       target_devices=None) -> bool:
+        """Synthetic mirror of ``LocalExecutor.request_resize``: the gang
+        pauses for ``resize_duration``, then the attempt commits on a
+        later poll (metrics + the ``meta["elastic"]`` audit trail). The
+        same grant rules apply — one in-flight resize, bounded budget,
+        grow only after a shrink."""
+        gang = self._gangs.get(run_uuid)
+        if gang is None or gang[2] or gang[3]:
+            return False
+        elastic = gang[4]
+        if elastic is None:
+            elastic = {"budget": 2, "used": 0, "resizing": False,
+                       "shrunk": False, "attempts": []}
+            gang[4] = elastic
+        if elastic["resizing"] or elastic["used"] >= elastic["budget"]:
+            return False
+        if direction == "grow" and not elastic["shrunk"]:
+            return False
+        elastic["used"] += 1
+        elastic["resizing"] = True
+        elastic["attempts"].append(
+            {"direction": direction, "reason": reason, "outcome": "pending"})
+        self._write_elastic_meta(run_uuid, elastic)
+        gang[0] += self.resize_duration  # training pauses for the resize
+        heapq.heappush(
+            self._resizes,
+            (time.monotonic() + self.resize_duration, run_uuid, direction))
+        return True
+
+    def _write_elastic_meta(self, run_uuid: str, elastic: dict) -> None:
+        record = self.store.get_run(run_uuid)
+        meta = dict(record.meta or {})
+        meta["elastic"] = {**elastic,
+                           "attempts": [dict(a) for a in elastic["attempts"]]}
+        self.store.update_run(run_uuid, meta=meta)
+
+    def _complete_resizes(self, now: float) -> int:
+        if self.suppress_resize_completion:
+            return 0  # inject: the resize never lands, the flag stays up
+        done = 0
+        while self._resizes and self._resizes[0][0] <= now:
+            _, run_uuid, direction = heapq.heappop(self._resizes)
+            gang = self._gangs.get(run_uuid)
+            if gang is None or gang[4] is None:
+                continue  # reaped mid-resize (storm preempt / stop)
+            elastic = gang[4]
+            elastic["resizing"] = False
+            elastic["shrunk"] = direction == "shrink"
+            elastic["attempts"][-1]["outcome"] = "ok"
+            self._write_elastic_meta(run_uuid, elastic)
+            obs_metrics.elastic_resizes_total().inc(
+                direction=direction, outcome="ok")
+            obs_metrics.elastic_resize_hist().observe(self.resize_duration)
+            self.resized_total += 1
+            done += 1
+        return done
+
     def poll(self) -> int:
         now = time.monotonic()
+        actions = 0
+        if self._resizes and self._resizes[0][0] <= now:
+            with self.store.transaction():
+                actions += self._complete_resizes(now)
         if not self._heap or self._heap[0][0] > now:
-            return 0
+            return actions
         # All reaps due this tick commit as one batch (one WAL fsync
         # instead of one per reaped gang — the sim reaps in bulk).
         with self.store.transaction():
-            return self._reap_due(now)
+            return actions + self._reap_due(now)
 
     def _reap_due(self, now: float) -> int:
         actions = 0
         while self._heap and self._heap[0][0] <= now:
             _, run_uuid = heapq.heappop(self._heap)
-            gang = self._gangs.pop(run_uuid, None)
+            gang = self._gangs.get(run_uuid)
             if gang is None:
                 continue  # stale heap entry (stopped/preempted earlier)
-            deadline, outcome, stopping, preempted = gang
+            deadline, outcome, stopping, preempted, elastic = gang
+            if not stopping and not preempted:
+                if elastic is not None and elastic["resizing"]:
+                    # Mid-resize gangs are not reapable (the sim twin of
+                    # the scheduler's resizing-hold); revisit once the
+                    # resize lands. Under the stuck-resize inject this
+                    # loops forever and the drain times out — by design.
+                    heapq.heappush(
+                        self._heap, (now + self.resize_duration, run_uuid))
+                    continue
+                if deadline > now:
+                    # Resize pauses pushed the authoritative deadline
+                    # past this (stale) heap entry.
+                    heapq.heappush(self._heap, (deadline, run_uuid))
+                    continue
+            self._gangs.pop(run_uuid)
             record = self.store.get_run(run_uuid)
             if stopping or record.status == V1Statuses.STOPPING:
                 self.store.transition(run_uuid, V1Statuses.STOPPED)
             elif preempted:
+                if (elastic is not None and elastic["resizing"]
+                        and not self.suppress_resize_completion):
+                    # Reap-time flush (the LocalExecutor contract): a
+                    # gang dying mid-resize fails the attempt and clears
+                    # the flag, else the scheduler's resizing-hold would
+                    # strand the PREEMPTED fallback requeue forever.
+                    elastic["resizing"] = False
+                    elastic["attempts"][-1]["outcome"] = "failed"
+                    self._write_elastic_meta(run_uuid, elastic)
+                    obs_metrics.elastic_resizes_total().inc(
+                        direction=elastic["attempts"][-1]["direction"],
+                        outcome="failed")
                 self.store.transition(
                     run_uuid, V1Statuses.PREEMPTED,
                     reason="SlicePreempted", force=True)
@@ -120,6 +219,11 @@ class SyntheticExecutor:
         gang[3] = True
         heapq.heappush(self._heap, (0.0, run_uuid))
         return True
+
+    def shrunk_elastic_runs(self) -> list[str]:
+        return [uuid for uuid, gang in self._gangs.items()
+                if gang[4] is not None and gang[4]["shrunk"]
+                and not gang[2] and not gang[3]]
 
     @property
     def active_runs(self) -> list[str]:

@@ -30,6 +30,7 @@ from polyaxon_tpu.sim.executor import SyntheticExecutor
 # Synthetic workload meta hints (read by SyntheticExecutor).
 _SERVING_DURATION = 30.0  # deploys hold capacity ~forever at sim scale
 _CHURN_FAILURE_RATE = 0.7
+_ELASTIC_DURATION = 4.0  # elastic train jobs outlive the resize lane
 
 
 def percentile(values: list[float], q: float) -> float:
@@ -82,6 +83,7 @@ class FleetSim:
         self.tick_queries: list[int] = []
         self.tick_rows: list[int] = []
         self.submitted_total = 0
+        self._elastic_uuids: list[str] = []  # slice-loss lane targets
 
     # ------------------------------------------------------------ submit
     def _submit_event(self, event: traces.TraceEvent) -> None:
@@ -91,12 +93,27 @@ class FleetSim:
             for uuid in active[: int(len(active) * fraction)]:
                 self.executor.preempt(uuid)
             return
+        if event.kind == "slice-loss":
+            # Elastic lane: "kill" shrinks a live elastic gang in place,
+            # "restore" offers the grow back — the sim twin of the
+            # chaos slice-loss seam (runtime.elastic / ISSUE 14).
+            op = (event.payload or {}).get("op", "kill")
+            direction = "shrink" if op == "kill" else "grow"
+            for uuid in self._elastic_uuids:
+                if uuid in self.executor.active_runs:
+                    self.executor.request_resize(
+                        uuid, direction, reason="ChaosSliceLoss")
+                    break
+            return
         record = self.plane.submit(event.spec, project=event.project)
         hints = {}
         if event.kind == "serving":
             hints["sim_duration"] = _SERVING_DURATION
         elif event.kind == "churn":
             hints["sim_failure_rate"] = _CHURN_FAILURE_RATE
+        elif event.kind == "elastic":
+            hints["sim_duration"] = _ELASTIC_DURATION
+            self._elastic_uuids.append(record.uuid)
         if hints:
             meta = dict(record.meta or {})
             meta.update(hints)

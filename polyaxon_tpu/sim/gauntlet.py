@@ -47,7 +47,7 @@ logger = logging.getLogger(__name__)
 
 GAUNTLET_SEED = 7
 HORIZON = 6.0
-INJECTS = ("stuck-requeue",)
+INJECTS = ("stuck-requeue", "stuck-resize")
 # The invariants a green gauntlet must have actually judged (verdict
 # `pass`, not `skip`): terminal end state and a clean alert board are
 # the whole point of the episode.
@@ -77,6 +77,14 @@ def build_gauntlet_trace(seed: int = GAUNTLET_SEED) -> list[TraceEvent]:
         TraceEvent(0.2, "job",
                    job_op(queue="batch", name="train-lowpri"),
                    "research"),
+        # The elastic lane (ISSUE 14): a long train job loses a slice
+        # mid-run (shrink in place), capacity returns (grow back) — in
+        # sim time, via SyntheticExecutor.request_resize.
+        TraceEvent(0.2, "elastic",
+                   job_op(queue="batch", name="train-elastic"),
+                   "research"),
+        TraceEvent(1.5, "slice-loss", None, payload={"op": "kill"}),
+        TraceEvent(2.5, "slice-loss", None, payload={"op": "restore"}),
         TraceEvent(0.5, "sweep", sweep_op(8, queue="batch"), "research"),
     ]
     for _ in range(12):
@@ -155,6 +163,14 @@ def run_gauntlet(*, seed: int = GAUNTLET_SEED,
         # the storm's victims sit PREEMPTED past the drain timeout, and
         # all-runs-terminal MUST flip the episode to failure.
         sim.agent.scheduler._tick_preempted = lambda record: 0
+        max_wall = min(max_wall, 20.0)
+    elif inject == "stuck-resize":
+        # The elastic self-test: the slice-loss lane's shrink never
+        # completes, so the gang is never reapable (or, if the storm
+        # kills it first, its stale `resizing` meta holds the PREEMPTED
+        # requeue) — either way the drain times out and
+        # all-runs-terminal MUST flip the episode to failure.
+        sim.executor.suppress_resize_completion = True
         max_wall = min(max_wall, 20.0)
     chaos.install(chaos.ChaosPlan.load(_CHAOS_PLAN))
     baseline = obs_metrics.REGISTRY.snapshot()

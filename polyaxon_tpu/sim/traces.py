@@ -34,6 +34,7 @@ QUEUES = ({"name": "prod", "priority": 10, "preemptible": False},
 class TraceEvent:
     at: float  # seconds from trace start (compressed time)
     kind: str  # job | sweep | dag | schedule | serving | churn | storm
+    #          # | elastic | slice-loss (the elastic resize lane)
     spec: dict | None = None  # operation spec for submit kinds
     project: str = "platform"
     payload: dict | None = None  # non-submit actions (storm fraction, ...)

@@ -66,6 +66,15 @@ fi
 # and serving load-shedding all exercised under injected faults.
 echo "== chaos drills (fixed-seed fault plans)"
 python -m pytest tests/test_chaos.py -q -m chaos
+# Elastic-gang stage (ISSUE 14): chaos kills slices mid-train twice
+# against the REAL agent/scheduler/runtime — the run must SUCCEED with
+# both resizes (shrink then regrow) recorded as timeline spans and
+# loss-curve continuity judged by the telemetry oracle; the
+# budget-exhausted path must degrade cleanly to PREEMPTED → backoff
+# requeue; the slow-marked prewarm-failure drills (induced PrewarmError
+# on shrink and on grow) prove the fallback-to-requeue seam.
+echo "== elastic gangs (shrink/regrow drills + prewarm fallbacks)"
+python -m pytest tests/test_elastic.py -q -m elastic
 # Scheduling stage: multi-tenant admission invariants (queue priority,
 # fair-share convergence, quota walls, bounded starvation, the
 # preemption-for-priority drill) — deterministic and CPU-only.
@@ -149,6 +158,14 @@ JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --gauntlet
 if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --gauntlet \
     --inject stuck-requeue >/dev/null 2>&1; then
     echo "gauntlet self-test FAILED: stuck requeues passed the oracle"
+    exit 1
+fi
+# ...and so must the elastic lane: wedging resize completion strands
+# the shrink mid-flight (resizing=True forever), and the oracle's
+# all-runs-terminal invariant must flip the stage to exit 1.
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --gauntlet \
+    --inject stuck-resize >/dev/null 2>&1; then
+    echo "gauntlet self-test FAILED: stuck resize passed the oracle"
     exit 1
 fi
 # Incident replay (ISSUE 13): the committed preemption-storm

@@ -206,6 +206,11 @@ def pytest_collection_modifyitems(config, items):
             # own `-m analysis` stage in scripts/ci.sh, whole module in
             # the smoke tier.
             item.add_marker(pytest.mark.analysis)
+        if fname == "test_elastic.py":
+            # Elastic gangs (ISSUE 14): shrink/regrow drills, resize
+            # budget fallback, prewarm contract — its own `-m elastic`
+            # stage in scripts/ci.sh, and part of tier-1.
+            item.add_marker(pytest.mark.elastic)
         if fname == "test_sim.py":
             # Fleet simulator (ISSUE 8): traces, synthetic executor,
             # budget gate, query-count regressions — its own `-m sim`

@@ -519,6 +519,35 @@ class TestRuleLifecycle:
         transitions = [e["event"] for e in engine.history]
         assert transitions == ["fired", "resolved"]
 
+    def test_elastic_resize_storm_fires_and_resolves(self):
+        """The committed elastic-resize-storm rule (ISSUE 14): flapping
+        slices drive resizes above 0.05/s, the rule fires, and resolves
+        once the 2m window slides past the burst."""
+        (committed,) = [r for r in obs_rules.load_ruleset()
+                        if r.id == "elastic-resize-storm"]
+        assert committed.metric == "polyaxon_elastic_resizes_total"
+        assert committed.kind == "rate"
+        registry = obs_metrics.MetricsRegistry()
+        counter = registry.counter("polyaxon_elastic_resizes_total", "",
+                                   ("direction", "outcome"))
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([committed], registry=registry,
+                                       clock=clock)
+        counter.inc(0, direction="shrink", outcome="ok")  # series exists
+        engine.evaluate()  # baseline sample at value 0
+        clock.now += 10
+        counter.inc(3, direction="shrink", outcome="ok")
+        counter.inc(2, direction="grow", outcome="ok")
+        counter.inc(1, direction="shrink", outcome="failed")
+        # 6 resizes / 10s = 0.6/s > 0.05/s, summed across the series.
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "elastic-resize-storm"
+        assert fired["value"] == pytest.approx(0.6)
+        clock.now += 240  # slides the 120s window past the burst
+        engine.evaluate()
+        assert [e["event"] for e in engine.history] == ["fired", "resolved"]
+
     def test_threshold_against_derived_value_step_regression(self):
         """value_from: p99 > 3x p50 — the relative rule the default
         step-time-regression alert uses."""
@@ -793,6 +822,33 @@ class TestReportUnit:
         assert notes["retries"] == {"init": 1}
         assert notes["chaos"] == {"init": 1}
         assert notes["requeues"] == {"RestartPolicy": 1}
+
+    def test_resize_spans_attributed_to_resize_phase(self):
+        """Elastic resize windows (ISSUE 14) are a first-class phase:
+        their wall time must land under ``resize``, not ``other``."""
+        def span(name, sid, start, end, parent=None, attrs=None):
+            return {"type": "span", "name": name, "span_id": sid,
+                    "parent_id": parent, "trace_id": "r", "start": start,
+                    "end": end, "duration_ms": (end - start) * 1e3,
+                    "status": "ok", "attributes": attrs or {}, "events": []}
+
+        records = [
+            span("execute", "x", 0.0, 10.0),
+            span("runtime", "r", 0.0, 10.0, parent="x"),
+            span("resize", "z1", 4.0, 4.6, parent="r",
+                 attrs={"direction": "shrink", "outcome": "ok",
+                        "from_devices": 8, "to_devices": 4}),
+            span("resize", "z2", 7.0, 7.4, parent="r",
+                 attrs={"direction": "grow", "outcome": "ok",
+                        "from_devices": 4, "to_devices": 8}),
+        ]
+        report = obs_analyze.analyze_timeline(
+            obs_trace.build_timeline(records, trace_id="r"))
+        assert report["phases"]["resize"]["ms"] == pytest.approx(1000.0)
+        assert report["phases"]["resize"]["count"] == 2
+        # The resize wall is accounted: `other` holds only the genuinely
+        # uncovered remainder of the 10s, not the resize windows.
+        assert report["phases"]["other"]["ms"] == pytest.approx(9000.0)
 
     def test_empty_timeline_reports_cleanly(self):
         report = obs_analyze.analyze_timeline(

@@ -284,6 +284,37 @@ class TestLossContinuity:
         assert "loss jumped" in (
             v["evidence"]["discontinuities"][0]["problem"])
 
+    def test_twice_resized_run_passes(self):
+        """The elastic-gang golden (ISSUE 14): a run that shrank and
+        regrew mid-train produces three mesh segments whose step windows
+        stay contiguous and whose loss keeps descending — the oracle
+        must certify that as continuity, resize phases and all."""
+        bundle = TelemetryBundle(reports={"u1": {
+            "steps": {"windows": [
+                {"from_step": 1, "to_step": 4, "loss": 3.1},   # 8 devices
+                {"from_step": 5, "to_step": 8, "loss": 2.7},   # 4 devices
+                {"from_step": 9, "to_step": 12, "loss": 2.4},  # 8 again
+            ]},
+            "phases": {"resize": {"ms": 120.0, "count": 2},
+                       "restore": {"ms": 40.0, "count": 2}}}})
+        v = _one(_inv(kind="loss_continuity", max_loss_jump=1.0), bundle)
+        assert v["verdict"] == "pass"
+        assert v["evidence"]["runs_judged"] == 1
+
+    def test_resize_boundary_gap_fails(self):
+        """A resize that loses the batch pointer (window restarts past
+        the saved step) is exactly what loss_continuity exists to catch."""
+        bundle = TelemetryBundle(reports={"u1": {
+            "steps": {"windows": [
+                {"from_step": 1, "to_step": 4, "loss": 3.1},
+                {"from_step": 7, "to_step": 10, "loss": 2.9},
+            ]},
+            "phases": {"resize": {"ms": 60.0, "count": 1}}}})
+        v = _one(_inv(kind="loss_continuity"), bundle)
+        assert v["verdict"] == "fail"
+        assert v["evidence"]["discontinuities"][0]["problem"] == \
+            "skipped 2 step(s)"
+
     def test_single_window_skips(self):
         bundle = self._bundle([{"from_step": 1, "to_step": 50}])
         assert _one(_inv(kind="loss_continuity"),
