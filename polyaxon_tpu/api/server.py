@@ -264,6 +264,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # reconciling agent still answers truthfully.
                 self._require(caller)
                 return self._json(self._alerts())
+            if rest == ["metrics", "history"]:
+                # Sampled metrics history (obs.history): the bounded
+                # ring the alert engine and the telemetry oracle share.
+                # Same read posture as /alerts — cluster telemetry, any
+                # authenticated caller; ?name= picks a family, ?window=
+                # scopes to a marked window or a trailing span, ?labels=
+                # (k=v,...) picks one series.
+                self._require(caller)
+                return self._json(self._history(query))
             if rest and rest[0] == "queues":
                 return self._queues(method, caller, rest[1:])
             if rest and rest[0] == "quotas":
@@ -376,6 +385,29 @@ class _Handler(BaseHTTPRequestHandler):
         engine = obs_rules.default_engine()
         engine.evaluate(plane=self.plane)
         return engine.to_json()
+
+    def _history(self, query: dict) -> dict:
+        from polyaxon_tpu.obs import history as obs_history
+
+        ring = obs_history.default_history()
+        ring.sample()  # cadence-gated freshness on read
+        name = (query.get("name") or [None])[0]
+        window = (query.get("window") or [None])[0]
+        raw = (query.get("labels") or [None])[0]
+        labels = None
+        if raw:
+            labels = {}
+            for part in raw.split(","):
+                key, sep, value = part.partition("=")
+                if not sep or not key.strip():
+                    raise ApiError(400, f"bad labels selector {raw!r} "
+                                        "(want k=v[,k2=v2])")
+                labels[key.strip()] = value.strip()
+        try:
+            return obs_history.query_history(
+                ring.to_json(), name=name, window=window, labels=labels)
+        except ValueError as exc:
+            raise ApiError(400, str(exc))
 
     def _dashboard(self) -> None:
         """Polyboard-lite (api.ui): the static runs dashboard."""

@@ -66,6 +66,15 @@ DASHBOARD_HTML = r"""<!doctype html>
           border-radius: 8px; padding: 10px 16px; min-width: 120px; }
   .tile .v { font-size: 22px; font-weight: 650; }
   .tile .k { color: var(--ink-2); font-size: 12px; }
+  .history { background: var(--surface); border: 1px solid var(--ring);
+             border-radius: 8px; padding: 8px 14px; margin-bottom: 16px;
+             font-size: 12px; }
+  .history .k { color: var(--ink-2); margin-right: 10px; }
+  .hist-line { display: inline-flex; gap: 8px; margin-right: 18px;
+               align-items: baseline; }
+  .hist-key { color: var(--muted); }
+  .hist-spark { font-family: monospace; letter-spacing: 1px; }
+  .hist-last { font-variant-numeric: tabular-nums; font-weight: 600; }
   table { width: 100%; border-collapse: collapse; background: var(--surface);
           border: 1px solid var(--ring); border-radius: 8px; overflow: hidden; }
   th { text-align: left; color: var(--muted); font-weight: 500; font-size: 12px; }
@@ -177,6 +186,7 @@ DASHBOARD_HTML = r"""<!doctype html>
 </header>
 <main>
   <div class="tiles" id="tiles"></div>
+  <div id="historyPanel"></div>
   <div id="alertsPanel" aria-live="polite"></div>
   <div id="projectPanel"></div>
   <div id="slicesPanel"></div>
@@ -311,6 +321,36 @@ async function loadAlerts() {
     </div>`).join("") + `</div>`;
 }
 
+// History tile (obs.history): a sparkline over the shared metrics-
+// history ring — queue depth over the trailing 15m shows the operator
+// the SHAPE of the backlog, not just its current number. Quiet until
+// the ring has sampled the series.
+const SPARK = "▁▂▃▄▅▆▇█";
+function spark(values) {
+  const lo = Math.min(...values), hi = Math.max(...values);
+  if (!(hi - lo > 1e-12)) return SPARK[0].repeat(values.length);
+  return values.map(v =>
+    SPARK[Math.floor((v - lo) / (hi - lo) * (SPARK.length - 1))]).join("");
+}
+async function loadHistory() {
+  const el = $("#historyPanel");
+  let data;
+  try {
+    data = await api(
+      "/api/v1/metrics/history?name=polyaxon_queue_depth&window=15m");
+  } catch (e) { el.innerHTML = ""; return; }  // not sampled yet
+  const series = (data.metric || {}).series || {};
+  const lines = Object.entries(series).map(([key, pts]) => {
+    const vals = pts.map(p =>
+      typeof p[1] === "object" ? (p[1].count || 0) : p[1]);
+    if (!vals.length) return "";
+    return `<div class="hist-line"><span class="hist-key">${esc(key || "fleet")}</span><span class="hist-spark">${spark(vals)}</span><span class="hist-last">${vals[vals.length - 1]}</span></div>`;
+  }).filter(Boolean);
+  el.innerHTML = lines.length
+    ? `<div class="history"><span class="k">queue depth · 15m</span>${lines.join("")}</div>`
+    : "";
+}
+
 async function loadRuns() {
   const status = $("#statusFilter").value;
   const q = status ? `?status=${encodeURIComponent(status)}` : "";
@@ -349,6 +389,7 @@ async function loadRuns() {
   renderRuns();
   renderSlices();
   loadAlerts();
+  loadHistory();
 }
 
 function renderRuns() {

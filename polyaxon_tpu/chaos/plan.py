@@ -142,6 +142,22 @@ class ChaosPlan:
                                          detail=detail, event=fault.seen)
                     except ImportError:  # pragma: no cover
                         pass
+                    # Bracket the fault's active stretch as a named
+                    # history window (``chaos.<seam>``): opened on its
+                    # first firing, closed when its budget exhausts, so
+                    # during-window oracle invariants can scope to the
+                    # drill. Fail-open like the trace annotation.
+                    try:
+                        from polyaxon_tpu.obs import history as _history
+
+                        hist = _history.default_history()
+                        if fault.fired == 1:
+                            hist.mark_window(f"chaos.{seam}", start=True)
+                        if fault.exhausted:
+                            hist.mark_window(f"chaos.{seam}", end=True)
+                    # polycheck: ignore[invariant-swallow] -- window markers are telemetry garnish on the fault path; a broken history ring must never mask the fault being injected
+                    except Exception:  # noqa: BLE001
+                        pass
                     return fault
         return None
 

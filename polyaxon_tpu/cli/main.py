@@ -513,6 +513,87 @@ def ops_alerts(as_json, show_all, since, limit):
                        f"  at={event.get('at')}")
 
 
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values):
+    lo, hi = min(values), max(values)
+    if hi - lo < 1e-12:
+        return _SPARK_GLYPHS[0] * len(values)
+    scale = (len(_SPARK_GLYPHS) - 1) / (hi - lo)
+    return "".join(_SPARK_GLYPHS[int((v - lo) * scale)] for v in values)
+
+
+def _point_scalar(sample):
+    # Histogram points carry the cumulative sample dict; plot the count.
+    if isinstance(sample, dict):
+        return float(sample.get("count") or 0.0)
+    return float(sample)
+
+
+@ops.command("history")
+@click.argument("metric", required=False)
+@click.option("--window", default=None, metavar="WINDOW",
+              help="scope to a marked window name (e.g. storm) or a "
+                   "trailing span (e.g. 15m)")
+@click.option("--labels", "labels_raw", default=None, metavar="K=V[,K=V]",
+              help="pick one labeled series of the family")
+@click.option("--json", "as_json", is_flag=True)
+def ops_history(metric, window, labels_raw, as_json):
+    """Sampled metrics history (obs.history): the bounded ring the
+    alert engine and the telemetry oracle share. Without METRIC, lists
+    the sampled families; with one, renders each series as a sparkline
+    over the selected scope (a marked window or a trailing span)."""
+    from polyaxon_tpu.obs import history as obs_history
+    from polyaxon_tpu.obs import rules as obs_rules
+
+    plane = get_plane()
+    # Evaluating the default engine force-samples the shared ring, so a
+    # fresh process still answers with at least the current instant.
+    obs_rules.default_engine().evaluate(plane=plane)
+    labels = None
+    if labels_raw:
+        labels = {}
+        for part in labels_raw.split(","):
+            key, sep, value = part.partition("=")
+            if not sep or not key.strip():
+                raise click.UsageError(
+                    f"bad --labels selector {labels_raw!r} "
+                    "(want k=v[,k2=v2])")
+            labels[key.strip()] = value.strip()
+    try:
+        payload = obs_history.query_history(
+            obs_history.default_history().to_json(),
+            name=metric, window=window, labels=labels)
+    except ValueError as exc:
+        raise click.UsageError(str(exc))
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+        return
+    cov = payload.get("coverage") or {}
+    span = ((float(cov["end"]) - float(cov["start"]))
+            if cov.get("start") is not None else 0.0)
+    click.echo(f"coverage: {cov.get('samples', 0)} sample(s) over "
+               f"{span:.1f}s; cadence {payload.get('cadence')}s")
+    scope = payload.get("scope")
+    if scope:
+        click.echo(f"scope: {scope['window']} "
+                   f"[{scope['start']:.3f} .. {scope['end']:.3f}]")
+    if metric is None:
+        for name in payload.get("metrics") or []:
+            click.echo(f"  {name}")
+        return
+    family = payload["metric"]
+    for key, points in sorted(family["series"].items()):
+        values = [_point_scalar(p[1]) for p in points]
+        label = key if key else "(no labels)"
+        if not values:
+            click.echo(f"  {label}: no points in scope")
+            continue
+        click.echo(f"  {label}: {_sparkline(values)}  "
+                   f"last={values[-1]:g} n={len(values)}")
+
+
 @ops.command("logs")
 @click.option("-uid", "--uid", required=True)
 @click.option("--follow", is_flag=True)

@@ -368,9 +368,15 @@ class MetricsRegistry:
         """Drop every instrument AND its samples (test-visible): the
         process-global REGISTRY otherwise leaks series across tests —
         get-or-create re-creates families fresh on next touch, so a
-        reset between tests is safe for every accessor-style caller."""
+        reset between tests is safe for every accessor-style caller.
+        The default metrics-history ring is derived state over this
+        registry, so resetting the global REGISTRY drops it too."""
         with self._lock:
             self._metrics.clear()
+        if self is REGISTRY:
+            from polyaxon_tpu.obs import history as obs_history
+
+            obs_history.reset_default()
 
     def render(self) -> str:
         """The whole registry in Prometheus text-format 0.0.4."""
@@ -729,6 +735,93 @@ def serving_trace_dumps_total(registry: MetricsRegistry = REGISTRY) -> Counter:
         ("outcome",))
 
 
+def history_samples_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_history_samples_total",
+        "Metrics-history sampling passes by outcome (ok / error — the "
+        "sampler is fail-open, so errors are counted, not raised)",
+        ("outcome",))
+
+
+def history_points(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_history_points",
+        "Points retained in the metrics-history ring by tier (recent = "
+        "full-cadence ring, coarse = downsampled old samples)",
+        ("tier",))
+
+
+def history_series(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_history_series",
+        "Distinct (metric, label-set) series tracked by the "
+        "metrics-history ring (capped; overflow series are dropped and "
+        "counted in polyaxon_history_evictions_total)")
+
+
+def history_windows(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_history_windows",
+        "Named window markers held by the metrics history "
+        "(mark_window; bounded ring, oldest-out)")
+
+
+def history_coarsened_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_history_coarsened_total",
+        "Samples migrated from the full-cadence recent ring into the "
+        "coarse tier (one survivor per coarsening interval)")
+
+
+def history_evictions_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_history_evictions_total",
+        "Metrics-history data dropped to hold the memory ceiling, by "
+        "reason (point = aged out of both tiers, series = over the "
+        "series cap, window = window-marker ring overflow)",
+        ("reason",))
+
+
+def history_sample_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_history_sample_seconds",
+        "Wall seconds per metrics-history sampling pass (registry "
+        "snapshot + changed-series append)",
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1))
+
+
+def project_usage(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_project_usage",
+        "Live per-project resource usage as admission accounts it "
+        "(resource = runs | chips), sampled into the metrics history "
+        "for the quota_violation oracle invariant",
+        ("project", "resource"))
+
+
+def project_quota_limit(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_project_quota_limit",
+        "Configured per-project quota ceiling (resource = runs | "
+        "chips); 0 or absent means uncapped",
+        ("project", "resource"))
+
+
+def ensure_history_metrics(registry: MetricsRegistry = REGISTRY) -> None:
+    """Pre-register the metrics-history self-accounting families and
+    the quota usage/limit gauges the history sampler records
+    (idempotent) — one source of truth for :func:`catalog_metric_names`."""
+    history_samples_total(registry)
+    history_points(registry)
+    history_series(registry)
+    history_windows(registry)
+    history_coarsened_total(registry)
+    history_evictions_total(registry)
+    history_sample_hist(registry)
+    project_usage(registry)
+    project_quota_limit(registry)
+
+
 def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     """Pre-register the documented families (idempotent) so /metrics
     exposes a stable schema — including at least one histogram — even
@@ -768,6 +861,7 @@ def catalog_metric_names() -> set[str]:
     ensure_core_metrics(scratch)
     ensure_serving_metrics(scratch)
     ensure_perf_metrics(scratch)
+    ensure_history_metrics(scratch)
     names = set(scratch._metrics)
     names.update(SCRAPE_TIME_METRICS)
     names.add(DROPPED_LABELS_METRIC)

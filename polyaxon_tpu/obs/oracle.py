@@ -35,6 +35,18 @@ Invariant kinds:
 - ``slo``              — per-class SLO adherence from histogram
   buckets: ``objective`` of observations ≤ the ``le`` bound, per label
   selector (Prometheus SLI semantics, but as an acceptance check).
+- ``metric_during``    — a threshold predicate scoped in *time*: the
+  value (gauge worst-instant via ``agg``, counter in-window movement,
+  or histogram in-window ``quantile``) judged over a named history
+  window (``window: "storm"``) or a trailing ``span``, read from the
+  bundle's metrics history (``obs.history``).
+- ``slo_during``       — the ``slo`` bucket-ratio check over only the
+  observations that landed inside the named window / trailing span
+  (bucket-wise difference of carry-forward history samples).
+- ``quota_violation``  — no sampled instant shows a project over its
+  quota: every ``polyaxon_project_usage`` point is compared against
+  the carry-forward ``polyaxon_project_quota_limit`` for the same
+  (project, resource) series; a limit of 0 means unlimited.
 
 Missing telemetry is handled per invariant via ``missing``: ``skip``
 (default — verdict ``skip`` with the reason as evidence), ``fail``
@@ -56,12 +68,15 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from polyaxon_tpu.obs import history as obs_history
 from polyaxon_tpu.obs import metrics as obs_metrics
 
 DEFAULT_ORACLE_PATH = os.path.join(os.path.dirname(__file__), "oracle.json")
 
 KINDS = ("run_terminal", "phase_budget", "metric", "loss_continuity",
-         "alerts_resolved", "slo")
+         "alerts_resolved", "slo", "metric_during", "slo_during",
+         "quota_violation")
+WINDOW_AGGS = ("max", "min", "last")
 MISSING_POLICIES = ("skip", "fail", "zero")
 EVIDENCE_CAP = 16  # offending items attached per verdict, not a census
 
@@ -102,6 +117,10 @@ class Invariant:
     # loss_continuity
     max_gap_steps: int = 0
     max_loss_jump: Optional[float] = None
+    # metric_during / slo_during (window-scoped judgments)
+    window: Optional[str] = None   # named history window, e.g. "storm"
+    span: Optional[float] = None   # trailing seconds before coverage end
+    agg: str = "max"               # gauge aggregation inside the window
 
     @classmethod
     def from_dict(cls, data: dict) -> "Invariant":
@@ -130,6 +149,31 @@ class Invariant:
             raise OracleError(f"invariant {inv_id}: quantile {quantile!r} "
                               "outside [0, 1]")
         mode = data.get("mode", "value")
+        window = data.get("window")
+        span = data.get("span")
+        if window is not None and (not isinstance(window, str) or not window):
+            raise OracleError(f"invariant {inv_id}: `window` must be a "
+                              f"non-empty window name, got {window!r}")
+        if span is not None:
+            from polyaxon_tpu.obs import rules as obs_rules
+            try:
+                span = obs_rules.parse_window(span, field_name="span")
+            except obs_rules.RuleError as exc:
+                raise OracleError(f"invariant {inv_id}: {exc}") from exc
+        agg = data.get("agg", "max")
+        if agg not in WINDOW_AGGS:
+            raise OracleError(f"invariant {inv_id}: agg must be one of "
+                              f"{WINDOW_AGGS}, got {agg!r}")
+        if kind in ("metric_during", "slo_during"):
+            if (window is None) == (span is None):
+                raise OracleError(
+                    f"invariant {inv_id}: {kind} needs exactly one of "
+                    "`window` (a named marker) or `span` (a trailing "
+                    "duration)")
+        elif window is not None or span is not None:
+            raise OracleError(
+                f"invariant {inv_id}: `window`/`span` only apply to "
+                "metric_during|slo_during")
         if kind == "metric":
             if not metric or not isinstance(metric, str):
                 raise OracleError(f"invariant {inv_id}: metric kind needs "
@@ -144,14 +188,21 @@ class Invariant:
                 raise OracleError(f"invariant {inv_id}: quantile predicates "
                                   "only run on absolute snapshots "
                                   "(mode: value)")
-        elif kind == "slo":
+        elif kind == "metric_during":
             if not metric or not isinstance(metric, str):
-                raise OracleError(f"invariant {inv_id}: slo kind needs "
+                raise OracleError(f"invariant {inv_id}: metric_during "
+                                  "kind needs a `metric` name")
+            if data.get("value") is None:
+                raise OracleError(f"invariant {inv_id}: metric_during "
+                                  "kind needs a `value` to compare against")
+        elif kind in ("slo", "slo_during"):
+            if not metric or not isinstance(metric, str):
+                raise OracleError(f"invariant {inv_id}: {kind} kind needs "
                                   "a `metric` name")
             le = data.get("le")
             objective = data.get("objective")
             if le is None or objective is None:
-                raise OracleError(f"invariant {inv_id}: slo needs `le` "
+                raise OracleError(f"invariant {inv_id}: {kind} needs `le` "
                                   "and `objective`")
             if not 0.0 < float(objective) <= 1.0:
                 raise OracleError(f"invariant {inv_id}: objective "
@@ -199,6 +250,7 @@ class Invariant:
             max_loss_jump=(float(data["max_loss_jump"])
                            if data.get("max_loss_jump") is not None
                            else None),
+            window=window, span=span, agg=agg,
         )
 
 
@@ -240,7 +292,9 @@ class TelemetryBundle:
     ``runs`` rows carry at least ``uuid``/``status``; ``reports`` maps
     run uuid → ``obs.analyze.analyze_timeline`` output; ``snapshot``/
     ``baseline`` are ``MetricsRegistry.snapshot()`` dicts; ``alerts``
-    is ``AlertEngine.to_json()`` (alerts / rules / history)."""
+    is ``AlertEngine.to_json()`` (alerts / rules / history); ``history``
+    is ``MetricsHistory.to_json()`` — the time-series surface the
+    ``*_during`` and ``quota_violation`` kinds judge."""
 
     runs: list[dict] = field(default_factory=list)
     timelines: dict[str, dict] = field(default_factory=dict)
@@ -248,6 +302,7 @@ class TelemetryBundle:
     snapshot: Optional[dict] = None
     baseline: Optional[dict] = None
     alerts: Optional[dict] = None
+    history: Optional[dict] = None
 
     def deltas(self) -> Optional[dict]:
         """Changed-series registry movement vs the baseline (None when
@@ -298,9 +353,11 @@ class TelemetryBundle:
             reports[record.uuid] = analyze_timeline(timeline)
         if engine is None:
             engine = obs_rules.default_engine()
+        hist = obs_history.history_for(registry)
+        hist.sample(force=True)  # coverage end = bundle time
         return cls(runs=runs, timelines=timelines, reports=reports,
                    snapshot=registry.snapshot(), baseline=baseline,
-                   alerts=engine.to_json())
+                   alerts=engine.to_json(), history=hist.to_json())
 
 
 # --------------------------------------------------------- snapshot math
@@ -594,6 +651,176 @@ def _eval_slo(inv: Invariant, bundle: TelemetryBundle) -> dict:
                     evidence)
 
 
+def _window_scope(inv: Invariant,
+                  hist: dict) -> tuple[Optional[tuple[float, float]], str]:
+    """The (start, end) seconds an invariant judges, or (None, reason)."""
+    if inv.window is not None:
+        bounds = obs_history.window_bounds(hist, inv.window)
+        if bounds is None:
+            return None, f"no window {inv.window!r} marked in history"
+        return bounds, ""
+    bounds = obs_history.trailing_bounds(hist, inv.span)
+    if bounds is None:
+        return None, "history has no sample coverage"
+    return bounds, ""
+
+
+def _scope_evidence(inv: Invariant, start: float, end: float) -> dict:
+    scope = ({"window": inv.window} if inv.window is not None
+             else {"span": inv.span})
+    scope["start"] = round(start, 3)
+    scope["end"] = round(end, 3)
+    return scope
+
+
+def _eval_metric_during(inv: Invariant, bundle: TelemetryBundle) -> dict:
+    if bundle.history is None:
+        return _missing(inv, "no metrics history in bundle")
+    bounds, reason = _window_scope(inv, bundle.history)
+    if bounds is None:
+        return _missing(inv, reason)
+    start, end = bounds
+    selected = obs_history.select_series_points(
+        bundle.history, inv.metric, inv.labels)
+    family = (bundle.history.get("series") or {}).get(inv.metric) or {}
+    kind = family.get("type")
+    observed: Optional[float] = None
+    if selected:
+        if kind == "histogram":
+            merged: Optional[dict] = None
+            for pts in selected.values():
+                sample = obs_history.windowed_hist_sample(pts, start, end)
+                if sample is None:
+                    continue
+                if merged is None:
+                    merged = {"count": 0, "sum": 0.0,
+                              "buckets": {b: 0 for b in sample["buckets"]}}
+                merged["count"] += sample["count"]
+                merged["sum"] += sample["sum"]
+                for b, n in sample["buckets"].items():
+                    merged["buckets"][b] = merged["buckets"].get(b, 0) + n
+            if merged is not None:
+                if inv.quantile is not None:
+                    observed = _snapshot_quantile(merged, inv.quantile)
+                else:
+                    observed = float(merged["count"])
+        elif kind == "counter":
+            deltas = [obs_history.windowed_counter_delta(pts, start, end)
+                      for pts in selected.values()]
+            deltas = [d for d in deltas if d is not None]
+            if deltas:
+                observed = sum(deltas)
+        else:  # gauge: worst/best/final instant, per `agg`
+            extents = [obs_history.windowed_gauge_extent(
+                pts, start, end, agg=inv.agg)
+                for pts in selected.values()]
+            extents = [e for e in extents if e is not None]
+            if extents:
+                observed = {"min": min, "max": max}.get(
+                    inv.agg, max)(extents)
+    if observed is None:
+        if inv.missing == "zero":
+            observed = 0.0
+        else:
+            return _missing(
+                inv, f"no sampled points for {inv.metric} "
+                     f"(labels {inv.labels or {}}) inside the window")
+    holds = _OPS[inv.op](observed, inv.value)
+    evidence = {
+        "metric": inv.metric,
+        "labels": inv.labels or None,
+        "scope": _scope_evidence(inv, start, end),
+        **({"quantile": inv.quantile} if inv.quantile is not None else {}),
+        **({"agg": inv.agg} if kind == "gauge" else {}),
+        "observed": round(observed, 6),
+        "op": inv.op,
+        "value": inv.value,
+    }
+    return _verdict(inv, "pass" if holds else "fail", evidence)
+
+
+def _eval_slo_during(inv: Invariant, bundle: TelemetryBundle) -> dict:
+    if bundle.history is None:
+        return _missing(inv, "no metrics history in bundle")
+    bounds, reason = _window_scope(inv, bundle.history)
+    if bounds is None:
+        return _missing(inv, reason)
+    start, end = bounds
+    family = (bundle.history.get("series") or {}).get(inv.metric)
+    if not family or family.get("type") != "histogram":
+        return _missing(inv, f"no histogram {inv.metric} in history")
+    selected = obs_history.select_series_points(
+        bundle.history, inv.metric, inv.labels)
+    if not selected:
+        return _missing(inv, f"no series matches labels {inv.labels}")
+    good = total = 0.0
+    for pts in selected.values():
+        sample = obs_history.windowed_hist_sample(pts, start, end)
+        if sample is None:
+            continue
+        counts = obs_history.sample_slo_counts(sample, inv.le)
+        if counts is None:
+            return _missing(
+                inv, f"le={inv.le} is not a bucket bound of {inv.metric}")
+        good += counts[0]
+        total += counts[1]
+    if total <= 0:
+        return _missing(inv, "no observations inside the window")
+    ratio = good / total
+    evidence = {
+        "metric": inv.metric,
+        "labels": inv.labels or None,
+        "scope": _scope_evidence(inv, start, end),
+        "le": inv.le,
+        "objective": inv.objective,
+        "good": int(good),
+        "total": int(total),
+        "ratio": round(ratio, 6),
+    }
+    return _verdict(inv, "pass" if ratio >= inv.objective else "fail",
+                    evidence)
+
+
+def _eval_quota_violation(inv: Invariant, bundle: TelemetryBundle) -> dict:
+    """No sampled instant may show a project over its quota: every
+    usage point is compared against the carry-forward limit for the
+    same (project, resource) series. Limit <= 0 (or never sampled)
+    means unlimited — admission semantics."""
+    if bundle.history is None:
+        return _missing(inv, "no metrics history in bundle")
+    series = bundle.history.get("series") or {}
+    usage = (series.get("polyaxon_project_usage") or {}).get("series") or {}
+    limits = ((series.get("polyaxon_project_quota_limit") or {})
+              .get("series") or {})
+    if not usage:
+        return _missing(inv, "no project-usage samples in history")
+    breaches = []
+    instants = 0
+    for key, points in usage.items():
+        limit_points = limits.get(key) or []
+        for t, used in points:
+            if isinstance(used, dict):
+                continue
+            instants += 1
+            limit = obs_history.value_at(limit_points, t)
+            if limit is None or float(limit) <= 0:
+                continue
+            if float(used) > float(limit) + 1e-9:
+                breaches.append({
+                    "series": key,
+                    "at": round(float(t), 3),
+                    "used": float(used),
+                    "limit": float(limit),
+                })
+    evidence = {"series_checked": len(usage),
+                "instants_checked": instants}
+    if breaches:
+        evidence["breaches"] = breaches[:EVIDENCE_CAP]
+        evidence["breach_total"] = len(breaches)
+        return _verdict(inv, "fail", evidence)
+    return _verdict(inv, "pass", evidence)
+
+
 _EVALUATORS = {
     "run_terminal": _eval_run_terminal,
     "phase_budget": _eval_phase_budget,
@@ -601,6 +828,9 @@ _EVALUATORS = {
     "loss_continuity": _eval_loss_continuity,
     "alerts_resolved": _eval_alerts_resolved,
     "slo": _eval_slo,
+    "metric_during": _eval_metric_during,
+    "slo_during": _eval_slo_during,
+    "quota_violation": _eval_quota_violation,
 }
 
 

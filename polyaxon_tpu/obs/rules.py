@@ -18,9 +18,10 @@ Three rule kinds:
   step-time-regression rule fires when p99 > 3×p50: the distribution
   grew a tail).
 - ``rate`` — counter increase per second over a trailing ``window``,
-  computed from samples the engine itself records at each evaluation
-  (labeled counters sum across series). The retry-storm rule lives
-  here.
+  computed from the shared metrics-history ring (obs.history); each
+  evaluation forces a history sample, so windows are exact at
+  evaluation times (labeled counters sum across series). The
+  retry-storm rule lives here.
 - ``slo_burn_rate`` — Prometheus burn-rate alerting on a histogram SLO:
   ``objective`` of observations must land ≤ the ``le`` bucket bound;
   the rule fires when (window error-rate / allowed error-rate) exceeds
@@ -256,21 +257,35 @@ class AlertEngine:
 
     def __init__(self, rules: list[Rule],
                  registry: obs_metrics.MetricsRegistry = obs_metrics.REGISTRY,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 history: Optional["obs_history.MetricsHistory"] = None):
+        from polyaxon_tpu.obs import history as obs_history
+
         self.rules = rules
         self.registry = registry
         self.clock = clock
         # Rate rules need the zero BEFORE the first increment (a
         # counter born at 1 would hide its own first delta), so the
-        # documented families exist from the engine's first pass.
+        # documented families exist from the engine's first pass — the
+        # history ring anchors every first-seen series with a point.
         obs_metrics.ensure_core_metrics(registry)
+        # Rate/burn windows read from the shared metrics-history ring
+        # (ONE sampling path with the agent hook, the history API, and
+        # the oracle's during-window invariants) — each evaluation
+        # forces a sample so windows are exact at evaluation times.
+        # Sharing requires one time domain: an engine on an injected
+        # clock (drills, fake-clock tests, skewed gauntlet engines)
+        # gets a private ring in its own clock domain instead — mixed
+        # domains would trip the ring's monotonic guard.
+        if history is not None:
+            self.metrics_history = history
+        elif clock is time.time:
+            self.metrics_history = obs_history.history_for(registry)
+        else:
+            self.metrics_history = obs_history.MetricsHistory(
+                registry, clock=clock)
         self._lock = threading.Lock()
         self._states = {rule.id: AlertState(rule) for rule in rules}
-        # (t, scalar-or-bucket-vector) samples per rate/slo rule, pruned
-        # to each rule's window (+ slack for the edge sample).
-        self._samples: dict[str, deque] = {
-            rule.id: deque() for rule in rules
-            if rule.kind in ("rate", "slo_burn_rate")}
         self.history: deque = deque(maxlen=self.HISTORY)
 
     def _append_history(self, event: dict) -> None:
@@ -283,55 +298,6 @@ class AlertEngine:
         self.history.append(event)
 
     # -- observations ------------------------------------------------------
-    def _counter_total(self, rule: Rule) -> Optional[float]:
-        metric = self.registry.get(rule.metric)
-        if metric is None:
-            return None
-        snap = metric.snapshot()["series"]
-        if rule.labels:
-            key = ",".join(str(rule.labels.get(k, ""))
-                           for k in metric.labelnames)
-            if key not in snap:
-                return None
-            sample = snap[key]
-            return (float(sample["count"]) if isinstance(sample, dict)
-                    else float(sample))
-        total = 0.0
-        for sample in snap.values():
-            if isinstance(sample, dict):  # histogram series: use count
-                total += sample["count"]
-            else:
-                total += float(sample)
-        return total if snap else None
-
-    def _bucket_counts(self, rule: Rule) -> Optional[tuple[float, float]]:
-        """(good, total) cumulative counts for an SLO rule: good = the
-        observations ≤ the rule's ``le`` bound, summed across series."""
-        metric = self.registry.get(rule.metric)
-        if not isinstance(metric, obs_metrics.Histogram):
-            return None
-        le_label = None
-        for bound in metric.buckets:
-            if abs(bound - rule.le) < 1e-12:
-                le_label = obs_metrics._fmt_value(bound)
-                break
-        if le_label is None:
-            return None  # le not a bucket bound of this layout
-        good = total = 0.0
-        seen = False
-        for sample in metric.snapshot()["series"].values():
-            if not isinstance(sample, dict):
-                continue
-            seen = True
-            total += sample["count"]
-            cumulative = 0
-            for bound, n in sample["buckets"].items():
-                cumulative += n
-                if bound == le_label:
-                    good += cumulative
-                    break
-        return (good, total) if seen else None
-
     def _instant_value(self, rule: Rule) -> Optional[float]:
         metric = self.registry.get(rule.metric)
         if metric is None:
@@ -371,44 +337,51 @@ class AlertEngine:
         return base * float(rule.value_from["factor"])
 
     def _windowed_rate(self, rule: Rule, now: float) -> Optional[float]:
-        total = self._counter_total(rule)
-        samples = self._samples[rule.id]
-        if total is not None:
-            samples.append((now, total))
-        # Keep one sample older than the window as the left edge.
-        while len(samples) > 1 and samples[1][0] <= now - rule.window:
-            samples.popleft()
-        if len(samples) < 2:
+        """Counter increase per second over the trailing window, read
+        from the shared history ring. The right edge is the
+        carry-forward total at ``now`` (the evaluation just sampled);
+        the left edge sits at ``now - window``, floored at the series'
+        first retained point — before that the series did not exist, so
+        the window shrinks to the data exactly like the old per-rule
+        deque kept its oldest sample as the edge. A clock fast-forward
+        (drills) makes both edges read the same carry-forward total →
+        rate 0 → stale firings resolve."""
+        hist = self.metrics_history
+        v1 = hist.counter_total_at(rule.metric, rule.labels, now)
+        if v1 is None:
             return None
-        (t0, v0), (t1, v1) = samples[0], samples[-1]
-        if t0 < now - rule.window * 2 or t1 <= t0:
-            # Left edge fell far outside the window (evaluation gap —
-            # e.g. a drill fast-forwarded the clock): stale evidence,
-            # not a live breach.
-            while len(samples) > 1:
-                samples.popleft()
+        t_first = hist.first_time(rule.metric, rule.labels)
+        if t_first is None:
             return None
-        return max(v1 - v0, 0.0) / (t1 - t0)
+        left = max(now - rule.window, t_first)
+        if now <= left:
+            return None  # one instant of data: no window yet
+        v0 = hist.counter_total_at(rule.metric, rule.labels, left)
+        if v0 is None:
+            return None
+        return max(v1 - v0, 0.0) / (now - left)
 
     def _burn_rate(self, rule: Rule, now: float) -> Optional[float]:
-        counts = self._bucket_counts(rule)
-        samples = self._samples[rule.id]
-        if counts is not None:
-            samples.append((now, counts))
-        while len(samples) > 1 and samples[1][0] <= now - rule.window:
-            samples.popleft()
-        if len(samples) < 2:
+        """Windowed SLO burn from the history ring: (good, total)
+        cumulative bucket counts at both window edges, same edge
+        semantics as :meth:`_windowed_rate`."""
+        hist = self.metrics_history
+        counts1 = hist.bucket_counts_at(rule.metric, rule.le, now)
+        if counts1 is None:
             return None
-        (t0, (good0, total0)) = samples[0]
-        (t1, (good1, total1)) = samples[-1]
-        if t0 < now - rule.window * 2 or t1 <= t0:
-            while len(samples) > 1:
-                samples.popleft()
+        t_first = hist.first_time(rule.metric, None)
+        if t_first is None:
             return None
-        d_total = total1 - total0
+        left = max(now - rule.window, t_first)
+        if now <= left:
+            return None
+        counts0 = hist.bucket_counts_at(rule.metric, rule.le, left)
+        if counts0 is None:
+            return None
+        d_total = counts1[1] - counts0[1]
         if d_total <= 0:
             return None  # no traffic in the window: nothing to burn
-        error_rate = max(d_total - (good1 - good0), 0.0) / d_total
+        error_rate = max(d_total - (counts1[0] - counts0[0]), 0.0) / d_total
         allowed = 1.0 - rule.objective
         return error_rate / allowed if allowed > 0 else None
 
@@ -420,6 +393,11 @@ class AlertEngine:
         runs (condition + ``meta["alerts"]``) so ``plx ops get`` and
         ``plx ops statuses`` show the alert on the run it implicates."""
         now = self.clock()
+        # One sampling path: every evaluation records a history sample
+        # at the engine's clock, so rate/burn windows are exact at
+        # evaluation times (fail-open inside — a sampling error reads
+        # as carry-forward, not an engine crash).
+        self.metrics_history.sample(now=now, force=True)
         transitions: list[dict] = []
         with self._lock:
             for rule in self.rules:

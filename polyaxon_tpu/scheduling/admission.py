@@ -267,6 +267,21 @@ class AdmissionController:
         with self._live_lock:
             return dict(self._live)
 
+    def usage_snapshot(self) -> dict[str, dict[str, int]]:
+        """{project: {"runs": n, "chips": n}} over the current live
+        map — exactly the counts ``_admissible`` enforces quotas
+        against, so the ``polyaxon_project_usage`` gauges (and the
+        oracle's ``quota_violation`` invariant) see the same truth.
+        O(live), no store scan, no pass-cadence side effects."""
+        with self._live_lock:
+            entries = list(self._live.values())
+        usage: dict[str, dict[str, int]] = {}
+        for entry in entries:
+            row = usage.setdefault(entry.project, {"runs": 0, "chips": 0})
+            row["runs"] += 1
+            row["chips"] += entry.chips
+        return usage
+
     # --------------------------------------------------------------- pass
     def plan(self, queued: list[RunRecord], *, capacity: int,
              active: set[str] | None = None) -> AdmissionDecision:

@@ -8,6 +8,9 @@ Modes:
   python -m polyaxon_tpu.sim --trace quick       # replay a whole trace
   python -m polyaxon_tpu.sim --gauntlet          # oracle-judged episode
   python -m polyaxon_tpu.sim --gauntlet --inject stuck-requeue  # must FAIL
+  python -m polyaxon_tpu.sim --cluster-day --quick  # compressed day (CI)
+  python -m polyaxon_tpu.sim --cluster-day --full   # the full day profile
+  python -m polyaxon_tpu.sim --cluster-day --quick --inject quota-breach
   python -m polyaxon_tpu.sim --replay sim/scenarios/preemption-storm.json
 """
 
@@ -40,9 +43,19 @@ def main(argv=None) -> int:
     parser.add_argument("--gauntlet", action="store_true",
                         help="run the oracle-judged mini-gauntlet "
                              "(sim/gauntlet.py); exit reflects verdicts")
+    parser.add_argument("--cluster-day", action="store_true",
+                        dest="cluster_day",
+                        help="run the oracle-judged cluster-day gauntlet "
+                             "(--quick = compressed CI form, --full = the "
+                             "day profile); exit reflects verdicts")
+    parser.add_argument("--no-serving", action="store_true",
+                        help="(--cluster-day) skip the real-engine "
+                             "serving lane (the serving anchors then "
+                             "skip)")
     parser.add_argument("--inject", default=None, metavar="DEOPT",
-                        help="(--gauntlet) apply a named deopt, e.g. "
-                             "stuck-requeue; the run should then FAIL")
+                        help="(--gauntlet/--cluster-day) apply a named "
+                             "deopt, e.g. stuck-requeue or quota-breach; "
+                             "the run should then FAIL")
     parser.add_argument("--serving", action="store_true",
                         help="(--gauntlet) include the real-engine "
                              "serving segment (needs jax)")
@@ -55,6 +68,19 @@ def main(argv=None) -> int:
                         help="write the result JSON to this path "
                              "('' = stdout only)")
     args = parser.parse_args(argv)
+
+    if args.cluster_day:
+        from polyaxon_tpu.sim import gauntlet
+
+        profile = "full" if args.full else "quick"
+        result = gauntlet.run_cluster_day(
+            profile=profile, seed=args.seed or gauntlet.GAUNTLET_SEED,
+            inject=args.inject, serving=not args.no_serving)
+        gauntlet.print_result(result, label=f"cluster-day[{profile}]")
+        if args.json_out:
+            with open(args.json_out, "w") as fh:
+                json.dump(result, fh, indent=2, default=str)
+        return 0 if result["passed"] else 1
 
     if args.gauntlet:
         from polyaxon_tpu.sim import gauntlet

@@ -22,6 +22,7 @@ from polyaxon_tpu.agent import Agent
 from polyaxon_tpu.controlplane import ControlPlane
 from polyaxon_tpu.controlplane.scheduler import Scheduler
 from polyaxon_tpu.lifecycle import V1Statuses
+from polyaxon_tpu.obs import history as obs_history
 from polyaxon_tpu.obs import metrics as obs_metrics
 from polyaxon_tpu.scheduling import AdmissionController
 from polyaxon_tpu.sim import traces
@@ -31,6 +32,7 @@ from polyaxon_tpu.sim.executor import SyntheticExecutor
 _SERVING_DURATION = 30.0  # deploys hold capacity ~forever at sim scale
 _CHURN_FAILURE_RATE = 0.7
 _ELASTIC_DURATION = 4.0  # elastic train jobs outlive the resize lane
+_STORM_WINDOW = 3.0  # marked-window span a storm event opens (sim seconds)
 
 
 def percentile(values: list[float], q: float) -> float:
@@ -84,11 +86,24 @@ class FleetSim:
         self.tick_rows: list[int] = []
         self.submitted_total = 0
         self._elastic_uuids: list[str] = []  # slice-loss lane targets
+        self._open_windows: dict[str, float] = {}  # name -> close deadline
 
     # ------------------------------------------------------------ submit
     def _submit_event(self, event: traces.TraceEvent) -> None:
         if event.kind == "storm":
-            fraction = float((event.payload or {}).get("fraction", 0.5))
+            payload = event.payload or {}
+            fraction = float(payload.get("fraction", 0.5))
+            # The storm opens (or extends) a named history window so
+            # during-window oracle invariants can scope to it; tick()
+            # closes it once the window span elapses.
+            window = str(payload.get("window", "storm"))
+            deadline = time.monotonic() + float(
+                payload.get("window_seconds", _STORM_WINDOW))
+            if window not in self._open_windows:
+                obs_history.default_history().mark_window(
+                    window, start=True)
+            self._open_windows[window] = max(
+                self._open_windows.get(window, 0.0), deadline)
             active = self.executor.active_runs
             for uuid in active[: int(len(active) * fraction)]:
                 self.executor.preempt(uuid)
@@ -146,6 +161,14 @@ class FleetSim:
         self._depth_gauge.set(
             self.store.count_runs(statuses=[V1Statuses.QUEUED]),
             queue="fleet")
+        if self._open_windows:
+            self._close_due_windows(time.monotonic())
+
+    def _close_due_windows(self, now: float) -> None:
+        for name, deadline in list(self._open_windows.items()):
+            if now >= deadline:
+                obs_history.default_history().mark_window(name, end=True)
+                del self._open_windows[name]
 
     def reset_measurements(self) -> None:
         self.tick_seconds.clear()
@@ -238,5 +261,8 @@ class FleetSim:
         }
 
     def close(self) -> None:
+        if self._open_windows:
+            # Never leave a marker dangling past the sim's lifetime.
+            self._close_due_windows(float("inf"))
         if self._tmp:
             shutil.rmtree(self._tmp, ignore_errors=True)

@@ -195,8 +195,15 @@ def replay_scenario(source: Any, *, seed: int = 0, max_wall: float = 120.0,
                     oracle_source: Any = None) -> dict:
     """Replay one scenario through the real control plane and judge
     the end state with the oracle. A fresh ``AlertEngine`` (committed
-    ruleset) watches the replay so the alerts_resolved invariant sees
-    this episode's firings, not ambient process state."""
+    ruleset) watches every few ticks — its rate/burn windows read the
+    shared metrics history, so the replayed incident produces the same
+    fire→resolve arcs a live one would — and the whole episode is
+    bracketed by a named ``replay`` window marker (storm events inside
+    mark their own ``storm`` windows via the sim), so during-window
+    invariants scope to the replayed phases exactly like live runs."""
+    import time as _time
+
+    from polyaxon_tpu.obs import history as obs_history
     from polyaxon_tpu.obs import metrics as obs_metrics
     from polyaxon_tpu.obs import oracle as obs_oracle
     from polyaxon_tpu.obs import rules as obs_rules
@@ -206,10 +213,28 @@ def replay_scenario(source: Any, *, seed: int = 0, max_wall: float = 120.0,
     events = scenario_trace(scenario)
     invariants = obs_oracle.load_invariants(oracle_source)
     sim = FleetSim(seed=seed, capacity=capacity)
-    engine = obs_rules.AlertEngine(obs_rules.load_ruleset())
+    clock_skew = [0.0]
+    engine = obs_rules.AlertEngine(
+        obs_rules.load_ruleset(),
+        clock=lambda: _time.time() + clock_skew[0])
+    history = obs_history.default_history()
     baseline = obs_metrics.REGISTRY.snapshot()
     try:
+        orig_tick = sim.tick
+
+        def tick_with_alerts() -> None:
+            orig_tick()
+            if len(sim.tick_seconds) % 5 == 0:
+                engine.evaluate(plane=sim.plane)
+
+        sim.tick = tick_with_alerts
+        history.mark_window("replay", start=True)
         sim_result = sim.run_trace(events, max_wall=max_wall)
+        history.mark_window("replay", end=True)
+        # The fleet is drained: jump the engine clock past every rate/
+        # burn window so firings the incident legitimately tripped
+        # resolve, leaving the fire→resolve arc in history evidence.
+        clock_skew[0] = 600.0
         engine.evaluate(plane=sim.plane)
         bundle = obs_oracle.TelemetryBundle.from_plane(
             sim.plane, engine=engine, baseline=baseline)

@@ -79,6 +79,39 @@ def sweep_op(n_trials: int, *, queue: str | None = None) -> dict:
     return spec
 
 
+def hyperband_op(*, queue: str | None = None, max_iterations: int = 4,
+                 eta: float = 2.0, seed: int = 0) -> dict:
+    """A Hyperband sweep (successive halving through tune.hyperband):
+    the cluster-day gauntlet's tuning lane. Synthetic trials report no
+    metric, so rungs never promote — each bracket runs its first rung
+    and the matrix still terminates, which is exactly the fan-out/
+    drain behavior the control plane is judged on."""
+    spec = {
+        "kind": "operation",
+        "matrix": {
+            "kind": "hyperband",
+            "maxIterations": max_iterations,
+            "eta": eta,
+            "seed": seed,
+            "resource": {"name": "epochs", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"lr": {"kind": "uniform",
+                              "value": {"low": 0.001, "high": 0.1}}},
+        },
+        "component": {
+            "inputs": [
+                {"name": "lr", "type": "float", "toEnv": "LR"},
+                {"name": "epochs", "type": "int", "value": 1,
+                 "isOptional": True, "toEnv": "EPOCHS"},
+            ],
+            "run": _job_run(),
+        },
+    }
+    if queue:
+        spec["queue"] = queue
+    return spec
+
+
 def dag_op(shape: str = "chain") -> dict:
     step = {"run": _job_run()}
     if shape == "diamond":

@@ -168,6 +168,25 @@ if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --gauntlet \
     echo "gauntlet self-test FAILED: stuck resize passed the oracle"
     exit 1
 fi
+# Cluster-day gauntlet (ISSUE 15): the compressed day — morning trace,
+# Hyperband sweep lane, cron + DAG lanes, real-engine serving under
+# continuous mixed-class traffic, store-fault chaos, and a MARKED
+# mid-day preemption storm — judged exclusively by oracle verdicts,
+# including metric_during (interactive serving p99 inside the storm
+# window) and quota_violation (no sampled instant over quota). The
+# full day profile is the slow-marked tier; CI runs the compressed
+# form.
+echo "== cluster-day gauntlet (window-scoped oracle verdicts)"
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --cluster-day --quick
+# The quota invariant must be able to FAIL: bypassing admission's
+# quota check while the limit gauges stay published must put sampled
+# usage over the limit, and quota-violations-zero must flip the stage
+# to exit 1.
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --cluster-day --quick \
+    --inject quota-breach >/dev/null 2>&1; then
+    echo "cluster-day self-test FAILED: quota breach passed the oracle"
+    exit 1
+fi
 # Incident replay (ISSUE 13): the committed preemption-storm
 # postmortem converts deterministically into an arrival trace and
 # replays through the real control plane; the oracle must see every

@@ -77,7 +77,7 @@ SMOKE_MODULES = {
     "test_api.py", "test_tracking.py", "test_schedules_cache.py",
     "test_joins_events.py", "test_sliced.py", "test_controlplane.py",
     "test_utils_env.py", "test_scheduling.py", "test_analysis.py",
-    "test_oracle.py",
+    "test_oracle.py", "test_history.py",
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",
@@ -198,6 +198,12 @@ def pytest_collection_modifyitems(config, items):
             # determinism — rides the `-m obs` stage and is a smoke
             # module (the two-drain replay round-trip test carries the
             # `sim` marker on top for the sim-focused slice).
+            item.add_marker(pytest.mark.obs)
+        if fname == "test_history.py":
+            # Temporal telemetry (ISSUE 15): the bounded metrics-
+            # history ring, windowed-math goldens, the *_during /
+            # quota_violation oracle kinds, and the history API/CLI —
+            # rides the `-m obs` stage and the smoke tier.
             item.add_marker(pytest.mark.obs)
         if fname == "test_analysis.py":
             # Static-analysis gate (ISSUE 9): golden analyzer fixtures,
