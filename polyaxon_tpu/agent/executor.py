@@ -83,6 +83,11 @@ class _Gang:
     # restore possible; slice loss files a shrink here instead of a kill.
     elastic: Optional[elastic_mod.ElasticController] = None
     failed_resizes_dumped: int = 0  # postmortems already written
+    # Restore audit from the runtime (ISSUE 16): which tier satisfied
+    # the run's restore, mirrored into meta["checkpoint"] on poll so
+    # ops surfaces read the store, not the thread.
+    checkpoint_audit: Optional[dict] = None
+    checkpoint_flushed: bool = False
 
 
 class LocalExecutor:
@@ -492,12 +497,22 @@ class LocalExecutor:
                     f"restored checkpoint step {result.restored_from_step} "
                     f"after skipping corrupt step(s) "
                     f"{result.restore_skipped_steps}")
+            if result.restored_from_step is not None:
+                gang.checkpoint_audit = {
+                    "restored_from_step": result.restored_from_step,
+                    "restore_tier": result.restore_tier,
+                    **({"restore_skipped_steps":
+                        result.restore_skipped_steps}
+                       if result.restore_skipped_steps else {}),
+                }
             tracking.log_outputs(
                 steps=result.steps, throughput=result.throughput,
                 wall_time=result.wall_time, param_count=result.param_count,
                 # Same resume-audit field as the subprocess entrypoint
                 # (runtime/launch.py): None means cold start.
                 restored_from_step=result.restored_from_step,
+                **({"restore_tier": result.restore_tier}
+                   if result.restore_tier is not None else {}),
                 **({"restore_skipped_steps": result.restore_skipped_steps}
                    if result.restore_skipped_steps else {}),
                 **{f"final_{k}": v for k, v in result.final_metrics.items()},
@@ -565,6 +580,7 @@ class LocalExecutor:
             # while the gang is LIVE: the scheduler's resizing-hold and
             # the ops surfaces read the store, not the controller.
             self._flush_elastic(run_uuid, gang)
+            self._flush_checkpoint(run_uuid, gang)
         for run_uuid, gang in list(self._gangs.items()):
             status = self._gang_status(gang)
             if status is None:
@@ -573,6 +589,7 @@ class LocalExecutor:
             # Final audit flush: the thread may have finished an attempt
             # between the live flush above and its exit.
             self._flush_elastic(run_uuid, gang)
+            self._flush_checkpoint(run_uuid, gang)
             record = self.store.get_run(run_uuid)
             if record.status == V1Statuses.STOPPING:
                 self._finish_gang_span(gang, final="stopped")
@@ -658,6 +675,22 @@ class LocalExecutor:
                 message=(f"{last['direction']} {last['from_devices']}→"
                          f"{last['to_devices']} devices: "
                          f"{last.get('error', '')}")[:500])
+
+    def _flush_checkpoint(self, run_uuid: str, gang: _Gang) -> None:
+        """Write the runtime's restore audit into ``meta["checkpoint"]``
+        once it exists: ``restore_tier`` ("0" memory / "1" spill / "2"
+        store) + ``restored_from_step`` (+ any culled steps), so `plx ops
+        report` and the drills can assert WHERE a rerun resumed from."""
+        if gang.checkpoint_audit is None or gang.checkpoint_flushed:
+            return
+        try:
+            record = self.store.get_run(run_uuid)
+        except KeyError:
+            return
+        meta = dict(record.meta or {})
+        meta["checkpoint"] = dict(gang.checkpoint_audit)
+        self.store.update_run(run_uuid, meta=meta)
+        gang.checkpoint_flushed = True
 
     def _gang_status(self, gang: _Gang) -> Optional[int]:
         """None while running; else first nonzero exit code of the gang.

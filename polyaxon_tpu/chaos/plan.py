@@ -19,7 +19,11 @@ one of four seams the orchestration spine crosses on every run:
                    resize; a non-elastic gang is preempted), op
                    ``restore`` returns the capacity (files a *grow*).
                    ``min_checkpoints`` gates like the gang seam, and a
-                   ``restore`` is only eligible after a ``kill`` fired.
+                   ``restore`` is only eligible after a ``kill`` fired;
+- ``tier0-loss`` — tiered checkpointing (ISSUE 16): drop the in-memory
+                   tier-0 replica AND its local-disk spill right before
+                   a restore, so the store-fallback path is drilled,
+                   not assumed (``runtime.tiers`` consults this seam).
 
 Activation: tests call :func:`polyaxon_tpu.chaos.install`; operators
 point ``POLYAXON_TPU_CHAOS_PLAN`` at a JSON file (or inline JSON) or
@@ -37,7 +41,8 @@ Plan JSON::
       {"seam": "checkpoint", "op": "corrupt_latest"},
       {"seam": "tick", "op": "skip", "at": 3},
       {"seam": "slice-loss", "op": "kill", "config": {"min_checkpoints": 2}},
-      {"seam": "slice-loss", "op": "restore", "config": {"min_checkpoints": 4}}
+      {"seam": "slice-loss", "op": "restore", "config": {"min_checkpoints": 4}},
+      {"seam": "tier0-loss", "op": "drop"}
     ]}
 
 ``at`` is 1-based over MATCHING events; ``times`` consecutive events
@@ -218,6 +223,18 @@ class ChaosPlan:
                 return op
             return None
         return None
+
+    def tier0_loss_due(self, directory: str) -> bool:
+        """True (once per fault budget) when a ``tier0-loss`` fault is
+        due for this checkpoint directory. The caller
+        (:func:`runtime.tiers.tier0_loss_due`) drops the in-memory
+        replica and the local spill so the restore must walk down to
+        the persistent store — the fallback drill."""
+        pending = [f for f in self.faults
+                   if f.matches("tier0-loss", "drop") and not f.exhausted]
+        if not pending:
+            return False
+        return self.fire("tier0-loss", "drop", detail=directory) is not None
 
     def maybe_stall_init(self, phase_kind: str) -> float:
         """Stall seam for executor init phases; returns seconds slept."""

@@ -400,10 +400,19 @@ def ops_report(uid, as_json):
                f"wall={report['wall_clock_ms'] / 1e3:.2f}s  "
                f"(phases sum {report['phase_sum_ms'] / 1e3:.2f}s)")
     for name, entry in report["phases"].items():
+        extra = ""
+        if name == "restore":
+            # Tier/culling audit (ISSUE 16): which tier answered each
+            # restore and which corrupt steps the fallback skipped.
+            if entry.get("tiers"):
+                extra += "  tiers " + " ".join(
+                    f"{t}:{n}" for t, n in entry["tiers"].items())
+            if entry.get("skipped_steps"):
+                extra += (f"  skipped={entry['skipped_steps']}")
         frac = (f"{entry['fraction'] * 100:5.1f}%"
                 if entry["fraction"] is not None else "    -")
         click.echo(f"  {name:<13} {entry['ms']:>10.1f}ms  {frac}"
-                   f"  x{entry['count']}")
+                   f"  x{entry['count']}{extra}")
     steps = report["steps"]
     if steps["windows"]:
         click.echo(f"step windows: {len(steps['windows'])}  "

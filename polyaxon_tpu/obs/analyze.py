@@ -105,6 +105,12 @@ def analyze_timeline(timeline: dict[str, Any]) -> dict[str, Any]:
     compiles: list[dict] = []
     retries: dict[str, int] = {}
     chaos: dict[str, int] = {}
+    # Restore-phase audit (ISSUE 16): corrupt steps culled during
+    # fallback and the tier each restore was satisfied from, surfaced
+    # on the report's restore phase so `plx ops report` shows WHERE a
+    # rerun resumed and what it had to skip to get there.
+    restore_skipped: list[int] = []
+    restore_tiers: dict[str, int] = {}
     for span in spans:
         name = span.get("name") or ""
         duration = float(span.get("duration_ms") or 0.0)
@@ -144,6 +150,14 @@ def analyze_timeline(timeline: dict[str, Any]) -> dict[str, Any]:
             credit(phase, duration)
             if name == "compile":
                 compiles.append(span)
+            elif name == "restore":
+                attrs = span.get("attributes") or {}
+                restore_skipped.extend(
+                    int(s) for s in attrs.get("skipped_steps") or [])
+                tier = attrs.get("restore_tier")
+                if tier is not None:
+                    tier = str(tier)
+                    restore_tiers[tier] = restore_tiers.get(tier, 0) + 1
 
     # Waits between phases: compile end → first execute start is queue
     # time; gaps between execute attempts are requeue backoff.
@@ -186,6 +200,12 @@ def analyze_timeline(timeline: dict[str, Any]) -> dict[str, Any]:
                          if wall_ms > 0 else None),
             "count": int(entry["count"]),
         }
+    if "restore" in report_phases:
+        if restore_skipped:
+            report_phases["restore"]["skipped_steps"] = restore_skipped
+        if restore_tiers:
+            report_phases["restore"]["tiers"] = dict(
+                sorted(restore_tiers.items()))
     return {
         "run_uuid": timeline.get("trace_id"),
         "wall_clock_ms": round(wall_ms, 3),

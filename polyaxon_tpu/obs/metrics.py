@@ -727,6 +727,24 @@ def elastic_resize_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
         "Wall seconds per elastic resize attempt (prewarm + commit)")
 
 
+def checkpoint_restore_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_checkpoint_restore_seconds",
+        "Wall seconds per checkpoint restore by winning tier (0 = "
+        "in-memory replica, 1 = local-disk spill, 2 = fsspec store) — "
+        "budgeted by the checkpoint-restore-slow rule and the "
+        "restore-budget-during-storm oracle invariant",
+        ("tier",))
+
+
+def checkpoint_save_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_checkpoint_save_seconds",
+        "Wall seconds per checkpoint save by tier (0 / 1 / 2) and mode "
+        "(sync = on the step loop, async = publisher thread off it)",
+        ("tier", "mode"))
+
+
 def serving_trace_dumps_total(registry: MetricsRegistry = REGISTRY) -> Counter:
     return registry.counter(
         "polyaxon_serving_trace_dumps_total",
@@ -839,6 +857,8 @@ def ensure_core_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     oracle_verdicts_total(registry)
     elastic_resizes_total(registry)
     elastic_resize_hist(registry)
+    checkpoint_restore_hist(registry)
+    checkpoint_save_hist(registry)
 
 
 # Families registered at scrape time (api/server.py) rather than by an

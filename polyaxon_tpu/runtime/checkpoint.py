@@ -6,18 +6,29 @@ process writes its own shards (orbax OCDBT), saves are async by default
 so the step loop never blocks on IO, and restore re-lays tensors onto
 the current mesh from the saved shardings — preemption-safe resume is
 ``latest_step() → restore(state_like)``.
+
+:class:`TieredCheckpointManager` (ISSUE 16) layers the cheap restore
+tiers from :mod:`runtime.tiers` in front of the store: a rolling
+in-memory replica (tier-0) and a local-disk spill (tier-1), published
+off the step loop by a daemon thread, restored tier-0-first with
+per-step fallback down through the store and the PR 1 corrupt-step
+culling — a poisoned tier can never win over an older clean one.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
+import time
 from typing import Any, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from polyaxon_tpu.polyflow.runs import V1JaxCheckpointing
+from polyaxon_tpu.runtime import tiers
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +51,13 @@ class CheckpointManager:
         # on-disk bytes failed to deserialize (newest first); surfaced
         # through TrainResult → outputs + a WARNING run condition.
         self.last_restore_skipped: list[int] = []
+        # Which tier satisfied the most recent restore() ("0" memory /
+        # "1" local spill / "2" store) — the meta["checkpoint"] audit.
+        self.last_restore_tier: Optional[str] = None
+        # Store step listing, shared by latest_step() and restore() so
+        # the resume path lists the step directory ONCE; invalidated on
+        # every mutation (save, corrupt-step delete).
+        self._steps_cache: Optional[list[int]] = None
 
     @property
     def enabled(self) -> bool:
@@ -58,9 +76,19 @@ class CheckpointManager:
         if not self.enabled and not force:
             return
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._steps_cache = None
+
+    def _list_steps(self) -> list[int]:
+        """Committed store steps, newest first — listed once and cached
+        so ``latest_step() → restore()`` costs a single directory scan
+        (the listing is a store round trip under fsspec)."""
+        if self._steps_cache is None:
+            self._steps_cache = sorted(self._mgr.all_steps(), reverse=True)
+        return self._steps_cache
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        steps = self._list_steps()
+        return steps[0] if steps else None
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the sharding/layout of ``state_like`` (an existing
@@ -75,14 +103,19 @@ class CheckpointManager:
         asked for those exact bytes.
         """
         self.last_restore_skipped = []
+        self.last_restore_tier = None
+        t_restore = time.perf_counter()
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
         if step is not None:
             restored = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
+            self.last_restore_tier = tiers.TIER_STORE
+            tiers._observe_restore(tiers.TIER_STORE,
+                                   time.perf_counter() - t_restore)
             logger.info("Restored checkpoint step=%s from %s", step,
                         self.directory)
             return restored
-        steps = sorted(self._mgr.all_steps(), reverse=True)
+        steps = self._list_steps()
         if not steps:
             raise FileNotFoundError(f"No checkpoint under {self.directory}")
         from polyaxon_tpu import chaos
@@ -110,6 +143,7 @@ class CheckpointManager:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     logger.warning("could not delete corrupt step %s",
                                    candidate)
+                self._steps_cache = None
                 continue
             if self.last_restore_skipped:
                 logger.warning(
@@ -118,6 +152,9 @@ class CheckpointManager:
             else:
                 logger.info("Restored checkpoint step=%s from %s",
                             candidate, self.directory)
+            self.last_restore_tier = tiers.TIER_STORE
+            tiers._observe_restore(tiers.TIER_STORE,
+                                   time.perf_counter() - t_restore)
             return restored
         raise RuntimeError(
             f"no restorable checkpoint under {self.directory}: every step "
@@ -129,3 +166,269 @@ class CheckpointManager:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+class TieredCheckpointManager(CheckpointManager):
+    """Store-backed manager with the ISSUE 16 cheap tiers in front.
+
+    ``save`` snapshots the state to host (the same copy orbax's async
+    path makes) and hands it to a daemon publisher that commits the
+    tier-0 in-memory replica and the tier-1 local spill off the step
+    loop — rolling, latest-wins, atomic (tmp→rename) on disk. ``restore``
+    walks candidate steps newest-first and, per step, tries memory →
+    spill → store; a tier that fails validation is culled and the walk
+    falls through, so a poisoned tier can never win over an older clean
+    one. The winning tier lands in ``last_restore_tier`` and the
+    catalogued ``polyaxon_checkpoint_restore_seconds{tier}`` sample.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        spec: Optional[V1JaxCheckpointing] = None,
+    ):
+        super().__init__(directory, spec)
+        self._spill = tiers.LocalSpill(self.directory)
+        self._publish_cv = threading.Condition()
+        self._pending: Optional[tuple[int, dict[str, np.ndarray]]] = None
+        self._publishing = False
+        self._publisher_stop = False
+        self._publisher: Optional[threading.Thread] = None
+        self.publish_errors = 0
+
+    # ------------------------------------------------------------- save
+    def save(self, step: int, state: Any, *, force: bool = False) -> None:
+        if not self.enabled and not force:
+            return
+        mode = "async" if self.spec.async_save else "sync"
+        t0 = time.perf_counter()
+        super().save(step, state, force=force)
+        tiers._observe_save(tiers.TIER_STORE, mode,
+                            time.perf_counter() - t0)
+        try:
+            # Host snapshot NOW (the step loop reassigns/donates state
+            # buffers); the registry publish + spill IO commit on the
+            # publisher thread, off the step loop.
+            arrays = {f"leaf_{i}": np.asarray(jax.device_get(leaf))
+                      for i, leaf in enumerate(jax.tree.leaves(state))}
+        except Exception as exc:  # noqa: BLE001 — tiers are an accelerant,
+            # never a correctness dependency: the store save above holds.
+            self.publish_errors += 1
+            logger.warning("tier-0 snapshot for step %s failed (%s); "
+                           "store tier still committed", step, exc)
+            return
+        with self._publish_cv:
+            self._pending = (int(step), arrays)  # rolling: latest wins
+            if self._publisher is None or not self._publisher.is_alive():
+                self._publisher_stop = False
+                self._publisher = threading.Thread(
+                    target=self._publish_loop,
+                    name="ckpt-tier0-publisher", daemon=True)
+                self._publisher.start()
+            self._publish_cv.notify_all()
+
+    def _publish_loop(self) -> None:
+        while True:
+            with self._publish_cv:
+                while self._pending is None and not self._publisher_stop:
+                    self._publish_cv.wait()
+                if self._pending is None:
+                    return
+                step, arrays = self._pending
+                self._pending = None
+                self._publishing = True
+            try:
+                t0 = time.perf_counter()
+                tiers.TIER0.publish(self.directory, step, arrays)
+                tiers._observe_save(tiers.TIER_MEMORY, "async",
+                                    time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                committed = self._spill.spill(step, arrays)
+                tiers._observe_save(tiers.TIER_LOCAL, "async",
+                                    time.perf_counter() - t1)
+                if not committed:
+                    logger.warning("tier-1 commit withheld for step %s",
+                                   step)
+            except Exception as exc:  # noqa: BLE001 — fail-open (see save)
+                self.publish_errors += 1
+                logger.warning("tier-0/1 publish for step %s failed: %s",
+                               step, exc)
+            finally:
+                with self._publish_cv:
+                    self._publishing = False
+                    self._publish_cv.notify_all()
+
+    # ---------------------------------------------------------- restore
+    def latest_step(self) -> Optional[int]:
+        candidates = list(self._list_steps())
+        replica = tiers.TIER0.lookup(self.directory)
+        if replica is not None:
+            candidates.append(int(replica["step"]))
+        candidates.extend(self._spill.steps())
+        return max(candidates, default=None)
+
+    def _materialize(self, state_like: Any,
+                     arrays: dict[str, np.ndarray]) -> Any:
+        """Re-lay flat tier-0/1 leaves onto ``state_like``'s structure
+        and shardings (cross-mesh: an elastic resize restores the
+        replica straight onto the survivor mesh). Any mismatch raises —
+        the caller culls the tier and falls through."""
+        leaves_like, treedef = jax.tree.flatten(state_like)
+        if len(arrays) != len(leaves_like):
+            raise ValueError(
+                f"tier replica holds {len(arrays)} leaves, state expects "
+                f"{len(leaves_like)}")
+        out = []
+        for i, like in enumerate(leaves_like):
+            leaf = arrays[f"leaf_{i}"]
+            want_shape = getattr(like, "shape", None)
+            want_dtype = getattr(like, "dtype", None)
+            if (want_shape is not None
+                    and tuple(leaf.shape) != tuple(want_shape)):
+                raise ValueError(
+                    f"leaf_{i}: replica shape {tuple(leaf.shape)} != "
+                    f"expected {tuple(want_shape)}")
+            if (want_dtype is not None
+                    and np.dtype(leaf.dtype) != np.dtype(want_dtype)):
+                raise ValueError(
+                    f"leaf_{i}: replica dtype {leaf.dtype} != expected "
+                    f"{want_dtype}")
+            sharding = getattr(like, "sharding", None)
+            if sharding is not None:
+                out.append(jax.device_put(leaf, sharding))
+            elif want_shape is not None:
+                out.append(jax.device_put(leaf))
+            else:
+                out.append(leaf)
+        return jax.tree.unflatten(treedef, out)
+
+    def _won(self, restored: Any, candidate: int, tier: str,
+             t_restore: float) -> Any:
+        self.last_restore_tier = tier
+        tiers._observe_restore(tier, time.perf_counter() - t_restore)
+        if self.last_restore_skipped:
+            logger.warning(
+                "restored step %s from tier %s after skipping corrupt "
+                "step(s) %s", candidate, tier, self.last_restore_skipped)
+        else:
+            logger.info("Restored checkpoint step=%s tier=%s from %s",
+                        candidate, tier, self.directory)
+        return restored
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        if step is not None:
+            # Explicit step: the caller asked for those exact store
+            # bytes — no tier preference, no fallback (base contract).
+            return super().restore(state_like, step)
+        self.last_restore_skipped = []
+        self.last_restore_tier = None
+        t_restore = time.perf_counter()
+        # Chaos fallback drill: a due tier0-loss fault drops the memory
+        # replica AND the spill before we even look at them.
+        tiers.tier0_loss_due(self.directory)
+        replica = tiers.TIER0.lookup(self.directory)
+        spill_steps = set(self._spill.steps())
+        store_steps = self._list_steps()
+        candidates = sorted(
+            set(store_steps) | spill_steps
+            | ({int(replica["step"])} if replica is not None else set()),
+            reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"No checkpoint under {self.directory}")
+        from polyaxon_tpu import chaos
+
+        plan = chaos.active_plan()
+        if plan is not None and store_steps:
+            target = plan.corrupt_checkpoint(self.directory, store_steps)
+            if target is not None:
+                # The fault models the newest step's bytes rotting
+                # wherever they are replicated: the cheap tiers lose
+                # that step too, so the drill still proves the
+                # fall-back-to-older-step path.
+                if replica is not None and int(replica["step"]) == target:
+                    tiers.TIER0.drop(self.directory)
+                    replica = None
+                if target in spill_steps:
+                    self._spill.cull(target)
+                    spill_steps.discard(target)
+        abstract = None
+        last_error: Optional[Exception] = None
+        for candidate in candidates:
+            if replica is not None and int(replica["step"]) == candidate:
+                try:
+                    restored = self._materialize(state_like,
+                                                 replica["arrays"])
+                except Exception as exc:  # noqa: BLE001 — cull, fall through
+                    last_error = exc
+                    tiers.TIER0.drop(self.directory)
+                    replica = None
+                    logger.warning(
+                        "tier-0 replica at step %s unusable (%s: %s); "
+                        "falling through", candidate, type(exc).__name__,
+                        str(exc)[:200])
+                else:
+                    return self._won(restored, candidate,
+                                     tiers.TIER_MEMORY, t_restore)
+            if candidate in spill_steps:
+                try:
+                    arrays = self._spill.load(candidate)
+                    restored = self._materialize(state_like, arrays)
+                except Exception as exc:  # noqa: BLE001 — cull, fall through
+                    last_error = exc
+                    self._spill.cull(candidate)
+                    logger.warning(
+                        "tier-1 spill step %s unusable (%s: %s); falling "
+                        "through", candidate, type(exc).__name__,
+                        str(exc)[:200])
+                else:
+                    # Promote the winning spill into the memory slot so
+                    # the NEXT restore is a tier-0 hit.
+                    tiers.TIER0.publish(self.directory, candidate, arrays)
+                    return self._won(restored, candidate,
+                                     tiers.TIER_LOCAL, t_restore)
+            if candidate in store_steps:
+                if abstract is None:
+                    abstract = jax.tree.map(
+                        ocp.utils.to_shape_dtype_struct, state_like)
+                try:
+                    restored = self._mgr.restore(
+                        candidate, args=ocp.args.StandardRestore(abstract))
+                except Exception as exc:  # noqa: BLE001 — cull, fall back
+                    last_error = exc
+                    logger.warning(
+                        "checkpoint step %s under %s failed to restore "
+                        "(%s: %s); falling back to the next-older step",
+                        candidate, self.directory, type(exc).__name__,
+                        str(exc)[:200])
+                    try:
+                        self._mgr.delete(candidate)
+                    except Exception:  # noqa: BLE001 — best-effort cleanup
+                        logger.warning("could not delete corrupt step %s",
+                                       candidate)
+                    self._steps_cache = None
+                else:
+                    return self._won(restored, candidate,
+                                     tiers.TIER_STORE, t_restore)
+            # Every tier that held this step failed: the PR 1 culling
+            # audit, now cross-tier.
+            self.last_restore_skipped.append(candidate)
+        raise RuntimeError(
+            f"no restorable checkpoint under {self.directory}: every step "
+            f"{candidates} failed across all tiers") from last_error
+
+    # ---------------------------------------------------------- drain
+    def wait(self) -> None:
+        super().wait()
+        with self._publish_cv:
+            while self._pending is not None or self._publishing:
+                self._publish_cv.wait(timeout=0.1)
+
+    def close(self) -> None:
+        self.wait()
+        with self._publish_cv:
+            self._publisher_stop = True
+            self._publish_cv.notify_all()
+        if self._publisher is not None:
+            self._publisher.join(timeout=5.0)
+            self._publisher = None
+        super().close()

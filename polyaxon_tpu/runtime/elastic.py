@@ -488,8 +488,26 @@ def run_elastic(
                         f"resize target equals current topology "
                         f"({current_n} devices)")
                 target_axes = scaled_axes(base_axes, full_n, target_n)
-                info = prewarm(job, target_n, target_axes,
-                               devices=all_devices[:target_n])
+                warm_thread = None
+                if artifacts_dir:
+                    # Overlap the tier-0 fetch with the survivor-mesh
+                    # prewarm: while the target topology compiles, a
+                    # side thread promotes the newest local spill into
+                    # the in-memory slot so the next segment's restore
+                    # is a tier-0 hit instead of a store round trip.
+                    from polyaxon_tpu.runtime import tiers
+
+                    warm_thread = threading.Thread(
+                        target=tiers.warm,
+                        args=(f"{artifacts_dir}/checkpoints",),
+                        name="tier0-warm", daemon=True)
+                    warm_thread.start()
+                try:
+                    info = prewarm(job, target_n, target_axes,
+                                   devices=all_devices[:target_n])
+                finally:
+                    if warm_thread is not None:
+                        warm_thread.join(timeout=30.0)
             except PrewarmError as exc:
                 dt = time.perf_counter() - t0
                 controller.finish_attempt(attempt, "failed",

@@ -23,7 +23,8 @@ from polyaxon_tpu.parallel import build_mesh, rules_for_mesh
 from polyaxon_tpu.parallel.sharding import param_bytes
 from polyaxon_tpu.polyflow.runs import V1JAXJob, V1JaxCheckpointing
 from polyaxon_tpu.runtime import data as data_lib
-from polyaxon_tpu.runtime.checkpoint import CheckpointManager
+from polyaxon_tpu.runtime.checkpoint import (CheckpointManager,
+                                             TieredCheckpointManager)
 from polyaxon_tpu.runtime.config import RuntimeConfig
 from polyaxon_tpu.runtime.optim import build_optimizer
 from polyaxon_tpu.runtime.step import build_eval_step, build_init, build_train_step
@@ -47,6 +48,9 @@ class TrainResult:
     # restore that produced restored_from_step (newest first; empty on
     # a clean restore or cold start).
     restore_skipped_steps: list[int] = dataclasses.field(default_factory=list)
+    # Tier that satisfied the restore ("0" in-memory replica, "1" local
+    # spill, "2" store); None on a cold start.
+    restore_tier: Optional[str] = None
     # Host time blocked on `next(batches)`, averaged per timed step —
     # ~0 when the prefetcher keeps up, ≈ generation+transfer time when
     # the input pipeline is the bottleneck.
@@ -234,17 +238,21 @@ def _run_jaxjob(
         ckpt: Optional[CheckpointManager] = None
         restored_from = None
         restore_skipped: list[int] = []
+        restore_tier: Optional[str] = None
         ckpt_spec = job.checkpointing or V1JaxCheckpointing(enabled=False)
         if artifacts_dir and ckpt_spec.enabled:
-            ckpt = CheckpointManager(f"{artifacts_dir}/checkpoints", ckpt_spec)
+            ckpt = TieredCheckpointManager(f"{artifacts_dir}/checkpoints",
+                                           ckpt_spec)
             if ckpt_spec.restore_on_start and ckpt.latest_step() is not None:
                 with _span(tracer, "restore") as sp:
                     state = ckpt.restore(state)
                     restored_from = int(state["step"])
                     restore_skipped = list(ckpt.last_restore_skipped)
+                    restore_tier = ckpt.last_restore_tier
                     if sp is not None:
                         sp.set(restored_from_step=restored_from,
-                               skipped_steps=restore_skipped)
+                               skipped_steps=restore_skipped,
+                               restore_tier=restore_tier)
 
         seq = ds_kwargs.get("seq_len", 1)
         units_per_step = global_batch * (seq if model_def.unit == "tokens" else 1)
@@ -264,6 +272,7 @@ def _run_jaxjob(
                 param_count=int(n_params),
                 restored_from_step=restored_from,
                 restore_skipped_steps=restore_skipped,
+                restore_tier=restore_tier,
             )
         # Data streams are index-addressable (batch i = f(seed, i)), so a
         # restored run resumes the stream at its step instead of replaying
@@ -535,6 +544,7 @@ def _run_jaxjob(
         param_count=int(n_params),
         restored_from_step=restored_from,
         restore_skipped_steps=restore_skipped,
+        restore_tier=restore_tier,
         input_wait_ms=1e3 * wait_total / timed_steps if timed_steps else 0.0,
         compile_time_s=compile_time_s,
     )

@@ -1,0 +1,230 @@
+"""Tier-0/tier-1 checkpoint planes (ISSUE 16): the cheap restore tiers
+in front of the persistent store.
+
+Orbax's production answer to restore cost (PAPERS.md) is multi-tier
+checkpointing: a rolling in-memory replica of the latest committed step
+(tier-0) over a local-disk spill (tier-1) over the fsspec store
+(tier-2), so preemption and elasticity cost seconds instead of a full
+store round trip. This module owns the two cheap tiers; the orbax-backed
+store tier stays in :mod:`runtime.checkpoint`, whose
+``TieredCheckpointManager`` composes all three.
+
+Deliberately dependency-light (numpy + stdlib, no jax/orbax): the fleet
+simulator drives the REAL tier mechanics — same registry, same atomic
+commit, same chaos seam — without paying a jax import, so the
+cluster-day's restore-budget verdicts judge this exact code.
+
+Commit protocol (tier-1): every spill writes the full payload to a
+``.tmp-<step>`` sibling and publishes it with ``os.replace`` — the
+Orbax-style tmp→rename atomic commit. A reader can never observe a
+half-written step file; a crash mid-write leaves only a tmp orphan that
+the next spill for that step overwrites. The spill dir is named
+``.tier1`` (non-digit) so orbax step listings and the chaos plan's
+``_checkpoint_steps`` gate never see it as a committed store step.
+
+Tier-0 is a process-global registry keyed by the absolute checkpoint
+directory: an in-process preemption-requeue rerun (same agent process,
+same artifacts dir) and every elastic segment land on the same slot.
+Subprocess reruns lose the memory replica by construction and fall
+through to the tier-1 spill — that asymmetry is the tier ladder working,
+not a bug.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Tier labels as they appear in metrics (`polyaxon_checkpoint_restore_
+# seconds{tier=...}`) and the `meta["checkpoint"]["restore_tier"]` audit.
+TIER_MEMORY = "0"
+TIER_LOCAL = "1"
+TIER_STORE = "2"
+
+# The committed restore-budget floor: restore p99 must stay under this
+# many wall seconds. Mirrored by obs/rules.json `checkpoint-restore-slow`
+# and obs/oracle.json `restore-budget-during-storm` — change all three
+# together.
+RESTORE_BUDGET_P99_SECONDS = 2.5
+
+SPILL_DIRNAME = ".tier1"
+SPILL_KEEP = 2  # committed spill steps retained per directory
+
+# Red-team wedge (sim.gauntlet --inject stuck-tier0-commit): when set,
+# spills write their tmp file but withhold the os.replace commit — the
+# atomic-commit protocol's failure mode, drilled for real. Readers then
+# never see the step (tmp files are invisible to steps()/load()).
+WEDGE_TIER0_COMMITS = False
+
+
+def _observe_restore(tier: str, seconds: float) -> None:
+    """Catalogued restore wall time; fail-open like every telemetry
+    garnish — a broken metrics plane must never fail a restore."""
+    try:
+        from polyaxon_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.checkpoint_restore_hist().observe(seconds, tier=tier)
+    # polycheck: ignore[invariant-swallow] -- telemetry garnish on the restore path; a broken registry must not fail the restore that just succeeded
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _observe_save(tier: str, mode: str, seconds: float) -> None:
+    try:
+        from polyaxon_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.checkpoint_save_hist().observe(seconds, tier=tier,
+                                                   mode=mode)
+    # polycheck: ignore[invariant-swallow] -- telemetry garnish on the save path; same fail-open contract as _observe_restore
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class Tier0Registry:
+    """Process-global in-memory replica slots, one per checkpoint dir.
+
+    Rolling: each publish replaces the slot (the replica tracks only the
+    latest committed step — older steps live in the spill/store tiers).
+    Payloads are host-side numpy leaves; the registry never touches
+    devices, so it is safe from any thread.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slots: dict[str, dict[str, Any]] = {}
+
+    def publish(self, directory: str, step: int,
+                arrays: dict[str, np.ndarray]) -> None:
+        directory = os.path.abspath(directory)
+        with self._lock:
+            self._slots[directory] = {"step": int(step), "arrays": arrays}
+
+    def lookup(self, directory: str) -> Optional[dict[str, Any]]:
+        """``{"step", "arrays"}`` for the replica, or None. The arrays
+        are returned by reference — callers must not mutate them."""
+        with self._lock:
+            return self._slots.get(os.path.abspath(directory))
+
+    def drop(self, directory: str) -> bool:
+        with self._lock:
+            return self._slots.pop(os.path.abspath(directory),
+                                   None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots.clear()
+
+
+TIER0 = Tier0Registry()
+
+
+class LocalSpill:
+    """Tier-1: npz step files under ``<directory>/.tier1``, committed
+    atomically (tmp → ``os.replace``) so readers never see torn bytes."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        self.path = os.path.join(self.directory, SPILL_DIRNAME)
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.path, f"{int(step)}.npz")
+
+    def spill(self, step: int, arrays: dict[str, np.ndarray], *,
+              keep: int = SPILL_KEEP) -> bool:
+        """Commit one step; returns False when the commit was withheld
+        (:data:`WEDGE_TIER0_COMMITS`) — the tmp bytes exist but the step
+        is not published."""
+        os.makedirs(self.path, exist_ok=True)
+        final = self._step_path(step)
+        tmp = os.path.join(self.path, f".tmp-{int(step)}.npz")
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        with open(tmp, "wb") as fh:
+            fh.write(buf.getvalue())
+        if WEDGE_TIER0_COMMITS:
+            logger.warning("tier-1 commit wedged for step %s under %s "
+                           "(WEDGE_TIER0_COMMITS)", step, self.path)
+            return False
+        os.replace(tmp, final)
+        self._prune(keep)
+        return True
+
+    def _prune(self, keep: int) -> None:
+        for stale in self.steps()[keep:]:
+            try:
+                os.remove(self._step_path(stale))
+            except OSError:
+                pass
+
+    def steps(self) -> list[int]:
+        """Committed spill steps, newest first."""
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            stem, ext = os.path.splitext(name)
+            if ext == ".npz" and stem.isdigit():
+                out.append(int(stem))
+        return sorted(out, reverse=True)
+
+    def load(self, step: int) -> dict[str, np.ndarray]:
+        """Raises on missing/corrupt bytes — the caller culls and falls
+        through to the next tier."""
+        with np.load(self._step_path(step)) as data:
+            return {k: data[k] for k in data.files}
+
+    def cull(self, step: int) -> None:
+        try:
+            os.remove(self._step_path(step))
+        except OSError:
+            pass
+
+    def drop_all(self) -> None:
+        for step in self.steps():
+            self.cull(step)
+
+
+def tier0_loss_due(directory: str) -> bool:
+    """Consult the chaos ``tier0-loss`` seam for this checkpoint dir;
+    when a fault fires, kill BOTH cheap tiers — the memory replica and
+    the local spill — so the restore drills the store fallback instead
+    of assuming it."""
+    from polyaxon_tpu import chaos
+
+    plan = chaos.active_plan()
+    if plan is None or not plan.tier0_loss_due(directory):
+        return False
+    TIER0.drop(directory)
+    LocalSpill(directory).drop_all()
+    logger.warning("chaos: tier-0 replica and local spill dropped for %s",
+                   directory)
+    return True
+
+
+def warm(directory: str) -> Optional[int]:
+    """Promote the newest committed spill step into the memory slot when
+    the slot is cold (the elastic resize path runs this on a side thread,
+    overlapped with the survivor-mesh prewarm, so the next segment's
+    restore is a tier-0 memory hit). Returns the warmed step, or None
+    when the slot was already hot or nothing is spilled."""
+    if TIER0.lookup(directory) is not None:
+        return None
+    spill = LocalSpill(directory)
+    for step in spill.steps():
+        try:
+            arrays = spill.load(step)
+        except Exception:  # noqa: BLE001 — corrupt spill: cull, keep looking
+            spill.cull(step)
+            continue
+        TIER0.publish(directory, step, arrays)
+        return step
+    return None

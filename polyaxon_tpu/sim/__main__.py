@@ -11,6 +11,10 @@ Modes:
   python -m polyaxon_tpu.sim --cluster-day --quick  # compressed day (CI)
   python -m polyaxon_tpu.sim --cluster-day --full   # the full day profile
   python -m polyaxon_tpu.sim --cluster-day --quick --inject quota-breach
+  python -m polyaxon_tpu.sim --cluster-day --quick --inject tier0-loss
+      # must still PASS: restores fall back to the store tier
+  python -m polyaxon_tpu.sim --cluster-day --quick --inject stuck-tier0-commit
+      # must FAIL: wedged tier-1 commits strand gangs, runs never terminal
   python -m polyaxon_tpu.sim --replay sim/scenarios/preemption-storm.json
 """
 

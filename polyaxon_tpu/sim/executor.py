@@ -11,16 +11,30 @@ sees is the real one.
 Determinism: all sampling comes from a seeded ``random.Random``;
 durations/failures are configurable per-instance so traces can model
 serving long-runs next to subsecond churn jobs.
+
+Checkpoint lane (ISSUE 16): when ``checkpoint_dir`` is set, every gang
+start commits a tiny payload through the REAL multi-tier plane
+(``runtime.tiers``: tier-0 registry publish + local spill + a store
+stand-in file) and every post-preemption rerun restores tier-0-first
+through the same fallback ladder, observing the catalogued
+``polyaxon_checkpoint_{save,restore}_seconds`` histograms — so the
+cluster-day gauntlet's restore-budget invariant and the ``tier0-loss``
+/ ``stuck-tier0-commit`` injects exercise the production tier
+mechanics, not a model of them.
 """
 
 from __future__ import annotations
 
 import heapq
+import os
 import random
 import time
 
+import numpy as np
+
 from polyaxon_tpu.lifecycle import V1Statuses
 from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.runtime import tiers
 
 
 class SyntheticExecutor:
@@ -28,13 +42,25 @@ class SyntheticExecutor:
 
     def __init__(self, plane, *, mean_duration: float = 0.05,
                  duration_jitter: float = 0.5, failure_rate: float = 0.0,
-                 seed: int = 0, resize_duration: float = 0.05):
+                 seed: int = 0, resize_duration: float = 0.05,
+                 checkpoint_dir: str | None = None):
         self.plane = plane
         self.store = plane.store
         self.mean_duration = mean_duration
         self.duration_jitter = duration_jitter
         self.failure_rate = failure_rate
         self.resize_duration = resize_duration
+        # Multi-tier checkpoint lane (off for pure perf benches): gangs
+        # save/restore through the real runtime.tiers plane under this
+        # directory, one subdir per run uuid.
+        self.checkpoint_dir = checkpoint_dir
+        # Gangs whose tier-1 commit was withheld (stuck-tier0-commit
+        # inject); _reap_due refuses to reap them, so the drain times
+        # out and all-runs-terminal flips the gauntlet gate.
+        self.wedged_commits: set[str] = set()
+        self._preempted_ever: set[str] = set()
+        self._ckpt_dirs: set[str] = set()
+        self.restores_by_tier: dict[str, int] = {}
         # stuck-resize inject (sim.gauntlet): completions suppressed,
         # the meta `resizing` flag never clears, and the oracle's
         # all-runs-terminal invariant must flip the gate.
@@ -77,7 +103,91 @@ class SyntheticExecutor:
                                  False, False, None]
         heapq.heappush(self._heap, (deadline, run_uuid))
         self.started_total += 1
+        if self.checkpoint_dir is not None:
+            self._checkpoint_start(run_uuid)
         return True
+
+    # ------------------------------------------------- checkpoint lane
+    def _checkpoint_start(self, run_uuid: str) -> None:
+        """Rerun restore (tier-0-first) then a fresh save through the
+        real tier plane: spill commit, tier-0 publish, store stand-in."""
+        directory = os.path.join(self.checkpoint_dir, run_uuid)
+        self._ckpt_dirs.add(directory)
+        if run_uuid in self._preempted_ever:
+            self._restore_checkpoint(run_uuid, directory, audit=True)
+        step = self.started_total
+        arrays = {"leaf_0": np.full(4, float(step))}
+        t0 = time.perf_counter()
+        committed = tiers.LocalSpill(directory).spill(step, arrays)
+        tiers._observe_save(tiers.TIER_LOCAL, "sync",
+                            time.perf_counter() - t0)
+        if not committed:  # WEDGE_TIER0_COMMITS withheld the rename
+            self.wedged_commits.add(run_uuid)
+            return
+        tiers.TIER0.publish(directory, step, arrays)
+        np.savez(os.path.join(directory, "store.npz"), step=step, **arrays)
+
+    def _restore_checkpoint(self, run_uuid: str, directory: str, *,
+                            audit: bool) -> str | None:
+        """One measured restore down the tier ladder; mirrors the audit
+        into ``meta["checkpoint"]`` (the LocalExecutor contract) when
+        ``audit`` is set."""
+        t0 = time.perf_counter()
+        tiers.tier0_loss_due(directory)  # chaos seam: may drop tiers 0/1
+        tier = step = None
+        replica = tiers.TIER0.lookup(directory)
+        if replica is not None:
+            tier, step = tiers.TIER_MEMORY, replica["step"]
+        if tier is None:
+            spill = tiers.LocalSpill(directory)
+            for candidate in spill.steps():
+                try:
+                    spill.load(candidate)
+                except Exception:
+                    spill.cull(candidate)
+                    continue
+                tier, step = tiers.TIER_LOCAL, candidate
+                break
+        if tier is None:
+            try:
+                with np.load(os.path.join(directory, "store.npz")) as data:
+                    step = int(data["step"])
+                tier = tiers.TIER_STORE
+            except Exception:
+                return None  # nothing ever committed for this gang
+        tiers._observe_restore(tier, time.perf_counter() - t0)
+        self.restores_by_tier[tier] = self.restores_by_tier.get(tier, 0) + 1
+        if audit:
+            record = self.store.get_run(run_uuid)
+            meta = dict(record.meta or {})
+            meta["checkpoint"] = {"restore_tier": tier,
+                                  "restored_from_step": int(step)}
+            self.store.update_run(run_uuid, meta=meta)
+        return tier
+
+    def drill_restore(self) -> str | None:
+        """One measured restore against the most recently started live
+        gang — the storm loop's analogue of the serving lane's
+        one-request drill, so the restore-budget-during-storm invariant
+        always has in-window tier samples to judge."""
+        if self.checkpoint_dir is None:
+            return None
+        for run_uuid in reversed(list(self._gangs)):
+            if run_uuid in self.wedged_commits:
+                continue
+            tier = self._restore_checkpoint(
+                run_uuid, os.path.join(self.checkpoint_dir, run_uuid),
+                audit=False)
+            if tier is not None:
+                return tier
+        return None
+
+    def close_checkpoints(self) -> None:
+        """Drop this fleet's tier-0 entries from the process-global
+        registry (the sim home is about to be deleted)."""
+        for directory in self._ckpt_dirs:
+            tiers.TIER0.drop(directory)
+        self._ckpt_dirs.clear()
 
     # -------------------------------------------------------- elastic resize
     def request_resize(self, run_uuid: str, direction: str, *,
@@ -161,6 +271,14 @@ class SyntheticExecutor:
                 continue  # stale heap entry (stopped/preempted earlier)
             deadline, outcome, stopping, preempted, elastic = gang
             if not stopping and not preempted:
+                if run_uuid in self.wedged_commits:
+                    # Outstanding tier-0 commit (stuck-tier0-commit
+                    # inject): the executor will not reap a gang whose
+                    # checkpoint publisher never committed — the drain
+                    # times out and the oracle's all-runs-terminal
+                    # invariant flips the gate, by design.
+                    heapq.heappush(self._heap, (now + 0.05, run_uuid))
+                    continue
                 if elastic is not None and elastic["resizing"]:
                     # Mid-resize gangs are not reapable (the sim twin of
                     # the scheduler's resizing-hold); revisit once the
@@ -216,6 +334,7 @@ class SyntheticExecutor:
         gang = self._gangs.get(run_uuid)
         if gang is None:
             return False
+        self._preempted_ever.add(run_uuid)
         gang[3] = True
         heapq.heappush(self._heap, (0.0, run_uuid))
         return True

@@ -13,6 +13,7 @@ every store access are the production code paths.
 
 from __future__ import annotations
 
+import os
 import shutil
 import statistics
 import tempfile
@@ -48,7 +49,7 @@ class FleetSim:
                  seed: int = 0, incremental: bool = True,
                  legacy_scan: bool = False, deopt: bool = False,
                  mean_duration: float = 0.05, failure_rate: float = 0.02,
-                 rebuild_ticks: int = 50):
+                 rebuild_ticks: int = 50, checkpoint_lane: bool = False):
         self._tmp = None
         if home is None:
             self._tmp = tempfile.mkdtemp(prefix="polyaxon-sim-")
@@ -57,7 +58,9 @@ class FleetSim:
         self.store = self.plane.store
         self.executor = SyntheticExecutor(
             self.plane, mean_duration=mean_duration,
-            failure_rate=failure_rate, seed=seed)
+            failure_rate=failure_rate, seed=seed,
+            checkpoint_dir=(os.path.join(home, "ckpt-tiers")
+                            if checkpoint_lane else None))
         self.admission = AdmissionController(
             self.plane, incremental=incremental,
             rebuild_ticks=rebuild_ticks)
@@ -264,5 +267,6 @@ class FleetSim:
         if self._open_windows:
             # Never leave a marker dangling past the sim's lifetime.
             self._close_due_windows(float("inf"))
+        self.executor.close_checkpoints()
         if self._tmp:
             shutil.rmtree(self._tmp, ignore_errors=True)

@@ -234,7 +234,8 @@ def run_gauntlet(*, seed: int = GAUNTLET_SEED,
 # trials; the compressed day keeps the mapping-sweep lane (~90k trials)
 # and sizes the Hyperband lane to a CI-feasible fraction of the anchor.
 TRIALS_PER_HOUR = 295.6
-CLUSTER_DAY_INJECTS = ("quota-breach", "stuck-requeue")
+CLUSTER_DAY_INJECTS = ("quota-breach", "stuck-requeue", "tier0-loss",
+                       "stuck-tier0-commit")
 # Invariants a green cluster day must have actually judged (pass, not
 # skip). The serving-p99-during-storm anchor joins when the real
 # serving engine ran (it skips only when the serving stack is absent).
@@ -331,6 +332,15 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     ``inject="quota-breach"`` is the red-team self-test: admission's
     quota check is bypassed (and quotas tightened), so sampled usage
     must exceed the limit gauges and ``quota-violations-zero`` MUST
+    flip the exit code.
+
+    The checkpoint-lane injects (ISSUE 16) drill both directions:
+    ``tier0-loss`` adds an inexhaustible chaos fault that drops the
+    cheap tiers before every restore — the day must STILL pass via the
+    store fallback (the restore-budget anchor is waived; no tier-0
+    samples exist to judge) — while ``stuck-tier0-commit`` wedges the
+    tier-1 atomic commit (``tiers.WEDGE_TIER0_COMMITS``), gangs with an
+    outstanding commit are never reaped, and ``all-runs-terminal`` MUST
     flip the exit code."""
     import dataclasses
 
@@ -338,6 +348,7 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     from polyaxon_tpu.obs import metrics as obs_metrics
     from polyaxon_tpu.obs import oracle as obs_oracle
     from polyaxon_tpu.obs import rules as obs_rules
+    from polyaxon_tpu.runtime import tiers
     from polyaxon_tpu.sim.fleet import FleetSim
 
     if inject is not None and inject not in CLUSTER_DAY_INJECTS:
@@ -353,7 +364,8 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     evening = [dataclasses.replace(e, at=round(e.at - storm_at, 6))
                for e in events if e.at > storm_at]
 
-    sim = FleetSim(seed=seed, capacity=spec["capacity"])
+    sim = FleetSim(seed=seed, capacity=spec["capacity"],
+                   checkpoint_lane=True)
     quota_runs = 2 if inject == "quota-breach" else spec["max_runs"]
     for project, weight in (("platform", 2.0), ("research", 1.0),
                             ("serving", 4.0), ("growth", 1.0)):
@@ -371,6 +383,9 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     elif inject == "stuck-requeue":
         sim.agent.scheduler._tick_preempted = lambda record: 0
         max_wall = min(max_wall, 30.0)
+    elif inject == "stuck-tier0-commit":
+        tiers.WEDGE_TIER0_COMMITS = True  # reset in the finally below
+        max_wall = min(max_wall, 30.0)
 
     clock_skew = [0.0]
     engine = obs_rules.AlertEngine(
@@ -383,7 +398,13 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     history = obs_history.MetricsHistory(
         obs_metrics.REGISTRY, cadence=spec["cadence"])
     obs_history.set_default_history(history)
-    chaos.install(chaos.ChaosPlan.load(_CLUSTER_DAY_CHAOS))
+    chaos_spec = json.loads(_CLUSTER_DAY_CHAOS)
+    if inject == "tier0-loss":
+        # Inexhaustible: EVERY restore finds its cheap tiers dropped
+        # and must walk down to the store stand-in.
+        chaos_spec["faults"].append(
+            {"seam": "tier0-loss", "op": "drop", "times": 1000000})
+    chaos.install(chaos.ChaosPlan.load(json.dumps(chaos_spec)))
     baseline = obs_metrics.REGISTRY.snapshot()
     serving_lane = _start_serving() if serving else None
     traffic = [0]  # requests served (continuous lane + storm lane)
@@ -406,6 +427,8 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
             ticks = len(sim.tick_seconds)
             if ticks % 8 == 0:
                 _one_request()  # continuous mixed-class traffic
+            if ticks % 8 == 4:
+                sim.executor.drill_restore()  # day-wide restore samples
             if ticks % 5 == 0:
                 engine.evaluate(plane=sim.plane)
 
@@ -419,6 +442,7 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
         storm_deadline = time.monotonic() + spec["storm_span"]
         while time.monotonic() < storm_deadline:
             _one_request()  # in-window serving samples
+            sim.executor.drill_restore()  # in-window restore samples
             sim.tick()
         history.sample(force=True)  # catch in-window TTFT before close
         sim.tick()  # past the deadline: closes the storm window
@@ -442,6 +466,7 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
             "reaped": sim.executor.reaped_total,
             "wall_seconds": round(time.monotonic() - t_start, 3),
             "divergence_total": sim.admission.divergence_total,
+            "restores_by_tier": dict(sim.executor.restores_by_tier),
             **sim.tick_report(),
         }
         window = obs_history.window_bounds(bundle.history or {}, "storm")
@@ -452,6 +477,7 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
             # polycheck: ignore[invariant-swallow] -- cleanup in a finally: a lane already stopped by the episode raising must not shadow the original exception
             except Exception:  # noqa: BLE001
                 pass
+        tiers.WEDGE_TIER0_COMMITS = False
         chaos.uninstall()
         sim.close()
         obs_history.set_default_history(prior_history)
@@ -460,6 +486,11 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     required = list(CLUSTER_DAY_REQUIRED)
     if serving_lane is not None:
         required.append("serving-p99-during-storm")
+    if inject != "tier0-loss":
+        # Under tier0-loss every restore lands on the store tier, so no
+        # tier-0 samples exist in the window and the invariant rightly
+        # skips — requiring it there would punish the fallback working.
+        required.append("restore-budget-during-storm")
     anchors_held = all(by_id.get(i) == "pass" for i in required)
     return {
         "passed": oracle_result["passed"] and anchors_held,

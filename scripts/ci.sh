@@ -75,6 +75,15 @@ python -m pytest tests/test_chaos.py -q -m chaos
 # on shrink and on grow) prove the fallback-to-requeue seam.
 echo "== elastic gangs (shrink/regrow drills + prewarm fallbacks)"
 python -m pytest tests/test_elastic.py -q -m elastic
+# Multi-tier checkpointing stage (ISSUE 16): the cross-tier fallback
+# ladder on the REAL TieredCheckpointManager — tier-0 hit, corrupt
+# replica → local spill (with re-promotion), cheap tiers gone → store,
+# all tiers corrupt at latest → older clean step — plus the atomic
+# spill commit, the tier0-loss chaos seam, the restore-phase audit in
+# the attribution report, and the acceptance timing claim (tier-0
+# measurably beats the store round trip on the same checkpoint).
+echo "== tiered checkpointing (fallback ladder + restore audit)"
+python -m pytest tests/test_checkpoint_tiers.py -q
 # Scheduling stage: multi-tenant admission invariants (queue priority,
 # fair-share convergence, quota walls, bounded starvation, the
 # preemption-for-priority drill) — deterministic and CPU-only.
@@ -187,6 +196,21 @@ if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --cluster-day --quick \
     echo "cluster-day self-test FAILED: quota breach passed the oracle"
     exit 1
 fi
+# The checkpoint ladder must DEGRADE, not fail: dropping the tier-0
+# replica and local spill on every restore (tier0-loss chaos) forces
+# the whole day onto the store tier — the day must still PASS (the
+# tier-0 restore-budget anchor rightly skips: no tier-0 samples land).
+echo "== cluster-day tier0-loss drill (store fallback must carry the day)"
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --cluster-day --quick \
+    --inject tier0-loss >/dev/null
+# ...and the commit protocol must be able to FAIL: wedging tier-1
+# commits (tmp written, rename withheld) strands every gang behind an
+# uncommitted checkpoint, and all-runs-terminal must flip to exit 1.
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --cluster-day --quick \
+    --inject stuck-tier0-commit >/dev/null 2>&1; then
+    echo "cluster-day self-test FAILED: wedged tier commits passed the oracle"
+    exit 1
+fi
 # Incident replay (ISSUE 13): the committed preemption-storm
 # postmortem converts deterministically into an arrival trace and
 # replays through the real control plane; the oracle must see every
@@ -194,6 +218,12 @@ fi
 echo "== incident replay (committed scenario, oracle-judged)"
 JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim \
     --replay polyaxon_tpu/sim/scenarios/preemption-storm.json >/dev/null
+# ISSUE 16 companion scenario: a mid-storm preemption whose rerun
+# found both cheap checkpoint tiers gone and walked the ladder to the
+# store (budget floor breached, alert fired→resolved) — replayed
+# against a loaded fleet, the oracle must still come back clean.
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim \
+    --replay polyaxon_tpu/sim/scenarios/tier0-loss-during-storm.json >/dev/null
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives
 # in the compiled HLO, and gate against polyaxon_tpu/perf/budgets.json

@@ -396,6 +396,13 @@ class TestElasticDrill:
         assert [c["seam"] for c in plan.consumed] == \
             ["slice-loss", "slice-loss"]
 
+        # Each post-resize segment restored tier-0-first: the in-memory
+        # replica answered (same process, same artifacts dir), audited
+        # into the run meta by the executor's checkpoint flush.
+        ckpt_audit = final.meta["checkpoint"]
+        assert ckpt_audit["restore_tier"] == "0"
+        assert ckpt_audit["restored_from_step"] >= 1
+
         audit = final.meta["elastic"]
         assert audit["budget"] == 2 and audit["used"] == 2
         assert audit["resizing"] is False and audit["shrunk"] is False
@@ -454,6 +461,10 @@ class TestElasticDrill:
         assert len(final.meta["backoff"]["preempt_delays"]) >= 1
         # The denied channel never spent budget it did not have.
         assert final.meta["elastic"]["used"] == 0
+        # The requeued rerun restored tier-0-first: the replica the
+        # first attempt published survived the in-process gang death.
+        assert final.meta["checkpoint"]["restore_tier"] == "0"
+        assert final.meta["checkpoint"]["restored_from_step"] >= 1
         assert plane.streams.get_outputs(record.uuid)["steps"] == 6
         # Preemption is a death the operator did not ask for: the black
         # box landed next to the run artifacts.

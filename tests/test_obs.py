@@ -548,6 +548,40 @@ class TestRuleLifecycle:
         engine.evaluate()
         assert [e["event"] for e in engine.history] == ["fired", "resolved"]
 
+    def test_checkpoint_restore_slow_fires_and_resolves(self):
+        """The committed checkpoint-restore-slow rule (ISSUE 16): the
+        rule judges the WORST tier series (quantile_max), so healthy
+        tier-0 hits cannot mask a slow store tier — slow store restores
+        push that series' p99 over the 2.5s budget floor and the rule
+        fires; once store restores run fast again the tail dilutes
+        under budget and the clear held past resolve_after resolves."""
+        (committed,) = [r for r in obs_rules.load_ruleset()
+                        if r.id == "checkpoint-restore-slow"]
+        assert committed.metric == "polyaxon_checkpoint_restore_seconds"
+        assert committed.kind == "threshold"
+        registry = obs_metrics.MetricsRegistry()
+        hist = obs_metrics.checkpoint_restore_hist(registry)
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([committed], registry=registry,
+                                       clock=clock)
+        for _ in range(50):
+            hist.observe(0.002, tier="0")  # healthy memory-replica hits
+        assert engine.evaluate() == []
+        for _ in range(10):
+            hist.observe(4.0, tier="2")  # slow store fallbacks: p99 over
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "checkpoint-restore-slow"
+        assert fired["value"] > 2.5
+        for _ in range(2000):
+            hist.observe(0.002, tier="2")  # store recovers: tail dilutes
+        clock.now += 10
+        assert engine.evaluate() == []  # clear clock starts here
+        clock.now += 31  # clear held past resolve_after = 30s
+        (resolved,) = engine.evaluate()
+        assert resolved["event"] == "resolved"
+        assert [e["event"] for e in engine.history] == ["fired", "resolved"]
+
     def test_threshold_against_derived_value_step_regression(self):
         """value_from: p99 > 3x p50 — the relative rule the default
         step-time-regression alert uses."""
