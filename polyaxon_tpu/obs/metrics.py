@@ -753,6 +753,50 @@ def serving_trace_dumps_total(registry: MetricsRegistry = REGISTRY) -> Counter:
         ("outcome",))
 
 
+def fleet_replicas(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_fleet_replicas",
+        "Serving-fleet replicas by lifecycle state (warming / standby / "
+        "ready / draining / released) — serving.fleet.ServingFleet",
+        ("state",))
+
+
+def fleet_routed_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_fleet_routed_total",
+        "Fleet router decisions by reason (affinity = prefix→replica "
+        "map hit, hash = consistent-hash placement, spill = hotness-cap "
+        "or unhealthy-owner deflection) — serving.router.FleetRouter",
+        ("reason",))
+
+
+def fleet_scale_events_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_fleet_scale_events_total",
+        "Autoscaler scale events by direction (up / down) and outcome "
+        "(ok / failed / refused / timeout) — watched by the "
+        "fleet-scale-flap rate rule",
+        ("direction", "outcome"))
+
+
+def fleet_replica_queue_depth(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_fleet_replica_queue_depth",
+        "Pending-queue depth per serving replica as the fleet poll saw "
+        "it last — the fleet-replica-hot threshold rule judges the "
+        "hottest series (alert-engine gauges take max across series)",
+        ("replica",))
+
+
+def ensure_fleet_metrics(registry: MetricsRegistry = REGISTRY) -> None:
+    """Pre-register the serving-fleet families (idempotent) — one
+    source of truth for :func:`catalog_metric_names`."""
+    fleet_replicas(registry)
+    fleet_routed_total(registry)
+    fleet_scale_events_total(registry)
+    fleet_replica_queue_depth(registry)
+
+
 def history_samples_total(registry: MetricsRegistry = REGISTRY) -> Counter:
     return registry.counter(
         "polyaxon_history_samples_total",
@@ -880,6 +924,7 @@ def catalog_metric_names() -> set[str]:
     scratch = MetricsRegistry()
     ensure_core_metrics(scratch)
     ensure_serving_metrics(scratch)
+    ensure_fleet_metrics(scratch)
     ensure_perf_metrics(scratch)
     ensure_history_metrics(scratch)
     names = set(scratch._metrics)

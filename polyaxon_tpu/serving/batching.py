@@ -997,9 +997,12 @@ class ContinuousBatchingEngine:
         return self._ring.summaries()
 
     def health(self) -> dict:
-        """Liveness + load view for /healthz: queue depth and slot
-        occupancy, so a balancer can shed or route before generate
-        requests start bouncing off the 503 cap."""
+        """Liveness + load view for /healthz: queue depth, slot
+        occupancy, radix hit rate, and paged-KV headroom — ONE polled
+        surface, so a balancer (serving.router.FleetRouter) can route
+        on affinity and shed on pressure without stitching /metrics
+        and /v1/stats by hand."""
+        denom = sum(p for _, p in self._hit_window)
         return {
             "status": "stopped" if self._stopped else "ok",
             "model": self.model,
@@ -1008,6 +1011,18 @@ class ContinuousBatchingEngine:
             "active": sum(1 for r in self._slot_req if r is not None),
             "slots": self.slots,
             "max_pending": self.max_pending,
+            # Rolling radix prefix hit rate (same admission window as
+            # the polyaxon_serving_prefix_hit_rate gauge); None until
+            # the window has samples, so cold starts read as unknown,
+            # not as a collapse.
+            "radix_hit_rate": (
+                round(sum(s for s, _ in self._hit_window) / denom, 4)
+                if len(self._hit_window) >= self._hit_window_min and denom
+                else None),
+            # Paged-KV headroom (None on dense engines): the router
+            # treats free == 0 as not-routable.
+            "kv_headroom": (self._pool.utilization()
+                            if self._pool is not None else None),
         }
 
     def stats(self) -> dict:

@@ -1,0 +1,385 @@
+"""Serving fleet: N engine replicas behind one router, scaled by rules.
+
+``ServingFleet`` owns the replica set and closes the loop the alert
+engine already measures: the **autoscaler** grows/shrinks the fleet
+from live rule state (``fleet-replica-hot``, ``serving-queue-
+saturation``, ``serving-ttft-slo-burn``) instead of a load guess.
+
+Two disciplines are non-negotiable, both inherited from the elastic
+runtime (PR 14's prewarm-before-commit):
+
+* **Scale-up warms before admission routes to it.** A replica enters
+  the router only in state ``ready``; the path there runs the model
+  (compiling every prefill/decode program) first. The cheap form is a
+  **standby**: an engine built AND warmed at fleet start, promoted to
+  ready in O(1) when the autoscaler fires — the spike pays zero
+  in-window compile. With no standby left, scale-up builds+warms a
+  fresh replica on a background thread and commits only when warm.
+  ``prewarm=False`` is the red-team seam (ci.sh ``cold-scale``): the
+  standby is built cold, promotion commits an engine whose first
+  routed request eats the XLA compile — the during-spike TTFT
+  invariant must catch exactly that.
+* **Scale-down drains before release.** The victim leaves the router
+  first (no new routes), then a background thread waits for its queue
+  and live slots to empty before ``stop()`` — in-flight decode always
+  finishes on the replica that admitted it.
+
+Engines are injected via ``engine_factory`` so unit tests drive the
+whole state machine with fakes at pure-Python speed; the real factory
+(:func:`engine_factory`) builds paged-KV ``ContinuousBatchingEngine``
+replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.serving.router import FleetRouter
+
+# Rule ids whose firing state means "add capacity". The autoscaler
+# consumes AlertEngine.active() — telemetry driving placement, not
+# only verdicts (ROADMAP item 2).
+SCALE_UP_RULES = frozenset((
+    "fleet-replica-hot",
+    "serving-queue-saturation",
+    "serving-ttft-slo-burn",
+))
+
+REPLICA_STATES = ("warming", "standby", "ready", "draining", "released")
+
+
+class Replica:
+    """One engine + its lifecycle state and last-polled telemetry."""
+
+    def __init__(self, rid: str):
+        self.id = rid
+        self.engine = None
+        self.state = "warming"
+        self.telemetry: dict = {}
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Replica({self.id}, {self.state})"
+
+
+def engine_factory(model: str = "llama_tiny", *, slots: int = 2,
+                   kv: str = "paged", page_size: int = 4,
+                   kv_pages: Optional[int] = None,
+                   **engine_kw) -> Callable:
+    """Real-engine factory: each call builds a fresh paged-KV
+    ``ContinuousBatchingEngine`` (its own jit wrappers — a new replica
+    really does pay compile until warmed)."""
+    def build():
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+        from polyaxon_tpu.serving.server import load_params
+        cfg, params = load_params(model, seed=0)
+        return ContinuousBatchingEngine(
+            model, cfg, params, slots=slots, kv=kv,
+            page_size=page_size, kv_pages=kv_pages, **engine_kw)
+    return build
+
+
+class ServingFleet:
+    """Replica set + router + SLO-driven autoscaler.
+
+    ``replicas`` engines start ready (warmed when ``prewarm``),
+    ``standby`` more are built warm but kept out of the router until a
+    scale-up promotes them. ``maybe_scale(firing)`` is the control
+    loop: call it with the alert engine's active rule ids.
+    """
+
+    def __init__(self, factory: Callable, *, replicas: int = 2,
+                 standby: int = 0, min_replicas: int = 1,
+                 max_replicas: int = 4, prewarm: bool = True,
+                 warmup_rows: Optional[Sequence[Sequence[int]]] = None,
+                 router: Optional[FleetRouter] = None,
+                 cooldown: float = 5.0, idle_hold: float = 2.0,
+                 registry=None, clock: Callable[[], float] = time.monotonic):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if replicas < min_replicas or replicas > max_replicas:
+            raise ValueError("replicas must sit in "
+                             "[min_replicas, max_replicas]")
+        self._factory = factory
+        self._initial = int(replicas)
+        self._standby_n = int(standby)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.prewarm = bool(prewarm)
+        self.warmup_rows = [list(r) for r in (warmup_rows or ())]
+        self.router = router or FleetRouter()
+        self.cooldown = float(cooldown)
+        self.idle_hold = float(idle_hold)
+        self._registry = registry or obs_metrics.REGISTRY
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        self._next_id = 0
+        self._threads: list[threading.Thread] = []
+        self._last_scale = -float("inf")
+        self._idle_since: Optional[float] = None
+        self.scale_events: list[dict] = []
+
+    # ------------------------------------------------------------ build
+    def _new_replica(self) -> Replica:
+        rep = Replica(f"r{self._next_id}")
+        self._next_id += 1
+        self._replicas[rep.id] = rep
+        return rep
+
+    def _warm(self, engine) -> None:
+        """Compile every program traffic will need: two passes so both
+        the full-prefill and the post-hit suffix-prefill programs (plus
+        the decode step) are built before admission sees the replica."""
+        if not self.warmup_rows:
+            return
+        for _ in range(2):
+            engine.generate(self.warmup_rows, max_new_tokens=2,
+                            klass="warmup")
+
+    def _build(self, rep: Replica, *, warm: bool) -> None:
+        rep.engine = self._factory()
+        if warm:
+            self._warm(rep.engine)
+
+    def start(self) -> None:
+        """Build the initial ready set + warm standbys (blocking — all
+        compile cost lands here, before any traffic window opens)."""
+        for _ in range(self._initial):
+            rep = self._new_replica()
+            self._build(rep, warm=self.prewarm)
+            rep.state = "ready"
+            self.router.add_replica(rep.id)
+        for _ in range(self._standby_n):
+            rep = self._new_replica()
+            # prewarm=False (cold-scale inject) leaves the standby's
+            # jit caches empty: promotion commits a cold engine.
+            self._build(rep, warm=self.prewarm)
+            rep.state = "standby"
+        self.poll()
+
+    # ------------------------------------------------------------ state
+    def _in_state(self, *states: str) -> list[Replica]:
+        return sorted((r for r in self._replicas.values()
+                       if r.state in states), key=lambda r: r.id)
+
+    @property
+    def ready(self) -> list[Replica]:
+        return self._in_state("ready")
+
+    # ------------------------------------------------------------- poll
+    def poll(self) -> dict:
+        """Refresh per-replica telemetry (the ONE polled surface —
+        ``engine.health()``) and publish the fleet gauges. Returns
+        ``{replica_id: health}`` for router consumption."""
+        counts = {s: 0 for s in REPLICA_STATES}
+        view: dict[str, dict] = {}
+        for rep in self._replicas.values():
+            counts[rep.state] += 1
+            if rep.engine is None or rep.state == "released":
+                continue
+            try:
+                rep.telemetry = rep.engine.health()
+            except Exception:
+                rep.telemetry = {"status": "error"}
+            if rep.state == "ready":
+                view[rep.id] = rep.telemetry
+            obs_metrics.fleet_replica_queue_depth(self._registry).set(
+                rep.telemetry.get("queued", 0), replica=rep.id)
+        gauge = obs_metrics.fleet_replicas(self._registry)
+        for state, n in counts.items():
+            gauge.set(n, state=state)
+        return view
+
+    # ------------------------------------------------------------ serve
+    def submit(self, tokens: Sequence[int], max_new_tokens: int, **kw):
+        """Route one request and submit it to the chosen replica.
+        Returns ``(request, decision)``."""
+        with self._lock:
+            telemetry = {r.id: r.telemetry for r in self.ready}
+            decision = self.router.route(tokens, telemetry=telemetry)
+            rep = self._replicas[decision.replica]
+        req = rep.engine.submit(list(tokens), max_new_tokens, **kw)
+        return req, decision
+
+    def generate(self, token_rows: Iterable[Sequence[int]],
+                 max_new_tokens: int, timeout: Optional[float] = None,
+                 **kw) -> list[list[int]]:
+        """Blocking convenience: route each row, wait for all."""
+        reqs = [self.submit(row, max_new_tokens, **kw)[0]
+                for row in token_rows]
+        return [r.wait(timeout=timeout) for r in reqs]
+
+    # -------------------------------------------------------- autoscale
+    def maybe_scale(self, firing: Iterable[str],
+                    now: Optional[float] = None) -> Optional[dict]:
+        """One control-loop step: grow on SLO-burn / saturation rule
+        state, shrink after a sustained idle hold. Cooldown-gated in
+        both directions so rule flap cannot thrash the fleet (the
+        ``fleet-scale-flap`` rate rule watches the event counter as a
+        second line of defense)."""
+        now = self._clock() if now is None else now
+        firing = set(firing)
+        with self._lock:
+            ready = self._in_state("ready")
+            warming = self._in_state("warming")
+            idle = all(
+                (r.telemetry.get("queued", 0)
+                 + r.telemetry.get("active", 0)) == 0 for r in ready)
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+        if now - self._last_scale < self.cooldown:
+            return None
+        if firing & SCALE_UP_RULES:
+            if warming or len(ready) + len(warming) >= self.max_replicas:
+                return None
+            self._last_scale = now
+            return self.scale_up()
+        if (not firing and len(ready) > self.min_replicas
+                and self._idle_since is not None
+                and now - self._idle_since >= self.idle_hold):
+            self._last_scale = now
+            return self.scale_down()
+        return None
+
+    def _record(self, direction: str, outcome: str, replica: str,
+                mode: str) -> dict:
+        event = {"direction": direction, "outcome": outcome,
+                 "replica": replica, "mode": mode}
+        self.scale_events.append(event)
+        obs_metrics.fleet_scale_events_total(self._registry).inc(
+            direction=direction, outcome=outcome)
+        return event
+
+    def scale_up(self) -> dict:
+        """Add capacity: promote a standby (already warm — O(1) commit)
+        or build+warm a fresh replica off-thread, committing to the
+        router only once warm. Admission NEVER routes to a replica the
+        prewarm discipline hasn't finished with — unless ``prewarm``
+        was disabled, which is the cold-scale red-team seam."""
+        with self._lock:
+            standbys = self._in_state("standby")
+            if standbys:
+                rep = standbys[0]
+                rep.state = "ready"
+                self.router.add_replica(rep.id)
+                return self._record("up", "ok", rep.id, "promote")
+            rep = self._new_replica()  # state: warming
+
+        def build() -> None:
+            try:
+                self._build(rep, warm=self.prewarm)
+            except Exception:
+                with self._lock:
+                    rep.state = "released"
+                self._record("up", "failed", rep.id, "build")
+                return
+            with self._lock:
+                rep.state = "ready"
+                self.router.add_replica(rep.id)
+            self._record("up", "ok", rep.id, "build")
+
+        t = threading.Thread(target=build, daemon=True,
+                             name=f"fleet-warm-{rep.id}")
+        self._threads.append(t)
+        t.start()
+        return {"direction": "up", "outcome": "pending",
+                "replica": rep.id, "mode": "build"}
+
+    def scale_down(self, timeout: float = 30.0) -> dict:
+        """Shed capacity: newest ready replica leaves the router NOW
+        (no new routes), then drains in-flight decode off-thread and
+        only then stops — release never kills admitted work."""
+        with self._lock:
+            ready = self._in_state("ready")
+            if len(ready) <= self.min_replicas:
+                return self._record("down", "refused", "", "drain")
+            rep = ready[-1]
+            rep.state = "draining"
+            self.router.remove_replica(rep.id)
+
+        def drain() -> None:
+            deadline = time.monotonic() + timeout
+            outcome = "ok"
+            while time.monotonic() < deadline:
+                try:
+                    h = rep.engine.health()
+                except Exception:
+                    break
+                if h.get("queued", 0) + h.get("active", 0) == 0:
+                    break
+                time.sleep(0.02)
+            else:
+                outcome = "timeout"  # stop anyway; waiters get unblocked
+            try:
+                rep.engine.stop()
+            except Exception:
+                outcome = "failed"
+            with self._lock:
+                rep.state = "released"
+            self._record("down", outcome, rep.id, "drain")
+
+        t = threading.Thread(target=drain, daemon=True,
+                             name=f"fleet-drain-{rep.id}")
+        self._threads.append(t)
+        t.start()
+        return {"direction": "down", "outcome": "pending",
+                "replica": rep.id, "mode": "drain"}
+
+    def wait_settled(self, timeout: float = 60.0) -> bool:
+        """Join outstanding warm/drain threads (tests + lane teardown)."""
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        return not any(t.is_alive() for t in self._threads)
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        """Fleet-wide aggregate: the acceptance surface. Prefix reuse
+        is summed over replicas (hit rate = skipped/total prefill
+        tokens fleet-wide) and ``kv_invariant_violations`` is the SUM
+        over every replica's live ``check_invariants()``."""
+        total = skipped = violations = 0
+        per_replica = {}
+        for rep in self._replicas.values():
+            if rep.engine is None:
+                continue
+            try:
+                s = rep.engine.stats()
+            # polycheck: ignore[invariant-swallow] -- a replica racing its own release (engine thread gone mid-stats) contributes nothing to the aggregate; the fleet-wide sums must still report
+            except Exception:  # noqa: BLE001
+                continue
+            per_replica[rep.id] = {"state": rep.state,
+                                   "served": s.get("requests_served", 0)}
+            total += s.get("prefill_tokens_total", 0) or 0
+            skipped += s.get("prefill_tokens_skipped", 0) or 0
+            violations += s.get("kv_invariant_violations", 0) or 0
+        return {
+            "replicas": per_replica,
+            "states": {s: len(self._in_state(s)) for s in REPLICA_STATES},
+            "prefill_tokens_total": total,
+            "prefill_tokens_skipped": skipped,
+            "prefix_hit_rate": (round(skipped / total, 4) if total
+                                else None),
+            "kv_invariant_violations": violations,
+            "scale_events": list(self.scale_events),
+            "router": self.router.stats(),
+        }
+
+    def stop(self) -> None:
+        """Stop every engine (any state); idempotent."""
+        self.wait_settled(timeout=5.0)
+        for rep in self._replicas.values():
+            if rep.engine is not None and rep.state != "released":
+                try:
+                    rep.engine.stop()
+                # polycheck: ignore[invariant-swallow] -- teardown fan-out: one replica failing to stop must not strand the rest un-stopped; stop() is the last call on the fleet
+                except Exception:  # noqa: BLE001
+                    pass
+                rep.state = "released"
+        self.poll()

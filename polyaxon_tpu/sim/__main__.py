@@ -16,6 +16,11 @@ Modes:
   python -m polyaxon_tpu.sim --cluster-day --quick --inject stuck-tier0-commit
       # must FAIL: wedged tier-1 commits strand gangs, runs never terminal
   python -m polyaxon_tpu.sim --replay sim/scenarios/preemption-storm.json
+  python -m polyaxon_tpu.sim --fleet-serve --quick  # serving-fleet episode
+  python -m polyaxon_tpu.sim --fleet-serve --quick --inject route-blind
+      # must FAIL: round-robin routing collapses the prefix hit rate
+  python -m polyaxon_tpu.sim --fleet-serve --quick --inject cold-scale
+      # must FAIL: unwarmed scale-up breaks during-spike TTFT
 """
 
 from __future__ import annotations
@@ -63,6 +68,12 @@ def main(argv=None) -> int:
     parser.add_argument("--serving", action="store_true",
                         help="(--gauntlet) include the real-engine "
                              "serving segment (needs jax)")
+    parser.add_argument("--fleet-serve", action="store_true",
+                        dest="fleet_serve",
+                        help="run the serving-fleet episode (spike → "
+                             "scale-up → drain → scale-down) over real "
+                             "engines, judged by the oracle's scale-up "
+                             "window; exit reflects verdicts")
     parser.add_argument("--replay", default=None, metavar="SCENARIO",
                         help="replay a committed incident scenario "
                              "(sim/scenarios/*.json) judged by the "
@@ -72,6 +83,19 @@ def main(argv=None) -> int:
                         help="write the result JSON to this path "
                              "('' = stdout only)")
     args = parser.parse_args(argv)
+
+    if args.fleet_serve:
+        from polyaxon_tpu.sim import fleet_serve
+
+        profile = "full" if args.full else "quick"
+        result = fleet_serve.run_fleet_serve(
+            profile=profile, seed=args.seed, inject=args.inject)
+        fleet_serve.print_result(result,
+                                 label=f"fleet-serve[{profile}]")
+        if args.json_out:
+            with open(args.json_out, "w") as fh:
+                json.dump(result, fh, indent=2, default=str)
+        return 0 if result["passed"] else 1
 
     if args.cluster_day:
         from polyaxon_tpu.sim import gauntlet

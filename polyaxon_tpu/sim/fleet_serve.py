@@ -1,0 +1,356 @@
+"""Fleet-serve episode: traffic spike → scale-up → drain → scale-down.
+
+One compressed serving-fleet day over REAL ``ContinuousBatchingEngine``
+replicas (llama_tiny), judged the gauntlet way — by the telemetry
+oracle over a marked history window, never by reaching into internals:
+
+1. **Warm traffic.** A handful of multi-turn conversations (shared
+   12-token prefixes, fresh suffix per turn) flows through the
+   router; the prefix→replica affinity map forms and every replica's
+   radix tree holds exactly its own conversations.
+2. **Marked spike.** ``mark_window("scale-up")`` brackets a burst of
+   interactive requests. Per-replica queues cross the
+   ``fleet-replica-hot`` threshold, the alert engine fires, and the
+   autoscaler promotes the warm standby — ring ownership moves ~1/N
+   of prefixes onto the new replica and queue-pressure spill routes
+   them there. The ``serving-ttft-during-scaleup`` oracle invariant
+   judges interactive TTFT p99 over ONLY this window: the
+   prewarm-before-commit discipline is exactly why it holds.
+3. **Drain + scale-down.** Traffic stops, rules resolve (alert-clock
+   fast-forward — the fire→resolve arc is the evidence), the idle
+   hold elapses, and the autoscaler drains the newest replica before
+   release.
+
+Red-team injects (ci.sh must show each flips the gate):
+
+* ``route-blind`` — the router round-robins, ignoring affinity AND
+  the hash. Conversations spray across replicas, every replica's tree
+  churns through everyone's prefixes under eviction pressure, and the
+  fleet-wide prefix hit rate collapses below the gate floor.
+* ``cold-scale`` — prewarm is skipped, so the promoted standby's jit
+  caches are empty and its first in-window requests eat the XLA
+  compiles; the during-spike TTFT invariant must fail.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+from polyaxon_tpu.obs import history as obs_history
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import oracle as obs_oracle
+from polyaxon_tpu.obs import rules as obs_rules
+
+logger = logging.getLogger(__name__)
+
+FLEET_SERVE_INJECTS = ("route-blind", "cold-scale")
+
+# Fleet-wide prefix hit rate the episode must clear (skipped / total
+# prefill tokens summed over replicas). On the spec workload affinity
+# routing holds ~0.61 while blind round-robin under the same KV
+# budget thrashes down to ~0.39 (both near-deterministic at fixed
+# profile+seed) — the floor sits between the two distributions so the
+# route-blind inject fails on the hit rate itself, not only on the
+# TTFT collateral its re-prefill storms sometimes cause.
+FLEET_HIT_RATE_FLOOR = 0.45
+
+# Oracle verdicts that must PASS (not skip) for the episode to pass.
+FLEET_SERVE_REQUIRED = ("serving-ttft-during-scaleup",
+                        "zero-unresolved-alerts")
+
+# Sizing is load-bearing, not incidental. Each 12-token prefix is 3
+# pages (page_size=4); suffix leaves are evicted first (they have no
+# children), so the per-replica KV budget is really a PREFIX budget.
+# 7 conversations × 3 = 21 pages: more than one replica's 16-page
+# pool, so a router that sprays conversations everywhere forces every
+# replica to evict prefixes it will need again next turn. An affinity
+# split (≤4 conversations ≈ 12 prefix pages + ~4 transient) fits.
+# The conversation count is deliberately ODD: round-robin over an even
+# replica count with an even conversation count would partition the
+# set perfectly by accident and hide the blindness.
+_PROFILES = {
+    "quick": {
+        "replicas": 2, "standby": 1, "min_replicas": 1,
+        "slots": 2, "page_size": 4, "kv_pages": 16,
+        "conversations": 7, "prefix_tokens": 12, "suffix_tokens": 4,
+        "warm_turns": 4, "burst": 36, "max_new": 2,
+        "cadence": 0.25, "spike_wall": 90.0,
+    },
+    "full": {
+        "replicas": 2, "standby": 1, "min_replicas": 1,
+        "slots": 2, "page_size": 4, "kv_pages": 16,
+        "conversations": 7, "prefix_tokens": 12, "suffix_tokens": 4,
+        "warm_turns": 6, "burst": 72, "max_new": 2,
+        "cadence": 0.25, "spike_wall": 180.0,
+    },
+}
+
+
+# ------------------------------------------------------------ workload
+def make_conversations(vocab: int, n: int, prefix_tokens: int,
+                       seed: int) -> list[list[int]]:
+    """Deterministic shared prefixes, one per conversation, drawn from
+    the LOWER vocab half (warmup rows use the upper half, so fleet
+    prewarm never pre-seeds the traffic prefixes into any tree)."""
+    half = max(2, vocab // 2)
+    return [[(seed * 101 + c * 37 + j * 7) % half
+             for j in range(prefix_tokens)] for c in range(n)]
+
+
+def turn_row(prefix: list[int], t: int, vocab: int, suffix_tokens: int,
+             seed: int) -> list[int]:
+    """Turn ``t`` of a conversation: shared prefix + fresh suffix."""
+    half = max(2, vocab // 2)
+    return prefix + [half + (seed * 13 + t * 29 + j * 11) % (half - 1)
+                     for j in range(suffix_tokens)]
+
+
+def warmup_rows(vocab: int, prefix_tokens: int, suffix_tokens: int,
+                seed: int) -> list[list[int]]:
+    """Compile-coverage rows at the exact traffic length, disjoint
+    token region: the engine jits per prompt length, so two warm
+    passes build the full-prefill, suffix-prefill, and decode
+    programs without warming any traffic prefix."""
+    half = max(2, vocab // 2)
+    length = prefix_tokens + suffix_tokens
+    return [[half + (seed * 17 + r * 31 + j * 13) % (half - 1)
+             for j in range(length)] for r in range(2)]
+
+
+# ------------------------------------------------------------- episode
+def build_fleet(*, profile: str = "quick", seed: int = 0,
+                inject: Optional[str] = None, replicas: Optional[int] = None,
+                standby: Optional[int] = None):
+    """(fleet, vocab, spec): real-engine fleet per the profile, with
+    the inject seams applied (blind router / cold standby). Blocking —
+    all build+prewarm compile cost lands here, before any window."""
+    from polyaxon_tpu.serving.fleet import ServingFleet, engine_factory
+    from polyaxon_tpu.serving.router import FleetRouter
+    from polyaxon_tpu.serving.server import load_params
+
+    spec = dict(_PROFILES[profile])
+    if replicas is not None:
+        spec["replicas"] = replicas
+    if standby is not None:
+        spec["standby"] = standby
+    cfg, _ = load_params("llama_tiny", seed=0)
+    vocab = cfg.vocab_size
+    factory = engine_factory(
+        "llama_tiny", slots=spec["slots"], kv="paged",
+        page_size=spec["page_size"], kv_pages=spec["kv_pages"])
+    # Prefix window == the workload's shared-prefix length: a window
+    # that swallowed the per-turn suffix would make every turn a
+    # distinct key and affinity could never form.
+    router = FleetRouter(seed=seed, prefix_window=spec["prefix_tokens"],
+                         blind=(inject == "route-blind"))
+    fleet = ServingFleet(
+        factory, replicas=spec["replicas"], standby=spec["standby"],
+        min_replicas=spec["min_replicas"],
+        max_replicas=spec["replicas"] + spec["standby"],
+        prewarm=(inject != "cold-scale"),
+        warmup_rows=warmup_rows(vocab, spec["prefix_tokens"],
+                                spec["suffix_tokens"], seed),
+        router=router, cooldown=2.0, idle_hold=0.5)
+    fleet.start()
+    return fleet, vocab, spec
+
+
+def _firing(engine: obs_rules.AlertEngine) -> set:
+    return {a["rule"] for a in engine.active()}
+
+
+def warm_phase(fleet, vocab: int, spec: dict, seed: int) -> None:
+    """Pre-spike conversation turns: builds the affinity map and each
+    replica's radix working set (no window open yet)."""
+    convs = make_conversations(vocab, spec["conversations"],
+                               spec["prefix_tokens"], seed)
+    for t in range(spec["warm_turns"]):
+        for prefix in convs:
+            fleet.generate(
+                [turn_row(prefix, t, vocab, spec["suffix_tokens"], seed)],
+                spec["max_new"], klass="interactive")
+        fleet.poll()
+
+
+def spike_phase(fleet, vocab: int, spec: dict, seed: int,
+                history: obs_history.MetricsHistory,
+                alert_engine: obs_rules.AlertEngine,
+                plane: Any = None) -> dict:
+    """The marked scale-up window: burst traffic, rule-driven scale-up,
+    and in-window samples on BOTH the old and the joining replica.
+    Returns the spike summary (the caller folds it into its result)."""
+    convs = make_conversations(vocab, spec["conversations"],
+                               spec["prefix_tokens"], seed)
+    deadline = time.monotonic() + spec["spike_wall"]
+    history.mark_window("scale-up", start=True)
+    try:
+        reqs = []
+        for i in range(spec["burst"]):
+            prefix = convs[i % len(convs)]
+            t = spec["warm_turns"] + i // len(convs)
+            row = turn_row(prefix, t, vocab, spec["suffix_tokens"], seed)
+            klass = "interactive" if i % 4 != 3 else "batch"
+            req, _ = fleet.submit(row, spec["max_new"], klass=klass)
+            reqs.append(req)
+            if (i + 1) % 6 == 0:
+                fleet.poll()
+                alert_engine.evaluate(plane=plane)
+                fleet.maybe_scale(_firing(alert_engine))
+        # Pump the control loop until the burst drains AND a scale-up
+        # committed — in-window traffic keeps flowing through the
+        # grown fleet so the invariant really judges "through" the
+        # scale event, not just up to it.
+        trickle = 0
+        while time.monotonic() < deadline:
+            fleet.poll()
+            alert_engine.evaluate(plane=plane)
+            fleet.maybe_scale(_firing(alert_engine))
+            scaled = any(e["direction"] == "up" and e["outcome"] == "ok"
+                         for e in fleet.scale_events)
+            pending = [r for r in reqs if not r.done.is_set()]
+            if scaled and trickle < 2 * len(convs):
+                # Post-commit turns: ring ownership moved, so some of
+                # these land on the joining replica (cold-scale makes
+                # exactly these eat the compile).
+                prefix = convs[trickle % len(convs)]
+                t = 100 + trickle // len(convs)
+                row = turn_row(prefix, t, vocab, spec["suffix_tokens"],
+                               seed)
+                reqs.append(fleet.submit(row, spec["max_new"],
+                                         klass="interactive")[0])
+                trickle += 1
+                continue
+            if scaled and not pending:
+                break
+            time.sleep(0.02)
+        for r in reqs:
+            r.wait(timeout=60.0)
+        # In-window TTFT observations are all in the registry now;
+        # force a history sample stamped before the window closes.
+        fleet.poll()
+        history.sample(force=True)
+    finally:
+        history.mark_window("scale-up", end=True)
+    scale_up_ok = any(e["direction"] == "up" and e["outcome"] == "ok"
+                      for e in fleet.scale_events)
+    return {"requests": len(reqs), "scale_up_committed": scale_up_ok}
+
+
+def drain_phase(fleet, alert_engine: obs_rules.AlertEngine,
+                clock_skew: list, plane: Any = None,
+                max_wall: float = 20.0) -> bool:
+    """Post-spike: fast-forward the alert clock so spike firings
+    resolve, then let the idle hold elapse and the autoscaler drain
+    and release the newest replica. True when a scale-down landed."""
+    clock_skew[0] += 30.0
+    deadline = time.monotonic() + max_wall
+    while time.monotonic() < deadline:
+        fleet.poll()
+        alert_engine.evaluate(plane=plane)
+        fleet.maybe_scale(_firing(alert_engine))
+        if any(e["direction"] == "down" and e["outcome"] == "ok"
+               for e in fleet.scale_events):
+            return fleet.wait_settled(timeout=max_wall)
+        time.sleep(0.05)
+    return False
+
+
+def run_fleet_serve(*, profile: str = "quick", seed: int = 0,
+                    inject: Optional[str] = None,
+                    oracle_source: Any = None) -> dict:
+    """One standalone fleet-serve episode → ``{passed, ...}``.
+
+    Pass criteria: the required oracle verdicts PASS (during-window
+    TTFT + alerts resolved), the fleet-wide prefix hit rate clears
+    :data:`FLEET_HIT_RATE_FLOOR`, every replica's pool reports zero
+    ``check_invariants()`` violations, and a scale-up committed plus a
+    scale-down drained — the full spike → grow → drain → shrink arc.
+    """
+    if inject is not None and inject not in FLEET_SERVE_INJECTS:
+        raise ValueError(
+            f"unknown inject {inject!r} (one of {FLEET_SERVE_INJECTS})")
+    invariants = obs_oracle.load_invariants(oracle_source)
+    t_start = time.monotonic()
+    fleet, vocab, spec = build_fleet(profile=profile, seed=seed,
+                                     inject=inject)
+    clock_skew = [0.0]
+    alert_engine = obs_rules.AlertEngine(
+        obs_rules.load_ruleset(),
+        clock=lambda: time.time() + clock_skew[0])
+    prior_history = obs_history.default_history()
+    history = obs_history.MetricsHistory(
+        obs_metrics.REGISTRY, cadence=spec["cadence"])
+    obs_history.set_default_history(history)
+    baseline = obs_metrics.REGISTRY.snapshot()
+    try:
+        warm_phase(fleet, vocab, spec, seed)
+        spike = spike_phase(fleet, vocab, spec, seed, history,
+                            alert_engine)
+        scaled_down = drain_phase(fleet, alert_engine, clock_skew)
+        stats = fleet.stats()
+        fleet.stop()
+        # Fast-forward past every rate/burn window so anything still
+        # firing resolves; unresolved-at-end is then real evidence.
+        clock_skew[0] = 600.0
+        alert_engine.evaluate()
+        history.sample(force=True)
+        bundle = obs_oracle.TelemetryBundle(
+            snapshot=obs_metrics.REGISTRY.snapshot(), baseline=baseline,
+            alerts=alert_engine.to_json(), history=history.to_json())
+        verdicts = obs_oracle.evaluate(invariants, bundle)
+    finally:
+        fleet.stop()
+        obs_history.set_default_history(prior_history)
+    oracle_result = obs_oracle.summarize(verdicts)
+    by_id = {v["invariant"]: v["verdict"] for v in verdicts}
+    anchors_held = all(by_id.get(i) == "pass"
+                       for i in FLEET_SERVE_REQUIRED)
+    hit_rate = stats["prefix_hit_rate"] or 0.0
+    checks = {
+        "prefix_hit_rate_above_floor": hit_rate >= FLEET_HIT_RATE_FLOOR,
+        "zero_kv_invariant_violations":
+            stats["kv_invariant_violations"] == 0,
+        "scale_up_committed": spike["scale_up_committed"],
+        "scale_down_drained": scaled_down,
+    }
+    window = obs_history.window_bounds(bundle.history or {}, "scale-up")
+    return {
+        "passed": (oracle_result["passed"] and anchors_held
+                   and all(checks.values())),
+        "profile": profile,
+        "inject": inject,
+        "anchors": {i: by_id.get(i, "missing")
+                    for i in FLEET_SERVE_REQUIRED},
+        "checks": checks,
+        "prefix_hit_rate": round(hit_rate, 4),
+        "hit_rate_floor": FLEET_HIT_RATE_FLOOR,
+        "requests": spike["requests"],
+        "scale_events": stats["scale_events"],
+        "routed": stats["router"]["routed"],
+        "scale_up_window": ([round(t, 3) for t in window] if window
+                            else None),
+        "wall_seconds": round(time.monotonic() - t_start, 3),
+        "oracle": oracle_result,
+    }
+
+
+def print_result(result: dict, label: str = "fleet-serve") -> None:
+    """Human summary (mirrors gauntlet.print_result)."""
+    import json as _json
+
+    print(f"{label}: {result['requests']} requests, "
+          f"hit-rate {result['prefix_hit_rate']} "
+          f"(floor {result['hit_rate_floor']}), "
+          f"routed {result['routed']}, "
+          f"{result['wall_seconds']}s")
+    for v in result["oracle"]["verdicts"]:
+        marker = {"pass": "ok  ", "skip": "skip", "fail": "FAIL"}
+        detail = ("" if v["verdict"] == "pass"
+                  else f"  {_json.dumps(v['evidence'], default=str)[:160]}")
+        print(f"  [{marker[v['verdict']]}] {v['invariant']}{detail}")
+    print(f"checks: {result['checks']}; anchors: {result['anchors']}; "
+          f"scale events: "
+          f"{[(e['direction'], e['outcome'], e['mode']) for e in result['scale_events']]}")
+    print("FLEET-SERVE " + ("PASSED" if result["passed"] else "FAILED"))

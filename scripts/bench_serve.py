@@ -10,8 +10,12 @@ Writes bench_serve_results.json at the repo root.
 Usage: python scripts/bench_serve.py [--model llama3_1b] [--clients 8]
        [--requests 32] [--max-new 64] [--slots 8] [--quick]
        [--workload mixed|shared-prefix|conversation-tree]
-       [--configs paged,paged-nocache] [--check-prefix]
+       [--configs paged,paged-nocache] [--check-prefix] [--fleet N]
 CPU smoke: JAX_PLATFORMS=cpu ... --model llama_tiny --quick
+Fleet A/B (ISSUE 17): --fleet N routes the workload through a
+ServingFleet of N paged replicas twice — prefix-affinity router vs
+blind round-robin — recording per-mode hit rate, p50 latency, and the
+routed-reason breakdown.
 Radix A/B (ISSUE 11): the paged vs paged-nocache rows + the top-level
 `prefix_ab` block record prefill tokens skipped, hit rate, and the
 interactive p50-TTFT dividend per workload.
@@ -264,6 +268,64 @@ def make_prompts(workload: str, requests: int, prompt_len: int,
     raise ValueError(f"unknown workload {workload!r}")
 
 
+def run_fleet(model: str, prompts: list[list[int]], max_new: int,
+              clients: int, *, replicas: int, slots: int,
+              page_size: int, blind: bool) -> dict:
+    """Drive the workload through a ServingFleet (router + replicas,
+    no HTTP — the fleet front door is engine-level). The affinity vs
+    blind pair is the fleet A/B: same replicas, same pool, only the
+    routing discipline differs."""
+    from polyaxon_tpu.serving.fleet import ServingFleet, engine_factory
+    from polyaxon_tpu.serving.router import FleetRouter
+
+    fleet = ServingFleet(
+        engine_factory(model, slots=slots, kv="paged",
+                       page_size=page_size),
+        replicas=replicas, standby=0, min_replicas=1,
+        max_replicas=replicas,
+        router=FleetRouter(blind=blind), warmup_rows=[prompts[0]])
+    fleet.start()
+    lat: list[float] = []
+    lock = threading.Lock()
+    queue = list(prompts)
+    t0 = time.monotonic()
+    try:
+        def worker():
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    row = queue.pop()
+                start = time.monotonic()
+                req, _ = fleet.submit(row, max_new, klass="interactive")
+                req.wait(timeout=300)
+                with lock:
+                    lat.append(time.monotonic() - start)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        stats = fleet.stats()
+    finally:
+        fleet.stop()
+    lat.sort()
+    return {
+        "name": "fleet-blind" if blind else "fleet-affinity",
+        "replicas": replicas, "completed": len(lat),
+        "wall_seconds": round(wall, 3),
+        "latency_p50_ms": (round(lat[len(lat) // 2] * 1e3, 1)
+                           if lat else None),
+        "prefix_hit_rate": stats["prefix_hit_rate"],
+        "prefill_tokens_skipped": stats["prefill_tokens_skipped"],
+        "kv_invariant_violations": stats["kv_invariant_violations"],
+        "routed": stats["router"]["routed"],
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="llama3_1b")
@@ -284,6 +346,12 @@ def main() -> int:
                         help="also bench continuous speculative with "
                              "this draft model (vocab must match)")
     parser.add_argument("--spec-k", type=int, default=4)
+    parser.add_argument("--fleet", type=int, default=0, metavar="N",
+                        help="bench a ServingFleet of N replicas "
+                             "instead of the single-engine configs: "
+                             "prefix-affinity routing vs blind "
+                             "round-robin over the same workload "
+                             "(docs/serving.md 'Serving fleet')")
     parser.add_argument("--quick", action="store_true",
                         help="tiny load (CPU smoke of the harness)")
     parser.add_argument("--check-prefix", action="store_true",
@@ -304,6 +372,38 @@ def main() -> int:
     rng = random.Random(0)
     prompts = make_prompts(args.workload, args.requests, args.prompt_len,
                            rng)
+
+    if args.fleet:
+        results = [run_fleet(args.model, prompts, args.max_new,
+                             args.clients, replicas=args.fleet,
+                             slots=args.slots,
+                             page_size=args.kv_page_size, blind=blind)
+                   for blind in (False, True)]
+        out = {
+            "backend": jax.devices()[0].platform,
+            "model": args.model, "workload": args.workload,
+            "load": {"clients": args.clients, "requests": args.requests,
+                     "max_new": args.max_new, "slots": args.slots,
+                     "replicas": args.fleet,
+                     "prompt_len": args.prompt_len,
+                     "kv_page_size": args.kv_page_size},
+            "results": results,
+        }
+        for r in results:
+            print(f"{r['name']}: hit_rate {r['prefix_hit_rate']}, "
+                  f"p50 {r['latency_p50_ms']}ms, routed {r['routed']}",
+                  flush=True)
+        path = args.out or os.path.join(REPO, "bench_serve_results.json")
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=2)
+        print(f"wrote {path}")
+        incomplete = [r["name"] for r in results
+                      if r["completed"] < args.requests]
+        if incomplete:
+            print(f"ERROR: configs with failed requests: {incomplete}",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     configs = [
         ("dense", dict(slots=args.slots)),

@@ -224,6 +224,39 @@ JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim \
 # against a loaded fleet, the oracle must still come back clean.
 JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim \
     --replay polyaxon_tpu/sim/scenarios/tier0-loss-during-storm.json >/dev/null
+# ISSUE 17 companion scenario: an interactive traffic spike that
+# drove a rule-fired scale-up (warm-standby promotion mid-spike) and
+# a post-quiet drain + scale-down — replayed against a loaded fleet,
+# the oracle must come back clean.
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim \
+    --replay polyaxon_tpu/sim/scenarios/traffic-spike-scale.json >/dev/null
+# Serving fleet (ISSUE 17): real-engine replicas behind the
+# prefix-affinity router + SLO-driven autoscaler — spike traffic in a
+# marked window, rule-fired warm-standby promotion, drain-before-
+# release scale-down; judged by the telemetry oracle (interactive
+# TTFT p99 inside the scale-up window) plus the fleet-wide prefix
+# hit-rate floor and per-replica KV invariants.
+echo "== serving fleet (prefix-affinity router + SLO autoscaler)"
+JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --fleet-serve --quick
+# The hit-rate gate must be able to FAIL: a router that round-robins
+# (ignoring affinity AND the hash) sprays conversations across
+# replicas; under the episode's deliberately tight per-replica KV
+# budget every replica churns through everyone's prefixes and the
+# fleet-wide hit rate collapses below the floor.
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --fleet-serve --quick \
+    --inject route-blind >/dev/null 2>&1; then
+    echo "fleet-serve self-test FAILED: blind routing passed the gate"
+    exit 1
+fi
+# ...and so must the scale-up SLO: skipping prewarm leaves the
+# promoted standby's jit caches empty, its first in-window requests
+# eat the XLA compiles, and serving-ttft-during-scaleup must flip the
+# stage to exit 1.
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --fleet-serve --quick \
+    --inject cold-scale >/dev/null 2>&1; then
+    echo "fleet-serve self-test FAILED: cold scale-up passed the TTFT oracle"
+    exit 1
+fi
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives
 # in the compiled HLO, and gate against polyaxon_tpu/perf/budgets.json

@@ -78,6 +78,10 @@ SMOKE_MODULES = {
     "test_joins_events.py", "test_sliced.py", "test_controlplane.py",
     "test_utils_env.py", "test_scheduling.py", "test_analysis.py",
     "test_oracle.py", "test_history.py",
+    # Serving fleet (ISSUE 17): consistent-hash bounds, router decision
+    # order, autoscaler state machine — fake engines, pure python (the
+    # real-engine episode is the ci.sh fleet stage / gauntlet lane).
+    "test_fleet.py",
 }
 SMOKE_NODES = (
     "test_models.py::TestLlama::test_forward_and_init_loss",

@@ -1,0 +1,374 @@
+"""Serving-fleet tier (ISSUE 17): consistent-hash placement bounds,
+router decision order (affinity → hotness/pressure spill → hash), and
+the autoscaler state machine (prewarm-before-commit up, drain-before-
+release down) — all over fake engines at pure-Python speed."""
+
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.serving.fleet import SCALE_UP_RULES, ServingFleet
+from polyaxon_tpu.serving.router import (ConsistentHashRing, FleetRouter,
+                                         prefix_key)
+
+
+def _conv(c, n=8):
+    return [c * 131 + j for j in range(n)]
+
+
+# --------------------------------------------------------- fake engine
+class _FakeReq:
+    def __init__(self):
+        self.done = threading.Event()
+        self.done.set()
+
+    def wait(self, timeout=None):
+        return [1, 2]
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.queued = 0
+        self.active = 0
+        self.stopped = False
+        self.warm_calls = 0
+        self.submits = []
+
+    def generate(self, rows, max_new_tokens, **kw):
+        self.warm_calls += 1
+        return [[0]] * len(rows)
+
+    def submit(self, tokens, max_new_tokens, **kw):
+        self.submits.append(list(tokens))
+        return _FakeReq()
+
+    def health(self):
+        return {"status": "stopped" if self.stopped else "ok",
+                "queued": self.queued, "active": self.active,
+                "radix_hit_rate": None, "kv_headroom": None}
+
+    def stats(self):
+        return {"prefill_tokens_total": 16,
+                "prefill_tokens_skipped": 12,
+                "kv_invariant_violations": 0,
+                "requests_served": len(self.submits)}
+
+    def stop(self):
+        self.stopped = True
+
+
+def _fleet(*, replicas=2, standby=1, max_replicas=4, prewarm=True,
+           factory=None, clock=None, **kw):
+    reg = obs_metrics.MetricsRegistry()
+    engines = []
+
+    def default_factory():
+        engines.append(_FakeEngine())
+        return engines[-1]
+
+    fleet = ServingFleet(
+        factory or default_factory, replicas=replicas, standby=standby,
+        max_replicas=max_replicas, prewarm=prewarm,
+        warmup_rows=[[1, 2, 3]], router=FleetRouter(registry=reg),
+        registry=reg, cooldown=1.0, idle_hold=1.0,
+        clock=clock or time.monotonic, **kw)
+    fleet.start()
+    return fleet, engines
+
+
+# ==================================================== consistent hash
+class TestConsistentHashRing:
+    def test_keyspace_movement_bounded_on_add(self):
+        """Adding the Nth replica remaps ~1/N of keys, never a
+        wholesale reshuffle (the property that makes scale-up cheap
+        for every OTHER replica's radix cache)."""
+        ring = ConsistentHashRing(["r0", "r1", "r2"], seed=3)
+        keys = [prefix_key(_conv(i)) for i in range(2000)]
+        before = {k: ring.owner(k) for k in keys}
+        ring.add("r3")
+        moved = sum(1 for k in keys if ring.owner(k) != before[k])
+        # ideal is 1/4; allow generous vnode-variance headroom but pin
+        # well under any "most keys moved" regression.
+        assert moved / len(keys) < 0.4
+        # every moved key landed on the newcomer — an add never
+        # shuffles keys between surviving replicas.
+        for k in keys:
+            if ring.owner(k) != before[k]:
+                assert ring.owner(k) == "r3"
+
+    def test_keyspace_movement_bounded_on_remove(self):
+        ring = ConsistentHashRing(["r0", "r1", "r2", "r3"], seed=3)
+        keys = [prefix_key(_conv(i)) for i in range(2000)]
+        before = {k: ring.owner(k) for k in keys}
+        ring.remove("r1")
+        for k in keys:
+            if before[k] != "r1":
+                # survivors keep every key they already owned
+                assert ring.owner(k) == before[k]
+            else:
+                assert ring.owner(k) != "r1"
+
+    def test_add_then_remove_restores_ownership(self):
+        ring = ConsistentHashRing(["a", "b", "c"], seed=7)
+        keys = [prefix_key(_conv(i)) for i in range(500)]
+        before = {k: ring.owner(k) for k in keys}
+        ring.add("d")
+        ring.remove("d")
+        assert {k: ring.owner(k) for k in keys} == before
+
+    def test_deterministic_across_instances_and_seeds(self):
+        keys = [prefix_key(_conv(i)) for i in range(200)]
+        a = ConsistentHashRing(["x", "y", "z"], seed=5)
+        b = ConsistentHashRing(["z", "x", "y"], seed=5)  # order-free
+        assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
+        c = ConsistentHashRing(["x", "y", "z"], seed=6)
+        assert [a.owner(k) for k in keys] != [c.owner(k) for k in keys]
+
+
+# ============================================================= router
+class TestFleetRouter:
+    def test_affinity_sticks_after_first_route(self):
+        r = FleetRouter(["a", "b", "c"], seed=1)
+        first = r.route(_conv(4))
+        assert first.reason == "hash"
+        for _ in range(5):
+            d = r.route(_conv(4))
+            assert (d.reason, d.replica) == ("affinity", first.replica)
+
+    def test_routing_deterministic_for_fixed_set_and_seed(self):
+        def drive():
+            r = FleetRouter(["a", "b", "c"], seed=9)
+            return [(r.route(_conv(i % 6)).replica,
+                     r.route(_conv(i % 6)).reason) for i in range(60)]
+        assert drive() == drive()
+
+    def test_spill_lands_on_hash_owner(self):
+        """The hotness cap deflects a drifted-affinity prefix to its
+        ring owner — never to an arbitrary replica."""
+        r = FleetRouter(["a", "b", "c"], seed=1, recent=32, hot_min=16,
+                        hot_fraction=0.5, spill_depth=None)
+        convs = [_conv(i) for i in range(6)]
+        for _ in range(4):
+            for c in convs:
+                r.route(c)
+        r.add_replica("d")  # ownership moves for ~1/4 of prefixes
+        moved = [c for c in convs
+                 if r.ring.owner(prefix_key(c)) == "d"]
+        assert moved, "seed must move at least one conversation"
+        decisions = [r.route(moved[0]) for _ in range(40)]
+        spills = [d for d in decisions if d.reason == "spill"]
+        assert spills, "hot drifted prefix must spill"
+        assert all(d.replica == r.ring.owner(d.prefix) == "d"
+                   for d in spills)
+
+    def test_pressure_spill_uses_queue_telemetry(self):
+        r = FleetRouter(["a", "b"], seed=2, spill_depth=4, hot_min=999)
+        d0 = r.route(_conv(1))
+        target = d0.replica
+        r.ring.remove(target)  # force the prefix's owner to differ
+        r.ring.add(target)
+        owner = r.ring.owner(d0.prefix)
+        telemetry = {target: {"status": "ok", "queued": 10}}
+        d = r.route(_conv(1), telemetry=telemetry)
+        if owner == target:
+            assert d.reason == "affinity"  # at home: cap is a no-op
+        else:
+            assert (d.reason, d.replica) == ("spill", owner)
+
+    def test_unhealthy_replica_skipped(self):
+        r = FleetRouter(["a", "b"], seed=1)
+        d0 = r.route(_conv(2))
+        sick = d0.replica
+        well = ({"a", "b"} - {sick}).pop()
+        d = r.route(_conv(2),
+                    telemetry={sick: {"status": "stopped", "queued": 0}})
+        assert d.replica == well
+
+    def test_blind_mode_round_robins_and_learns_nothing(self):
+        r = FleetRouter(["a", "b"], seed=1, blind=True)
+        seq = [r.route(_conv(3)).replica for _ in range(4)]
+        assert seq == ["a", "b", "a", "b"]
+        assert r.stats()["affinity_entries"] == 0
+
+    def test_remove_replica_drops_its_affinity(self):
+        r = FleetRouter(["a", "b"], seed=1)
+        d = r.route(_conv(5))
+        r.remove_replica(d.replica)
+        d2 = r.route(_conv(5))
+        assert d2.replica != d.replica
+        assert d2.reason in ("hash", "spill")
+
+
+# ========================================================= autoscaler
+class TestServingFleetAutoscaler:
+    def test_start_builds_warm_ready_and_standby(self):
+        fleet, engines = _fleet(replicas=2, standby=1)
+        try:
+            assert fleet.stats()["states"]["ready"] == 2
+            assert fleet.stats()["states"]["standby"] == 1
+            # prewarm discipline: every engine (standby included) ran
+            # its warmup passes before any admission could reach it.
+            assert all(e.warm_calls == 2 for e in engines)
+        finally:
+            fleet.stop()
+
+    def test_cold_fleet_skips_warmup(self):
+        fleet, engines = _fleet(replicas=1, standby=1, prewarm=False)
+        try:
+            assert all(e.warm_calls == 0 for e in engines)
+        finally:
+            fleet.stop()
+
+    def test_scale_up_promotes_standby_on_rule_state(self):
+        clock = [100.0]
+        fleet, engines = _fleet(clock=lambda: clock[0])
+        try:
+            ev = fleet.maybe_scale({"fleet-replica-hot"})
+            assert ev["mode"] == "promote" and ev["outcome"] == "ok"
+            assert len(fleet.ready) == 3
+            assert fleet.router.replicas == {"r0", "r1", "r2"}
+        finally:
+            fleet.stop()
+
+    def test_cooldown_blocks_immediate_flap(self):
+        clock = [100.0]
+        fleet, _ = _fleet(clock=lambda: clock[0])
+        try:
+            assert fleet.maybe_scale(SCALE_UP_RULES) is not None
+            assert fleet.maybe_scale(SCALE_UP_RULES) is None
+            clock[0] += 2.0
+            assert fleet.maybe_scale(SCALE_UP_RULES) is not None
+        finally:
+            fleet.stop()
+
+    def test_background_build_commits_only_when_warm(self):
+        clock = [100.0]
+        fleet, engines = _fleet(standby=0, clock=lambda: clock[0])
+        try:
+            ev = fleet.maybe_scale({"serving-queue-saturation"})
+            assert ev["mode"] == "build"
+            assert fleet.wait_settled(timeout=10.0)
+            assert len(fleet.ready) == 3
+            assert engines[-1].warm_calls == 2  # warmed before commit
+            assert fleet.scale_events[-1]["outcome"] == "ok"
+        finally:
+            fleet.stop()
+
+    def test_failed_build_records_failed_event(self):
+        built = []
+
+        def flaky():
+            if built:
+                raise RuntimeError("no capacity")
+            built.append(1)
+            return _FakeEngine()
+
+        clock = [100.0]
+        fleet = ServingFleet(flaky, replicas=1, standby=0,
+                             max_replicas=2,
+                             router=FleetRouter(
+                                 registry=obs_metrics.MetricsRegistry()),
+                             registry=obs_metrics.MetricsRegistry(),
+                             cooldown=0.0, clock=lambda: clock[0])
+        fleet.start()
+        try:
+            fleet.maybe_scale({"fleet-replica-hot"})
+            assert fleet.wait_settled(timeout=10.0)
+            assert fleet.scale_events[-1] == {
+                "direction": "up", "outcome": "failed",
+                "replica": "r1", "mode": "build"}
+            assert len(fleet.ready) == 1  # failure never strands routing
+        finally:
+            fleet.stop()
+
+    def test_scale_down_drains_in_flight_before_release(self):
+        clock = [100.0]
+        fleet, engines = _fleet(replicas=3, standby=0,
+                                clock=lambda: clock[0])
+        try:
+            victim_engine = engines[2]
+            victim_engine.queued = 3  # in-flight work
+            fleet.poll()
+            clock[0] += 5.0
+            fleet.maybe_scale(set())  # idle clock starts (not idle yet)
+            clock[0] += 5.0
+            ev = fleet.maybe_scale(set())
+            # the fleet is NOT idle (queued=3) so no down-scale yet
+            assert ev is None
+            victim_engine.queued = 0
+            fleet.poll()
+            clock[0] += 5.0
+            fleet.maybe_scale(set())
+            clock[0] += 5.0
+            ev = fleet.maybe_scale(set())
+            assert ev and ev["direction"] == "down"
+            # the victim left the router the moment draining started
+            assert fleet.router.replicas == {"r0", "r1"}
+            assert fleet.wait_settled(timeout=10.0)
+            assert victim_engine.stopped
+            assert fleet.stats()["states"]["released"] == 1
+            assert fleet.scale_events[-1]["outcome"] == "ok"
+        finally:
+            fleet.stop()
+
+    def test_scale_down_waits_for_drain(self):
+        """stop() must not land while the victim still holds work: the
+        drain thread spins until queued+active hits zero."""
+        clock = [100.0]
+        fleet, engines = _fleet(replicas=2, standby=0,
+                                clock=lambda: clock[0])
+        try:
+            victim = engines[1]
+            victim.queued = 2  # in-flight work BEFORE drain starts
+            ev = fleet.scale_down(timeout=10.0)
+            assert ev["mode"] == "drain"
+            time.sleep(0.1)
+            assert not victim.stopped  # still draining
+            victim.queued = 0
+            assert fleet.wait_settled(timeout=10.0)
+            assert victim.stopped
+        finally:
+            fleet.stop()
+
+    def test_scale_down_refused_at_min(self):
+        fleet, _ = _fleet(replicas=1, standby=0)
+        try:
+            ev = fleet.scale_down()
+            assert ev["outcome"] == "refused"
+            assert len(fleet.ready) == 1
+        finally:
+            fleet.stop()
+
+    def test_no_scale_up_past_max(self):
+        clock = [100.0]
+        fleet, _ = _fleet(replicas=2, standby=1, max_replicas=3,
+                          clock=lambda: clock[0])
+        try:
+            fleet.maybe_scale({"fleet-replica-hot"})  # 3 ready (max)
+            clock[0] += 5.0
+            assert fleet.maybe_scale({"fleet-replica-hot"}) is None
+        finally:
+            fleet.stop()
+
+    def test_stats_aggregates_fleet_wide(self):
+        fleet, _ = _fleet(replicas=2, standby=0)
+        try:
+            fleet.generate([[1, 2, 3], [4, 5, 6]], 2)
+            s = fleet.stats()
+            assert s["prefix_hit_rate"] == pytest.approx(0.75)
+            assert s["kv_invariant_violations"] == 0
+            assert set(s["router"]["routed"]) <= {"affinity", "hash",
+                                                  "spill"}
+        finally:
+            fleet.stop()
+
+    def test_poll_feeds_router_view_for_ready_only(self):
+        fleet, engines = _fleet(replicas=2, standby=1)
+        try:
+            view = fleet.poll()
+            assert set(view) == {"r0", "r1"}  # standby not routable
+            assert all(v["status"] == "ok" for v in view.values())
+        finally:
+            fleet.stop()

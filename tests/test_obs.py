@@ -582,6 +582,67 @@ class TestRuleLifecycle:
         assert resolved["event"] == "resolved"
         assert [e["event"] for e in engine.history] == ["fired", "resolved"]
 
+    def test_fleet_replica_hot_fires_and_resolves(self):
+        """The committed fleet-replica-hot rule (ISSUE 17): the gauge
+        is per-replica and the alert judges the HOTTEST series (max
+        across series), so one melting replica fires it even while its
+        siblings idle; once the router/autoscaler relieve the queue and
+        the clear holds past resolve_after it resolves."""
+        (committed,) = [r for r in obs_rules.load_ruleset()
+                        if r.id == "fleet-replica-hot"]
+        assert committed.metric == "polyaxon_fleet_replica_queue_depth"
+        assert committed.kind == "threshold"
+        registry = obs_metrics.MetricsRegistry()
+        gauge = obs_metrics.fleet_replica_queue_depth(registry)
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([committed], registry=registry,
+                                       clock=clock)
+        gauge.set(1, replica="r0")
+        gauge.set(2, replica="r1")
+        assert engine.evaluate() == []  # balanced fleet: quiet
+        gauge.set(12, replica="r1")  # one replica melts
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "fleet-replica-hot"
+        assert fired["value"] == 12
+        gauge.set(0, replica="r1")  # spill + scale-up relieved it
+        clock.now += 5
+        assert engine.evaluate() == []  # clear < resolve_after (10s)
+        clock.now += 11
+        (resolved,) = engine.evaluate()
+        assert resolved["event"] == "resolved"
+        assert [e["event"] for e in engine.history] == ["fired",
+                                                        "resolved"]
+
+    def test_fleet_scale_flap_fires_and_resolves(self):
+        """The committed fleet-scale-flap rule (ISSUE 17): an
+        autoscaler thrashing grow/shrink pushes scale events above
+        0.15/s over 1m, the rule fires, and resolves once the window
+        slides past the flap."""
+        (committed,) = [r for r in obs_rules.load_ruleset()
+                        if r.id == "fleet-scale-flap"]
+        assert committed.metric == "polyaxon_fleet_scale_events_total"
+        assert committed.kind == "rate"
+        registry = obs_metrics.MetricsRegistry()
+        counter = obs_metrics.fleet_scale_events_total(registry)
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([committed], registry=registry,
+                                       clock=clock)
+        counter.inc(0, direction="up", outcome="ok")  # series exists
+        engine.evaluate()  # baseline sample at value 0
+        clock.now += 10
+        counter.inc(2, direction="up", outcome="ok")
+        counter.inc(2, direction="down", outcome="ok")
+        # 4 events / 10s = 0.4/s > 0.15/s summed across series: flap.
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "fleet-scale-flap"
+        assert fired["value"] == pytest.approx(0.4)
+        clock.now += 120  # slides the 60s window past the flap
+        engine.evaluate()
+        assert [e["event"] for e in engine.history] == ["fired",
+                                                        "resolved"]
+
     def test_threshold_against_derived_value_step_regression(self):
         """value_from: p99 > 3x p50 — the relative rule the default
         step-time-regression alert uses."""
