@@ -646,6 +646,47 @@ def serving_radix_pages(registry: MetricsRegistry = REGISTRY) -> Gauge:
         ("state",))
 
 
+def serving_lane_ticks_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_lane_ticks_total",
+        "Engine ticks in which each scheduling lane ran a device "
+        "program (prefill = staged suffix-chunk programs within the "
+        "lane budget, decode = a decode step or speculative round) — "
+        "the disaggregated scheduler's share-of-tick observable",
+        ("lane",))
+
+
+def serving_handoff_pages_total(
+        registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_handoff_pages_total",
+        "KV pages transferred prefill lane → decode slot at handoff "
+        "(a block-table row move arbitrated by the radix tree: "
+        "refcount/ownership transfer plus at most the admission-time "
+        "CoW fork, never a recompute)")
+
+
+def serving_spec_draft_len(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_spec_draft_len",
+        "Draft length k the speculation policy chose for the current "
+        "decode-lane tick (k_max = idle headroom, shrinking under "
+        "prefill backlog, 0 = disabled while the TTFT budget burns)")
+
+
+def serving_decode_tpot_hist(
+        registry: MetricsRegistry = REGISTRY) -> Histogram:
+    return registry.histogram(
+        "polyaxon_serving_decode_tpot_seconds",
+        "Decode-lane inter-step gap (wall time between consecutive "
+        "decode-lane steps, idle periods excluded): the per-token "
+        "cadence a live request feels, inflated exactly when prefill "
+        "work occupies ticks the decode batch needed — judged by the "
+        "decode-tpot-interference rule and the storm-window oracle "
+        "invariant",
+        buckets=_SERVING_TOKEN_BUCKETS)
+
+
 def perf_overlap_ratio(registry: MetricsRegistry = REGISTRY) -> Gauge:
     return registry.gauge(
         "polyaxon_perf_overlap_ratio",
@@ -693,6 +734,10 @@ def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     serving_radix_nodes(registry)
     serving_radix_pages(registry)
     serving_trace_dumps_total(registry)
+    serving_lane_ticks_total(registry)
+    serving_handoff_pages_total(registry)
+    serving_spec_draft_len(registry)
+    serving_decode_tpot_hist(registry)
 
 
 def alert_history_evictions(registry: MetricsRegistry = REGISTRY) -> Counter:

@@ -47,6 +47,17 @@ def main() -> int:
                              "iteration instead of one blocking prefill; "
                              "each in-flight reservation holds its own "
                              "full-length row cache until it inserts")
+    parser.add_argument("--prefill-slots", type=int, default=None,
+                        help="(continuous, paged kv) disaggregate the "
+                             "scheduler: this many prefill-lane rows "
+                             "stream prompts in suffix chunks and hand "
+                             "committed KV pages to the decode pool "
+                             "(--prefill-chunk sizes the lane chunk); "
+                             "decode TPOT stays flat under prompt storms")
+    parser.add_argument("--prefill-lane-budget", type=int, default=1,
+                        help="(with --prefill-slots) max lane chunk "
+                             "programs per engine tick while decode rows "
+                             "are live")
     parser.add_argument("--draft-checkpoint", default=None)
     parser.add_argument("--spec-k", type=int, default=4)
     parser.add_argument("--lora-alpha", type=float, default=16.0,
@@ -87,6 +98,8 @@ def main() -> int:
                        draft_checkpoint=args.draft_checkpoint,
                        spec_k=args.spec_k, lora_alpha=args.lora_alpha,
                        prefill_chunk=args.prefill_chunk,
+                       prefill_slots=args.prefill_slots,
+                       prefill_lane_budget=args.prefill_lane_budget,
                        max_pending=args.max_pending,
                        request_tracing=not args.no_request_tracing,
                        trace_dump_path=args.trace_dump) as s:

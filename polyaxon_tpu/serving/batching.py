@@ -41,6 +41,7 @@ import numpy as np
 
 from polyaxon_tpu.obs import metrics as obs_metrics
 from polyaxon_tpu.obs import reqtrace
+from polyaxon_tpu.serving.speculative import LaneView, SpeculationPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -133,6 +134,10 @@ class ContinuousBatchingEngine:
                  page_size: int = 16, kv_pages: Optional[int] = None,
                  prefix_cache: bool = True,
                  draft=None, prefill_chunk: Optional[int] = None,
+                 prefill_slots: Optional[int] = None,
+                 prefill_lane_budget: int = 1,
+                 decode_lane_budget: int = 1,
+                 spec_policy: Optional[SpeculationPolicy] = None,
                  max_pending: Optional[int] = None,
                  request_tracing: bool = True,
                  trace_capacity: int = reqtrace.DEFAULT_RING_CAPACITY,
@@ -140,6 +145,43 @@ class ContinuousBatchingEngine:
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
+        # Disaggregated prefill/decode (ISSUE 18): `prefill_slots`
+        # extra block-table rows form a prefill LANE — admissions land
+        # there, stream their novel suffix in chunks via the radix
+        # suffix path, and HAND their committed pages to a free decode
+        # slot (PagePool.handoff — a block-table row move plus at most
+        # the admission-time CoW fork, never a recompute). Per-lane
+        # budgets bound interference: at most `prefill_lane_budget`
+        # chunk programs run per tick while decode rows are live, and
+        # the decode lane gets `decode_lane_budget` steps per tick
+        # (0 = deliberately starved, the bench's lane-starve inject).
+        if prefill_slots is not None:
+            if prefill_slots < 1:
+                raise ValueError(
+                    f"prefill_slots must be >= 1, got {prefill_slots}")
+            if kv != "paged":
+                raise ValueError(
+                    "disaggregated prefill/decode requires kv='paged' "
+                    "(the handoff boundary is a block-table row move)")
+            if draft is not None:
+                raise ValueError(
+                    "prefill_slots and draft are mutually exclusive: "
+                    "the draft's verify chunk needs kv='dense' while "
+                    "the page handoff needs kv='paged'")
+            if not (hasattr(family, "paged_prefill_suffix_kv")
+                    and hasattr(family, "paged_insert_suffix")):
+                raise ValueError(
+                    f"`{model}` ({family.__name__}) has no paged suffix-"
+                    "prefill surface; the prefill lane streams chunks "
+                    "through paged_prefill_suffix_kv")
+        if prefill_lane_budget < 1:
+            raise ValueError(
+                f"prefill_lane_budget must be >= 1, got "
+                f"{prefill_lane_budget}")
+        if decode_lane_budget < 0:
+            raise ValueError(
+                f"decode_lane_budget must be >= 0, got "
+                f"{decode_lane_budget}")
         # Chunked prefill (vLLM-style): a long prompt's admission no
         # longer blocks the pool for one monolithic prefill — the
         # prompt streams into a standalone row cache `prefill_chunk`
@@ -154,20 +196,23 @@ class ContinuousBatchingEngine:
             if prefill_chunk < 1:
                 raise ValueError(
                     f"prefill_chunk must be >= 1, got {prefill_chunk}")
-            if kv != "dense":
+            if kv != "dense" and prefill_slots is None:
                 raise ValueError(
                     "chunked prefill requires kv='dense' (the chunk "
-                    "writer needs the slot==position row cache)")
-            if not hasattr(family, "decode_chunk"):
-                raise ValueError(
-                    f"`{model}` ({family.__name__}) has no decode_chunk "
-                    "surface; chunked prefill supports llama/moe-family "
-                    "decoders")
-            if getattr(cfg, "sliding_window", None) is not None:
-                raise ValueError(
-                    "chunked prefill requires a full-length cache "
-                    "(no sliding_window): the padded tail chunk's "
-                    "junk writes rely on slot == position")
+                    "writer needs the slot==position row cache) — or "
+                    "prefill_slots, where it sizes the lane's per-tick "
+                    "suffix chunk instead")
+            if kv == "dense":
+                if not hasattr(family, "decode_chunk"):
+                    raise ValueError(
+                        f"`{model}` ({family.__name__}) has no "
+                        "decode_chunk surface; chunked prefill supports "
+                        "llama/moe-family decoders")
+                if getattr(cfg, "sliding_window", None) is not None:
+                    raise ValueError(
+                        "chunked prefill requires a full-length cache "
+                        "(no sliding_window): the padded tail chunk's "
+                        "junk writes rely on slot == position")
         # Speculative decoding over the slot pool: ``draft`` =
         # (draft_model, draft_cfg, draft_params, k). Each loop
         # iteration becomes one draft→verify round — every live slot
@@ -219,12 +264,21 @@ class ContinuousBatchingEngine:
         self._family_mod = family
         self.kv = kv
         self._pool = None
+        # Prefill-lane rows sit AFTER the decode slots in the block
+        # table (rows slots..slots+prefill_slots-1): the decode step's
+        # [slots]-shaped tables slice never sees them, and a handoff is
+        # a row move inside the same pool.
+        self.prefill_slots = int(prefill_slots or 0)
+        n_rows = slots + self.prefill_slots
         if kv == "paged":
             from polyaxon_tpu.serving.paged import PagePool
 
             if kv_pages is None:
+                # Sized to every row's dense reservation, lane rows
+                # included — staged prefills hold pages concurrently
+                # with the decode pool, by design.
                 self._pool = PagePool.dense_equivalent(
-                    slots, self.max_len, page_size,
+                    n_rows, self.max_len, page_size,
                     prefix_cache=prefix_cache)
             else:
                 # kv_pages counts USABLE pages (what /v1/stats reports
@@ -233,7 +287,7 @@ class ContinuousBatchingEngine:
                 if kv_pages < 1:
                     raise ValueError(
                         f"kv_pages must be >= 1, got {kv_pages}")
-                self._pool = PagePool(slots, self.max_len, page_size,
+                self._pool = PagePool(n_rows, self.max_len, page_size,
                                       kv_pages + 1,
                                       prefix_cache=prefix_cache)
             self._cache = family.paged_init_cache(
@@ -273,6 +327,22 @@ class ContinuousBatchingEngine:
             self._draft_cache = self._draft_family.cb_init_cache(
                 draft_cfg, slots, self.max_len)
         self.prefill_chunk = prefill_chunk
+        # Lane scheduler state (paged disaggregation). `_lane` maps a
+        # prefill ROW → [request, prefill tokens, progress, pos0,
+        # tok0]; dict insertion order is the staging FIFO. A staged
+        # reservation whose progress reached its prompt waits in place
+        # for a free decode slot (natural backpressure — no page churn).
+        self.prefill_lane_budget = int(prefill_lane_budget)
+        self.decode_lane_budget = int(decode_lane_budget)
+        self._lane: dict[int, list] = {}
+        self._lane_chunk = (int(prefill_chunk) if prefill_chunk
+                            else max(2 * page_size, 32))
+        self._handoffs = 0
+        self._handoff_pages = 0
+        # Decode-lane cadence: wall time between CONSECUTIVE decode
+        # steps (reset to None whenever the decode lane goes idle, so
+        # quiet gaps never pollute the interference histogram).
+        self._last_decode_at: Optional[float] = None
         # Per-slot chunked-prefill state: [request, prompt tokens to
         # write, progress, target row cache, draft row cache or None,
         # pos0, tok0]. A slot in this dict is RESERVED but not yet
@@ -411,7 +481,10 @@ class ContinuousBatchingEngine:
             if hasattr(family, "paged_prefill_suffix_kv"):
                 ps = page_size
 
-                @lru_cache(maxsize=16)
+                # 32, not 16: the prefill LANE reuses this cache with
+                # bucketed (chunk length, prefix-page) pairs on top of
+                # the classic suffix shapes.
+                @lru_cache(maxsize=32)
                 def compiled_suffix_prefill(slen: int, n_pref: int):
                     def run(params, suffix, cache, page_ids, m, real_len):
                         pref = jnp.maximum(page_ids[:n_pref], 0)
@@ -452,7 +525,6 @@ class ContinuousBatchingEngine:
 
         if draft is not None:
             draft_family, draft_cfg = self._draft_family, self._draft_cfg
-            k_spec = self.spec_k
 
             @lru_cache(maxsize=16)
             def compiled_draft_prefill(plen: int):
@@ -466,47 +538,70 @@ class ContinuousBatchingEngine:
             self._draft_insert = jax.jit(draft_family.insert_cache_row,
                                          donate_argnums=(0,))
 
-            def spec_round(params, draft_params, cache_t, cache_d,
-                           cur, pos, budget_left):
-                """One draft→verify round for the whole pool. Returns
-                (candidates [B, k+1], emit [B], next cur, caches).
-                Idle rows (pos < 0) run with clamped positions and
-                emit 0 — their cache rows are garbage the next
-                admission's insert replaces wholesale."""
-                B = cur.shape[0]
-                rows = jnp.arange(B)
-                live = pos >= 0
-                p0 = jnp.maximum(pos, 0)
+            # One executable PER DRAFT LENGTH (the scan length is
+            # static): the speculation policy retunes k per tick, and
+            # k only ever takes values in 1..spec_k, so the compile
+            # count is bounded by spec_k. Greedy speculation is
+            # lossless for ANY k — the target verifies — so varying k
+            # across rounds (including k=0 plain-step rounds, which
+            # leave draft-cache holes that degrade ACCEPTANCE, never
+            # output) changes throughput only.
+            @lru_cache(maxsize=16)
+            def spec_round_for(k_spec: int):
+                def spec_round(params, draft_params, cache_t, cache_d,
+                               cur, pos, budget_left):
+                    """One draft→verify round for the whole pool.
+                    Returns (candidates [B, k+1], emit [B], next cur,
+                    caches). Idle rows (pos < 0) run with clamped
+                    positions and emit 0 — their cache rows are garbage
+                    the next admission's insert replaces wholesale."""
+                    B = cur.shape[0]
+                    rows = jnp.arange(B)
+                    live = pos >= 0
+                    p0 = jnp.maximum(pos, 0)
 
-                def draft_step(carry, _):
-                    cache_d, tok, p = carry
-                    lg, cache_d = draft_family.decode_step_ragged(
-                        draft_cfg, draft_params, cache_d, tok, p)
-                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                    return (cache_d, nxt, p + 1), nxt
+                    def draft_step(carry, _):
+                        cache_d, tok, p = carry
+                        lg, cache_d = draft_family.decode_step_ragged(
+                            draft_cfg, draft_params, cache_d, tok, p)
+                        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                        return (cache_d, nxt, p + 1), nxt
 
-                # k+1 draft steps for k proposals: the extra step
-                # writes the LAST proposal's draft KV (same hole-free
-                # invariant as speculative.py).
-                (cache_d, _, _), d = jax.lax.scan(
-                    draft_step, (cache_d, cur, p0), None,
-                    length=k_spec + 1)
-                d = d.T[:, :k_spec]  # [B, k]
+                    # k+1 draft steps for k proposals: the extra step
+                    # writes the LAST proposal's draft KV (same
+                    # hole-free invariant as speculative.py).
+                    (cache_d, _, _), d = jax.lax.scan(
+                        draft_step, (cache_d, cur, p0), None,
+                        length=k_spec + 1)
+                    d = d.T[:, :k_spec]  # [B, k]
 
-                chunk = jnp.concatenate([cur[:, None], d], axis=1)
-                logits, cache_t = family.decode_chunk(
-                    cfg, params, cache_t, chunk, p0)
-                t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                match = (d == t[:, :k_spec]).astype(jnp.int32)
-                accepted = jnp.cumprod(match, axis=1).sum(axis=1)
-                emit = jnp.minimum(accepted + 1, budget_left)
-                emit = jnp.where(live, emit, 0)
-                cur_nxt = jnp.where(
-                    emit > 0, t[rows, jnp.maximum(emit - 1, 0)], cur)
-                return t, emit, cur_nxt, cache_t, cache_d
+                    chunk = jnp.concatenate([cur[:, None], d], axis=1)
+                    logits, cache_t = family.decode_chunk(
+                        cfg, params, cache_t, chunk, p0)
+                    t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    match = (d == t[:, :k_spec]).astype(jnp.int32)
+                    accepted = jnp.cumprod(match, axis=1).sum(axis=1)
+                    emit = jnp.minimum(accepted + 1, budget_left)
+                    emit = jnp.where(live, emit, 0)
+                    cur_nxt = jnp.where(
+                        emit > 0, t[rows, jnp.maximum(emit - 1, 0)], cur)
+                    return t, emit, cur_nxt, cache_t, cache_d
 
-            self._spec_round = jax.jit(spec_round,
-                                       donate_argnums=(2, 3))
+                return jax.jit(spec_round, donate_argnums=(2, 3))
+
+            self._spec_round_for = spec_round_for
+        # Speculation as a POLICY OUTPUT (ISSUE 18), not a static
+        # flag: each decode-lane tick asks the policy for the draft
+        # length given live pressure (prefill backlog, decode
+        # headroom, oldest queue wait). k=0 falls back to a plain
+        # decode step. Injectable for tests; draft-less engines
+        # carry no policy.
+        self._spec_policy = None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if draft is not None:
+            self._spec_policy = (spec_policy if spec_policy is not None
+                                 else SpeculationPolicy(self.spec_k))
 
         if prefill_chunk is not None:
             if draft is not None and not hasattr(self._draft_family,
@@ -692,6 +787,7 @@ class ContinuousBatchingEngine:
         self._thread.join()
         with self._cv:
             pending = [state[0] for state in self._prefilling.values()]
+            pending += [state[0] for state in self._lane.values()]
             for req in list(self._queue) + self._slot_req + pending:
                 if req is not None and not req.done.is_set():
                     req.error = "engine stopped"
@@ -757,6 +853,8 @@ class ContinuousBatchingEngine:
                 req.error = f"engine failed: {err}"
                 self._finish_trace(req)
                 req.done.set()
+        for p in list(self._lane):
+            self._drop_lane_reservation(p, f"engine failed: {err}")
         with self._cv:
             self._stopped = True
             while self._queue:
@@ -986,6 +1084,188 @@ class ContinuousBatchingEngine:
                 if not self._count_request_failure(exc):
                     return
 
+    # ------------------------------------------------------ prefill lane
+    def _admit_lane(self) -> None:
+        """Disaggregated admission: queued requests land on free
+        prefill-lane ROWS (never directly on a decode slot). The pool
+        admission is identical to the classic path — radix match,
+        page adoption, CoW fork, fresh-leaf registration — but no
+        prefill program runs here; the lane tick streams the novel
+        suffix in chunks and the handoff moves the finished row."""
+        for p in range(self.slots, self.slots + self.prefill_slots):
+            if p in self._lane:
+                continue
+            with self._cv:
+                if not self._queue:
+                    break
+                req = self._pick_next_locked()
+                if req is None:
+                    head = self._queue[0]
+                    if head.trace is not None:
+                        head.trace.event(
+                            "kv_backpressure",
+                            pages_free=self._pool.free_pages)
+                    break
+                obs_metrics.serving_queue_depth().set(len(self._queue))
+            admit_res = self._pool.admit(p, len(req.tokens), req.tokens)
+            if not admit_res:
+                obs_metrics.serving_admissions_total().inc(
+                    outcome="deferred")
+                if req.trace is not None:
+                    req.trace.event("requeue", reason="kv_pages")
+                with self._cv:
+                    self._queue.appendleft(req)
+                break
+            obs_metrics.serving_queue_wait_hist().observe(
+                time.time() - req.submitted_at, **{"class": req.klass})
+            if req.trace is not None:
+                req.trace.end_phase(slot=p)
+            try:
+                pos0, tok0, prefill_tokens = self._family_mod.cb_admission(
+                    req.tokens)
+                skip = self._note_prefix_outcome(
+                    req, admit_res, len(prefill_tokens or ()))
+                if admit_res.cow is not None:
+                    src, dst = admit_res.cow
+                    self._cache = self._copy_page(
+                        self._cache, jnp.int32(src), jnp.int32(dst))
+                toks = np.asarray(prefill_tokens or [], np.int32)
+                skip = min(skip, len(toks))
+                if req.trace is not None:
+                    req.trace.start_phase(
+                        "prefill",
+                        mode="cached" if skip >= len(toks) else "lane",
+                        prompt_tokens=int(len(toks)), cached_tokens=skip,
+                        slot=p)
+                self._lane[p] = [req, toks, skip, pos0, tok0]
+            except Exception as exc:  # noqa: BLE001 — request-scoped
+                self._pool.release(p, invalidate_prefix=True)
+                obs_metrics.serving_admissions_total().inc(
+                    outcome="failed")
+                req.error = f"{type(exc).__name__}: {exc}"
+                self._finish_trace(req)
+                req.done.set()
+                if not self._count_request_failure(exc):
+                    return
+
+    def _drop_lane_reservation(self, p: int, error: str) -> None:
+        """Abort one staged reservation: pages freed AND the fresh
+        radix leaf detached (its content was never fully written —
+        exactly the failed-prefill contract `release` documents)."""
+        req = self._lane.pop(p)[0]
+        self._pool.release(p, invalidate_prefix=True)
+        if not req.done.is_set():
+            if error != "cancelled" or not req.error:
+                req.error = error
+            self._finish_trace(req)
+            req.done.set()
+
+    def _lane_tick(self, decode_live: int) -> bool:
+        """Advance the prefill lane. While decode rows are live, at
+        most ``prefill_lane_budget`` chunk programs run — a prefill
+        storm can inflate its OWN latency but never occupy more than
+        the budgeted share of a tick the decode batch needed. With the
+        decode lane idle, every staged reservation advances (the
+        cold-start argument from _advance_prefill). Returns False when
+        fail-fast stopped the engine."""
+        budget = (len(self._lane) if decode_live == 0
+                  else self.prefill_lane_budget)
+        ran = 0
+        for p in list(self._lane):
+            if ran >= budget:
+                break
+            state = self._lane[p]
+            req, toks, i, pos0, tok0 = state
+            if req.cancelled:
+                self._drop_lane_reservation(p, "cancelled")
+                continue
+            if i >= len(toks):
+                continue  # staged, waiting for a free decode slot
+            chunk = toks[i:i + self._lane_chunk]
+            bucket = bucket_suffix_len(len(chunk))
+            padded = np.zeros(bucket, np.int32)
+            padded[:len(chunk)] = chunk
+            n_pref = self._bucket_pages(-(-i // self._pool.page_size))
+            try:
+                fn = self._suffix_prefill(bucket, n_pref)
+                self._cache = fn(
+                    self.params, jnp.asarray([padded], jnp.int32),
+                    self._cache,
+                    jnp.asarray(self._pool.padded_row(p)),
+                    jnp.int32(i), jnp.int32(len(chunk)))
+            except Exception as exc:  # noqa: BLE001 — request-scoped
+                self._drop_lane_reservation(
+                    p, f"{type(exc).__name__}: {exc}")
+                obs_metrics.serving_admissions_total().inc(
+                    outcome="failed")
+                if not self._count_request_failure(exc):
+                    return False
+                continue
+            ran += 1
+            state[2] = i + len(chunk)
+            if req.trace is not None:
+                req.trace.event("chunk", pos=int(i), of=int(len(toks)))
+        if ran:
+            obs_metrics.serving_lane_ticks_total().inc(lane="prefill")
+        return True
+
+    def _bucket_pages(self, n: int) -> int:
+        """Bucket a prefix-page count to the next power of two (capped
+        at the row width) so lane chunks share suffix executables
+        across progress depths. Safe over-read: table entries past the
+        real prefix gather the scratch page and _suffix_mask hides
+        every prefix column >= the traced match depth m."""
+        if n <= 0:
+            return 0
+        return min(1 << (n - 1).bit_length(),
+                   self._pool.max_pages_per_row)
+
+    def _lane_handoff(self) -> None:
+        """Move finished reservations to free decode slots: commit the
+        fresh radix leaf (the lane really wrote its pages), transfer
+        row ownership (PagePool.handoff — refcounts conserved), and go
+        live. Staging order is FIFO among finished rows; an unfinished
+        head does not block a finished sibling (per-iteration
+        scheduling: the decode lane should never idle on ceremony)."""
+        for p in list(self._lane):
+            state = self._lane[p]
+            req, toks, i, pos0, tok0 = state
+            if req.cancelled:
+                self._drop_lane_reservation(p, "cancelled")
+                continue
+            if i < len(toks):
+                continue
+            b = next((s for s in range(self.slots)
+                      if self._slot_req[s] is None), None)
+            if b is None:
+                return  # decode pool full: staged rows wait in place
+            self._pool.commit_prefix(p)
+            moved = self._pool.handoff(p, b)
+            del self._lane[p]
+            self._handoffs += 1
+            self._handoff_pages += moved
+            obs_metrics.serving_handoff_pages_total().inc(moved)
+            if req.trace is not None:
+                req.trace.event("handoff", src_row=p, dst_slot=b,
+                                pages=moved)
+            self._go_live(b, req, pos0, tok0)
+
+    def _lane_view(self) -> LaneView:
+        """Pressure snapshot for the speculation policy (and the
+        health surface): prefill backlog counts everything that still
+        owes prefill work — queued, dense chunked reservations, lane
+        reservations."""
+        with self._cv:
+            backlog = (len(self._queue) + len(self._prefilling)
+                       + len(self._lane))
+            oldest = (time.time() - self._queue[0].submitted_at
+                      if self._queue else 0.0)
+        free = sum(1 for b in range(self.slots)
+                   if self._slot_req[b] is None
+                   and b not in self._prefilling)
+        return LaneView(prefill_backlog=backlog, decode_free=free,
+                        oldest_wait=oldest)
+
     def request_timeline(self, request_id: str) -> Optional[dict]:
         """Assembled span tree for one recent request (None = unknown
         id or already evicted from the ring) — the payload behind
@@ -1011,6 +1291,21 @@ class ContinuousBatchingEngine:
             "active": sum(1 for r in self._slot_req if r is not None),
             "slots": self.slots,
             "max_pending": self.max_pending,
+            # Per-lane depths (ISSUE 18): the router spills on PREFILL
+            # pressure (work not yet decoding — queued plus staged
+            # reservations) instead of total queue depth, so a replica
+            # that is merely decode-busy no longer looks crowded; the
+            # autoscaler reads both sides separately.
+            "prefill_pending": (len(self._queue) + len(self._prefilling)
+                                + len(self._lane)),
+            "decode_active": sum(1 for r in self._slot_req
+                                 if r is not None),
+            # Rolling draft-acceptance rate (None until a draft engine
+            # has proposed something): accepted draft tokens over
+            # proposed — the policy's throughput dividend observable.
+            "spec_tokens_accepted_rate": (
+                round(self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else None),
             # Rolling radix prefix hit rate (same admission window as
             # the polyaxon_serving_prefix_hit_rate gauge); None until
             # the window has samples, so cold starts read as unknown,
@@ -1061,8 +1356,18 @@ class ContinuousBatchingEngine:
                 # acceptance.
                 "spec_tokens_per_round": (
                     round(self._spec_tokens / self._spec_rounds, 3)
-                    if self._spec_rounds else None)}
+                    if self._spec_rounds else None),
+                "spec_policy_state": self._spec_policy.state,
+                "spec_tokens_accepted_rate": (
+                    round(self._spec_accepted / self._spec_proposed, 4)
+                    if self._spec_proposed else None)}
                if self.draft is not None else {}),
+            **({"prefill_slots": self.prefill_slots,
+                "lane_staging": len(self._lane),
+                "handoffs": self._handoffs,
+                "handoff_pages": self._handoff_pages,
+                "decode_lane_budget": self.decode_lane_budget}
+               if self.prefill_slots else {}),
             **({"kv_pages_total": self._pool.n_pages - 1,
                 "kv_pages_free": self._pool.free_pages,
                 "kv_page_size": self._pool.page_size,
@@ -1194,6 +1499,12 @@ class ContinuousBatchingEngine:
             if self._slot_req[b] is not None:
                 self._slot_req[b].error = err
                 self._retire(b)
+        # Lane reservations die with the cache: their staged pages
+        # were in the donated buffer, so the KV they hold is gone —
+        # failing them is the only honest option (pages freed, fresh
+        # leaves detached).
+        for p in list(self._lane):
+            self._drop_lane_reservation(p, err)
         if self._consec_step_failures >= self.max_step_failures:
             self._fail_fast(err)
             return False
@@ -1214,13 +1525,16 @@ class ContinuousBatchingEngine:
                 self._draft_cfg, self.slots, self.max_len)
         return True
 
-    def _spec_iteration(self) -> bool:
+    def _spec_iteration(self, k: Optional[int] = None) -> bool:
         """One draft→verify round for the pool: every live slot emits
-        1..k+1 tokens (ragged acceptance, per-row budget caps). Returns
+        1..k+1 tokens (ragged acceptance, per-row budget caps). ``k``
+        is the POLICY's draft length for this round (default: the
+        configured spec_k); each distinct k compiles once. Returns
         False when a persistent failure stopped the engine. Mirrors the
         plain step's failure semantics, rebuilding BOTH caches on a
         transient device error (they were donated to the failed round).
         """
+        k = self.spec_k if k is None else k
         budget = np.zeros(self.slots, np.int32)
         for b in range(self.slots):
             req = self._slot_req[b]
@@ -1228,7 +1542,7 @@ class ContinuousBatchingEngine:
                 budget[b] = req.max_new - len(req.out)
         try:
             t, emit, cur_nxt, self._cache, self._draft_cache = (
-                self._spec_round(
+                self._spec_round_for(k)(
                     self.params, self._draft_params,
                     self._cache, self._draft_cache,
                     jnp.asarray(self._cur), jnp.asarray(self._pos),
@@ -1246,6 +1560,11 @@ class ContinuousBatchingEngine:
                 continue
             n = int(emit[b])
             self._spec_tokens += n
+            # Acceptance accounting for the policy observable: of the
+            # k proposals this row verified, emit-1 were the draft's
+            # (the last emitted token is always the target's own).
+            self._spec_proposed += k
+            self._spec_accepted += max(n - 1, 0)
             fresh = [int(tok) for tok in t[b, :n]]
             hit = next((j for j, tok in enumerate(fresh)
                         if tok in req.eos), None)
@@ -1326,7 +1645,7 @@ class ContinuousBatchingEngine:
         while True:
             with self._cv:
                 while (not self._stopped and not self._queue
-                       and not self._prefilling
+                       and not self._prefilling and not self._lane
                        and all(r is None for r in self._slot_req)):
                     self._cv.wait()
                 if self._stopped:
@@ -1346,11 +1665,14 @@ class ContinuousBatchingEngine:
         prefill-bound vs page-starved)."""
         obs_metrics.serving_tick_hist().observe(dt)
         decode = sum(1 for r in self._slot_req if r is not None)
-        prefill = len(self._prefilling)
+        prefill = len(self._prefilling) + len(self._lane)
         slots = obs_metrics.serving_batch_slots()
         slots.set(decode, state="decode")
         slots.set(prefill, state="prefill")
-        slots.set(max(self.slots - decode - prefill, 0), state="free")
+        # Lane rows are capacity ON TOP of the decode slots, so free
+        # counts only unreserved decode-pool slots.
+        slots.set(max(self.slots - decode - len(self._prefilling), 0),
+                  state="free")
         if self._pool is not None:
             util = self._pool.utilization()
             pages = obs_metrics.serving_kv_pages()
@@ -1363,32 +1685,92 @@ class ContinuousBatchingEngine:
             rpages.set(radix["resident"], state="resident")
 
     def _tick(self) -> bool:
-        """One engine iteration: drop cancellations, admit, advance
-        chunked prefills, run one decode step or speculative round.
-        Returns False when fail-fast stopped the engine (the loop
-        exits); True otherwise — including idle iterations."""
+        """One engine iteration. Classic: drop cancellations, admit,
+        advance chunked prefills, one decode step or speculative
+        round. Disaggregated (``prefill_slots``): handoff finished
+        lane rows, admit into lane rows, run the budgeted lane chunk
+        programs, handoff again (a prefill that finished this tick
+        goes live this tick), then give the decode lane its budgeted
+        steps. Returns False when fail-fast stopped the engine (the
+        loop exits); True otherwise — including idle iterations."""
         for b in range(self.slots):  # drop cancelled live requests
             req = self._slot_req[b]
             if req is not None and req.cancelled:
                 self._retire(b)
-        self._admit()
-        if self._stopped:  # _admit may fail-fast mid-pass
+        if self.prefill_slots:
+            self._lane_handoff()  # free lane rows before admission
+            self._admit_lane()
+        else:
+            self._admit()
+        if self._stopped:  # admission may fail-fast mid-pass
             return False
         self._queue_depth_peak = max(self._queue_depth_peak,
                                      len(self._queue))
         live = sum(1 for r in self._slot_req if r is not None)
-        if self._prefilling:
+        if self._lane:
+            if not self._lane_tick(live):
+                return False  # fail-fast stopped the engine
+            self._lane_handoff()
+            live = sum(1 for r in self._slot_req if r is not None)
+        elif self._prefilling:
             # Idle pool → advance every reservation (a cold-start
             # burst must not serialize one slot at a time).
             if not self._advance_prefill(all_slots=(live == 0)):
                 return False  # fail-fast stopped the engine
             live = sum(1 for r in self._slot_req if r is not None)
         if live == 0:
+            self._last_decode_at = None
             return True
-        self._steps_total += 1
-        self._live_slot_steps += live
-        if self.draft is not None:
-            return self._spec_iteration()
+        if self.prefill_slots and self.decode_lane_budget < 1:
+            # Red-team knob (bench --inject lane-starve): a zeroed
+            # decode budget means staged work goes live and then sits
+            # emitting nothing — the lane gate must catch this, so the
+            # engine honors it rather than quietly clamping to 1.
+            self._last_decode_at = None
+            time.sleep(0.005)  # don't spin hot while starved
+            return True
+        obs_metrics.serving_lane_ticks_total().inc(lane="decode")
+        steps = self.decode_lane_budget if self.prefill_slots else 1
+        for _ in range(max(steps, 1)):
+            live = sum(1 for r in self._slot_req if r is not None)
+            if live == 0:
+                break
+            self._steps_total += 1
+            self._live_slot_steps += live
+            if self.draft is not None:
+                k = max(0, min(
+                    self._spec_policy.draft_len(self._lane_view()),
+                    self.spec_k))
+                obs_metrics.serving_spec_draft_len().set(k)
+                if k > 0:
+                    if not self._spec_iteration(k):
+                        return False
+                    self._note_decode_step()
+                    continue
+                # Policy says no headroom: fall through to a plain
+                # step (lossless either way — the draft cache just
+                # accrues holes that degrade later acceptance).
+            if not self._plain_step():
+                return False
+            self._note_decode_step()
+        return True
+
+    def _note_decode_step(self) -> None:
+        """Decode-lane cadence: the wall gap between CONSECUTIVE
+        decode-lane steps, including whatever prefill work the
+        scheduler let land in between — THE interference observable
+        the decode-tpot-interference rule and the storm-window oracle
+        invariant judge. Idle gaps never count (_last_decode_at resets
+        whenever the lane goes quiet)."""
+        now = time.monotonic()
+        if self._last_decode_at is not None:
+            obs_metrics.serving_decode_tpot_hist().observe(
+                now - self._last_decode_at)
+        self._last_decode_at = now
+
+    def _plain_step(self) -> bool:
+        """One ragged decode step for the decode pool. Returns False
+        when fail-fast stopped the engine."""
         try:
             keys = jnp.stack([
                 jax.random.fold_in(self._keys[b],
@@ -1400,7 +1782,9 @@ class ContinuousBatchingEngine:
                 for r in self._slot_req)
             step_fn = (self._step_filtered if filtered
                        else self._step_plain)
-            tables = (jnp.asarray(self._pool.tables)
+            # Decode sees ONLY the decode-pool rows: lane rows sit
+            # past self.slots and belong to staged prefills.
+            tables = (jnp.asarray(self._pool.tables[:self.slots])
                       if self._pool is not None else None)
             nxt, self._cache = step_fn(
                 self.params, self._cache,

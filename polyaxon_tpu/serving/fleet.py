@@ -186,8 +186,14 @@ class ServingFleet:
                 rep.telemetry = {"status": "error"}
             if rep.state == "ready":
                 view[rep.id] = rep.telemetry
+            # Prefill depth when the engine reports per-lane fields
+            # (ISSUE 18) — the same pressure signal the router spills
+            # on; `queued` keeps older engines readable.
+            depth = rep.telemetry.get("prefill_pending")
+            if depth is None:
+                depth = rep.telemetry.get("queued", 0)
             obs_metrics.fleet_replica_queue_depth(self._registry).set(
-                rep.telemetry.get("queued", 0), replica=rep.id)
+                depth, replica=rep.id)
         gauge = obs_metrics.fleet_replicas(self._registry)
         for state, n in counts.items():
             gauge.set(n, state=state)

@@ -464,6 +464,27 @@ class PagePool:
             self._ref[page] += 1
             return True
 
+    # ----------------------------------------------------------- handoff
+    def handoff(self, src_slot: int, dst_slot: int) -> int:
+        """Transfer ownership of ``src_slot``'s pages to ``dst_slot``
+        (prefill lane → decode lane). Pure bookkeeping: the block-table
+        row moves, the fresh-leaf marker follows, and refcounts are
+        untouched — the pages appear in exactly one row before and
+        after, so ``check_invariants`` holds across the boundary and
+        nothing is recomputed or copied on device. Returns the number
+        of pages transferred."""
+        with self._lock:
+            dst = self.tables[dst_slot]
+            assert (dst < 0).all(), \
+                f"handoff into slot {dst_slot} which still holds pages"
+            src = self.tables[src_slot]
+            dst[:] = src
+            src[:] = -1
+            leaf = self._fresh_leaf.pop(src_slot, None)
+            if leaf is not None:
+                self._fresh_leaf[dst_slot] = leaf
+            return int((dst >= 0).sum())
+
     # ----------------------------------------------------------- release
     def commit_prefix(self, slot: int) -> None:
         """The slot's prefill completed: its fresh tree leaf now holds

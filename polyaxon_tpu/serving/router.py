@@ -238,15 +238,23 @@ class FleetRouter:
 
     def _pressured(self, replica: str,
                    telemetry: Optional[dict]) -> bool:
-        """Queue-depth half of the hotness cap: a target whose pending
-        queue is past ``spill_depth`` is deflected exactly like a
+        """Queue-depth half of the hotness cap: a target whose PREFILL
+        backlog is past ``spill_depth`` is deflected exactly like a
         routing-share hog — this is what lets a freshly-committed
         replica actually RELIEVE a spike (ring ownership moved ~1/N of
-        prefixes onto it; pressure unsticks their affinity)."""
+        prefixes onto it; pressure unsticks their affinity).
+
+        Prefill depth, not total queue depth (ISSUE 18): a replica
+        whose slots are merely decode-busy admits new work next tick —
+        spilling away from it would shred affinity for nothing. Falls
+        back to `queued` for engines predating the per-lane fields."""
         if self.spill_depth is None:
             return False
         view = (telemetry or {}).get(replica) or {}
-        return view.get("queued", 0) > self.spill_depth
+        depth = view.get("prefill_pending")
+        if depth is None:
+            depth = view.get("queued", 0)
+        return depth > self.spill_depth
 
     def _hot(self, replica: str) -> bool:
         # The cap needs a populated window to mean anything: the first

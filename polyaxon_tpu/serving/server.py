@@ -715,6 +715,9 @@ class ServingServer:
                  draft_checkpoint: Optional[str] = None, spec_k: int = 4,
                  lora_alpha: float = 16.0,
                  prefill_chunk: Optional[int] = None,
+                 prefill_slots: Optional[int] = None,
+                 prefill_lane_budget: int = 1,
+                 decode_lane_budget: int = 1,
                  max_pending: Optional[int] = None,
                  request_tracing: bool = True,
                  trace_dump_path: Optional[str] = None):
@@ -771,7 +774,11 @@ class ServingServer:
                 model, cfg, params, slots=slots, kv=kv,
                 page_size=page_size, kv_pages=kv_pages,
                 prefix_cache=prefix_cache, draft=draft,
-                prefill_chunk=prefill_chunk, max_pending=max_pending,
+                prefill_chunk=prefill_chunk,
+                prefill_slots=prefill_slots,
+                prefill_lane_budget=prefill_lane_budget,
+                decode_lane_budget=decode_lane_budget,
+                max_pending=max_pending,
                 request_tracing=request_tracing,
                 trace_dump_path=trace_dump_path)
         elif batching == "static":
@@ -779,6 +786,11 @@ class ServingServer:
                 raise ValueError(
                     "--prefill-chunk requires --batching continuous "
                     "(the static engine compiles whole generations)")
+            if prefill_slots is not None:
+                raise ValueError(
+                    "--prefill-slots requires --batching continuous "
+                    "with kv='paged' (the disaggregated lane scheduler "
+                    "lives in the continuous engine)")
             if max_pending is not None:
                 raise ValueError(
                     "--max-pending requires --batching continuous (the "

@@ -129,3 +129,82 @@ def generate_speculative(
     if return_rounds:
         return out[:, :max_new], rounds
     return out[:, :max_new]
+
+
+# --------------------------------------------------------------- policy
+class LaneView:
+    """What the speculation policy sees each decode-lane tick: queue +
+    prefill-lane pressure, decode-lane headroom, and how long the
+    oldest waiting request has been burning its TTFT budget. Built by
+    the engine; plain data so the policy is testable without jax."""
+
+    __slots__ = ("prefill_backlog", "decode_free", "oldest_wait")
+
+    def __init__(self, prefill_backlog: int = 0, decode_free: int = 0,
+                 oldest_wait: float = 0.0):
+        self.prefill_backlog = int(prefill_backlog)
+        self.decode_free = int(decode_free)
+        self.oldest_wait = float(oldest_wait)
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"LaneView(prefill_backlog={self.prefill_backlog}, "
+                f"decode_free={self.decode_free}, "
+                f"oldest_wait={self.oldest_wait:.3f})")
+
+
+class SpeculationPolicy:
+    """Speculation as a scheduler output, not a static flag.
+
+    Greedy speculative decoding is lossless for ANY draft length — the
+    target verifies every proposal — so the policy is free to retune
+    ``k`` per tick purely on throughput/latency grounds:
+
+    state       | condition                                 | draft len
+    ----------- | ----------------------------------------- | ---------
+    speculate   | decode lane has idle headroom, no backlog  | k_max
+    throttled   | prefill backlog > 0 or decode lane full    | k_max - backlog (>= k_min)
+    off         | oldest wait > ttft_budget, or backlog >=   | 0
+                | off_backlog (TTFT budget burning)          |
+
+    Rationale: each extra draft token is speculative compute the decode
+    tick must verify; under prefill pressure that compute competes with
+    the chunk programs that bound TTFT, so the draft shrinks first and
+    disappears entirely once the backlog is burning the TTFT budget.
+    ``state`` after a ``draft_len`` call names the branch taken (the
+    tests drive the machine through all three).
+    """
+
+    STATES = ("speculate", "throttled", "off")
+
+    def __init__(self, k_max: int, *, k_min: int = 1, off_backlog: int = 4,
+                 ttft_budget: float = 0.5):
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {k_max}")
+        if not 1 <= k_min <= k_max:
+            raise ValueError(
+                f"need 1 <= k_min <= k_max, got k_min={k_min} "
+                f"k_max={k_max}")
+        if off_backlog < 1:
+            raise ValueError(
+                f"off_backlog must be >= 1, got {off_backlog}")
+        if ttft_budget <= 0:
+            raise ValueError(
+                f"ttft_budget must be > 0, got {ttft_budget}")
+        self.k_max = int(k_max)
+        self.k_min = int(k_min)
+        self.off_backlog = int(off_backlog)
+        self.ttft_budget = float(ttft_budget)
+        self.state = "speculate"
+
+    def draft_len(self, view: LaneView) -> int:
+        """Draft tokens the next decode tick should propose (0 = run a
+        plain decode step)."""
+        if (view.oldest_wait > self.ttft_budget
+                or view.prefill_backlog >= self.off_backlog):
+            self.state = "off"
+            return 0
+        if view.prefill_backlog > 0 or view.decode_free == 0:
+            self.state = "throttled"
+            return max(self.k_min, self.k_max - view.prefill_backlog)
+        self.state = "speculate"
+        return self.k_max

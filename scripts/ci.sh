@@ -141,6 +141,27 @@ JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
     --quick --workload shared-prefix --slots 2 --kv-page-size 8 \
     --configs paged,paged-nocache --check-prefix \
     --out /tmp/bench_serve_smoke.json
+# Lane A/B smoke (ISSUE 18): interleaved vs disaggregated
+# prefill/decode over the long-prompt-storm mix, paired per trial.
+# --check-lanes fails the build unless pages actually moved
+# prefill→decode (handoffs > 0), refcount invariants came out clean
+# on BOTH arms, decode gap p99 stayed <= 1.15x interleaved (the
+# whole point of the split), and prefill throughput held >= 0.90x
+# (pacing, not starvation).
+echo "== lane A/B smoke (disaggregated prefill/decode handoff)"
+JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
+    --quick --workload long-prompt-storm --slots 4 --kv-page-size 8 \
+    --check-lanes --out /tmp/bench_serve_lanes.json
+# The lane gate must be able to FAIL: zeroing the decode lane budget
+# starves every request of its decode steps — nothing completes, and
+# the run must exit 1.
+if JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
+    --quick --workload long-prompt-storm --slots 4 --kv-page-size 8 \
+    --inject lane-starve --out /tmp/bench_serve_starve.json \
+    >/dev/null 2>&1; then
+    echo "lane self-test FAILED: a starved decode lane passed the gate"
+    exit 1
+fi
 # Fleet-sim stage (ISSUE 8): drive the REAL scheduler + admission +
 # store through the quick load points (idle → storm, seconds not the
 # full compressed day) and gate tick cost against

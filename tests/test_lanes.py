@@ -1,0 +1,246 @@
+"""Disaggregated prefill/decode (ISSUE 18): the speculation-policy
+state machine, PagePool handoff accounting, and engine-level lane
+behavior — output parity with the interleaved scheduler, page handoffs
+actually happening, per-lane health fields, and the lane-starve knob
+(a zeroed decode budget must starve, and restoring it must drain)."""
+
+import pytest
+
+from polyaxon_tpu.serving.paged import PagePool
+from polyaxon_tpu.serving.speculative import LaneView, SpeculationPolicy
+
+
+class TestSpeculationPolicy:
+    """Pure state machine — no jax, no engine."""
+
+    def test_idle_headroom_speculates_at_k_max(self):
+        policy = SpeculationPolicy(4)
+        assert policy.draft_len(LaneView(prefill_backlog=0,
+                                         decode_free=2)) == 4
+        assert policy.state == "speculate"
+
+    def test_backlog_throttles_draft_len(self):
+        policy = SpeculationPolicy(4, k_min=2)
+        assert policy.draft_len(LaneView(prefill_backlog=1,
+                                         decode_free=1)) == 3
+        assert policy.state == "throttled"
+        # Deep (but sub-off) backlog clamps at k_min, never below.
+        policy2 = SpeculationPolicy(4, k_min=2, off_backlog=10)
+        assert policy2.draft_len(LaneView(prefill_backlog=9,
+                                          decode_free=1)) == 2
+        assert policy2.state == "throttled"
+
+    def test_full_decode_lane_throttles_even_without_backlog(self):
+        policy = SpeculationPolicy(4)
+        assert policy.draft_len(LaneView(prefill_backlog=0,
+                                         decode_free=0)) == 4
+        assert policy.state == "throttled"
+
+    def test_off_at_backlog_threshold(self):
+        policy = SpeculationPolicy(4, off_backlog=3)
+        assert policy.draft_len(LaneView(prefill_backlog=3,
+                                         decode_free=2)) == 0
+        assert policy.state == "off"
+
+    def test_off_when_ttft_budget_burning(self):
+        policy = SpeculationPolicy(4, ttft_budget=0.5)
+        assert policy.draft_len(LaneView(prefill_backlog=0,
+                                         decode_free=2,
+                                         oldest_wait=0.6)) == 0
+        assert policy.state == "off"
+
+    def test_recovers_when_pressure_clears(self):
+        policy = SpeculationPolicy(4, off_backlog=2)
+        policy.draft_len(LaneView(prefill_backlog=2))
+        assert policy.state == "off"
+        policy.draft_len(LaneView(prefill_backlog=1, decode_free=1))
+        assert policy.state == "throttled"
+        assert policy.draft_len(LaneView(decode_free=2)) == 4
+        assert policy.state == "speculate"
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="k_max"):
+            SpeculationPolicy(0)
+        with pytest.raises(ValueError, match="k_min"):
+            SpeculationPolicy(2, k_min=3)
+        with pytest.raises(ValueError, match="k_min"):
+            SpeculationPolicy(2, k_min=0)
+        with pytest.raises(ValueError, match="off_backlog"):
+            SpeculationPolicy(2, off_backlog=0)
+        with pytest.raises(ValueError, match="ttft_budget"):
+            SpeculationPolicy(2, ttft_budget=0)
+
+
+class TestHandoffAccounting:
+    """PagePool.handoff is pure bookkeeping: the block-table row and
+    the fresh-leaf marker move, refcounts/invariants hold, and release
+    semantics follow the pages to their new row."""
+
+    def _pool(self):
+        # 4 rows: 0..1 "decode", 2..3 "lane" (the pool itself is
+        # lane-agnostic — the engine's convention is rows >= slots).
+        return PagePool(slots=4, max_len=32, page_size=4, n_pages=17)
+
+    def test_row_and_refcounts_move(self):
+        pool = self._pool()
+        tokens = list(range(10))
+        res = pool.admit(2, len(tokens), tokens)
+        assert res is not None
+        src_pages = [int(p) for p in pool.tables[2] if p >= 0]
+        free_before = pool.free_pages
+        moved = pool.handoff(2, 0)
+        assert moved == len(src_pages)
+        assert [int(p) for p in pool.tables[0] if p >= 0] == src_pages
+        assert (pool.tables[2] < 0).all()
+        # Pure ownership transfer: nothing allocated, nothing freed.
+        assert pool.free_pages == free_before
+        assert pool.check_invariants() == []
+
+    def test_fresh_leaf_follows_the_handoff(self):
+        pool = self._pool()
+        tokens = list(range(12))
+        pool.admit(2, len(tokens), tokens)
+        assert 2 in pool._fresh_leaf
+        pool.handoff(2, 1)
+        assert 2 not in pool._fresh_leaf and 1 in pool._fresh_leaf
+        # A failed prefill detected AFTER handoff must still be able
+        # to forget exactly its own fresh leaf via the new row.
+        pool.release(1, invalidate_prefix=True)
+        assert pool.check_invariants() == []
+        # The invalidated chain is gone: a re-admission of the same
+        # prompt matches nothing.
+        assert pool.peek_matched_tokens(len(tokens), tokens) == 0
+
+    def test_release_after_handoff_frees_everything(self):
+        pool = self._pool()
+        free0 = pool.free_pages
+        tokens = list(range(10))
+        pool.admit(3, len(tokens), tokens)
+        pool.handoff(3, 0)
+        pool.commit_prefix(0)
+        pool.release(0)
+        # Shareable prefix pages stay resident in the tree but count
+        # as reclaimable, so the allocatable total is fully restored.
+        assert pool.free_pages == free0
+        assert pool.check_invariants() == []
+
+    def test_handoff_into_occupied_row_asserts(self):
+        pool = self._pool()
+        pool.admit(2, 6, list(range(6)))
+        pool.admit(0, 6, list(range(100, 106)))
+        with pytest.raises(AssertionError, match="still holds pages"):
+            pool.handoff(2, 0)
+
+
+class TestDisaggregatedEngine:
+    """Engine-level: the lane scheduler must be output-invisible
+    (greedy parity with the interleaved engine) while actually moving
+    pages prefill→decode, and the per-lane health/stat surfaces must
+    report it."""
+
+    def _params(self):
+        from polyaxon_tpu.serving.server import load_params
+        return load_params("llama_tiny", seed=0)
+
+    def test_parity_handoffs_and_health(self):
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        cfg, params = self._params()
+        prompts = [[5, 6, 7, 8, 9, 10, 11, 12, 13],
+                   [1, 2, 3],
+                   [7, 3, 9, 11, 2, 4, 6, 8, 10, 12, 1, 5],
+                   [42, 43, 44, 45]]
+        plain = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=2, kv="paged",
+                                         page_size=4)
+        try:
+            want = [plain.submit(p, 6).wait(timeout=300)
+                    for p in prompts]
+        finally:
+            plain.stop()
+        engine = ContinuousBatchingEngine(
+            "llama_tiny", cfg, params, slots=2, kv="paged",
+            page_size=4, prefill_slots=2, prefill_chunk=8,
+            prefill_lane_budget=2, decode_lane_budget=2)
+        try:
+            got = [r.wait(timeout=300)
+                   for r in [engine.submit(p, 6) for p in prompts]]
+            health = engine.health()
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        assert got == want
+        assert stats["handoffs"] == len(prompts)
+        assert stats["handoff_pages"] > 0
+        assert stats["kv_invariant_violations"] == 0
+        assert stats["prefill_slots"] == 2
+        # The router/autoscaler surface: per-lane depths + the
+        # speculation observable (None — no draft engine here).
+        assert health["prefill_pending"] == 0
+        assert health["decode_active"] == 0
+        assert health["spec_tokens_accepted_rate"] is None
+
+    def test_lane_starve_and_recover(self):
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        cfg, params = self._params()
+        engine = ContinuousBatchingEngine(
+            "llama_tiny", cfg, params, slots=2, kv="paged",
+            page_size=4, prefill_slots=2, prefill_chunk=8,
+            decode_lane_budget=0)
+        try:
+            req = engine.submit([5, 6, 7, 8, 9], 4)
+            # Prefill + handoff happen, but with a zeroed decode
+            # budget the live row never steps: no tokens, ever.
+            with pytest.raises(TimeoutError):
+                req.wait(timeout=3)
+            assert engine.stats()["handoffs"] >= 1
+            # Restoring the budget drains the staged work.
+            engine.decode_lane_budget = 2
+            assert len(req.wait(timeout=300)) == 4
+            assert engine.stats()["kv_invariant_violations"] == 0
+        finally:
+            engine.stop()
+
+    def test_spec_policy_parity_under_forced_states(self):
+        """A draft engine whose policy cycles through throttled/off
+        draft lengths must still match the plain engine exactly —
+        speculation is lossless at EVERY k the policy can emit."""
+        from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+
+        cfg, params = self._params()
+        prompts = [[5, 6, 7, 8, 9, 10, 11], [1, 2, 3]]
+        plain = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=2)
+        try:
+            want = [plain.submit(p, 8).wait(timeout=300)
+                    for p in prompts]
+        finally:
+            plain.stop()
+
+        class CyclingPolicy(SpeculationPolicy):
+            """Ignores the lane view; emits 3, 1, 0, 3, 1, 0, ..."""
+
+            def __init__(self):
+                super().__init__(3)
+                self._i = 0
+
+            def draft_len(self, view):
+                k = (3, 1, 0)[self._i % 3]
+                self._i += 1
+                self.state = "off" if k == 0 else (
+                    "speculate" if k == 3 else "throttled")
+                return k
+
+        engine = ContinuousBatchingEngine(
+            "llama_tiny", cfg, params, slots=2,
+            draft=("llama_tiny", cfg, params, 3),
+            spec_policy=CyclingPolicy())
+        try:
+            got = [r.wait(timeout=300)
+                   for r in [engine.submit(p, 8) for p in prompts]]
+            state = engine.stats()["spec_policy_state"]
+        finally:
+            engine.stop()
+        assert got == want
+        assert state in SpeculationPolicy.STATES

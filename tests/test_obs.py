@@ -758,6 +758,63 @@ class TestRuleLifecycle:
         assert [e["event"] for e in engine.history] == [
             "fired", "resolved"]
 
+    def test_decode_tpot_interference_fires_then_resolves(self):
+        """ISSUE 18: the COMMITTED decode-tpot-interference rule is the
+        alerting half of the lane split — it burns when consecutive
+        decode steps drift past the 500ms SLO bucket (prefill work
+        occupying decode ticks), and resolves once the lane scheduler
+        (or an operator turning the budget knobs) restores cadence."""
+        (rule,) = [r for r in obs_rules.check_ruleset()
+                   if r.id == "decode-tpot-interference"]
+        assert rule.kind == "slo_burn_rate"
+        assert rule.le == 0.5  # the docs' 500ms decode-gap objective
+
+        registry = obs_metrics.MetricsRegistry()
+        obs_metrics.ensure_serving_metrics(registry)
+        hist = obs_metrics.serving_decode_tpot_hist(registry)
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([rule], registry=registry,
+                                       clock=clock)
+        # Cold start: registered but never observed → no data, silent.
+        assert engine.evaluate() == []
+
+        for _ in range(100):
+            hist.observe(0.02)  # healthy decode cadence
+        engine.evaluate()  # baseline window edge
+        clock.now += 30
+        for _ in range(50):
+            hist.observe(0.05)
+        assert engine.evaluate() == []  # within budget: no burn
+
+        clock.now += 30
+        # A prompt storm starves decode ticks: most in-window steps
+        # breach the 500ms bucket — far past the 30% burn the 5%%
+        # budget x factor 6 allows.
+        for _ in range(100):
+            hist.observe(2.0)
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "decode-tpot-interference"
+        assert fired["value"] > rule.value  # burn multiple > factor 6
+        assert engine.active()
+
+        # Lane budgets restored: cadence recovers, the breach window
+        # slides out, and hysteresis (resolve_after=30s) holds before
+        # the resolve lands.
+        clock.now += 61  # breach sample ages out of the 60s window
+        for _ in range(200):
+            hist.observe(0.02)
+        assert engine.evaluate() == []  # clear; resolve clock starts
+        clock.now += 31
+        for _ in range(50):
+            hist.observe(0.02)
+        (resolved,) = engine.evaluate()
+        assert resolved["event"] == "resolved"
+        assert resolved["rule"] == "decode-tpot-interference"
+        assert engine.active() == []
+        assert [e["event"] for e in engine.history] == [
+            "fired", "resolved"]
+
 
 # ============================================================ flight recorder
 class TestFlightRecorder:
