@@ -197,6 +197,15 @@ class Gauge(_Metric):
     def dec(self, amount: float = 1.0, **labels: Any) -> None:
         self.inc(-amount, **labels)
 
+    def unset(self, **labels: Any) -> None:
+        """Drop the series so readers see *no value* rather than a
+        stale one — for gauges whose meaning is scoped to a live
+        process (a stopped engine's rolling window describes nothing;
+        alert rules treat a missing series as not-breaching, which a
+        parked last value would not be)."""
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
     def value(self, **labels: Any) -> float:
         with self._lock:
             return float(self._series.get(self._key(labels), 0.0))
@@ -582,6 +591,33 @@ def serving_evictions_total(registry: MetricsRegistry = REGISTRY) -> Counter:
         ("reason",))
 
 
+def serving_class_pending(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_serving_class_pending",
+        "Pending (queued, not yet admitted) requests per request class "
+        "— the per-class admission backlog the router's pressure guard "
+        "reads against the class cap",
+        ("class",))
+
+
+def serving_preemptions_total(registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_preemptions_total",
+        "Preemptive slot/KV evictions by victim class and the blocked "
+        "resource that triggered them (slots = no free decode slot, "
+        "kv_pages = pool could not admit the urgent prefill)",
+        ("class", "reason"))
+
+
+def serving_readmit_suffix_tokens_total(
+        registry: MetricsRegistry = REGISTRY) -> Counter:
+    return registry.counter(
+        "polyaxon_serving_readmit_suffix_tokens_total",
+        "Novel prompt tokens prefilled when a preempted request "
+        "re-admits — the committed radix prefix serves the rest, so "
+        "this counter is the real recompute cost of eviction")
+
+
 def serving_tick_hist(registry: MetricsRegistry = REGISTRY) -> Histogram:
     return registry.histogram(
         "polyaxon_serving_engine_tick_seconds",
@@ -725,6 +761,9 @@ def ensure_serving_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     serving_rejected_total(registry)
     serving_admissions_total(registry)
     serving_evictions_total(registry)
+    serving_class_pending(registry)
+    serving_preemptions_total(registry)
+    serving_readmit_suffix_tokens_total(registry)
     serving_tick_hist(registry)
     serving_batch_slots(registry)
     serving_kv_pages(registry)

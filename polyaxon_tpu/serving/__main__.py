@@ -65,6 +65,21 @@ def main() -> int:
     parser.add_argument("--max-pending", type=int, default=None,
                         help="(continuous) pending-queue cap; saturated "
                              "generate requests answer 503 + Retry-After")
+    parser.add_argument("--no-class-admission", action="store_true",
+                        help="(continuous) disable class-aware admission "
+                             "and preemption: one FIFO-with-cache-affinity "
+                             "queue for every request class (the A/B "
+                             "baseline for bench_serve.py --streams)")
+    parser.add_argument("--class-max-pending", action="append", default=[],
+                        metavar="CLASS=N",
+                        help="(continuous) per-class pending cap, e.g. "
+                             "interactive=64; repeatable; saturated "
+                             "classes answer 503 + Retry-After while "
+                             "others keep queueing")
+    parser.add_argument("--no-preemption", action="store_true",
+                        help="(continuous) keep class-aware ranking but "
+                             "never evict a live slot for a blocked "
+                             "interactive prefill")
     parser.add_argument("--no-request-tracing", action="store_true",
                         help="(continuous) disable per-request span "
                              "timelines (GET /requests/{id}/timeline); "
@@ -75,6 +90,13 @@ def main() -> int:
                              "serving mirror of postmortem.json; "
                              "sim.replay can turn it into a trace)")
     args = parser.parse_args()
+    class_caps = {}
+    for spec in args.class_max_pending:
+        name, sep, cap = spec.partition("=")
+        if not sep or not name or not cap.isdigit():
+            parser.error(f"--class-max-pending expects CLASS=N, got "
+                         f"{spec!r}")
+        class_caps[name] = int(cap)
     mesh_axes = None
     if args.mesh:
         from polyaxon_tpu.parallel import parse_mesh_axes
@@ -101,6 +123,9 @@ def main() -> int:
                        prefill_slots=args.prefill_slots,
                        prefill_lane_budget=args.prefill_lane_budget,
                        max_pending=args.max_pending,
+                       class_admission=not args.no_class_admission,
+                       class_max_pending=class_caps or None,
+                       preemption=not args.no_preemption,
                        request_tracing=not args.no_request_tracing,
                        trace_dump_path=args.trace_dump) as s:
         print(f"serving {args.model} at {s.url}", flush=True)

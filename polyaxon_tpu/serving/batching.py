@@ -69,6 +69,55 @@ def bucket_suffix_len(n: int, floor: int = 8) -> int:
     return max(floor, 1 << (n - 1).bit_length())
 
 
+@dataclass(frozen=True)
+class RequestClass:
+    """One named serving class — the per-request mirror of the PR 2
+    queue/priority-class catalog (scheduling.catalog.V1Queue): a
+    numeric priority orders admission across classes, a TTFT target
+    anchors deadline urgency inside the rank tuple, and the
+    preemption flags say who may evict whom under pressure.
+
+    ``skip_cap`` is the PR 11 bounded-starvation barrier generalized
+    per class: a request overtaken that many times becomes a barrier
+    for younger requests OF ITS OWN CLASS (aging is within-class;
+    across classes priority is strict — a saturated high class starves
+    a lower one by design, and the per-class pending cap is the
+    shed-load bound on that starvation)."""
+
+    name: str
+    priority: int          # higher admits first (catalog ordering)
+    ttft_target: float     # seconds; past it the request is "overdue"
+    preemptible: bool      # may be evicted from a live slot
+    preempts: bool         # may trigger eviction when blocked
+    skip_cap: int          # within-class starvation barrier
+
+
+# Mirrors scheduling.catalog.PRIORITY_CLASSES (low=0, default=1,
+# high=2): interactive rides the `high` rung with a tight TTFT target
+# and is never evicted; `batch` is the default middle; `best-effort`
+# is the only preemptible class — its slots and KV pages are the
+# reserve an urgent interactive prefill draws down.
+REQUEST_CLASSES: dict[str, RequestClass] = {
+    "interactive": RequestClass("interactive", priority=2,
+                                ttft_target=0.5, preemptible=False,
+                                preempts=True, skip_cap=4),
+    "batch": RequestClass("batch", priority=1, ttft_target=2.5,
+                          preemptible=False, preempts=False,
+                          skip_cap=16),
+    "best-effort": RequestClass("best-effort", priority=0,
+                                ttft_target=30.0, preemptible=True,
+                                preempts=False, skip_cap=64),
+}
+DEFAULT_REQUEST_CLASS = "batch"
+
+
+def resolve_request_class(name: str) -> RequestClass:
+    """Catalog lookup; unknown class names fold to the default class
+    (the HTTP layer already bounds the raw string) so an arbitrary
+    label can never mint priority or preemption rights."""
+    return REQUEST_CLASSES.get(name, REQUEST_CLASSES[DEFAULT_REQUEST_CLASS])
+
+
 def validate_sampling(top_p: float, top_k: int) -> None:
     """Shared request-sampling validation (HTTP handler AND direct
     engine callers): out-of-range knobs must raise, not silently
@@ -101,9 +150,9 @@ class _Request:
     # into the unified registry's serving-latency histogram (ISSUE 5).
     submitted_at: float = field(default_factory=time.time)
     # Per-request observability (ISSUE 10): the id doubles as the trace
-    # id; `klass` labels the SLO histograms (one class, `batch`, until
-    # ROADMAP item 1 lands the per-class policy); `first_token_at`
-    # anchors TTFT at emission and TPOT at retirement.
+    # id; `klass` labels the SLO histograms and picks the admission
+    # queue (REQUEST_CLASSES; unknown labels fold to `batch`);
+    # `first_token_at` anchors TTFT at emission and TPOT at retirement.
     id: str = field(default_factory=reqtrace.new_request_id)
     klass: str = "batch"
     trace: Optional[reqtrace.RequestTrace] = None
@@ -114,6 +163,13 @@ class _Request:
     # count lands on the request trace at finish.
     admit_skips: int = 0
     prefix_cached_tokens: int = 0
+    # Class-aware admission (ISSUE 19): `seq` is the global arrival
+    # order (assigned under the engine lock at enqueue) — the FIFO
+    # tie-breaker now that pending work lives in per-class queues;
+    # `preemptions` counts evictions this request survived, so the
+    # re-admission path knows to account its suffix prefill.
+    seq: int = 0
+    preemptions: int = 0
 
     def wait(self, timeout: Optional[float] = None) -> list[int]:
         if not self.done.wait(timeout):
@@ -139,6 +195,9 @@ class ContinuousBatchingEngine:
                  decode_lane_budget: int = 1,
                  spec_policy: Optional[SpeculationPolicy] = None,
                  max_pending: Optional[int] = None,
+                 class_admission: bool = True,
+                 class_max_pending: Optional[dict] = None,
+                 preemption: bool = True,
                  request_tracing: bool = True,
                  trace_capacity: int = reqtrace.DEFAULT_RING_CAPACITY,
                  trace_dump_path: Optional[str] = None):
@@ -365,7 +424,37 @@ class ContinuousBatchingEngine:
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self.max_pending = max_pending
-        self._queue: collections.deque[_Request] = collections.deque()
+        # Class-aware admission (ISSUE 19): pending work lives in
+        # PER-CLASS queues (FIFO within a class, arrival `seq` as the
+        # cross-class tie-breaker) instead of one deque. With
+        # `class_admission` off — the A/B baseline — every request
+        # lands in one queue regardless of label and the pre-19
+        # FIFO-with-cache-affinity scan runs unchanged.
+        self.class_admission = bool(class_admission)
+        self.preemption = bool(preemption)
+        self._class_caps: dict[str, int] = {}
+        for name, cap in (class_max_pending or {}).items():
+            if cap is not None:
+                cap = int(cap)
+                if cap < 1:
+                    raise ValueError(
+                        f"class_max_pending[{name!r}] must be >= 1, "
+                        f"got {cap}")
+                self._class_caps[str(name)] = cap
+        # Pre-created for every reachable key (unknown labels fold to
+        # the default class) so the dict never grows after the ctor —
+        # unlocked readers (health/stats/gauges) iterate it safely.
+        self._queues: dict[str, collections.deque] = {
+            name: collections.deque()
+            for name in (REQUEST_CLASSES if self.class_admission
+                         else (DEFAULT_REQUEST_CLASS,))}
+        self._seq = 0
+        # Preemption accounting (stats + the bench gate): evictions by
+        # victim class, and the novel tokens re-admissions prefilled
+        # (the real recompute cost of eviction — the committed prefix
+        # rode the radix cache).
+        self._preemptions: dict[str, int] = {}
+        self._readmit_suffix_tokens = 0
         # Per-request observability (ISSUE 10): span trees in a bounded
         # ring behind GET /requests/{id}/timeline, shed-load accounting
         # for /v1/stats. Tracing defaults on — the parity check in
@@ -707,18 +796,30 @@ class ContinuousBatchingEngine:
             if self._stopped:
                 self._reject("shutdown")
                 raise RuntimeError("engine stopped")
-            if (self.max_pending is not None
-                    and len(self._queue) >= self.max_pending):
+            depth = self._queue_depth()
+            if self.max_pending is not None and depth >= self.max_pending:
                 self._reject("queue_full")
                 # Retry-After scales with how much decode work sits
                 # ahead of the caller: ~one hint-second per queued
                 # request per slot, floored at 1.
                 raise QueueFull(
-                    f"pending queue is full ({len(self._queue)}/"
+                    f"pending queue is full ({depth}/"
                     f"{self.max_pending}); retry later",
-                    retry_after=max(1, len(self._queue) // max(self.slots, 1)))
-            self._queue.append(req)
-            obs_metrics.serving_queue_depth().set(len(self._queue))
+                    retry_after=max(1, depth // max(self.slots, 1)))
+            key = self._queue_key(req.klass)
+            q = self._queues[key]
+            cap = (self._class_caps.get(key)
+                   if self.class_admission else None)
+            if cap is not None and len(q) >= cap:
+                self._reject("class_queue_full")
+                raise QueueFull(
+                    f"`{key}` pending queue is full ({len(q)}/{cap}); "
+                    f"retry later",
+                    retry_after=max(1, len(q) // max(self.slots, 1)))
+            req.seq = self._seq
+            self._seq += 1
+            q.append(req)
+            self._publish_queue_depth()
             self._cv.notify()
         if req.trace is not None:
             self._ring.add(req.trace)
@@ -730,7 +831,7 @@ class ContinuousBatchingEngine:
         req.cancelled = True
         with self._cv:
             try:
-                self._queue.remove(req)
+                self._queue_for(req).remove(req)
                 if not req.done.is_set():
                     req.error = "cancelled"
                     self._finish_trace(req)
@@ -788,11 +889,20 @@ class ContinuousBatchingEngine:
         with self._cv:
             pending = [state[0] for state in self._prefilling.values()]
             pending += [state[0] for state in self._lane.values()]
-            for req in list(self._queue) + self._slot_req + pending:
+            for req in self._pending_requests() + self._slot_req + pending:
                 if req is not None and not req.done.is_set():
                     req.error = "engine stopped"
                     self._finish_trace(req)
                     req.done.set()
+        if self._hit_window:
+            # This engine fed the shared prefix-hit-rate gauge; its
+            # rolling window dies with it. Unset rather than leave the
+            # last value parked: instant threshold rules read the live
+            # registry, so a stopped engine's stale low watermark would
+            # hold serving-prefix-hit-collapse in a breach that no
+            # amount of clock fast-forward can ever resolve. A live
+            # engine re-sets the gauge on its next admission.
+            obs_metrics.serving_prefix_hit_rate().unset()
         self._dump_ring()
 
     def _dump_ring(self) -> None:
@@ -857,45 +967,124 @@ class ContinuousBatchingEngine:
             self._drop_lane_reservation(p, f"engine failed: {err}")
         with self._cv:
             self._stopped = True
-            while self._queue:
-                req = self._queue.popleft()
-                if not req.done.is_set():
-                    req.error = f"engine failed: {err}"
-                    self._finish_trace(req)
-                    req.done.set()
+            for q in self._queues.values():
+                while q:
+                    req = q.popleft()
+                    if not req.done.is_set():
+                        req.error = f"engine failed: {err}"
+                        self._finish_trace(req)
+                        req.done.set()
+
+    # --------------------------------------------------- pending queues
+    def _queue_key(self, klass: str) -> str:
+        """Which pending queue a request class lands in. FIFO mode (the
+        A/B baseline) merges everything into one queue — the pre-19
+        scan semantics depend on global arrival order."""
+        if not self.class_admission or klass not in REQUEST_CLASSES:
+            return DEFAULT_REQUEST_CLASS
+        return klass
+
+    def _queue_for(self, req: _Request) -> collections.deque:
+        return self._queues[self._queue_key(req.klass)]
+
+    def _queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _queue_head(self) -> Optional[_Request]:
+        """Oldest pending request across every class queue."""
+        heads = [q[0] for q in self._queues.values() if q]
+        return min(heads, key=lambda r: r.seq) if heads else None
+
+    def _pending_requests(self) -> list[_Request]:
+        return [r for q in self._queues.values() for r in q]
+
+    def _publish_queue_depth(self) -> None:
+        obs_metrics.serving_queue_depth().set(self._queue_depth())
+        if self.class_admission:
+            gauge = obs_metrics.serving_class_pending()
+            for name, q in self._queues.items():
+                gauge.set(len(q), **{"class": name})
 
     def _pick_next_locked(self) -> Optional[_Request]:
         """Choose the next request to admit (caller holds ``_cv``).
-        Dense: strict FIFO. Paged: scan a bounded window of the queue
-        and pick the admissible request whose radix-matched prefix is
-        hottest (most cached tokens) — admitting it FIRST keeps its
-        shared pages referenced and maximizes prefill skipped; strict
-        `>` keeps FIFO order among equal scores. Starvation bound:
-        every request a younger one overtakes ages by one skip, and a
-        request at the skip cap becomes a barrier — the scan stops at
-        it, so nothing younger can pass again (if it fits, its
-        infinite score wins outright). None = nothing in the window
-        fits the pool right now (backpressure)."""
+
+        FIFO mode (``class_admission=False``): the pre-19 policy,
+        unchanged — dense pops strict FIFO; paged scans a bounded
+        window of the one queue and picks the admissible request whose
+        radix-matched prefix is hottest (most cached tokens), strict
+        `>` keeping FIFO among ties, with the skip-cap barrier
+        bounding starvation.
+
+        Class mode: every class queue's window is scanned and the
+        rank tuple ``(class priority, TTFT-deadline urgency, matched-
+        token hotness, age)`` picks the winner. Urgency is a bucket —
+        a request past its class TTFT target outranks a hotter fresh
+        one of the same class; below target, hotness keeps the radix
+        dividend (the PR 11 behavior within a class). The starvation
+        barrier is per class: a request at its class skip cap stops
+        younger SAME-CLASS requests from passing (if it fits, its
+        infinite hotness wins its tier outright); across classes
+        priority stays strict, and the per-class pending cap is the
+        shed-load bound on that starvation. None = nothing admissible
+        right now (backpressure)."""
         if self._pool is None:
-            return self._queue.popleft()
-        best_i, best_score = None, -1.0
-        for i in range(min(len(self._queue), self._admit_window)):
-            req = self._queue[i]
-            barrier = req.admit_skips >= self._admit_skip_cap
-            if self._pool.can_admit(len(req.tokens), req.tokens):
-                score = (float("inf") if barrier else
-                         float(self._pool.peek_matched_tokens(
-                             len(req.tokens), req.tokens)))
-                if score > best_score:
-                    best_i, best_score = i, score
-            if barrier:
-                break
-        if best_i is None:
+            if not self.class_admission:
+                return self._queues[DEFAULT_REQUEST_CLASS].popleft()
+            best = None
+            for name, q in self._queues.items():
+                if not q:
+                    continue
+                key = (resolve_request_class(name).priority, -q[0].seq)
+                if best is None or key > best[0]:
+                    best = (key, q)
+            return best[1].popleft() if best is not None else None
+        if not self.class_admission:
+            q = self._queues[DEFAULT_REQUEST_CLASS]
+            best_i, best_score = None, -1.0
+            for i in range(min(len(q), self._admit_window)):
+                req = q[i]
+                barrier = req.admit_skips >= self._admit_skip_cap
+                if self._pool.can_admit(len(req.tokens), req.tokens):
+                    score = (float("inf") if barrier else
+                             float(self._pool.peek_matched_tokens(
+                                 len(req.tokens), req.tokens)))
+                    if score > best_score:
+                        best_i, best_score = i, score
+                if barrier:
+                    break
+            if best_i is None:
+                return None
+            for i in range(best_i):
+                q[i].admit_skips += 1
+            req = q[best_i]
+            del q[best_i]
+            return req
+        now = time.time()
+        best = None  # ((priority, overdue, hotness, -seq), queue, index)
+        for name, q in self._queues.items():
+            if not q:
+                continue
+            rc = resolve_request_class(name)
+            for i in range(min(len(q), self._admit_window)):
+                req = q[i]
+                barrier = req.admit_skips >= rc.skip_cap
+                if self._pool.can_admit(len(req.tokens), req.tokens):
+                    hot = (float("inf") if barrier else
+                           float(self._pool.peek_matched_tokens(
+                               len(req.tokens), req.tokens)))
+                    overdue = int(now - req.submitted_at > rc.ttft_target)
+                    key = (rc.priority, overdue, hot, -req.seq)
+                    if best is None or key > best[0]:
+                        best = (key, q, i)
+                if barrier:
+                    break
+        if best is None:
             return None
+        _, q, best_i = best
         for i in range(best_i):
-            self._queue[i].admit_skips += 1
-        req = self._queue[best_i]
-        del self._queue[best_i]
+            q[i].admit_skips += 1  # within-class aging only
+        req = q[best_i]
+        del q[best_i]
         return req
 
     def _note_prefix_outcome(self, req: _Request, res,
@@ -921,6 +1110,15 @@ class ContinuousBatchingEngine:
         if res.cow is not None and req.trace is not None:
             req.trace.event("cow_fork", src=int(res.cow[0]),
                             dst=int(res.cow[1]))
+        if req.preemptions:
+            # Re-admission after eviction: the novel suffix is the real
+            # recompute cost of preempting this request — the committed
+            # prefix came back from the radix tree for free.
+            novel = max(prefill_len - skip, 0)
+            if novel:
+                self._readmit_suffix_tokens += novel
+                obs_metrics.serving_readmit_suffix_tokens_total().inc(
+                    novel)
         return skip
 
     def _admit(self) -> None:
@@ -930,7 +1128,7 @@ class ContinuousBatchingEngine:
             # Pick under the lock: cancel() mutates the queue from HTTP
             # threads, and an unsynchronized pop can race it empty.
             with self._cv:
-                if not self._queue:
+                if not self._queue_depth():
                     break
                 req = self._pick_next_locked()
                 if req is None:
@@ -940,12 +1138,12 @@ class ContinuousBatchingEngine:
                     # tick while blocked (the per-span event cap
                     # bounds a long wait): answers "why is my request
                     # stuck in queue_wait" from the timeline alone.
-                    head = self._queue[0]
-                    if head.trace is not None:
+                    head = self._queue_head()
+                    if head is not None and head.trace is not None:
                         head.trace.event("kv_backpressure",
                                          pages_free=self._pool.free_pages)
                     break
-                obs_metrics.serving_queue_depth().set(len(self._queue))
+                self._publish_queue_depth()
             admit_res = None
             if self._pool is not None:
                 admit_res = self._pool.admit(b, len(req.tokens),
@@ -960,7 +1158,7 @@ class ContinuousBatchingEngine:
                     if req.trace is not None:
                         req.trace.event("requeue", reason="kv_pages")
                     with self._cv:
-                        self._queue.appendleft(req)
+                        self._queue_for(req).appendleft(req)
                     break
             # Dequeued for real: close the queue_wait phase and feed
             # the SLO histogram (submit → admission dequeue).
@@ -1096,17 +1294,17 @@ class ContinuousBatchingEngine:
             if p in self._lane:
                 continue
             with self._cv:
-                if not self._queue:
+                if not self._queue_depth():
                     break
                 req = self._pick_next_locked()
                 if req is None:
-                    head = self._queue[0]
-                    if head.trace is not None:
+                    head = self._queue_head()
+                    if head is not None and head.trace is not None:
                         head.trace.event(
                             "kv_backpressure",
                             pages_free=self._pool.free_pages)
                     break
-                obs_metrics.serving_queue_depth().set(len(self._queue))
+                self._publish_queue_depth()
             admit_res = self._pool.admit(p, len(req.tokens), req.tokens)
             if not admit_res:
                 obs_metrics.serving_admissions_total().inc(
@@ -1114,7 +1312,7 @@ class ContinuousBatchingEngine:
                 if req.trace is not None:
                     req.trace.event("requeue", reason="kv_pages")
                 with self._cv:
-                    self._queue.appendleft(req)
+                    self._queue_for(req).appendleft(req)
                 break
             obs_metrics.serving_queue_wait_hist().observe(
                 time.time() - req.submitted_at, **{"class": req.klass})
@@ -1256,10 +1454,11 @@ class ContinuousBatchingEngine:
         owes prefill work — queued, dense chunked reservations, lane
         reservations."""
         with self._cv:
-            backlog = (len(self._queue) + len(self._prefilling)
+            backlog = (self._queue_depth() + len(self._prefilling)
                        + len(self._lane))
-            oldest = (time.time() - self._queue[0].submitted_at
-                      if self._queue else 0.0)
+            head = self._queue_head()
+            oldest = (time.time() - head.submitted_at
+                      if head is not None else 0.0)
         free = sum(1 for b in range(self.slots)
                    if self._slot_req[b] is None
                    and b not in self._prefilling)
@@ -1287,16 +1486,26 @@ class ContinuousBatchingEngine:
             "status": "stopped" if self._stopped else "ok",
             "model": self.model,
             "engine": "continuous",
-            "queued": len(self._queue),
+            "queued": self._queue_depth(),
             "active": sum(1 for r in self._slot_req if r is not None),
             "slots": self.slots,
             "max_pending": self.max_pending,
+            # Per-class admission view (ISSUE 19): the router's
+            # pressure guard reads interactive pending against its cap
+            # — aggregate prefill_pending can look fine while one class
+            # queue is saturated.
+            "class_admission": self.class_admission,
+            "class_pending": {name: len(q)
+                              for name, q in self._queues.items()},
+            "class_caps": dict(self._class_caps),
+            "preemptions": dict(self._preemptions),
             # Per-lane depths (ISSUE 18): the router spills on PREFILL
             # pressure (work not yet decoding — queued plus staged
             # reservations) instead of total queue depth, so a replica
             # that is merely decode-busy no longer looks crowded; the
             # autoscaler reads both sides separately.
-            "prefill_pending": (len(self._queue) + len(self._prefilling)
+            "prefill_pending": (self._queue_depth()
+                                + len(self._prefilling)
                                 + len(self._lane)),
             "decode_active": sum(1 for r in self._slot_req
                                  if r is not None),
@@ -1327,8 +1536,15 @@ class ContinuousBatchingEngine:
             "slots": self.slots,
             "active": sum(1 for r in self._slot_req if r is not None),
             "prefilling": len(self._prefilling),
-            "queued": len(self._queue),
+            "queued": self._queue_depth(),
             "queue_depth_peak": self._queue_depth_peak,
+            # Class-aware admission accounting (ISSUE 19): evictions by
+            # victim class, and the real recompute cost of them — novel
+            # suffix tokens prefilled at re-admission (the committed
+            # radix prefix served the rest).
+            "class_admission": self.class_admission,
+            "preemptions": dict(self._preemptions),
+            "readmit_suffix_tokens": self._readmit_suffix_tokens,
             "decode_steps": self._steps_total,
             # Mean fraction of slots live per decode step: ~1.0 means
             # continuous batching is actually winning; low values with
@@ -1637,14 +1853,124 @@ class ContinuousBatchingEngine:
                 obs_metrics.serving_tpot_hist().observe(
                     (now - req.first_token_at) / (len(req.out) - 1),
                     **{"class": req.klass})
-            obs_metrics.serving_queue_depth().set(len(self._queue))
+            self._publish_queue_depth()
             self._finish_trace(req)
             req.done.set()
+
+    # ------------------------------------------------------- preemption
+    def _maybe_preempt(self) -> None:
+        """Make room for a blocked urgent prefill by evicting one live
+        lower-priority slot (ISSUE 19). Runs at the top of every tick,
+        at most one eviction per tick (each eviction frees a slot AND
+        pages, so re-checking next tick is cheap and avoids cascades).
+
+        Trigger: pending ``preempts``-class demand (interactive)
+        exceeds what free capacity can absorb — more urgent requests
+        queued than free slot/lane entries, or the pool can't admit
+        the oldest one's prompt. Demand-vs-capacity, not
+        zero-capacity: under a storm, retirements free one slot per
+        tick and a zero-capacity trigger would stall eviction there,
+        capping the interactive lane at half width while best-effort
+        camps the rest. Victim: a live decode slot
+        of a ``preemptible`` class with strictly lower priority,
+        preferring the one holding the most KV pages ("most
+        over-budget"), fewest emitted tokens as tiebreak. Eviction
+        releases the slot's pages through the normal retire path — the
+        committed radix prefix stays resident, so the victim's
+        re-admission is a suffix-only prefill (pages, not recompute)."""
+        if (self._pool is None or not self.class_admission
+                or not self.preemption):
+            return
+        with self._cv:
+            cand = None
+            demand = 0
+            for name, q in self._queues.items():
+                if not q or not resolve_request_class(name).preempts:
+                    continue
+                demand += len(q)
+                if cand is None or q[0].seq < cand[0].seq:
+                    cand = (q[0], resolve_request_class(name))
+        if cand is None:
+            return
+        req, rc = cand
+        if self.prefill_slots:
+            free = sum(
+                1 for p in range(self.slots,
+                                 self.slots + self.prefill_slots)
+                if p not in self._lane)
+        else:
+            free = sum(
+                1 for b in range(self.slots)
+                if self._slot_req[b] is None
+                and b not in self._prefilling)
+        fits = self._pool.can_admit(len(req.tokens), req.tokens)
+        if free >= demand and fits:
+            return  # capacity absorbs every urgent pending request
+        victim = self._pick_victim(rc.priority)
+        if victim is None:
+            return  # nothing evictable (never touch peers/superiors)
+        self._evict_slot(victim,
+                         reason="kv_pages" if free else "slots")
+
+    def _pick_victim(self, min_priority: int) -> Optional[int]:
+        """Best decode slot to evict for a blocked class of
+        ``min_priority``: preemptible, strictly lower priority, most
+        pages held first. Lane reservations are never victims — their
+        fresh leaves are uncommitted, so releasing them would need a
+        prefix invalidate and cost full recompute."""
+        best = None  # ((priority asc, pages desc, emitted asc), slot)
+        for b in range(self.slots):
+            victim = self._slot_req[b]
+            if victim is None or b in self._prefilling:
+                continue
+            vrc = resolve_request_class(victim.klass)
+            if not vrc.preemptible or vrc.priority >= min_priority:
+                continue
+            key = (-vrc.priority, self._pool.slot_pages(b),
+                   -len(victim.out))
+            if best is None or key > best[0]:
+                best = (key, b)
+        return best[1] if best is not None else None
+
+    def _evict_slot(self, b: int, reason: str) -> None:
+        """Preemptively evict slot ``b`` and requeue its request at the
+        head of its class queue. Pages release through the same
+        fresh-leaf path _retire uses — the committed prompt prefix
+        stays resident in the radix tree (reclaimable, and a free
+        suffix-only re-admission), while decode-extension pages return
+        to the free list. Emitted tokens are discarded and regenerated
+        deterministically on resume (greedy argmax / seed folded by
+        position), so streaming clients see a consistent prefix; TTFT
+        re-observes at the retry's first token — degraded service is
+        measured, not hidden."""
+        req = self._slot_req[b]
+        rc = resolve_request_class(req.klass)
+        held = self._pool.slot_pages(b)
+        discarded = len(req.out)
+        self._slot_req[b] = None
+        self._pos[b] = -1
+        self._temps[b] = 0.0
+        self._top_ps[b] = 1.0
+        self._top_ks[b] = 0
+        self._pool.release(b)
+        req.preemptions += 1
+        req.out.clear()
+        req.first_token_at = None
+        self._preemptions[rc.name] = self._preemptions.get(rc.name, 0) + 1
+        obs_metrics.serving_preemptions_total().inc(
+            **{"class": rc.name, "reason": reason})
+        if req.trace is not None:
+            req.trace.event("preempted", reason=reason, slot=b,
+                            pages_held=held, tokens_discarded=discarded)
+            req.trace.start_phase("queue_wait", requeued=True)
+        with self._cv:
+            self._queue_for(req).appendleft(req)
+            self._publish_queue_depth()
 
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while (not self._stopped and not self._queue
+                while (not self._stopped and not self._queue_depth()
                        and not self._prefilling and not self._lane
                        and all(r is None for r in self._slot_req)):
                     self._cv.wait()
@@ -1697,6 +2023,7 @@ class ContinuousBatchingEngine:
             req = self._slot_req[b]
             if req is not None and req.cancelled:
                 self._retire(b)
+        self._maybe_preempt()
         if self.prefill_slots:
             self._lane_handoff()  # free lane rows before admission
             self._admit_lane()
@@ -1705,7 +2032,7 @@ class ContinuousBatchingEngine:
         if self._stopped:  # admission may fail-fast mid-pass
             return False
         self._queue_depth_peak = max(self._queue_depth_peak,
-                                     len(self._queue))
+                                     self._queue_depth())
         live = sum(1 for r in self._slot_req if r is not None)
         if self._lane:
             if not self._lane_tick(live):

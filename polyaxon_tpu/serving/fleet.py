@@ -350,7 +350,8 @@ class ServingFleet:
         is summed over replicas (hit rate = skipped/total prefill
         tokens fleet-wide) and ``kv_invariant_violations`` is the SUM
         over every replica's live ``check_invariants()``."""
-        total = skipped = violations = 0
+        total = skipped = violations = readmit = 0
+        preemptions: dict[str, int] = {}
         per_replica = {}
         for rep in self._replicas.values():
             if rep.engine is None:
@@ -365,6 +366,9 @@ class ServingFleet:
             total += s.get("prefill_tokens_total", 0) or 0
             skipped += s.get("prefill_tokens_skipped", 0) or 0
             violations += s.get("kv_invariant_violations", 0) or 0
+            readmit += s.get("readmit_suffix_tokens", 0) or 0
+            for name, n in (s.get("preemptions") or {}).items():
+                preemptions[name] = preemptions.get(name, 0) + n
         return {
             "replicas": per_replica,
             "states": {s: len(self._in_state(s)) for s in REPLICA_STATES},
@@ -373,6 +377,8 @@ class ServingFleet:
             "prefix_hit_rate": (round(skipped / total, 4) if total
                                 else None),
             "kv_invariant_violations": violations,
+            "preemptions": preemptions,
+            "readmit_suffix_tokens": readmit,
             "scale_events": list(self.scale_events),
             "router": self.router.stats(),
         }

@@ -369,6 +369,14 @@ class PagePool:
             matched, cow = self._match_locked(length, tokens, touch=False)
             return len(matched) * self.page_size + (cow[1] if cow else 0)
 
+    def slot_pages(self, slot: int) -> int:
+        """Pages mapped into this slot's row — its live KV footprint.
+        The preemption policy ranks eviction victims by it ("most
+        over-budget first"), and the eviction test uses it to assert
+        the exact page delta a release returns."""
+        with self._lock:
+            return int(np.count_nonzero(self.tables[slot] >= 0))
+
     # -------------------------------------------------------- allocation
     def _alloc_one_locked(self):
         """One page: free list first, then evict the LRU reclaimable

@@ -247,10 +247,22 @@ class FleetRouter:
         Prefill depth, not total queue depth (ISSUE 18): a replica
         whose slots are merely decode-busy admits new work next tick —
         spilling away from it would shred affinity for nothing. Falls
-        back to `queued` for engines predating the per-lane fields."""
+        back to `queued` for engines predating the per-lane fields.
+
+        Per-class saturation also counts (ISSUE 19): a replica whose
+        `interactive` pending has reached its class cap sheds the very
+        requests the fleet most wants served, even when the aggregate
+        prefill_pending looks fine — treat it as pressured so urgent
+        traffic deflects before it 503s. This guard is cap-relative,
+        so it applies whether or not a global spill_depth is set."""
+        view = (telemetry or {}).get(replica) or {}
+        pending = view.get("class_pending") or {}
+        caps = view.get("class_caps") or {}
+        cap = caps.get("interactive")
+        if cap is not None and pending.get("interactive", 0) >= cap:
+            return True
         if self.spill_depth is None:
             return False
-        view = (telemetry or {}).get(replica) or {}
         depth = view.get("prefill_pending")
         if depth is None:
             depth = view.get("queued", 0)

@@ -574,10 +574,11 @@ class _Handler(BaseHTTPRequestHandler):
                                    for t in eos_tokens)):
                     raise ValueError(
                         "`eos_tokens` must be a list of token ids")
-            # Request class labels the per-class SLO histograms
-            # (TTFT/TPOT/queue-wait); one `batch` class until ROADMAP
-            # item 1 lands the per-class admission policy. Bounded so a
-            # client can't mint unbounded label cardinality.
+            # Request class picks the admission queue (`interactive` /
+            # `batch` / `best-effort` — unknown labels fold to `batch`,
+            # no minted priority) and labels the per-class SLO
+            # histograms. Bounded so a client can't mint unbounded
+            # label cardinality.
             klass = req.get("class", "batch")
             if (not isinstance(klass, str) or not klass
                     or len(klass) > 64):
@@ -719,6 +720,9 @@ class ServingServer:
                  prefill_lane_budget: int = 1,
                  decode_lane_budget: int = 1,
                  max_pending: Optional[int] = None,
+                 class_admission: bool = True,
+                 class_max_pending: Optional[dict] = None,
+                 preemption: bool = True,
                  request_tracing: bool = True,
                  trace_dump_path: Optional[str] = None):
         self.mesh = None
@@ -779,6 +783,9 @@ class ServingServer:
                 prefill_lane_budget=prefill_lane_budget,
                 decode_lane_budget=decode_lane_budget,
                 max_pending=max_pending,
+                class_admission=class_admission,
+                class_max_pending=class_max_pending,
+                preemption=preemption,
                 request_tracing=request_tracing,
                 trace_dump_path=trace_dump_path)
         elif batching == "static":
@@ -795,6 +802,10 @@ class ServingServer:
                 raise ValueError(
                     "--max-pending requires --batching continuous (the "
                     "static engine has no pending queue to bound)")
+            if class_max_pending:
+                raise ValueError(
+                    "--class-max-pending requires --batching continuous "
+                    "(the static engine has no pending queue to bound)")
             if kv != "dense":
                 raise ValueError(
                     "kv='paged' requires --batching continuous (the "

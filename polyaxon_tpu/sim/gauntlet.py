@@ -377,6 +377,72 @@ def _storm_lane(history) -> dict:
         eng.stop()
 
 
+def _class_storm_lane(history) -> dict:
+    """The ISSUE 19 lane: best-effort traffic camps every decode slot,
+    then interactive arrivals must admit via preemptive slot/KV
+    eviction — judged by the ``interactive-ttft-during-storm``
+    invariant over the lane's own marked window (interactive p99 ONLY:
+    the all-class invariants average the best-effort wall in and so
+    cannot see priority inversion).
+
+    Same shape discipline as the long-prompt-storm lane: every prompt
+    shape runs once pre-window, so in-window admissions replay warm
+    programs and the TTFT histogram measures *scheduling* — not XLA
+    compiles, which on the CI CPU would dwarf the preemption signal."""
+    from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
+    from polyaxon_tpu.serving.server import load_params
+
+    cfg, params = load_params("llama_tiny", seed=0)
+    eng = ContinuousBatchingEngine(
+        "llama_tiny", cfg, params, slots=2, kv="paged", page_size=4)
+    vocab = cfg.vocab_size
+    # Distinct first tokens per prompt keep every admission a radix
+    # miss: same skip=0 compile shapes throughout.
+    best_effort = [[(31 + 19 * i + 5 * j) % vocab for j in range(6)]
+                   for i in range(4)]
+    interactive = [[(173 + 23 * i + 7 * j) % vocab for j in range(6)]
+                   for i in range(4)]
+    try:
+        eng.generate([interactive.pop()], max_new_tokens=4,
+                     klass="interactive")
+        eng.generate([best_effort.pop()], max_new_tokens=4,
+                     klass="best-effort")
+        # Saturate: long best-effort generations camp every decode slot
+        # (plus one queued spare) BEFORE the window opens, so every
+        # in-window interactive arrival finds the engine full.
+        campers = [eng.submit(r, 48, klass="best-effort")
+                   for r in best_effort]
+        deadline = time.monotonic() + 30.0
+        while (eng.health()["decode_active"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        history.sample(force=True)  # pre-window baseline for the delta
+        history.mark_window("class-preemption-storm", start=True)
+        for row in interactive:
+            eng.generate([row], max_new_tokens=4, klass="interactive")
+        history.sample(force=True)  # catch in-window TTFT before close
+        history.mark_window("class-preemption-storm", end=True)
+        for r in campers:  # evicted campers re-admit and finish
+            r.wait(timeout=120)
+        # Close the books in REAL time: the victims' re-emission TTFTs
+        # (long by design — they span the eviction round trip) land in
+        # this sample, so the post-skew final evaluate's trailing
+        # window diffs two identical carry-forward edges instead of
+        # bracketing only the lane's tail and reading it as a 100%
+        # TTFT-SLO error rate (day-end firings cannot resolve: there
+        # is no evaluate after the last one).
+        history.sample(force=True)
+        stats = eng.stats()
+        return {
+            "requests": stats["requests_served"],
+            "preemptions": sum(stats["preemptions"].values()),
+            "readmit_suffix_tokens": stats["readmit_suffix_tokens"],
+            "kv_invariant_violations": stats["kv_invariant_violations"],
+        }
+    finally:
+        eng.stop()
+
+
 _TRAFFIC_CLASSES = ("interactive", "batch", "interactive", "best-effort")
 
 
@@ -397,10 +463,14 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     judged through the scale event; (5) the long-prompt-storm lane
     (ISSUE 18) — a disaggregated prefill/decode engine absorbing
     concurrent long-batch prefills inside its own marked window, with
-    decode TPOT p99 judged during the storm; (6) alert-clock
-    fast-forward and the oracle's single judgment pass. Pass criteria
-    are ONLY oracle verdicts plus the fleet/storm lanes'
-    hit-rate/handoff/invariant checks.
+    decode TPOT p99 judged during the storm; (6) the
+    class-preemption-storm lane (ISSUE 19) — best-effort traffic
+    saturates the engine and interactive arrivals admit via preemptive
+    eviction, with interactive-only TTFT p99 judged inside the lane's
+    window; (7) alert-clock fast-forward and the oracle's single
+    judgment pass. Pass criteria are ONLY oracle verdicts plus the
+    fleet/storm/class lanes' hit-rate/handoff/preemption/invariant
+    checks.
 
     ``inject="quota-breach"`` is the red-team self-test: admission's
     quota check is bypassed (and quotas tightened), so sampled usage
@@ -580,11 +650,34 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
                 logger.warning("long-prompt-storm lane unavailable; "
                                "cluster day runs without it",
                                exc_info=True)
+        # -- the class-preemption-storm lane (ISSUE 19) ---------------
+        # Best-effort traffic saturates every slot, then interactive
+        # arrivals must admit via preemptive eviction inside the
+        # lane's own marked window; the interactive-only TTFT p99
+        # invariant is the judge. Same degradation posture as above.
+        class_lane_summary = None
+        if serving_lane is not None and inject is None:
+            try:
+                class_lane_summary = _class_storm_lane(history)
+                traffic[0] += class_lane_summary["requests"]
+            # polycheck: ignore[invariant-swallow] -- lane degradation, same posture as the fleet lane: the day still runs and the preemption anchor is simply not required
+            except Exception:  # noqa: BLE001
+                logger.warning("class-preemption-storm lane unavailable; "
+                               "cluster day runs without it",
+                               exc_info=True)
         # Drained: fast-forward the alert clock past every rate/burn
         # window so storm-tripped firings resolve (the mini-gauntlet
-        # posture — the fire→resolve arc is the evidence).
-        clock_skew[0] = 600.0
-        engine.evaluate(plane=sim.plane)
+        # posture — the fire→resolve arc is the evidence). STEPPED,
+        # not a single jump: tick-loop evaluates stop at trace end but
+        # serving activity continues through the lanes, so a burn rule
+        # still breaching at its last real-clock evaluate (the class
+        # lane's preemption round trips land exactly there) only
+        # STARTS its resolve_after clock at the first skewed pass —
+        # resolution needs a later clear evaluate, and each step's
+        # windows are empty (no samples move past the last real one).
+        for skew in (600.0, 700.0, 800.0):
+            clock_skew[0] = skew
+            engine.evaluate(plane=sim.plane)
         bundle = obs_oracle.TelemetryBundle.from_plane(
             sim.plane, engine=engine, baseline=baseline)
         verdicts = obs_oracle.evaluate(invariants, bundle)
@@ -619,6 +712,8 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
         required.append("serving-ttft-during-scaleup")
     if lane_summary is not None:
         required.append("decode-tpot-during-prompt-storm")
+    if class_lane_summary is not None:
+        required.append("interactive-ttft-during-storm")
     if inject != "tier0-loss":
         # Under tier0-loss every restore lands on the store tier, so no
         # tier-0 samples exist in the window and the invariant rightly
@@ -638,13 +733,22 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     lane_held = (lane_summary is None
                  or (lane_summary["handoffs"] > 0
                      and lane_summary["kv_invariant_violations"] == 0))
+    # The class lane's own acceptance (ISSUE 19): interactive arrivals
+    # really forced evictions, and every release went through the
+    # fresh-leaf path cleanly.
+    class_lane_held = (class_lane_summary is None
+                       or (class_lane_summary["preemptions"] > 0
+                           and class_lane_summary[
+                               "kv_invariant_violations"] == 0))
     scaleup_window = obs_history.window_bounds(bundle.history or {},
                                                "scale-up")
     storm_lane_window = obs_history.window_bounds(bundle.history or {},
                                                   "long-prompt-storm")
+    class_lane_window = obs_history.window_bounds(
+        bundle.history or {}, "class-preemption-storm")
     return {
         "passed": (oracle_result["passed"] and anchors_held
-                   and fleet_held and lane_held),
+                   and fleet_held and lane_held and class_lane_held),
         "profile": profile,
         "anchors": {i: by_id.get(i, "missing") for i in required},
         "inject": inject,
@@ -659,6 +763,10 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
         "long_prompt_storm_window": (
             [round(t, 3) for t in storm_lane_window]
             if storm_lane_window else None),
+        "class_preemption_storm": class_lane_summary,
+        "class_preemption_storm_window": (
+            [round(t, 3) for t in class_lane_window]
+            if class_lane_window else None),
         "history_samples": ((bundle.history or {}).get("coverage")
                             or {}).get("samples"),
         "sim": sim_result,

@@ -162,6 +162,26 @@ if JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
     echo "lane self-test FAILED: a starved decode lane passed the gate"
     exit 1
 fi
+# Class-admission A/B (ISSUE 19): a thousand-plus concurrent
+# mixed-class streams land on a slot-camped engine, three arms
+# (interactive-only unloaded, class-aware admission + preemptive
+# eviction, FIFO baseline). --check-classes fails the build unless
+# interactive TTFT p99 stays <= 1.5x its unloaded value WITH
+# best-effort preemptions > 0 (the policy actually fired), page
+# refcount invariants clean on every arm, peak concurrency >= 1000,
+# and the FIFO pair beaten (p99 lower, aggregate tok/s >= 0.90x).
+echo "== class-admission A/B (thousand-stream preemption gate)"
+JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
+    --streams 1100 --check-classes --out /tmp/bench_serve_classes.json
+# The class gate must be able to FAIL: disabling eviction leaves
+# interactive TTFT at the natural-retirement wall with zero
+# preemptions, and the run must exit 1.
+if JAX_PLATFORMS=cpu python scripts/bench_serve.py --model llama_tiny \
+    --streams 120 --check-classes --inject no-preempt \
+    --out /tmp/bench_serve_nopreempt.json >/dev/null 2>&1; then
+    echo "class self-test FAILED: disabled preemption passed the gate"
+    exit 1
+fi
 # Fleet-sim stage (ISSUE 8): drive the REAL scheduler + admission +
 # store through the quick load points (idle → storm, seconds not the
 # full compressed day) and gate tick cost against

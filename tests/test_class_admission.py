@@ -1,0 +1,366 @@
+"""Class-aware serving admission with preemptive slot/KV eviction
+(ISSUE 19): rank-tuple goldens, per-class starvation barriers,
+eviction page accounting against the pool invariants, suffix-only
+re-admission parity, the interactive-never-evicted invariant, and the
+e2e drill (saturate with best-effort, interactive still admits)."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyaxon_tpu.models import llama
+from polyaxon_tpu.serving.batching import (
+    ContinuousBatchingEngine,
+    DEFAULT_REQUEST_CLASS,
+    REQUEST_CLASSES,
+    QueueFull,
+    _Request,
+    resolve_request_class,
+)
+
+
+def _cfg():
+    return dataclasses.replace(llama.CONFIGS["llama_tiny"],
+                               dtype=jnp.float32)
+
+
+def _stopped_engine(**kw):
+    """A paged engine whose loop is stopped so _pick_next_locked and
+    the eviction paths can be driven deterministically by the test."""
+    cfg = _cfg()
+    params = llama.init(cfg, jax.random.key(0))["params"]
+    kw.setdefault("slots", 1)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("kv", "paged")
+    kw.setdefault("page_size", 4)
+    engine = ContinuousBatchingEngine("llama_tiny", cfg, params, **kw)
+    engine.stop()
+    return engine
+
+
+def _req(tokens, klass="batch", seq=0, **kw):
+    r = _Request(list(tokens), 4, 0.0, 0, klass=klass, **kw)
+    r.seq = seq
+    return r
+
+
+class TestClassCatalog:
+    def test_catalog_shape(self):
+        """Priority ordering and preemption roles are the contract the
+        admission scan and the eviction policy both read."""
+        inter = REQUEST_CLASSES["interactive"]
+        batch = REQUEST_CLASSES["batch"]
+        be = REQUEST_CLASSES["best-effort"]
+        assert inter.priority > batch.priority > be.priority
+        assert inter.preempts and not inter.preemptible
+        assert be.preemptible and not be.preempts
+        assert not batch.preempts and not batch.preemptible
+        assert inter.ttft_target < batch.ttft_target < be.ttft_target
+
+    def test_unknown_class_folds_to_batch(self):
+        """A client cannot mint priority with a made-up label."""
+        assert resolve_request_class("vip") is REQUEST_CLASSES["batch"]
+        assert resolve_request_class("interactive").priority == 2
+        assert DEFAULT_REQUEST_CLASS == "batch"
+
+
+class TestRankingGoldens:
+    def test_priority_beats_hotness(self):
+        """An interactive request with zero cached prefix outranks a
+        batch request whose whole chain is hot in the radix tree —
+        class priority is the leading tuple element."""
+        engine = _stopped_engine()
+        pool = engine._pool
+        hot = list(range(12))
+        assert pool.admit(0, 12, hot)
+        pool.release(0)  # hot's chain is resident in the tree
+        r_hot_batch = _req(hot, klass="batch", seq=0)
+        r_cold_inter = _req(range(100, 112), klass="interactive", seq=1)
+        engine._queues["batch"].append(r_hot_batch)
+        engine._queues["interactive"].append(r_cold_inter)
+        with engine._cv:
+            assert engine._pick_next_locked() is r_cold_inter
+        # Overtaking across classes does NOT age the loser: the barrier
+        # is per class, strict priority handles cross-class order.
+        assert r_hot_batch.admit_skips == 0
+
+    def test_overdue_beats_hotness_within_class(self):
+        """Past its class TTFT target a request outranks a hotter
+        on-time peer: deadline urgency is the second tuple element."""
+        engine = _stopped_engine()
+        pool = engine._pool
+        hot = list(range(12))
+        assert pool.admit(0, 12, hot)
+        pool.release(0)
+        overdue = _req(range(100, 112), klass="batch", seq=0)
+        overdue.submitted_at = (
+            time.time() - REQUEST_CLASSES["batch"].ttft_target - 1.0)
+        r_hot = _req(hot, klass="batch", seq=1)
+        engine._queues["batch"].extend([overdue, r_hot])
+        with engine._cv:
+            assert engine._pick_next_locked() is overdue
+
+    def test_hotness_then_age_within_class(self):
+        """On-time same-class requests keep the PR 11 order: hottest
+        matched prefix first, global arrival order among ties."""
+        engine = _stopped_engine()
+        pool = engine._pool
+        hot = list(range(12))
+        assert pool.admit(0, 12, hot)
+        pool.release(0)
+        r_cold = _req(range(100, 112), klass="batch", seq=0)
+        r_hot = _req(hot, klass="batch", seq=1)
+        engine._queues["batch"].extend([r_cold, r_hot])
+        with engine._cv:
+            assert engine._pick_next_locked() is r_hot
+        assert r_cold.admit_skips == 1  # within-class aging
+        engine._queues["batch"].clear()
+        a = _req(range(100, 112), klass="batch", seq=5)
+        b = _req(range(200, 212), klass="batch", seq=6)
+        engine._queues["batch"].extend([a, b])
+        with engine._cv:
+            assert engine._pick_next_locked() is a  # FIFO tie-break
+
+    def test_fifo_mode_merges_classes(self):
+        """--no-class-admission: one queue, pre-19 scan semantics —
+        arrival order wins regardless of class label."""
+        engine = _stopped_engine(class_admission=False)
+        assert list(engine._queues) == [DEFAULT_REQUEST_CLASS]
+        r_be = _req(range(100, 106), klass="best-effort", seq=0)
+        r_inter = _req(range(200, 206), klass="interactive", seq=1)
+        engine._queues[DEFAULT_REQUEST_CLASS].extend([r_be, r_inter])
+        with engine._cv:
+            assert engine._pick_next_locked() is r_be
+
+
+class TestPerClassStarvationBarrier:
+    def test_barrier_blocks_own_class_only(self):
+        """A best-effort request at its skip cap stops younger
+        best-effort work from passing (its infinite hotness wins its
+        tier), but interactive still admits first — the barrier is
+        per class, priority stays strict across classes."""
+        engine = _stopped_engine()
+        pool = engine._pool
+        hot = list(range(12))
+        assert pool.admit(0, 12, hot)
+        pool.release(0)
+        starved = _req(range(100, 112), klass="best-effort", seq=0)
+        starved.admit_skips = REQUEST_CLASSES["best-effort"].skip_cap
+        r_hot_be = _req(hot, klass="best-effort", seq=1)
+        r_inter = _req(range(200, 212), klass="interactive", seq=2)
+        engine._queues["best-effort"].extend([starved, r_hot_be])
+        engine._queues["interactive"].append(r_inter)
+        with engine._cv:
+            assert engine._pick_next_locked() is r_inter
+        with engine._cv:
+            assert engine._pick_next_locked() is starved
+        with engine._cv:
+            assert engine._pick_next_locked() is r_hot_be
+
+    def test_per_class_pending_caps(self):
+        """submit() sheds per class: a saturated best-effort queue
+        503s while interactive keeps queueing."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        engine = ContinuousBatchingEngine(
+            "llama_tiny", cfg, params, slots=1, max_len=32,
+            kv="paged", page_size=4,
+            class_max_pending={"best-effort": 1})
+        engine.stop()
+        with engine._cv:
+            engine._stopped = False  # accept submits; loop stays dead
+        try:
+            engine._queues["best-effort"].append(
+                _req(range(6), klass="best-effort"))
+            with pytest.raises(QueueFull) as exc:
+                engine.submit(list(range(10, 16)), 2, klass="best-effort")
+            assert "best-effort" in str(exc.value)
+            assert engine.stats()["rejected"] == {"class_queue_full": 1}
+            engine.submit(list(range(20, 26)), 2, klass="interactive")
+            assert len(engine._queues["interactive"]) == 1
+        finally:
+            with engine._cv:
+                engine._stopped = True
+        assert engine.health()["class_caps"] == {"best-effort": 1}
+
+    def test_class_cap_validation(self):
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        with pytest.raises(ValueError, match="class_max_pending"):
+            ContinuousBatchingEngine(
+                "llama_tiny", cfg, params, slots=1, max_len=32,
+                class_max_pending={"interactive": 0})
+
+
+class TestPreemptiveEviction:
+    def test_evict_releases_exact_pages_and_invariants_hold(self):
+        """Evicting a live slot returns exactly the pages it held
+        beyond its committed prompt prefix to the free list, parks the
+        prefix as reclaimable tree pages, and keeps the pool's
+        refcount/CoW invariants clean."""
+        engine = _stopped_engine(slots=2)
+        pool = engine._pool
+        prompt = list(range(8))  # 2 full pages committed at admission
+        req = _req(prompt, klass="best-effort")
+        assert pool.admit(0, len(prompt), prompt)
+        pool.commit_prefix(0)
+        engine._slot_req[0] = req
+        engine._pos[0] = len(prompt) - 1
+        free_before = len(pool._free)
+        held = pool.slot_pages(0)
+        assert held == 2
+        engine._evict_slot(0, reason="slots")
+        assert engine._slot_req[0] is None
+        assert pool.slot_pages(0) == 0
+        # Exact page split: the tail page is private (it holds the
+        # decode write position, never tree-matchable) and returns to
+        # the free list; the full committed-prefix page stays
+        # TREE-owned — resident and reclaimable, ready to serve the
+        # re-admission. Every page the slot held is allocatable again.
+        assert len(pool._free) == free_before + 1
+        assert pool.radix_stats()["resident"] == 1
+        assert pool.free_pages == free_before + held
+        assert pool.check_invariants() == []
+        # The victim went back to the HEAD of its class queue.
+        assert engine._queues["best-effort"][0] is req
+        assert req.preemptions == 1 and req.out == []
+        assert req.first_token_at is None  # TTFT re-observes on retry
+        stats = engine.stats()
+        assert stats["preemptions"] == {"best-effort": 1}
+
+    def test_interactive_never_evicted(self):
+        """No victim exists when every live slot is interactive or
+        batch — neither class is preemptible, whatever the pressure."""
+        engine = _stopped_engine(slots=2)
+        engine._slot_req[0] = _req(range(6), klass="interactive")
+        engine._slot_req[1] = _req(range(10, 16), klass="batch")
+        assert engine._pick_victim(
+            REQUEST_CLASSES["interactive"].priority) is None
+
+    def test_victim_ranking_prefers_most_pages(self):
+        """Among preemptible victims the policy evicts the slot
+        holding the most KV pages — the most over-budget one."""
+        engine = _stopped_engine(slots=2)
+        pool = engine._pool
+        small, big = list(range(4)), list(range(50, 62))
+        assert pool.admit(0, len(small), small)
+        assert pool.admit(1, len(big), big)
+        engine._slot_req[0] = _req(small, klass="best-effort")
+        engine._slot_req[1] = _req(big, klass="best-effort")
+        assert pool.slot_pages(1) > pool.slot_pages(0)
+        assert engine._pick_victim(
+            REQUEST_CLASSES["interactive"].priority) == 1
+
+    def test_no_preemption_flag_disables_eviction(self):
+        engine = _stopped_engine(slots=1, preemption=False)
+        pool = engine._pool
+        prompt = list(range(6))
+        assert pool.admit(0, len(prompt), prompt)
+        engine._slot_req[0] = _req(prompt, klass="best-effort")
+        engine._queues["interactive"].append(
+            _req(range(100, 106), klass="interactive"))
+        engine._maybe_preempt()
+        assert engine._slot_req[0] is not None
+        assert engine.stats()["preemptions"] == {}
+
+
+class TestEndToEnd:
+    def test_suffix_only_readmission_parity_vs_dense(self):
+        """An evicted best-effort request re-admits with its committed
+        prefix served by the radix tree (suffix-only prefill) and
+        still produces the dense engine's exact tokens."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        dense = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                         slots=1, max_len=64)
+        try:
+            want = dense.generate([prompt], max_new_tokens=24,
+                                  timeout=300)[0]
+        finally:
+            dense.stop()
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=1, max_len=64,
+                                          kv="paged", page_size=4)
+        try:
+            be = engine.submit(prompt, 24, klass="best-effort")
+            while not be.out:  # live and decoding before the rival
+                time.sleep(0.005)
+            ia = engine.submit([7, 7, 7], 2, klass="interactive")
+            ia.wait(timeout=300)
+            got = be.wait(timeout=300)
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        assert be.preemptions >= 1
+        assert got == want  # deterministic regeneration after eviction
+        # The committed prefix came back from the tree: the suffix the
+        # re-admission actually prefilled is shorter than the prompt.
+        assert 0 < stats["readmit_suffix_tokens"] < len(prompt)
+        assert stats["kv_invariant_violations"] == 0
+
+    def test_interactive_admits_through_saturation(self):
+        """The e2e drill: best-effort camps every slot, interactive
+        arrivals admit within their TTFT target anyway, with at least
+        one preemption observed and the pool invariants clean."""
+        cfg = _cfg()
+        params = llama.init(cfg, jax.random.key(0))["params"]
+        engine = ContinuousBatchingEngine("llama_tiny", cfg, params,
+                                          slots=2, max_len=64,
+                                          kv="paged", page_size=4)
+        try:
+            # Warm both prompt shapes so in-flight compiles don't land
+            # in the timed window (CPU-CI discipline).
+            engine.generate([[9, 9, 9, 9, 9, 9]], max_new_tokens=2,
+                            klass="interactive")
+            campers = [engine.submit([31 + 17 * i + j for j in range(6)],
+                                     48, klass="best-effort")
+                       for i in range(3)]
+            deadline = time.monotonic() + 30.0
+            while (engine.health()["decode_active"] < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            t0 = time.time()
+            ia = engine.submit([101, 102, 103, 104, 105, 106], 2,
+                               klass="interactive")
+            ia.wait(timeout=300)
+            ttft = ia.first_token_at - t0
+            for r in campers:
+                r.wait(timeout=300)
+            stats = engine.stats()
+            health = engine.health()
+        finally:
+            engine.stop()
+        # Generous multiple of the 0.5s target: CI boxes are slow, but
+        # without preemption the wait would be a full 64-token decode.
+        assert ttft < REQUEST_CLASSES["interactive"].ttft_target * 4
+        assert sum(stats["preemptions"].values()) >= 1
+        assert stats["kv_invariant_violations"] == 0
+        assert health["class_pending"] == {"interactive": 0, "batch": 0,
+                                           "best-effort": 0}
+
+
+class TestRouterPressureGuard:
+    def test_interactive_cap_saturation_counts_as_pressured(self):
+        """A replica whose interactive pending is at its class cap is
+        pressured even when aggregate prefill_pending looks fine — and
+        even when no global spill_depth is configured (ISSUE 19)."""
+        from polyaxon_tpu.serving.router import FleetRouter
+
+        router = FleetRouter(["r0", "r1"], spill_depth=None)
+        telemetry = {
+            "r0": {"prefill_pending": 0,
+                   "class_pending": {"interactive": 4},
+                   "class_caps": {"interactive": 4}},
+            "r1": {"prefill_pending": 0,
+                   "class_pending": {"interactive": 1},
+                   "class_caps": {"interactive": 4}},
+        }
+        assert router._pressured("r0", telemetry)
+        assert not router._pressured("r1", telemetry)
+        # Engines predating the per-class fields keep the old behavior.
+        assert not router._pressured("r0", {"r0": {"prefill_pending": 0}})

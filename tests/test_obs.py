@@ -643,6 +643,36 @@ class TestRuleLifecycle:
         assert [e["event"] for e in engine.history] == ["fired",
                                                         "resolved"]
 
+    def test_serving_preemption_storm_fires_and_resolves(self):
+        """The committed serving-preemption-storm rule (ISSUE 19): a
+        burst of preemptive slot/KV evictions pushes the rate above
+        0.2/s summed across (class, reason) series, the rule fires,
+        and resolves once the 2m window slides past the burst."""
+        (committed,) = [r for r in obs_rules.load_ruleset()
+                        if r.id == "serving-preemption-storm"]
+        assert committed.metric == "polyaxon_serving_preemptions_total"
+        assert committed.kind == "rate"
+        registry = obs_metrics.MetricsRegistry()
+        counter = obs_metrics.serving_preemptions_total(registry)
+        clock = _FakeClock()
+        engine = obs_rules.AlertEngine([committed], registry=registry,
+                                       clock=clock)
+        counter.inc(0, **{"class": "best-effort",
+                          "reason": "slots"})  # series exists
+        engine.evaluate()  # baseline sample at value 0
+        clock.now += 10
+        counter.inc(4, **{"class": "best-effort", "reason": "slots"})
+        counter.inc(2, **{"class": "best-effort", "reason": "kv_pages"})
+        # 6 evictions / 10s = 0.6/s > 0.2/s summed across series.
+        (fired,) = engine.evaluate()
+        assert fired["event"] == "fired"
+        assert fired["rule"] == "serving-preemption-storm"
+        assert fired["value"] == pytest.approx(0.6)
+        clock.now += 240  # slides the 120s window past the burst
+        engine.evaluate()
+        assert [e["event"] for e in engine.history] == ["fired",
+                                                        "resolved"]
+
     def test_threshold_against_derived_value_step_regression(self):
         """value_from: p99 > 3x p50 — the relative rule the default
         step-time-regression alert uses."""

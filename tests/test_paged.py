@@ -616,16 +616,16 @@ class TestRadixPrefixSharing:
         cold = list(range(100, 112))
         r_cold = _Request(list(cold), 4, 0.0, 0)
         r_hot = _Request(list(hot), 4, 0.0, 0)
-        engine._queue.extend([r_cold, r_hot])
+        engine._queues["batch"].extend([r_cold, r_hot])
         with engine._cv:
             assert engine._pick_next_locked() is r_hot
         assert r_cold.admit_skips == 1  # the overtaken request aged
-        engine._queue.clear()
+        engine._queues["batch"].clear()
         # Barrier: a starved request terminates the scan and wins.
         r_starved = _Request(list(cold), 4, 0.0, 0)
         r_starved.admit_skips = engine._admit_skip_cap
         r_hot2 = _Request(list(hot), 4, 0.0, 0)
-        engine._queue.extend([r_starved, r_hot2])
+        engine._queues["batch"].extend([r_starved, r_hot2])
         with engine._cv:
             assert engine._pick_next_locked() is r_starved
 
