@@ -379,6 +379,58 @@ def ops_request_timeline(url, request_id, as_json):
     _render_timeline(payload)
 
 
+@ops.command("fleet")
+@click.option("--url", default="http://127.0.0.1:8080",
+              help="serving server base URL")
+@click.option("--json", "as_json", is_flag=True,
+              help="raw payload instead of the rendered breakdown")
+def ops_fleet(url, as_json):
+    """Fleet telemetry breakdown (ISSUE 20): per-replica TTFT
+    p50/p99, preemption totals, and the cross-replica skew ratio read
+    from the component-scoped metric series of a live fleet server's
+    ``/v1/fleet``, plus replica states and routing decisions."""
+    import urllib.error
+    import urllib.request
+
+    target = url.rstrip("/") + "/v1/fleet"
+    try:
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        detail = exc.read().decode(errors="replace")
+        try:
+            detail = json.loads(detail).get("error", detail)
+        except (json.JSONDecodeError, AttributeError):
+            pass
+        raise click.ClickException(f"HTTP {exc.code} from {target}: {detail}")
+    except (urllib.error.URLError, OSError) as exc:
+        raise click.ClickException(f"cannot reach {target}: {exc}")
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+        return
+    stats = payload.get("stats") or {}
+    states = stats.get("states") or {}
+    skew = payload.get("ttft_skew")
+    click.echo("fleet: "
+               + " ".join(f"{s}={n}" for s, n in states.items() if n)
+               + (f"  ttft_skew={skew:.2f}" if skew is not None else "")
+               + f"  hit_rate={stats.get('prefix_hit_rate')}")
+    router = stats.get("router") or {}
+    if router.get("routed"):
+        click.echo("routed: " + " ".join(
+            f"{k}={v}" for k, v in sorted(router["routed"].items())))
+    per_replica = payload.get("per_replica") or {}
+    replicas = stats.get("replicas") or {}
+    for rid in sorted(set(per_replica) | set(replicas)):
+        t = per_replica.get(rid) or {}
+        r = replicas.get(rid) or {}
+        click.echo(f"{rid:<6} {r.get('state') or '-':<9} "
+                   f"served={r.get('served', 0):<5} "
+                   f"ttft_p50={t.get('ttft_p50_ms')}ms "
+                   f"p99={t.get('ttft_p99_ms')}ms "
+                   f"preemptions={t.get('preemptions', 0)}")
+
+
 @ops.command("report")
 @click.option("-uid", "--uid", required=True)
 @click.option("--json", "as_json", is_flag=True,

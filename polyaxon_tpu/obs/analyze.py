@@ -240,13 +240,18 @@ def request_phases(timeline: dict[str, Any]) -> dict[str, Any]:
     spans = list(walk_spans(timeline.get("spans") or []))
     root = next((s for s in spans if (s.get("name") or "") == "request"),
                 None)
+    # Behind a fleet (ISSUE 20) the tree's top hop is the router's
+    # `route` span; it is an upstream decision, not an engine phase,
+    # so it reports as its own field instead of joining phases_ms.
+    route = next((s for s in spans if (s.get("name") or "") == "route"),
+                 None)
     phases_ms: dict[str, float] = {}
     events: dict[str, int] = {}
     ttft_ms = None
     t0 = root.get("start") if root is not None else None
     for span in spans:
         name = span.get("name") or ""
-        if name != "request":
+        if name not in ("request", "route"):
             phases_ms[name] = (phases_ms.get(name, 0.0)
                                + float(span.get("duration_ms") or 0.0))
         for event in span.get("events") or []:
@@ -269,6 +274,13 @@ def request_phases(timeline: dict[str, Any]) -> dict[str, Any]:
         # cache instead of recomputed for THIS request.
         "prefix_cached_tokens": attrs.get("prefix_cached_tokens"),
         "events": events,
+        **({"route": {
+            "decision": (route.get("attributes") or {}).get("decision"),
+            "replica": (route.get("attributes") or {}).get("replica"),
+        }} if route is not None else {}),
+        **({"replica": root.get("component")}
+           if root is not None and root.get("component")
+           and root.get("component") != "serving" else {}),
         **({"error": root.get("error")}
            if root is not None and root.get("error") else {}),
     }

@@ -148,6 +148,14 @@ class MetricsHistory:
                 base = ((last.get(name) or {}).get("series")
                         if last is not None else None)
                 fam_meta = self._families.get(name)
+                if fam_meta is not None:
+                    labels = list(family.get("labels") or [])
+                    if fam_meta.get("labels") != labels:
+                        # A family grows the hidden component dimension
+                        # the moment something scoped records into it —
+                        # keep the cached label list current so reads
+                        # parse scoped keys correctly.
+                        fam_meta["labels"] = labels
                 for key, sample in family["series"].items():
                     if base is not None and key in base and (
                             obs_metrics.series_delta(
@@ -340,8 +348,10 @@ class MetricsHistory:
     def counter_total_at(self, metric: str, labels: Optional[dict],
                          t: float) -> Optional[float]:
         """The rules-engine counter read, reconstructed at time ``t``:
-        labeled → that series' carry-forward value; unlabeled → the sum
-        across series (histogram series contribute their count). A
+        labeled → carry-forward values summed across every series the
+        labels subset-match (a fleet's per-component series federate
+        into one total); unlabeled → the sum across all series
+        (histogram series contribute their count). A
         series with no point at-or-before ``t`` did not exist yet and
         contributes 0 (counters are born at zero). ``None`` when the
         metric has no series at all by ``t``."""
@@ -349,21 +359,16 @@ class MetricsHistory:
             meta = self._families.get(metric)
             if meta is None:
                 return None
-            if labels:
-                key = ",".join(str(labels.get(k, ""))
-                               for k in meta["labels"])
-                ring = self._series.get((metric, key))
-                if ring is None:
-                    return None
-                sample = self._value_at(ring.merged(), t)
-                if sample is None:
-                    return None
-                return (float(sample["count"])
-                        if isinstance(sample, dict) else float(sample))
             total = 0.0
             seen = False
-            for (m, _k), ring in self._series.items():
+            for (m, key), ring in self._series.items():
                 if m != metric:
+                    continue
+                # Subset match: unnamed dimensions — the hidden
+                # component above all — wildcard, so a labeled read
+                # sums every replica's series (the federated total).
+                if labels and not obs_metrics.match_series(
+                        meta["labels"], key, labels):
                     continue
                 sample = self._value_at(ring.merged(), t)
                 if sample is None:
@@ -404,15 +409,12 @@ class MetricsHistory:
             meta = self._families.get(metric)
             if meta is None:
                 return None
-            if labels:
-                key = ",".join(str(labels.get(k, ""))
-                               for k in meta["labels"])
-                ring = self._series.get((metric, key))
-                pts = ring.merged() if ring is not None else []
-                return pts[0][0] if pts else None
             first = None
-            for (m, _k), ring in self._series.items():
+            for (m, key), ring in self._series.items():
                 if m != metric:
+                    continue
+                if labels and not obs_metrics.match_series(
+                        meta["labels"], key, labels):
                     continue
                 pts = ring.merged()
                 if pts and (first is None or pts[0][0] < first):
@@ -578,16 +580,20 @@ def trailing_bounds(hist: dict, span: float) -> Optional[tuple[float, float]]:
 
 def select_series_points(hist: dict, metric: str,
                          labels: Optional[dict]) -> Optional[dict]:
-    """{key: points} for the invariant's selection: a labels dict picks
-    one series; no labels means every series of the family."""
+    """{key: points} for the invariant's selection: a labels dict
+    subset-matches (dimensions it does not name — the fleet's hidden
+    component dimension above all — are wildcards, so one selector
+    gathers every replica's series); no labels means every series of
+    the family."""
     family = (hist.get("series") or {}).get(metric)
     if not family:
         return None
     if labels:
-        key = ",".join(str(labels.get(k, ""))
-                       for k in (family.get("labels") or []))
-        pts = (family.get("series") or {}).get(key)
-        return {key: pts} if pts else None
+        labelnames = family.get("labels") or []
+        out = {key: pts
+               for key, pts in (family.get("series") or {}).items()
+               if pts and obs_metrics.match_series(labelnames, key, labels)}
+        return out or None
     return dict(family.get("series") or {})
 
 

@@ -19,6 +19,15 @@ accessor functions at the bottom — is the single source of truth for
 names, label sets, and bucket layouts (docs/observability.md mirrors
 it), and :func:`ensure_core_metrics` pre-registers the families so a
 fresh scrape exposes a stable schema before any sample lands.
+
+Fleet scoping (ISSUE 20): :meth:`MetricsRegistry.scoped` returns a
+view that stamps a ``component`` identity (a replica id, "router",
+"fleet", a sim agent) on every series recorded through it — the same
+instrument, an extra hidden dimension, so the alert engine and the
+oracle keep judging ONE family while ``federate()`` / the component
+helpers give the per-replica breakdown. Unscoped recording is
+byte-identical to before: the component dimension only appears on a
+family once something scoped lands in it.
 """
 
 from __future__ import annotations
@@ -97,23 +106,35 @@ class _Metric:
     def _zero(self):
         return 0.0
 
-    def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
+    def _key(self, labels: dict[str, Any],
+             component: str = "") -> tuple[str, ...]:
         if set(labels) != set(self.labelnames):
             raise ValueError(
                 f"metric {self.name} takes labels {self.labelnames}, "
                 f"got {tuple(labels)}")
-        return tuple(str(labels[k]) for k in self.labelnames)
+        key = tuple(str(labels[k]) for k in self.labelnames)
+        # The component identity rides as a hidden trailing element so
+        # unscoped series keep their historical keys untouched.
+        return key + (str(component),) if component else key
+
+    def _split_key(self, key: tuple[str, ...]
+                   ) -> tuple[tuple[str, ...], str]:
+        """(base label values, component) — component is "" for a
+        series recorded outside any scoped view."""
+        n = len(self.labelnames)
+        return (key[:n], key[n]) if len(key) > n else (key, "")
 
     def _admit(self, key: tuple[str, ...]) -> tuple[tuple[str, ...], bool]:
         """Cardinality cap, checked under ``self._lock``: an existing
         series always passes; a NEW series past ``max_series`` folds
         into the ``other`` row (created on first overflow — it does not
-        count against the cap, so the fold always lands)."""
-        if not self.labelnames or key in self._series:
+        count against the cap, so the fold always lands). The fold
+        keeps the component suffix, so per-replica accounting survives
+        an overflowing base label."""
+        if key in self._series or len(self._series) < self.max_series:
             return key, False
-        if len(self._series) < self.max_series:
-            return key, False
-        return (OVERFLOW_LABEL,) * len(self.labelnames), True
+        base = (OVERFLOW_LABEL,) * len(self.labelnames)
+        return base + key[len(self.labelnames):], True
 
     def _dropped(self) -> None:
         if self._on_drop is not None:
@@ -131,6 +152,35 @@ class _Metric:
             if not self.labelnames:
                 self._series[()] = self._zero()
 
+    def remove(self, **labels: Any) -> None:
+        """Drop one series so readers see *no value* rather than a
+        stale one (:meth:`Gauge.unset` generalized to every type, ISSUE
+        20): a released replica's counters and histograms must vanish
+        with it, or a dead component's last totals pin rules and skew
+        rollups forever."""
+        self._remove(labels, "")
+
+    def _remove(self, labels: dict[str, Any], component: str) -> None:
+        with self._lock:
+            self._series.pop(self._key(labels, component), None)
+
+    def components(self) -> set[str]:
+        """Every component identity with at least one live series (""
+        = unscoped). The federated-view gate and the skew rollup read
+        this."""
+        with self._lock:
+            return {self._split_key(k)[1] for k in self._series}
+
+    def _drop_component(self, component: str) -> int:
+        if not component:
+            return 0
+        with self._lock:
+            doomed = [k for k in self._series
+                      if self._split_key(k)[1] == component]
+            for k in doomed:
+                del self._series[k]
+        return len(doomed)
+
     # -- exposition --------------------------------------------------------
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",
@@ -140,15 +190,30 @@ class _Metric:
                 lines.extend(self._render_series(values, sample))
         return lines
 
+    def _component_extra(self, component: str) -> str:
+        return (f'component="{_escape_label(component)}"'
+                if component else "")
+
     def _render_series(self, values, sample) -> list[str]:
-        return [f"{self.name}{_label_str(self.labelnames, values)} "
-                f"{_fmt_value(sample)}"]
+        base, comp = self._split_key(values)
+        return [f"{self.name}"
+                f"{_label_str(self.labelnames, base, extra=self._component_extra(comp))}"
+                f" {_fmt_value(sample)}"]
 
     def snapshot(self) -> dict:
         with self._lock:
+            # The component dimension appears in the declared label
+            # list only once a scoped series exists — an all-unscoped
+            # family snapshots exactly as it always has (keys
+            # included), so nothing downstream moves until a fleet
+            # actually records.
+            scoped = any(len(k) > len(self.labelnames)
+                         for k in self._series)
+            labels = list(self.labelnames) + (
+                ["component"] if scoped else [])
             return {
                 "type": self.type,
-                "labels": list(self.labelnames),
+                "labels": labels,
                 "series": {",".join(k) if k else "": self._snap_sample(v)
                            for k, v in self._series.items()},
             }
@@ -161,9 +226,13 @@ class Counter(_Metric):
     type = "counter"
 
     def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        self._inc(amount, labels, "")
+
+    def _inc(self, amount: float, labels: dict[str, Any],
+             component: str) -> None:
         if amount < 0:
             raise ValueError("counters only go up")
-        key = self._key(labels)
+        key = self._key(labels, component)
         with self._lock:
             key, dropped = self._admit(key)
             self._series[key] = self._series.get(key, 0.0) + amount
@@ -171,15 +240,33 @@ class Counter(_Metric):
             self._dropped()
 
     def value(self, **labels: Any) -> float:
+        return self._value(labels, "")
+
+    def _value(self, labels: dict[str, Any], component: str) -> float:
         with self._lock:
-            return float(self._series.get(self._key(labels), 0.0))
+            return float(
+                self._series.get(self._key(labels, component), 0.0))
+
+    def total_by_component(self) -> dict[str, float]:
+        """Sum across base label sets per component — the per-replica
+        breakdown read (bench --fleet, /v1/fleet)."""
+        totals: dict[str, float] = {}
+        with self._lock:
+            for key, v in self._series.items():
+                comp = self._split_key(key)[1]
+                totals[comp] = totals.get(comp, 0.0) + float(v)
+        return totals
 
 
 class Gauge(_Metric):
     type = "gauge"
 
     def set(self, value: float, **labels: Any) -> None:
-        key = self._key(labels)
+        self._set(value, labels, "")
+
+    def _set(self, value: float, labels: dict[str, Any],
+             component: str) -> None:
+        key = self._key(labels, component)
         with self._lock:
             key, dropped = self._admit(key)
             self._series[key] = float(value)
@@ -187,7 +274,11 @@ class Gauge(_Metric):
             self._dropped()
 
     def inc(self, amount: float = 1.0, **labels: Any) -> None:
-        key = self._key(labels)
+        self._inc(amount, labels, "")
+
+    def _inc(self, amount: float, labels: dict[str, Any],
+             component: str) -> None:
+        key = self._key(labels, component)
         with self._lock:
             key, dropped = self._admit(key)
             self._series[key] = self._series.get(key, 0.0) + amount
@@ -203,12 +294,15 @@ class Gauge(_Metric):
         process (a stopped engine's rolling window describes nothing;
         alert rules treat a missing series as not-breaching, which a
         parked last value would not be)."""
-        with self._lock:
-            self._series.pop(self._key(labels), None)
+        self._remove(labels, "")
 
     def value(self, **labels: Any) -> float:
+        return self._value(labels, "")
+
+    def _value(self, labels: dict[str, Any], component: str) -> float:
         with self._lock:
-            return float(self._series.get(self._key(labels), 0.0))
+            return float(
+                self._series.get(self._key(labels, component), 0.0))
 
 
 class _HistSample:
@@ -235,7 +329,11 @@ class Histogram(_Metric):
         return _HistSample(len(self.buckets) + 1)  # + the +Inf bucket
 
     def observe(self, value: float, **labels: Any) -> None:
-        key = self._key(labels)
+        self._observe(value, labels, "")
+
+    def _observe(self, value: float, labels: dict[str, Any],
+                 component: str) -> None:
+        key = self._key(labels, component)
         value = float(value)
         with self._lock:
             key, dropped = self._admit(key)
@@ -263,15 +361,23 @@ class Histogram(_Metric):
         the series has no observations (or does not exist). Shared by
         the alert-rule engine (obs.rules), the trace analyzer
         (obs.analyze), and bench reporting."""
+        return self._quantile(q, labels, "")
+
+    def _quantile(self, q: float, labels: dict[str, Any],
+                  component: str) -> Optional[float]:
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile must be in [0, 1], got {q}")
-        key = self._key(labels)
+        key = self._key(labels, component)
         with self._lock:
             sample = self._series.get(key)
             if sample is None or sample.count == 0:
                 return None
             counts = list(sample.counts)
             total = sample.count
+        return self._quantile_from(counts, total, q)
+
+    def _quantile_from(self, counts: list, total: int,
+                       q: float) -> float:
         rank = q * total
         cumulative = 0
         for i, n in enumerate(counts):
@@ -287,25 +393,73 @@ class Histogram(_Metric):
 
     def quantile_max(self, q: float) -> Optional[float]:
         """Worst-series quantile: max of :meth:`quantile` across every
-        label set (the rules engine's view of a labeled histogram when
-        a rule names no labels). ``None`` when nothing has samples."""
+        series — base label sets AND components (the rules engine's
+        view of a labeled histogram when a rule names no labels).
+        ``None`` when nothing has samples."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
         with self._lock:
-            keys = list(self._series)
-        values = [self.quantile(q, **dict(zip(self.labelnames, key)))
-                  for key in keys]
-        values = [v for v in values if v is not None]
+            data = [(list(s.counts), s.count)
+                    for s in self._series.values() if s.count]
+        values = [self._quantile_from(c, t, q) for c, t in data]
         return max(values) if values else None
+
+    def quantile_merged(self, q: float, **labels: Any) -> Optional[float]:
+        """Quantile over the union of every component's series for one
+        base label set (labels optional: empty = the whole family) —
+        the FEDERATED read: one fleet-wide distribution out of
+        per-replica series."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        if labels:
+            base = self._key(labels)
+        counts = [0] * (len(self.buckets) + 1)
+        total = 0
+        with self._lock:
+            for key, sample in self._series.items():
+                if labels and self._split_key(key)[0] != base:
+                    continue
+                for i, n in enumerate(sample.counts):
+                    counts[i] += n
+                total += sample.count
+        if total == 0:
+            return None
+        return self._quantile_from(counts, total, q)
+
+    def quantile_by_component(self, q: float) -> dict[str, float]:
+        """{component: quantile} with each component's series merged
+        across base label sets — the per-replica skew/breakdown read.
+        Components with no observations are omitted."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        merged: dict[str, tuple[list, int]] = {}
+        with self._lock:
+            for key, sample in self._series.items():
+                if sample.count == 0:
+                    continue
+                comp = self._split_key(key)[1]
+                counts, total = merged.get(
+                    comp, ([0] * (len(self.buckets) + 1), 0))
+                for i, n in enumerate(sample.counts):
+                    counts[i] += n
+                merged[comp] = (counts, total + sample.count)
+        return {comp: self._quantile_from(c, t, q)
+                for comp, (c, t) in merged.items()}
 
     def _render_series(self, values, sample: _HistSample) -> list[str]:
         lines = []
         cumulative = 0
+        base_values, comp = self._split_key(values)
+        comp_extra = self._component_extra(comp)
         bounds = [*(_fmt_value(b) for b in self.buckets), "+Inf"]
         for bound, n in zip(bounds, sample.counts):
             cumulative += n
-            labels = _label_str(self.labelnames, values,
-                                extra=f'le="{bound}"')
+            extra = f'le="{bound}"'
+            if comp_extra:
+                extra = f"{comp_extra},{extra}"
+            labels = _label_str(self.labelnames, base_values, extra=extra)
             lines.append(f"{self.name}_bucket{labels} {cumulative}")
-        base = _label_str(self.labelnames, values)
+        base = _label_str(self.labelnames, base_values, extra=comp_extra)
         lines.append(f"{self.name}_sum{base} {_fmt_value(sample.sum)}")
         lines.append(f"{self.name}_count{base} {sample.count}")
         return lines
@@ -372,6 +526,54 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def scoped(self, component: str) -> "ScopedRegistry":
+        """A view of THIS registry that stamps ``component`` on every
+        series recorded through it — same instruments, one extra
+        hidden dimension. The view is stateless (accessors re-resolve
+        the base instrument per call), so it survives a
+        :meth:`reset`."""
+        return ScopedRegistry(self, component)
+
+    def drop_component(self, component: str) -> int:
+        """Drop every series ``component`` ever recorded, across all
+        instruments — Replica release calls this so a dead replica's
+        series cannot pin a rule or skew a federated read. Returns the
+        number of series dropped."""
+        if not component:
+            return 0
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return sum(m._drop_component(str(component)) for m in metrics)
+
+    def federate(self) -> dict:
+        """Snapshot-shaped fleet aggregation: the component dimension
+        collapsed per family — counters and histogram buckets summed,
+        gauges merged as max (the alert engine's worst-series view).
+        Each family also reports the ``components`` that contributed,
+        which is what the mute-replica red-team gate checks."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: dict[str, Any] = {}
+        for m in metrics:
+            snap = m.snapshot()
+            merged: dict[str, Any] = {}
+            comps: set[str] = set()
+            n = len(m.labelnames)
+            for key, sample in snap["series"].items():
+                parts = key.split(",") if key else []
+                base, comp = parts[:n], (parts[n] if len(parts) > n
+                                         else "")
+                comps.add(comp)
+                skey = ",".join(base)
+                merged[skey] = merge_snap_samples(
+                    m.type, [merged[skey], sample]
+                ) if skey in merged else sample
+            out[m.name] = {"type": m.type,
+                           "labels": list(m.labelnames),
+                           "components": sorted(comps),
+                           "series": merged}
+        return out
 
     def reset(self) -> None:
         """Drop every instrument AND its samples (test-visible): the
@@ -445,6 +647,174 @@ def snapshot_delta(snapshot: dict, baseline: Optional[dict]) -> dict:
                             "labels": family.get("labels") or [],
                             "series": changed}
     return {"absolute": False, "deltas": deltas}
+
+
+def merge_snap_samples(metric_type: str, samples: list) -> Any:
+    """Merge snapshot-shaped series samples of one family: counters
+    sum, gauges take max (matching the alert engine's across-series
+    read), histograms merge bucket counts / sum / count. The oracle's
+    subset-label selection and :meth:`MetricsRegistry.federate` share
+    this so a federated judgment and a federated export can never
+    disagree."""
+    if not samples:
+        return None
+    if isinstance(samples[0], dict):  # histogram snap samples
+        buckets: dict[str, float] = {}
+        count = 0
+        total = 0.0
+        for s in samples:
+            count += s.get("count", 0)
+            total += s.get("sum", 0.0)
+            for b, n in (s.get("buckets") or {}).items():
+                buckets[b] = buckets.get(b, 0) + n
+        return {"count": count, "sum": round(total, 6),
+                "buckets": buckets}
+    values = [float(s or 0.0) for s in samples]
+    if metric_type == "gauge":
+        return max(values)
+    return sum(values)
+
+
+def series_key_labels(labelnames: Iterable[str], key: str) -> dict:
+    """Parse a snapshot series key back into {label: value} plus the
+    hidden ``component`` (always "" when the series was recorded
+    unscoped). ``labelnames`` is the family's declared label list —
+    with or without the trailing "component" entry, and regardless of
+    whether the key itself carries a component part."""
+    names = [n for n in labelnames if n != "component"]
+    parts = key.split(",") if key else []
+    out = {name: (parts[i] if i < len(parts) else "")
+           for i, name in enumerate(names)}
+    out["component"] = parts[len(names)] if len(parts) > len(names) else ""
+    return out
+
+
+def match_series(labelnames: Iterable[str], key: str,
+                 selector: Optional[dict]) -> bool:
+    """Subset label match: every selector entry must equal the series'
+    value for that dimension; dimensions the selector does not name —
+    the component dimension above all — are wildcards. This is how a
+    ``{class: interactive}`` rule or invariant keeps selecting every
+    replica's series once the fleet records scoped."""
+    if not selector:
+        return True
+    got = series_key_labels(labelnames, key)
+    return all(str(got.get(k, "")) == str(v) for k, v in selector.items())
+
+
+class ScopedCounter:
+    """Component-stamping proxy over a :class:`Counter`."""
+
+    def __init__(self, base: Counter, component: str):
+        self._base = base
+        self.component = component
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        self._base._inc(amount, labels, self.component)
+
+    def value(self, **labels: Any) -> float:
+        return self._base._value(labels, self.component)
+
+    def remove(self, **labels: Any) -> None:
+        self._base._remove(labels, self.component)
+
+
+class ScopedGauge:
+    """Component-stamping proxy over a :class:`Gauge`."""
+
+    def __init__(self, base: Gauge, component: str):
+        self._base = base
+        self.component = component
+
+    def set(self, value: float, **labels: Any) -> None:
+        self._base._set(value, labels, self.component)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        self._base._inc(amount, labels, self.component)
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self._base._inc(-amount, labels, self.component)
+
+    def unset(self, **labels: Any) -> None:
+        self._base._remove(labels, self.component)
+
+    def remove(self, **labels: Any) -> None:
+        self._base._remove(labels, self.component)
+
+    def value(self, **labels: Any) -> float:
+        return self._base._value(labels, self.component)
+
+
+class ScopedHistogram:
+    """Component-stamping proxy over a :class:`Histogram`."""
+
+    def __init__(self, base: Histogram, component: str):
+        self._base = base
+        self.component = component
+
+    @property
+    def buckets(self):
+        return self._base.buckets
+
+    def observe(self, value: float, **labels: Any) -> None:
+        self._base._observe(value, labels, self.component)
+
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
+        return self._base._quantile(q, labels, self.component)
+
+    def remove(self, **labels: Any) -> None:
+        self._base._remove(labels, self.component)
+
+
+class ScopedRegistry:
+    """A component-identity view over a parent registry (ISSUE 20):
+    ``REGISTRY.scoped(component="r3")`` hands a replica an object that
+    quacks like the registry for the catalog accessors, while every
+    counter/gauge/histogram it vends stamps the component on the
+    series it records. The view holds NO series of its own — the base
+    instrument is resolved in the parent per call, so views stay valid
+    across a parent :meth:`MetricsRegistry.reset`."""
+
+    def __init__(self, parent: MetricsRegistry, component: str):
+        if not str(component):
+            raise ValueError("scoped registry needs a component name")
+        self.parent = parent
+        self.component = str(component)
+
+    def scoped(self, component: str) -> "ScopedRegistry":
+        return ScopedRegistry(self.parent, component)
+
+    def counter(self, name: str, help: str = "",
+                labelnames: tuple[str, ...] = (),
+                max_series: int = DEFAULT_MAX_SERIES) -> ScopedCounter:
+        return ScopedCounter(
+            self.parent.counter(name, help, labelnames,
+                                max_series=max_series), self.component)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: tuple[str, ...] = (),
+              max_series: int = DEFAULT_MAX_SERIES) -> ScopedGauge:
+        return ScopedGauge(
+            self.parent.gauge(name, help, labelnames,
+                              max_series=max_series), self.component)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: tuple[str, ...] = (),
+                  buckets: Iterable[float] = LATENCY_BUCKETS,
+                  max_series: int = DEFAULT_MAX_SERIES) -> ScopedHistogram:
+        return ScopedHistogram(
+            self.parent.histogram(name, help, labelnames, buckets=buckets,
+                                  max_series=max_series), self.component)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self.parent.get(name)
+
+
+def base_registry(registry: Any) -> MetricsRegistry:
+    """The concrete :class:`MetricsRegistry` behind ``registry``,
+    unwrapping a scoped view — for fleet-level reads (rollups,
+    federation) that must see every component."""
+    return getattr(registry, "parent", registry)
 
 
 # The process-global default registry every subsystem records into.
@@ -872,6 +1242,37 @@ def fleet_replica_queue_depth(registry: MetricsRegistry = REGISTRY) -> Gauge:
         ("replica",))
 
 
+def fleet_ttft_skew(registry: MetricsRegistry = REGISTRY) -> Gauge:
+    return registry.gauge(
+        "polyaxon_fleet_ttft_skew",
+        "Max/median of per-replica TTFT p99 across the fleet's scoped "
+        "component series (polyaxon_serving_ttft_seconds merged per "
+        "component) — 1.0 is a balanced fleet; the fleet-replica-skew "
+        "rule fires on a hot outlier. Unset while fewer than two "
+        "components have samples, and a released replica's dropped "
+        "series leave the ratio, so a dead replica cannot pin the rule")
+
+
+def publish_fleet_rollups(registry: Any = REGISTRY) -> None:
+    """Recompute the fleet-level derived series from the scoped
+    per-component series — called from ``ServingFleet.poll`` (and the
+    gauntlet's skew drill). Accepts a scoped view and unwraps it: a
+    rollup is by definition a fleet-wide read."""
+    base = base_registry(registry)
+    by_comp = {c: v for c, v
+               in serving_ttft_hist(base).quantile_by_component(
+                   0.99).items() if c}
+    gauge = fleet_ttft_skew(base)
+    if len(by_comp) < 2:
+        gauge.unset()
+        return
+    vals = sorted(by_comp.values())
+    mid = len(vals) // 2
+    median = (vals[mid] if len(vals) % 2
+              else (vals[mid - 1] + vals[mid]) / 2.0)
+    gauge.set(max(vals) / median if median > 0 else 0.0)
+
+
 def ensure_fleet_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     """Pre-register the serving-fleet families (idempotent) — one
     source of truth for :func:`catalog_metric_names`."""
@@ -879,6 +1280,7 @@ def ensure_fleet_metrics(registry: MetricsRegistry = REGISTRY) -> None:
     fleet_routed_total(registry)
     fleet_scale_events_total(registry)
     fleet_replica_queue_depth(registry)
+    fleet_ttft_skew(registry)
 
 
 def history_samples_total(registry: MetricsRegistry = REGISTRY) -> Counter:

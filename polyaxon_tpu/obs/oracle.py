@@ -363,13 +363,23 @@ class TelemetryBundle:
 # --------------------------------------------------------- snapshot math
 def _select_series(family: dict, labels: dict[str, str]) -> Optional[Any]:
     """One series sample from a snapshot family by label selector
-    (None = no such series). Empty selector on a labeled family sums
+    (None = no such series). The selector subset-matches: dimensions
+    it does not name — the fleet's hidden ``component`` dimension
+    above all — are wildcards, and multiple matches merge into the
+    federated sample (counters sum, gauges max, histogram buckets
+    merge). Empty selector on a labeled family sums
     scalars / returns None for histograms (ambiguous)."""
     series = family.get("series") or {}
     labelnames = family.get("labels") or []
     if labels:
-        key = ",".join(str(labels.get(k, "")) for k in labelnames)
-        return series.get(key)
+        matched = [v for k, v in series.items()
+                   if obs_metrics.match_series(labelnames, k, labels)]
+        if not matched:
+            return None
+        if len(matched) == 1:
+            return matched[0]
+        return obs_metrics.merge_snap_samples(
+            family.get("type") or "", matched)
     if not labelnames:
         return series.get("")
     scalars = [v for v in series.values() if not isinstance(v, dict)]
@@ -413,8 +423,8 @@ def _slo_counts(family: dict, le: float,
     series = family.get("series") or {}
     labelnames = family.get("labels") or []
     if labels:
-        key = ",".join(str(labels.get(k, "")) for k in labelnames)
-        samples = [series[key]] if key in series else []
+        samples = [v for k, v in series.items()
+                   if obs_metrics.match_series(labelnames, k, labels)]
     else:
         samples = list(series.values())
     good = total = 0.0

@@ -59,17 +59,33 @@ class RequestTrace:
     annotate whatever phase is current via :meth:`event`. ``finish`` is
     idempotent: every failure path may call it without coordinating
     with the retire path.
+
+    Fleet propagation (ISSUE 20): the fleet front door pre-generates
+    the request id, opens a ``route`` span under the same trace id,
+    and hands the engine its span record plus a ``parent_id`` — the
+    request root nests under the route decision and the finished
+    timeline is ONE tree across components. ``component`` names the
+    recording replica on every span, so an eviction→readmit arc reads
+    with per-hop identity.
     """
 
     def __init__(self, request_id: str, klass: str = "batch",
+                 component: str = "serving",
+                 parent_id: Optional[str] = None,
+                 extra_records: Optional[list] = None,
                  **attrs: Any):
         self.request_id = request_id
         self.klass = klass
+        self.component = component or "serving"
         self._lock = threading.Lock()
         self.root = Span(trace_id=request_id, name="request",
-                         component="serving",
+                         component=self.component, parent_id=parent_id,
                          attributes={"class": klass, **attrs})
         self._spans: list[Span] = [self.root]
+        # Upstream span records (the router's `route` span) replay
+        # verbatim into records(), so build_timeline sees the whole
+        # cross-component tree without any join step.
+        self._extra_records = list(extra_records or [])
         self._phase: Optional[Span] = None
         self._done = False
 
@@ -81,7 +97,8 @@ class RequestTrace:
             if self._phase is not None and self._phase.end is None:
                 self._phase.end = time.time()
             span = Span(trace_id=self.request_id, name=name,
-                        parent_id=self.root.span_id, component="serving",
+                        parent_id=self.root.span_id,
+                        component=self.component,
                         attributes=dict(attrs))
             self._spans.append(span)
             self._phase = span
@@ -139,9 +156,11 @@ class RequestTrace:
     # -- snapshots ---------------------------------------------------------
     def records(self) -> list[dict[str, Any]]:
         """Span records (open spans snapshot with end=now), consumable
-        by :func:`obs.trace.build_timeline`."""
+        by :func:`obs.trace.build_timeline` — upstream records (the
+        route span) first, so the tree root is the earliest hop."""
         with self._lock:
-            return [span.to_record() for span in self._spans]
+            return ([dict(r) for r in self._extra_records]
+                    + [span.to_record() for span in self._spans])
 
     def summary(self) -> dict[str, Any]:
         """One listing row for ``GET /requests``."""

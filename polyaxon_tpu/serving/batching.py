@@ -200,7 +200,8 @@ class ContinuousBatchingEngine:
                  preemption: bool = True,
                  request_tracing: bool = True,
                  trace_capacity: int = reqtrace.DEFAULT_RING_CAPACITY,
-                 trace_dump_path: Optional[str] = None):
+                 trace_dump_path: Optional[str] = None,
+                 registry=None):
         from polyaxon_tpu.serving.server import _family
 
         family = _family(model)
@@ -321,6 +322,14 @@ class ContinuousBatchingEngine:
         self.slots = slots
         self.max_len = max_len or cfg.max_seq_len
         self._family_mod = family
+        # Fleet-scoped telemetry (ISSUE 20): `registry` may be a
+        # `REGISTRY.scoped(component=...)` view — every series this
+        # engine records then carries the replica's identity, and its
+        # trace spans name the replica instead of the generic
+        # "serving". Standalone engines keep the unscoped global.
+        self._obs = registry if registry is not None else obs_metrics.REGISTRY
+        self._obs_component = (getattr(self._obs, "component", "")
+                               or "serving")
         self.kv = kv
         self._pool = None
         # Prefill-lane rows sit AFTER the decode slots in the block
@@ -766,12 +775,20 @@ class ContinuousBatchingEngine:
         must not vanish — the counter is THE load-shedding signal on
         /metrics and the dashboard (ISSUE 10 satellite)."""
         self._rejected[reason] = self._rejected.get(reason, 0) + 1
-        obs_metrics.serving_rejected_total().inc(reason=reason)
+        obs_metrics.serving_rejected_total(self._obs).inc(reason=reason)
 
     def submit(self, tokens: list[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                top_p: float = 1.0, top_k: int = 0,
-               eos_tokens=None, klass: str = "batch") -> _Request:
+               eos_tokens=None, klass: str = "batch",
+               request_id: Optional[str] = None,
+               trace_parent: Optional[str] = None,
+               route_record: Optional[dict] = None) -> _Request:
+        """`request_id`/`trace_parent`/`route_record` carry a
+        propagated trace context (ISSUE 20): the fleet front door
+        pre-generates the id, opens a `route` span, and the engine's
+        `request` root nests under it — one trace id, one cross-
+        component timeline."""
         self._validate(tokens, max_new_tokens)
         validate_sampling(top_p, top_k)
         eos = frozenset(int(t) for t in (eos_tokens or ()))
@@ -784,12 +801,18 @@ class ContinuousBatchingEngine:
         req = _Request(list(tokens), max_new_tokens, float(temperature),
                        int(seed), float(top_p), int(top_k), eos,
                        klass=str(klass) or "batch")
+        if request_id:
+            req.id = str(request_id)
         if self.request_tracing:
             # Built BEFORE the lock (span allocation off the critical
             # section); ringed only AFTER a successful enqueue so
             # rejected requests never occupy ring capacity.
             req.trace = reqtrace.RequestTrace(
-                req.id, req.klass, prompt_len=len(req.tokens),
+                req.id, req.klass,
+                component=self._obs_component,
+                parent_id=trace_parent,
+                extra_records=[route_record] if route_record else None,
+                prompt_len=len(req.tokens),
                 max_new=int(max_new_tokens))
             req.trace.start_phase("queue_wait")
         with self._cv:
@@ -902,7 +925,7 @@ class ContinuousBatchingEngine:
             # hold serving-prefix-hit-collapse in a breach that no
             # amount of clock fast-forward can ever resolve. A live
             # engine re-sets the gauge on its next admission.
-            obs_metrics.serving_prefix_hit_rate().unset()
+            obs_metrics.serving_prefix_hit_rate(self._obs).unset()
         self._dump_ring()
 
     def _dump_ring(self) -> None:
@@ -913,12 +936,16 @@ class ContinuousBatchingEngine:
         turn a clean stop into a crash; both outcomes are counted."""
         if not self.trace_dump_path or not self.request_tracing:
             return
+        # The dump path must work on a skeleton engine (no __init__ —
+        # postmortem tooling builds one around a recovered ring), so the
+        # scoped view is optional here.
+        obs = getattr(self, "_obs", None) or obs_metrics.REGISTRY
         try:
             path = reqtrace.dump_ring(self._ring, self.trace_dump_path)
-            obs_metrics.serving_trace_dumps_total().inc(outcome="ok")
+            obs_metrics.serving_trace_dumps_total(obs).inc(outcome="ok")
             logger.info("request-timeline ring dumped to %s", path)
         except Exception:
-            obs_metrics.serving_trace_dumps_total().inc(outcome="error")
+            obs_metrics.serving_trace_dumps_total(obs).inc(outcome="error")
             logger.warning("request-timeline ring dump to %s failed",
                            self.trace_dump_path, exc_info=True)
 
@@ -999,9 +1026,9 @@ class ContinuousBatchingEngine:
         return [r for q in self._queues.values() for r in q]
 
     def _publish_queue_depth(self) -> None:
-        obs_metrics.serving_queue_depth().set(self._queue_depth())
+        obs_metrics.serving_queue_depth(self._obs).set(self._queue_depth())
         if self.class_admission:
-            gauge = obs_metrics.serving_class_pending()
+            gauge = obs_metrics.serving_class_pending(self._obs)
             for name, q in self._queues.items():
                 gauge.set(len(q), **{"class": name})
 
@@ -1096,16 +1123,16 @@ class ContinuousBatchingEngine:
         req.prefix_cached_tokens = skip
         outcome = ("full" if skip >= prefill_len
                    else "partial" if skip > 0 else "miss")
-        obs_metrics.serving_prefix_hits_total().inc(outcome=outcome)
+        obs_metrics.serving_prefix_hits_total(self._obs).inc(outcome=outcome)
         if skip:
-            obs_metrics.serving_prefix_cached_tokens().inc(skip)
+            obs_metrics.serving_prefix_cached_tokens(self._obs).inc(skip)
         self._prefill_tokens_total += prefill_len
         self._prefill_tokens_skipped += skip
         self._hit_window.append((skip, prefill_len))
         if len(self._hit_window) >= self._hit_window_min:
             denom = sum(p for _, p in self._hit_window)
             if denom:
-                obs_metrics.serving_prefix_hit_rate().set(
+                obs_metrics.serving_prefix_hit_rate(self._obs).set(
                     sum(s for s, _ in self._hit_window) / denom)
         if res.cow is not None and req.trace is not None:
             req.trace.event("cow_fork", src=int(res.cow[0]),
@@ -1117,7 +1144,7 @@ class ContinuousBatchingEngine:
             novel = max(prefill_len - skip, 0)
             if novel:
                 self._readmit_suffix_tokens += novel
-                obs_metrics.serving_readmit_suffix_tokens_total().inc(
+                obs_metrics.serving_readmit_suffix_tokens_total(self._obs).inc(
                     novel)
         return skip
 
@@ -1153,7 +1180,7 @@ class ContinuousBatchingEngine:
                     # the head (FIFO preserved) and wait for
                     # retirements — running without pages would stream
                     # scratch-page garbage.
-                    obs_metrics.serving_admissions_total().inc(
+                    obs_metrics.serving_admissions_total(self._obs).inc(
                         outcome="deferred")
                     if req.trace is not None:
                         req.trace.event("requeue", reason="kv_pages")
@@ -1162,7 +1189,7 @@ class ContinuousBatchingEngine:
                     break
             # Dequeued for real: close the queue_wait phase and feed
             # the SLO histogram (submit → admission dequeue).
-            obs_metrics.serving_queue_wait_hist().observe(
+            obs_metrics.serving_queue_wait_hist(self._obs).observe(
                 time.time() - req.submitted_at, **{"class": req.klass})
             if req.trace is not None:
                 req.trace.end_phase(slot=b)
@@ -1269,7 +1296,7 @@ class ContinuousBatchingEngine:
                     # prefix keys registered for content the prefill
                     # never wrote.
                     self._pool.release(b, invalidate_prefix=True)
-                obs_metrics.serving_admissions_total().inc(
+                obs_metrics.serving_admissions_total(self._obs).inc(
                     outcome="failed")
                 req.error = f"{type(exc).__name__}: {exc}"
                 self._finish_trace(req)
@@ -1307,14 +1334,14 @@ class ContinuousBatchingEngine:
                 self._publish_queue_depth()
             admit_res = self._pool.admit(p, len(req.tokens), req.tokens)
             if not admit_res:
-                obs_metrics.serving_admissions_total().inc(
+                obs_metrics.serving_admissions_total(self._obs).inc(
                     outcome="deferred")
                 if req.trace is not None:
                     req.trace.event("requeue", reason="kv_pages")
                 with self._cv:
                     self._queue_for(req).appendleft(req)
                 break
-            obs_metrics.serving_queue_wait_hist().observe(
+            obs_metrics.serving_queue_wait_hist(self._obs).observe(
                 time.time() - req.submitted_at, **{"class": req.klass})
             if req.trace is not None:
                 req.trace.end_phase(slot=p)
@@ -1338,7 +1365,7 @@ class ContinuousBatchingEngine:
                 self._lane[p] = [req, toks, skip, pos0, tok0]
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 self._pool.release(p, invalidate_prefix=True)
-                obs_metrics.serving_admissions_total().inc(
+                obs_metrics.serving_admissions_total(self._obs).inc(
                     outcome="failed")
                 req.error = f"{type(exc).__name__}: {exc}"
                 self._finish_trace(req)
@@ -1394,7 +1421,7 @@ class ContinuousBatchingEngine:
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 self._drop_lane_reservation(
                     p, f"{type(exc).__name__}: {exc}")
-                obs_metrics.serving_admissions_total().inc(
+                obs_metrics.serving_admissions_total(self._obs).inc(
                     outcome="failed")
                 if not self._count_request_failure(exc):
                     return False
@@ -1404,7 +1431,7 @@ class ContinuousBatchingEngine:
             if req.trace is not None:
                 req.trace.event("chunk", pos=int(i), of=int(len(toks)))
         if ran:
-            obs_metrics.serving_lane_ticks_total().inc(lane="prefill")
+            obs_metrics.serving_lane_ticks_total(self._obs).inc(lane="prefill")
         return True
 
     def _bucket_pages(self, n: int) -> int:
@@ -1442,7 +1469,7 @@ class ContinuousBatchingEngine:
             del self._lane[p]
             self._handoffs += 1
             self._handoff_pages += moved
-            obs_metrics.serving_handoff_pages_total().inc(moved)
+            obs_metrics.serving_handoff_pages_total(self._obs).inc(moved)
             if req.trace is not None:
                 req.trace.event("handoff", src_row=p, dst_slot=b,
                                 pages=moved)
@@ -1611,7 +1638,7 @@ class ContinuousBatchingEngine:
         """Mark a slot live for decode — the ONE place slot state is
         initialized (monolithic admission and chunked-prefill
         completion both land here)."""
-        obs_metrics.serving_admissions_total().inc(outcome="admitted")
+        obs_metrics.serving_admissions_total(self._obs).inc(outcome="admitted")
         if req.trace is not None:
             # Closes the prefill phase when one ran (1-token prompts
             # go straight from queue_wait to decode).
@@ -1676,7 +1703,7 @@ class ContinuousBatchingEngine:
                         self._draft_params, row_d, tokens, p0)
             except Exception as exc:  # noqa: BLE001 — request-scoped
                 del self._prefilling[b]
-                obs_metrics.serving_admissions_total().inc(
+                obs_metrics.serving_admissions_total(self._obs).inc(
                     outcome="failed")
                 req.error = f"{type(exc).__name__}: {exc}"
                 self._finish_trace(req)
@@ -1809,7 +1836,7 @@ class ContinuousBatchingEngine:
         queue wait and prefill both count — that is the number a client
         feels) plus the timeline annotation."""
         req.first_token_at = time.time()
-        obs_metrics.serving_ttft_hist().observe(
+        obs_metrics.serving_ttft_hist(self._obs).observe(
             req.first_token_at - req.submitted_at,
             **{"class": req.klass})
         if req.trace is not None:
@@ -1844,13 +1871,13 @@ class ContinuousBatchingEngine:
                 self._served += 1
                 self._tokens_out += len(req.out)
             now = time.time()
-            obs_metrics.serving_request_hist().observe(
+            obs_metrics.serving_request_hist(self._obs).observe(
                 now - req.submitted_at)
             if (not req.error and req.first_token_at is not None
                     and len(req.out) >= 2):
                 # TPOT = steady-state decode cadence: the first token
                 # (prefill-dominated, already TTFT's job) is excluded.
-                obs_metrics.serving_tpot_hist().observe(
+                obs_metrics.serving_tpot_hist(self._obs).observe(
                     (now - req.first_token_at) / (len(req.out) - 1),
                     **{"class": req.klass})
             self._publish_queue_depth()
@@ -1957,7 +1984,7 @@ class ContinuousBatchingEngine:
         req.out.clear()
         req.first_token_at = None
         self._preemptions[rc.name] = self._preemptions.get(rc.name, 0) + 1
-        obs_metrics.serving_preemptions_total().inc(
+        obs_metrics.serving_preemptions_total(self._obs).inc(
             **{"class": rc.name, "reason": reason})
         if req.trace is not None:
             req.trace.event("preempted", reason=reason, slot=b,
@@ -1989,10 +2016,10 @@ class ContinuousBatchingEngine:
         composition and KV-page gauges a dashboard needs to say WHY
         throughput looks the way it does (decode-bound vs
         prefill-bound vs page-starved)."""
-        obs_metrics.serving_tick_hist().observe(dt)
+        obs_metrics.serving_tick_hist(self._obs).observe(dt)
         decode = sum(1 for r in self._slot_req if r is not None)
         prefill = len(self._prefilling) + len(self._lane)
-        slots = obs_metrics.serving_batch_slots()
+        slots = obs_metrics.serving_batch_slots(self._obs)
         slots.set(decode, state="decode")
         slots.set(prefill, state="prefill")
         # Lane rows are capacity ON TOP of the decode slots, so free
@@ -2001,12 +2028,12 @@ class ContinuousBatchingEngine:
                   state="free")
         if self._pool is not None:
             util = self._pool.utilization()
-            pages = obs_metrics.serving_kv_pages()
+            pages = obs_metrics.serving_kv_pages(self._obs)
             pages.set(util["used"], state="used")
             pages.set(util["free"], state="free")
             radix = self._pool.radix_stats()
-            obs_metrics.serving_radix_nodes().set(radix["nodes"])
-            rpages = obs_metrics.serving_radix_pages()
+            obs_metrics.serving_radix_nodes(self._obs).set(radix["nodes"])
+            rpages = obs_metrics.serving_radix_pages(self._obs)
             rpages.set(radix["referenced"], state="referenced")
             rpages.set(radix["resident"], state="resident")
 
@@ -2056,7 +2083,7 @@ class ContinuousBatchingEngine:
             self._last_decode_at = None
             time.sleep(0.005)  # don't spin hot while starved
             return True
-        obs_metrics.serving_lane_ticks_total().inc(lane="decode")
+        obs_metrics.serving_lane_ticks_total(self._obs).inc(lane="decode")
         steps = self.decode_lane_budget if self.prefill_slots else 1
         for _ in range(max(steps, 1)):
             live = sum(1 for r in self._slot_req if r is not None)
@@ -2068,7 +2095,7 @@ class ContinuousBatchingEngine:
                 k = max(0, min(
                     self._spec_policy.draft_len(self._lane_view()),
                     self.spec_k))
-                obs_metrics.serving_spec_draft_len().set(k)
+                obs_metrics.serving_spec_draft_len(self._obs).set(k)
                 if k > 0:
                     if not self._spec_iteration(k):
                         return False
@@ -2091,7 +2118,7 @@ class ContinuousBatchingEngine:
         whenever the lane goes quiet)."""
         now = time.monotonic()
         if self._last_decode_at is not None:
-            obs_metrics.serving_decode_tpot_hist().observe(
+            obs_metrics.serving_decode_tpot_hist(self._obs).observe(
                 now - self._last_decode_at)
         self._last_decode_at = now
 
@@ -2140,7 +2167,7 @@ class ContinuousBatchingEngine:
                 # fail THIS row loudly (its output so far is
                 # surfaced in the error path) rather than let it
                 # scribble over a neighbour's pages.
-                obs_metrics.serving_evictions_total().inc(
+                obs_metrics.serving_evictions_total(self._obs).inc(
                     reason="pool_exhausted")
                 if req.trace is not None:
                     req.trace.event("evicted", reason="pool_exhausted",

@@ -37,6 +37,8 @@ import time
 from typing import Callable, Iterable, Optional, Sequence
 
 from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import reqtrace
+from polyaxon_tpu.obs.trace import Span
 from polyaxon_tpu.serving.router import FleetRouter
 
 # Rule ids whose firing state means "add capacity". The autoscaler
@@ -71,13 +73,14 @@ def engine_factory(model: str = "llama_tiny", *, slots: int = 2,
     """Real-engine factory: each call builds a fresh paged-KV
     ``ContinuousBatchingEngine`` (its own jit wrappers — a new replica
     really does pay compile until warmed)."""
-    def build():
+    def build(registry=None):
         from polyaxon_tpu.serving.batching import ContinuousBatchingEngine
         from polyaxon_tpu.serving.server import load_params
         cfg, params = load_params(model, seed=0)
         return ContinuousBatchingEngine(
             model, cfg, params, slots=slots, kv=kv,
-            page_size=page_size, kv_pages=kv_pages, **engine_kw)
+            page_size=page_size, kv_pages=kv_pages,
+            registry=registry, **engine_kw)
     return build
 
 
@@ -112,7 +115,17 @@ class ServingFleet:
         self.router = router or FleetRouter()
         self.cooldown = float(cooldown)
         self.idle_hold = float(idle_hold)
-        self._registry = registry or obs_metrics.REGISTRY
+        # Fleet-scoped telemetry (ISSUE 20): `_registry` is the shared
+        # BASE registry (federation, rollups, component GC); the
+        # fleet's own series record through a `fleet` view, the router
+        # through a `router` view, and each engine replica gets its
+        # own view in `_build` — every series carries the component
+        # that produced it while rules keep judging the federated sum.
+        self._registry = obs_metrics.base_registry(
+            registry if registry is not None else obs_metrics.REGISTRY)
+        self._obs = self._registry.scoped("fleet")
+        if getattr(self.router, "_registry", None) is obs_metrics.REGISTRY:
+            self.router._registry = self._registry.scoped("router")
         self._clock = clock
         self._lock = threading.Lock()
         self._replicas: dict[str, Replica] = {}
@@ -140,7 +153,14 @@ class ServingFleet:
                             klass="warmup")
 
     def _build(self, rep: Replica, *, warm: bool) -> None:
-        rep.engine = self._factory()
+        view = self._registry.scoped(rep.id)
+        try:
+            rep.engine = self._factory(registry=view)
+        except TypeError:
+            # Legacy factories and test fakes take no kwargs; they
+            # record unscoped — for a real engine that is exactly the
+            # mute-replica failure the CI federated-view gate catches.
+            rep.engine = self._factory()
         if warm:
             self._warm(rep.engine)
 
@@ -192,22 +212,52 @@ class ServingFleet:
             depth = rep.telemetry.get("prefill_pending")
             if depth is None:
                 depth = rep.telemetry.get("queued", 0)
-            obs_metrics.fleet_replica_queue_depth(self._registry).set(
+            obs_metrics.fleet_replica_queue_depth(self._obs).set(
                 depth, replica=rep.id)
-        gauge = obs_metrics.fleet_replicas(self._registry)
+        gauge = obs_metrics.fleet_replicas(self._obs)
         for state, n in counts.items():
             gauge.set(n, state=state)
+        # Derived cross-component series (TTFT skew) refresh on the
+        # same cadence as the raw gauges.
+        obs_metrics.publish_fleet_rollups(self._registry)
         return view
 
     # ------------------------------------------------------------ serve
     def submit(self, tokens: Sequence[int], max_new_tokens: int, **kw):
         """Route one request and submit it to the chosen replica.
-        Returns ``(request, decision)``."""
+        Returns ``(request, decision)``.
+
+        The fleet pre-generates the request id and closes a ``route``
+        span under it before the hop, handing the engine the span
+        record plus its span id as trace parent — the replica's
+        ``request`` tree nests under the routing decision and ONE
+        trace id yields one fleet-wide timeline (ISSUE 20)."""
         with self._lock:
             telemetry = {r.id: r.telemetry for r in self.ready}
             decision = self.router.route(tokens, telemetry=telemetry)
             rep = self._replicas[decision.replica]
-        req = rep.engine.submit(list(tokens), max_new_tokens, **kw)
+        rid = kw.pop("request_id", None) or reqtrace.new_request_id()
+        span = Span(trace_id=rid, name="route", component="router",
+                    attributes={
+                        "decision": decision.reason,
+                        "replica": decision.replica,
+                        "prefix": decision.prefix,
+                        "candidates": {
+                            r: int((t or {}).get(
+                                "prefill_pending",
+                                (t or {}).get("queued", 0)) or 0)
+                            for r, t in telemetry.items()},
+                    })
+        span.end = time.time()  # the decision is made; closed pre-hop
+        try:
+            req = rep.engine.submit(
+                list(tokens), max_new_tokens, request_id=rid,
+                trace_parent=span.span_id,
+                route_record=span.to_record(), **kw)
+        except TypeError:
+            # Engine fakes without trace plumbing: the route context
+            # drops; routing itself is unaffected.
+            req = rep.engine.submit(list(tokens), max_new_tokens, **kw)
         return req, decision
 
     def generate(self, token_rows: Iterable[Sequence[int]],
@@ -258,7 +308,7 @@ class ServingFleet:
         event = {"direction": direction, "outcome": outcome,
                  "replica": replica, "mode": mode}
         self.scale_events.append(event)
-        obs_metrics.fleet_scale_events_total(self._registry).inc(
+        obs_metrics.fleet_scale_events_total(self._obs).inc(
             direction=direction, outcome=outcome)
         return event
 
@@ -283,6 +333,7 @@ class ServingFleet:
             except Exception:
                 with self._lock:
                     rep.state = "released"
+                self._registry.drop_component(rep.id)
                 self._record("up", "failed", rep.id, "build")
                 return
             with self._lock:
@@ -328,6 +379,16 @@ class ServingFleet:
                 outcome = "failed"
             with self._lock:
                 rep.state = "released"
+            # A released replica's scoped series leave the registry:
+            # a dead component must not pin a gauge rule or weight the
+            # federated view (the Gauge.unset discipline, generalized
+            # to every instrument the replica touched). The queue-depth
+            # series is recorded BY the fleet ABOUT the replica (label,
+            # not component), so it needs its own unset or the last
+            # polled depth would keep feeding fleet-replica-hot.
+            self._registry.drop_component(rep.id)
+            obs_metrics.fleet_replica_queue_depth(self._obs).unset(
+                replica=rep.id)
             self._record("down", outcome, rep.id, "drain")
 
         t = threading.Thread(target=drain, daemon=True,
@@ -344,7 +405,88 @@ class ServingFleet:
             t.join(max(0.0, deadline - time.monotonic()))
         return not any(t.is_alive() for t in self._threads)
 
+    # -------------------------------------------------- request lookup
+    def recent_requests(self) -> list[dict]:
+        """Fleet-wide request listing (``GET /requests``): every
+        replica's timeline ring, newest first, each row stamped with
+        the replica that served it. Draining/released replicas keep
+        answering while their engine object survives — a request that
+        finished on a scale-down victim stays queryable."""
+        rows: list[dict] = []
+        for rep in sorted(self._replicas.values(), key=lambda r: r.id):
+            eng = rep.engine
+            if eng is None or not hasattr(eng, "recent_requests"):
+                continue
+            try:
+                for row in eng.recent_requests():
+                    rows.append({**row, "replica": rep.id})
+            # polycheck: ignore[invariant-swallow] -- lookup fan-out races replica teardown; a dead ring contributes nothing, the listing must still render
+            except Exception:  # noqa: BLE001
+                continue
+        rows.sort(key=lambda r: r.get("start") or 0, reverse=True)
+        return rows
+
+    def request_timeline(self, request_id: str) -> Optional[dict]:
+        """Search every replica's ring for one trace id (``GET
+        /requests/{id}/timeline``). First hit wins: eviction→readmit
+        returns to the admitting engine, so a request id lives in
+        exactly one ring and fan-out is a lookup, not a merge."""
+        for rep in sorted(self._replicas.values(), key=lambda r: r.id):
+            eng = rep.engine
+            if eng is None or not hasattr(eng, "request_timeline"):
+                continue
+            try:
+                timeline = eng.request_timeline(request_id)
+            # polycheck: ignore[invariant-swallow] -- same teardown race as recent_requests; keep searching the other rings
+            except Exception:  # noqa: BLE001
+                continue
+            if timeline:
+                return timeline
+        return None
+
     # ------------------------------------------------------------ stats
+    def per_replica_telemetry(self) -> dict:
+        """Per-component serving breakdown read straight from the
+        scoped series: TTFT p50/p99 (ms, merged across classes) and
+        preemption totals, keyed by replica id. Components that never
+        observed TTFT (infrastructure views like ``fleet``/``router``)
+        are excluded."""
+        hist = obs_metrics.serving_ttft_hist(self._registry)
+        p50 = hist.quantile_by_component(0.5)
+        p99 = hist.quantile_by_component(0.99)
+        preempt = obs_metrics.serving_preemptions_total(
+            self._registry).total_by_component()
+        out: dict[str, dict] = {}
+        for comp in sorted(set(p50) | set(p99)):
+            if not comp:
+                continue
+            out[comp] = {
+                "ttft_p50_ms": (round(p50[comp] * 1e3, 3)
+                                if comp in p50 else None),
+                "ttft_p99_ms": (round(p99[comp] * 1e3, 3)
+                                if comp in p99 else None),
+                "preemptions": int(preempt.get(comp, 0.0)),
+            }
+        return out
+
+    def fleet_snapshot(self) -> dict:
+        """``GET /v1/fleet``: aggregate stats, the per-replica scoped
+        breakdown, and the cross-replica skew rollup in one payload."""
+        components = sorted(
+            obs_metrics.serving_ttft_hist(
+                self._registry).components() - {""})
+        return {
+            "stats": self.stats(),
+            "per_replica": self.per_replica_telemetry(),
+            # The skew ratio is defined only once >= 2 replicas have
+            # TTFT samples (the rollup keeps the gauge unset below
+            # that; value() reads absent series as 0.0).
+            "ttft_skew": (obs_metrics.fleet_ttft_skew(
+                self._registry).value() if len(components) >= 2
+                else None),
+            "components": components,
+        }
+
     def stats(self) -> dict:
         """Fleet-wide aggregate: the acceptance surface. Prefix reuse
         is summed over replicas (hit rate = skipped/total prefill
@@ -395,3 +537,7 @@ class ServingFleet:
                     pass
                 rep.state = "released"
         self.poll()
+        # The derived skew gauge dies with the fleet (scoped series
+        # survive for post-run oracle judgment, but a rollup over a
+        # stopped fleet must not keep a rule evaluable).
+        obs_metrics.fleet_ttft_skew(self._registry).unset()

@@ -22,6 +22,12 @@ TPU-first details:
 API (JSON over HTTP):
     GET  /healthz              → {"status": "ok", "model": name}
     GET  /v1/models            → {"models": [name]}
+    GET  /v1/fleet             → per-replica telemetry breakdown
+                               (ServingFleet front ends only; 404
+                               behind a single engine)
+    GET  /requests/{id}        → one request's summary row (behind a
+                               fleet: fan-out over every replica's
+                               ring, stamped with the serving replica)
     POST /v1/generate          {"tokens": [[...]], "max_new_tokens": N,
                                 "temperature": T?, "seed": S?,
                                 "stream": bool?}
@@ -404,6 +410,11 @@ async function refresh() {
     document.getElementById("state").textContent = "unreachable";
     return;
   }
+  let fleet = null;
+  try {
+    const fr = await fetch("/v1/fleet");
+    if (fr.ok) fleet = await fr.json();
+  } catch (e) { /* single-engine server: no fleet surface */ }
   const now = performance.now();
   let rate = "";
   if (lastTokens != null && s.tokens_generated >= lastTokens && now > lastT) {
@@ -442,6 +453,17 @@ async function refresh() {
       ? tile("radix pages (ref/resident)",
           `${s.kv_radix.referenced} / ${s.kv_radix.resident}`) : "",
   ];
+  if (fleet && fleet.per_replica) {
+    for (const [rid, t] of Object.entries(fleet.per_replica)) {
+      tiles.push(tile(`${rid} · ttft p50/p99 ms`,
+        `${t.ttft_p50_ms ?? "–"} / ${t.ttft_p99_ms ?? "–"}`));
+      if (t.preemptions)
+        tiles.push(tile(`${rid} · preemptions`, t.preemptions));
+    }
+    if (fleet.ttft_skew != null)
+      tiles.push(tile("ttft skew (max/median p99)",
+        Number(fleet.ttft_skew).toFixed(2)));
+  }
   document.getElementById("tiles").innerHTML = tiles.join("");
 }
 refresh();
@@ -507,6 +529,16 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"models": [self.engine.model]})
         if self.path == "/v1/stats":
             return self._json(self.engine.stats())
+        if self.path == "/v1/fleet":
+            # Fleet telemetry (ISSUE 20): aggregate stats plus the
+            # per-replica breakdown read from the component-scoped
+            # series. Only a ServingFleet front end carries it; a
+            # single engine 404s and the stats page silently skips.
+            if not hasattr(self.engine, "fleet_snapshot"):
+                return self._json(
+                    {"error": "fleet telemetry requires a "
+                              "ServingFleet front end"}, status=404)
+            return self._json(self.engine.fleet_snapshot())
         if self.path == "/requests":
             # Ring summaries, most recent first. Only the continuous
             # engine traces requests; the static engine 404s rather
@@ -537,6 +569,24 @@ class _Handler(BaseHTTPRequestHandler):
             # the span tree themselves.
             timeline["summary"] = request_phases(timeline)
             return self._json(timeline)
+        m = re.match(r"^/requests/([0-9a-f]{1,64})$", self.path)
+        if m is not None:
+            # One request's summary row. Behind a fleet front end the
+            # lookup fans out over every replica's ring and the row
+            # carries the serving replica's id.
+            if not hasattr(self.engine, "recent_requests"):
+                return self._json(
+                    {"error": "request timelines require "
+                              "--batching continuous"}, status=404)
+            rows = [r for r in self.engine.recent_requests()
+                    if r.get("request_id") == m.group(1)]
+            if not rows:
+                return self._json(
+                    {"error": f"unknown or evicted request "
+                              f"`{m.group(1)}` (the trace ring keeps "
+                              "the most recent requests only)"},
+                    status=404)
+            return self._json(rows[0])
         if self.path in ("/", "/ui"):
             body = STATS_PAGE.encode()
             self.send_response(200)

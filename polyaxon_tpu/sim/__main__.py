@@ -21,6 +21,8 @@ Modes:
       # must FAIL: round-robin routing collapses the prefix hit rate
   python -m polyaxon_tpu.sim --fleet-serve --quick --inject cold-scale
       # must FAIL: unwarmed scale-up breaks during-spike TTFT
+  python -m polyaxon_tpu.sim --fleet-serve --quick --inject mute-replica
+      # must FAIL: an unscoped replica breaks federated-view coverage
 """
 
 from __future__ import annotations

@@ -30,6 +30,14 @@ Red-team injects (ci.sh must show each flips the gate):
 * ``cold-scale`` — prewarm is skipped, so the promoted standby's jit
   caches are empty and its first in-window requests eat the XLA
   compiles; the during-spike TTFT invariant must fail.
+* ``mute-replica`` — one replica is built WITHOUT its component-scoped
+  registry view (ISSUE 20): its TTFT/queue series record unscoped, so
+  the federated per-component view silently under-covers the fleet.
+  The ``fleet_view_covers_replicas`` gate (every replica that served
+  requests appears as a component in the federated serving-TTFT view)
+  must flip the episode — a fleet whose telemetry cannot name which
+  replica produced a sample is unobservable, even when every SLO
+  number still looks healthy.
 """
 
 from __future__ import annotations
@@ -45,7 +53,7 @@ from polyaxon_tpu.obs import rules as obs_rules
 
 logger = logging.getLogger(__name__)
 
-FLEET_SERVE_INJECTS = ("route-blind", "cold-scale")
+FLEET_SERVE_INJECTS = ("route-blind", "cold-scale", "mute-replica")
 
 # Fleet-wide prefix hit rate the episode must clear (skipped / total
 # prefill tokens summed over replicas). On the spec workload affinity
@@ -57,7 +65,10 @@ FLEET_SERVE_INJECTS = ("route-blind", "cold-scale")
 FLEET_HIT_RATE_FLOOR = 0.45
 
 # Oracle verdicts that must PASS (not skip) for the episode to pass.
+# The federated invariant judges TTFT over every replica's merged
+# component series — the fleet-aggregate SLO surface (ISSUE 20).
 FLEET_SERVE_REQUIRED = ("serving-ttft-during-scaleup",
+                        "serving-ttft-federated-during-scaleup",
                         "zero-unresolved-alerts")
 
 # Sizing is load-bearing, not incidental. Each 12-token prefix is 3
@@ -140,6 +151,20 @@ def build_fleet(*, profile: str = "quick", seed: int = 0,
     factory = engine_factory(
         "llama_tiny", slots=spec["slots"], kv="paged",
         page_size=spec["page_size"], kv_pages=spec["kv_pages"])
+    if inject == "mute-replica":
+        # The FIRST engine built (replica r0, a ready member that
+        # serves real traffic) is constructed without its scoped
+        # registry view — everything it records lands unscoped, so the
+        # federated per-component view under-covers the fleet from the
+        # first sample on. The coverage gate must catch exactly this.
+        real_factory = factory
+        built = [0]
+
+        def factory(registry=None):
+            built[0] += 1
+            if built[0] == 1:
+                return real_factory()
+            return real_factory(registry=registry)
     # Prefix window == the workload's shared-prefix length: a window
     # that swallowed the per-turn suffix would make every turn a
     # distinct key and affinity could never form.
@@ -159,6 +184,24 @@ def build_fleet(*, profile: str = "quick", seed: int = 0,
 
 def _firing(engine: obs_rules.AlertEngine) -> set:
     return {a["rule"] for a in engine.active()}
+
+
+def telemetry_gaps(fleet) -> list:
+    """Replica ids that served requests but are ABSENT as components
+    from the federated serving-TTFT view (empty == full coverage).
+
+    This is the fleet-telemetry gate: a replica recording outside its
+    scoped view (mute-replica inject, or a regression in the factory →
+    registry plumbing) keeps every aggregate SLO number looking
+    healthy while the per-component breakdown silently loses a
+    replica. Must run BEFORE drain/stop — a released replica's scoped
+    series are dropped by design, so post-drain the gap would be
+    indistinguishable from legitimate GC."""
+    snap = fleet.fleet_snapshot()
+    covered = set(snap["components"])
+    served = {rid for rid, s in snap["stats"]["replicas"].items()
+              if s.get("served", 0) > 0}
+    return sorted(served - covered)
 
 
 def warm_phase(fleet, vocab: int, spec: dict, seed: int) -> None:
@@ -263,10 +306,13 @@ def run_fleet_serve(*, profile: str = "quick", seed: int = 0,
     """One standalone fleet-serve episode → ``{passed, ...}``.
 
     Pass criteria: the required oracle verdicts PASS (during-window
-    TTFT + alerts resolved), the fleet-wide prefix hit rate clears
+    TTFT — labeled AND federated over per-component series — plus
+    alerts resolved), the fleet-wide prefix hit rate clears
     :data:`FLEET_HIT_RATE_FLOOR`, every replica's pool reports zero
-    ``check_invariants()`` violations, and a scale-up committed plus a
-    scale-down drained — the full spike → grow → drain → shrink arc.
+    ``check_invariants()`` violations, the federated view covers every
+    replica that served (:func:`telemetry_gaps` — the mute-replica
+    inject flips this), and a scale-up committed plus a scale-down
+    drained — the full spike → grow → drain → shrink arc.
     """
     if inject is not None and inject not in FLEET_SERVE_INJECTS:
         raise ValueError(
@@ -288,6 +334,9 @@ def run_fleet_serve(*, profile: str = "quick", seed: int = 0,
         warm_phase(fleet, vocab, spec, seed)
         spike = spike_phase(fleet, vocab, spec, seed, history,
                             alert_engine)
+        # Coverage gate runs while every replica's scoped series are
+        # still live (drain/release drops them by design).
+        gaps = telemetry_gaps(fleet)
         scaled_down = drain_phase(fleet, alert_engine, clock_skew)
         stats = fleet.stats()
         fleet.stop()
@@ -314,6 +363,7 @@ def run_fleet_serve(*, profile: str = "quick", seed: int = 0,
             stats["kv_invariant_violations"] == 0,
         "scale_up_committed": spike["scale_up_committed"],
         "scale_down_drained": scaled_down,
+        "fleet_view_covers_replicas": not gaps,
     }
     window = obs_history.window_bounds(bundle.history or {}, "scale-up")
     return {
@@ -326,6 +376,7 @@ def run_fleet_serve(*, profile: str = "quick", seed: int = 0,
         "checks": checks,
         "prefix_hit_rate": round(hit_rate, 4),
         "hit_rate_floor": FLEET_HIT_RATE_FLOOR,
+        "telemetry_gaps": gaps,
         "requests": spike["requests"],
         "scale_events": stats["scale_events"],
         "routed": stats["router"]["routed"],

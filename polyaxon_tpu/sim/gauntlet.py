@@ -377,6 +377,38 @@ def _storm_lane(history) -> dict:
         eng.stop()
 
 
+def _skew_drill(engine, plane=None) -> bool:
+    """Drill the ``fleet-replica-skew`` rule's FIRE half on the real
+    evaluate path (ISSUE 20): three component-scoped views record TTFT
+    — two healthy, one far over — ``publish_fleet_rollups`` derives
+    the max/median ratio, and the threshold rule must fire. Teardown
+    then releases the drill components (``drop_component``, the same
+    GC a real replica release runs) and recomputes the rollup; the
+    RESOLVED half is asserted by the caller after the day's stepped
+    alert-clock passes, so the evidence in alert history is the full
+    fire→resolve arc. Returns whether the rule fired."""
+    from polyaxon_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.REGISTRY
+    # Two fast components pin the median near the healthy TTFT; the
+    # slow one is far past threshold x median, so the ratio fires the
+    # rule regardless of what the day's real replicas observed.
+    for comp, ttft in (("drill-a", 0.04), ("drill-b", 0.05),
+                       ("drill-slow", 30.0)):
+        view = reg.scoped(comp)
+        for _ in range(4):
+            obs_metrics.serving_ttft_hist(view).observe(
+                ttft, **{"class": "drill"})
+    obs_metrics.publish_fleet_rollups(reg)
+    engine.evaluate(plane=plane)
+    fired = any(a["rule"] == "fleet-replica-skew"
+                for a in engine.active())
+    for comp in ("drill-slow", "drill-a", "drill-b"):
+        reg.drop_component(comp)
+    obs_metrics.publish_fleet_rollups(reg)
+    return fired
+
+
 def _class_storm_lane(history) -> dict:
     """The ISSUE 19 lane: best-effort traffic camps every decode slot,
     then interactive arrivals must admit via preemptive slot/KV
@@ -613,14 +645,23 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
                     spike = fleet_serve.spike_phase(
                         fleet, vocab, fspec, seed, history, engine,
                         plane=sim.plane)
+                    # Federated-view coverage while every replica's
+                    # scoped series are still live (release drops them).
+                    gaps = fleet_serve.telemetry_gaps(fleet)
                     drained = fleet_serve.drain_phase(
                         fleet, engine, clock_skew, plane=sim.plane)
                     fstats = fleet.stats()
                     traffic[0] += spike["requests"]
+                    # Skew drill: fire the fleet-replica-skew rule on
+                    # scoped drill series; the stepped clock passes at
+                    # the end of the day must then observe it resolve.
+                    skew_fired = _skew_drill(engine, plane=sim.plane)
                     fleet_summary = {
                         "requests": spike["requests"],
                         "scale_up_committed": spike["scale_up_committed"],
                         "scale_down_drained": drained,
+                        "telemetry_gaps": gaps,
+                        "skew_fired": skew_fired,
                         "prefix_hit_rate": fstats["prefix_hit_rate"],
                         "kv_invariant_violations":
                             fstats["kv_invariant_violations"],
@@ -678,6 +719,13 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
         for skew in (600.0, 700.0, 800.0):
             clock_skew[0] = skew
             engine.evaluate(plane=sim.plane)
+        if fleet_summary is not None:
+            # The drill's resolve half: after the stepped passes the
+            # skew rule must be clear (the drilled components were
+            # dropped and the fleet's own teardown unset the gauge).
+            fleet_summary["skew_resolved"] = not any(
+                a["rule"] == "fleet-replica-skew"
+                for a in engine.active())
         bundle = obs_oracle.TelemetryBundle.from_plane(
             sim.plane, engine=engine, baseline=baseline)
         verdicts = obs_oracle.evaluate(invariants, bundle)
@@ -710,6 +758,9 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
         required.append("serving-p99-during-storm")
     if fleet_summary is not None:
         required.append("serving-ttft-during-scaleup")
+        # The fleet-federated TTFT invariant judges the merged
+        # per-component series over the same window (ISSUE 20).
+        required.append("serving-ttft-federated-during-scaleup")
     if lane_summary is not None:
         required.append("decode-tpot-during-prompt-storm")
     if class_lane_summary is not None:
@@ -726,7 +777,13 @@ def run_cluster_day(*, profile: str = "quick", seed: int = GAUNTLET_SEED,
     fleet_held = (fleet_summary is None
                   or ((fleet_summary["prefix_hit_rate"] or 0.0) > 0
                       and fleet_summary["kv_invariant_violations"] == 0
-                      and fleet_summary["scale_up_committed"]))
+                      and fleet_summary["scale_up_committed"]
+                      # ISSUE 20: every serving replica present in the
+                      # federated view, and the skew rule drilled
+                      # through its full fire→resolve arc.
+                      and not fleet_summary["telemetry_gaps"]
+                      and fleet_summary["skew_fired"]
+                      and fleet_summary["skew_resolved"]))
     # The storm lane's own acceptance (ISSUE 18): pages really crossed
     # the prefill→decode boundary and the pool's refcount/CoW
     # invariants held through every handoff.

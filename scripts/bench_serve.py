@@ -146,10 +146,15 @@ def _slo_percentiles() -> dict:
     out: dict[str, dict] = {}
     for stem, hist in (("ttft", obs_metrics.serving_ttft_hist()),
                        ("tpot", obs_metrics.serving_tpot_hist())):
-        for klass in hist.snapshot()["series"]:
+        # Under a fleet each series key may carry a trailing replica
+        # component ("interactive,r0"); the per-class numbers here are
+        # the FEDERATED view, so strip to the base class and merge
+        # every component's buckets.
+        classes = {key.split(",")[0] for key in hist.snapshot()["series"]}
+        for klass in classes:
             entry = out.setdefault(klass or "batch", {})
             for q, tag in ((0.5, "p50"), (0.99, "p99")):
-                value = hist.quantile(q, **{"class": klass})
+                value = hist.quantile_merged(q, **{"class": klass})
                 entry[f"{stem}_{tag}_s"] = (round(value, 4)
                                             if value is not None else None)
     return out
@@ -684,6 +689,13 @@ def run_fleet(model: str, prompts: list[list[int]], max_new: int,
             t.join()
         wall = time.monotonic() - t0
         stats = fleet.stats()
+        # Per-replica breakdown from the component-scoped series
+        # (ISSUE 20): which replica served how much, at what TTFT,
+        # evicting how often — the routing A/B's per-node evidence.
+        per_replica = fleet.per_replica_telemetry()
+        for rid, row in per_replica.items():
+            row["served"] = (stats["replicas"].get(rid)
+                             or {}).get("served", 0)
     finally:
         fleet.stop()
     lat.sort()
@@ -699,6 +711,7 @@ def run_fleet(model: str, prompts: list[list[int]], max_new: int,
         "prefill_tokens_skipped": stats["prefill_tokens_skipped"],
         "kv_invariant_violations": stats["kv_invariant_violations"],
         "routed": stats["router"]["routed"],
+        "per_replica": per_replica,
     }
 
 

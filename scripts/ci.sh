@@ -298,6 +298,19 @@ if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --fleet-serve --quick \
     echo "fleet-serve self-test FAILED: cold scale-up passed the TTFT oracle"
     exit 1
 fi
+# Fleet telemetry (ISSUE 20): every replica records through its own
+# component-scoped registry view, the oracle judges the FEDERATED
+# per-component series, and the coverage gate requires every replica
+# that served to appear as a component. The red-team half: building
+# one replica without its scoped view (it records unscoped — every
+# aggregate SLO number still looks healthy) must flip the episode to
+# exit 1 on federated-view coverage.
+echo "== fleet telemetry (scoped views + federated coverage)"
+if JAX_PLATFORMS=cpu python -m polyaxon_tpu.sim --fleet-serve --quick \
+    --inject mute-replica >/dev/null 2>&1; then
+    echo "fleet-telemetry self-test FAILED: muted replica passed the federated-view gate"
+    exit 1
+fi
 # Communication-audit stage: compile every standard schedule's REAL
 # train step on the 8-device virtual CPU mesh, census the collectives
 # in the compiled HLO, and gate against polyaxon_tpu/perf/budgets.json

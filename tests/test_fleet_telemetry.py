@@ -1,0 +1,673 @@
+"""Fleet-scoped telemetry (ISSUE 20): component-stamped series behind
+scoped registry views, federated reads (sum/max/bucket-merge) judged
+over every replica's series, series GC as release discipline, the TTFT
+skew rollup, trace-context propagation router → engine (ONE tree per
+trace id through an eviction→readmit arc), and the fleet-wide request
+lookup fan-out."""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.obs import metrics as obs_metrics
+from polyaxon_tpu.obs import oracle as obs_oracle
+from polyaxon_tpu.obs import reqtrace
+from polyaxon_tpu.obs import rules as obs_rules
+from polyaxon_tpu.obs.analyze import request_phases
+from polyaxon_tpu.obs.trace import Span, build_timeline
+from polyaxon_tpu.serving.fleet import ServingFleet
+from polyaxon_tpu.serving.router import FleetRouter
+from polyaxon_tpu.sim import fleet_serve
+
+
+def _reg():
+    return obs_metrics.MetricsRegistry()
+
+
+def _conv(c, n=8):
+    return [c * 131 + j for j in range(n)]
+
+
+# ==================================================== scoped recording
+class TestScopedSeries:
+    def test_scoped_view_stamps_component(self):
+        reg = _reg()
+        c = reg.counter("t_requests_total", "", ("klass",))
+        c.inc(2, klass="a")
+        reg.scoped("r0").counter("t_requests_total", "",
+                                 ("klass",)).inc(3, klass="a")
+        snap = c.snapshot()
+        assert snap["series"] == {"a": 2.0, "a,r0": 3.0}
+        # unscoped and scoped reads stay disjoint
+        assert c.value(klass="a") == 2.0
+        assert reg.scoped("r0").counter(
+            "t_requests_total", "", ("klass",)).value(klass="a") == 3.0
+        assert c.components() == {"", "r0"}
+
+    def test_snapshot_labels_append_component_only_when_scoped(self):
+        reg = _reg()
+        g = reg.gauge("t_depth", "", ("q",))
+        g.set(1, q="x")
+        assert g.snapshot()["labels"] == ["q"]  # unscoped-only: unchanged
+        reg.scoped("r1").gauge("t_depth", "", ("q",)).set(2, q="x")
+        assert g.snapshot()["labels"] == ["q", "component"]
+
+    def test_render_carries_component_label(self):
+        reg = _reg()
+        h = reg.histogram("t_lat", "", buckets=(0.1, 1.0))
+        reg.scoped("r2").histogram("t_lat", "",
+                                   buckets=(0.1, 1.0)).observe(0.05)
+        text = "\n".join(h.render())
+        assert 'component="r2"' in text
+        h.observe(0.05)  # unscoped series renders without the label
+        assert "t_lat_bucket{le=\"0.1\"} 1" in "\n".join(h.render())
+
+    def test_scoped_view_survives_registry_reset(self):
+        """Views are stateless (base instrument re-resolved per call) —
+        the bench resets the registry after warmup and the replica's
+        view must keep recording into the fresh instruments."""
+        reg = _reg()
+        view = reg.scoped("r0")
+        view.counter("t_total", "").inc()
+        reg._metrics.clear()  # the reset() core, sans global hooks
+        view.counter("t_total", "").inc(5)
+        # ("": 0.0 is the no-label instrument's seeded unscoped series)
+        assert reg.counter("t_total", "").snapshot()["series"] == {
+            "": 0.0, "r0": 5.0}
+
+    def test_overflow_fold_preserves_component(self):
+        """The cardinality-cap fold keeps the component suffix so a
+        replica's accounting survives an overflowing base label."""
+        reg = _reg()
+        c = reg.counter("t_cap_total", "", ("user",), max_series=2)
+        view = reg.scoped("r0")
+        sc = view.counter("t_cap_total", "", ("user",), max_series=2)
+        sc.inc(user="u1")
+        sc.inc(user="u2")
+        sc.inc(user="u3")  # folds — but stays r0's
+        totals = c.total_by_component()
+        assert totals == {"r0": 3.0}
+
+
+# ========================================================= federation
+class TestFederation:
+    def _ttft(self, reg):
+        return obs_metrics.serving_ttft_hist(reg)
+
+    def test_federate_sums_counters_and_maxes_gauges(self):
+        reg = _reg()
+        reg.counter("t_total", "", ("klass",)).inc(2, klass="a")
+        reg.scoped("r0").counter("t_total", "",
+                                 ("klass",)).inc(3, klass="a")
+        reg.scoped("r1").counter("t_total", "",
+                                 ("klass",)).inc(5, klass="a")
+        reg.gauge("t_g", "").set(1)
+        reg.scoped("r0").gauge("t_g", "").set(7)
+        reg.scoped("r1").gauge("t_g", "").set(3)
+        fed = reg.federate()
+        assert fed["t_total"]["series"] == {"a": 10.0}
+        assert fed["t_total"]["components"] == ["", "r0", "r1"]
+        assert fed["t_g"]["series"] == {"": 7.0}  # worst-series view
+
+    def test_federate_merges_histogram_buckets(self):
+        reg = _reg()
+        h = reg.histogram("t_h", "", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        reg.scoped("r0").histogram("t_h", "",
+                                   buckets=(0.1, 1.0)).observe(0.5)
+        reg.scoped("r1").histogram("t_h", "",
+                                   buckets=(0.1, 1.0)).observe(5.0)
+        merged = reg.federate()["t_h"]["series"][""]
+        assert merged["count"] == 3
+        assert merged["buckets"] == {"0.1": 1, "1": 1, "+Inf": 1}
+        assert merged["sum"] == pytest.approx(5.55)
+
+    def test_quantile_merged_is_the_federated_distribution(self):
+        reg = _reg()
+        hist = self._ttft(reg)
+        for _ in range(4):
+            obs_metrics.serving_ttft_hist(
+                reg.scoped("r0")).observe(0.04, **{"class": "interactive"})
+            obs_metrics.serving_ttft_hist(
+                reg.scoped("r1")).observe(0.04, **{"class": "interactive"})
+        # per-component and federated agree when the replicas agree
+        by_comp = hist.quantile_by_component(0.5)
+        assert set(by_comp) == {"r0", "r1"}
+        merged = hist.quantile_merged(0.5, **{"class": "interactive"})
+        assert merged == pytest.approx(by_comp["r0"])
+        # ...and for an unscoped-only registry merged == plain quantile
+        solo = _reg()
+        sh = self._ttft(solo)
+        sh.observe(0.04, **{"class": "interactive"})
+        assert (sh.quantile_merged(0.5, **{"class": "interactive"})
+                == sh.quantile(0.5, **{"class": "interactive"}))
+
+    def test_match_series_component_is_a_wildcard(self):
+        """A {class: interactive} selector keeps matching every
+        replica's series once the fleet records scoped — the property
+        that lets existing rules/invariants judge federated."""
+        names = ("class",)
+        assert obs_metrics.match_series(
+            names, "interactive,r0", {"class": "interactive"})
+        assert obs_metrics.match_series(
+            names, "interactive", {"class": "interactive"})
+        assert not obs_metrics.match_series(
+            names, "batch,r0", {"class": "interactive"})
+        # the component dimension is addressable when named
+        assert obs_metrics.match_series(
+            names, "interactive,r0", {"component": "r0"})
+        assert not obs_metrics.match_series(
+            names, "interactive,r0", {"component": ""})
+        assert obs_metrics.match_series(names, "interactive,r0", None)
+
+    def test_oracle_selection_merges_scoped_series(self):
+        """The metric_during judgment path: a labels selector that
+        doesn't name the component merges every replica's sample into
+        one federated histogram."""
+        reg = _reg()
+        for comp, v in (("r0", 0.1), ("r1", 0.3)):
+            for _ in range(4):
+                obs_metrics.serving_ttft_hist(
+                    reg.scoped(comp)).observe(v, **{"class": "interactive"})
+        family = obs_metrics.serving_ttft_hist(reg).snapshot()
+        sample = obs_oracle._select_series(
+            family, {"class": "interactive"})
+        assert sample["count"] == 8
+        assert sample["sum"] == pytest.approx(1.6)
+
+    def test_catalog_carries_fleet_telemetry_entries(self):
+        rule_ids = {r.id for r in obs_rules.load_ruleset()}
+        assert "fleet-replica-skew" in rule_ids
+        assert "serving-ttft-slo-burn" in rule_ids
+        inv_ids = {i.id for i in obs_oracle.load_invariants()}
+        assert "serving-ttft-federated-during-scaleup" in inv_ids
+
+
+# ============================================== series GC on release
+class TestSeriesRemoval:
+    def test_counter_and_histogram_remove_parity_with_gauge_unset(self):
+        reg = _reg()
+        c = reg.counter("t_c", "", ("k",))
+        c.inc(k="x")
+        c.remove(k="x")
+        assert c.snapshot()["series"] == {}
+        h = reg.histogram("t_h", "", ("k",), buckets=(1.0,))
+        h.observe(0.5, k="x")
+        h.remove(k="x")
+        assert h.snapshot()["series"] == {}
+        assert h.quantile(0.5, k="x") is None  # no value, not stale
+
+    def test_scoped_remove_leaves_other_components(self):
+        reg = _reg()
+        for comp in ("r0", "r1"):
+            reg.scoped(comp).counter("t_c", "", ("k",)).inc(k="x")
+        reg.scoped("r0").counter("t_c", "", ("k",)).remove(k="x")
+        assert reg.counter("t_c", "", ("k",)).components() == {"r1"}
+
+    def test_drop_component_sweeps_every_instrument(self):
+        reg = _reg()
+        view = reg.scoped("r3")
+        view.counter("t_c", "", ("k",)).inc(k="a")
+        view.counter("t_c", "", ("k",)).inc(k="b")
+        view.gauge("t_g", "").set(2)
+        view.histogram("t_h", "", buckets=(1.0,)).observe(0.5)
+        reg.scoped("r4").gauge("t_g", "").set(9)
+        assert reg.drop_component("r3") == 4  # exact eviction accounting
+        for name in ("t_c", "t_g", "t_h"):
+            assert "r3" not in reg.get(name).components()
+        # "" is the no-label gauge's seeded unscoped series — drop
+        # only swept r3's
+        assert reg.gauge("t_g", "").components() == {"", "r4"}
+        assert reg.drop_component("") == 0  # unscoped is never swept
+
+    def test_dropped_component_leaves_federated_reads(self):
+        reg = _reg()
+        for comp, v in (("r0", 0.04), ("r1", 30.0)):
+            obs_metrics.serving_ttft_hist(
+                reg.scoped(comp)).observe(v, **{"class": "interactive"})
+        hist = obs_metrics.serving_ttft_hist(reg)
+        assert "r1" in hist.quantile_by_component(0.99)
+        reg.drop_component("r1")
+        assert set(hist.quantile_by_component(0.99)) == {"r0"}
+        # the dead replica's slow tail no longer weights the federation
+        assert hist.quantile_merged(
+            0.99, **{"class": "interactive"}) < 1.0
+
+
+# ======================================================= skew rollup
+class TestFleetRollups:
+    def _observe(self, reg, comp, value, n=4):
+        for _ in range(n):
+            obs_metrics.serving_ttft_hist(
+                reg.scoped(comp)).observe(value, **{"class": "interactive"})
+
+    def test_rollup_unset_below_two_components(self):
+        reg = _reg()
+        self._observe(reg, "r0", 0.04)
+        obs_metrics.publish_fleet_rollups(reg)
+        assert obs_metrics.fleet_ttft_skew(reg).snapshot()["series"] == {}
+
+    def test_rollup_fires_on_hot_outlier_and_recovers(self):
+        reg = _reg()
+        self._observe(reg, "r0", 0.04)
+        self._observe(reg, "r1", 0.05)
+        self._observe(reg, "r2", 30.0)
+        obs_metrics.publish_fleet_rollups(reg)
+        gauge = obs_metrics.fleet_ttft_skew(reg)
+        assert gauge.value() > 3.0  # the fleet-replica-skew threshold
+        # the outlier releases: the ratio recomputes over survivors...
+        reg.drop_component("r2")
+        obs_metrics.publish_fleet_rollups(reg)
+        assert 0 < gauge.value() < 3.0
+        # ...and with one survivor the ratio is undefined, not stale
+        reg.drop_component("r1")
+        obs_metrics.publish_fleet_rollups(reg)
+        assert gauge.snapshot()["series"] == {}
+
+    def test_rollup_accepts_scoped_view(self):
+        """A rollup is a fleet-wide read by definition — handing it a
+        replica's view must unwrap to the base registry."""
+        reg = _reg()
+        self._observe(reg, "r0", 0.04)
+        self._observe(reg, "r1", 0.05)
+        obs_metrics.publish_fleet_rollups(reg.scoped("r0"))
+        assert obs_metrics.fleet_ttft_skew(reg).value() > 0
+
+
+# ===================================================== fleet plumbing
+class _Result:
+    def __init__(self, rid=None):
+        self.id = rid
+        self.done = threading.Event()
+        self.done.set()
+
+    def wait(self, timeout=None):
+        return [1]
+
+
+class _TraceFake:
+    """Fake engine exposing the full trace-propagation surface."""
+
+    def __init__(self, registry=None):
+        self._obs = registry
+        self.submits = []
+
+    def generate(self, rows, max_new_tokens, **kw):
+        return [[0]] * len(rows)
+
+    def submit(self, tokens, max_new_tokens, *, request_id=None,
+               trace_parent=None, route_record=None, klass="batch",
+               **kw):
+        self.submits.append({
+            "tokens": list(tokens), "request_id": request_id,
+            "trace_parent": trace_parent, "route_record": route_record,
+            "klass": klass})
+        if self._obs is not None:
+            obs_metrics.serving_ttft_hist(self._obs).observe(
+                0.02 + 0.01 * len(self.submits), **{"class": klass})
+            if klass == "best-effort":
+                obs_metrics.serving_preemptions_total(self._obs).inc(
+                    **{"class": klass, "reason": "slots"})
+        return _Result(request_id)
+
+    def health(self):
+        return {"status": "ok", "queued": 0, "active": 0}
+
+    def stats(self):
+        return {"prefill_tokens_total": 0, "prefill_tokens_skipped": 0,
+                "kv_invariant_violations": 0,
+                "requests_served": len(self.submits)}
+
+    def stop(self):
+        pass
+
+
+class _LegacyFake(_TraceFake):
+    """Strict-signature submit: no trace kwargs (pre-ISSUE-20 engine)."""
+
+    def submit(self, tokens, max_new_tokens):  # noqa: D102
+        self.submits.append({"tokens": list(tokens)})
+        return _Result()
+
+
+def _fake_fleet(cls=_TraceFake, *, replicas=2, mute_first=False, **kw):
+    reg = _reg()
+    engines = {}
+
+    def factory(registry=None):
+        view = (None if (mute_first and not engines)
+                else registry)
+        eng = cls(view)
+        engines[getattr(registry, "component", f"e{len(engines)}")] = eng
+        return eng
+
+    # The router is built with the default (global) registry on
+    # purpose: ServingFleet rebinds exactly that case to a `router`
+    # view of ITS base registry — the assertion that `router` series
+    # land scoped in `reg` is the rebind working.
+    fleet = ServingFleet(
+        factory, replicas=replicas, standby=0, max_replicas=replicas + 1,
+        prewarm=False, router=FleetRouter(seed=1),
+        registry=reg, cooldown=0.0, idle_hold=0.0, **kw)
+    fleet.start()
+    return fleet, engines, reg
+
+
+class TestFleetTracePropagation:
+    def test_submit_propagates_trace_context(self):
+        fleet, engines, _ = _fake_fleet()
+        try:
+            req, decision = fleet.submit(_conv(3), 4, klass="interactive")
+            eng = engines[decision.replica]
+            sub = eng.submits[-1]
+            assert sub["request_id"] == req.id
+            record = sub["route_record"]
+            assert record["name"] == "route"
+            assert record["component"] == "router"
+            assert record["trace_id"] == req.id
+            assert record["end"] is not None  # closed pre-hop
+            assert sub["trace_parent"] == record["span_id"]
+            attrs = record["attributes"]
+            assert attrs["decision"] == decision.reason
+            assert attrs["replica"] == decision.replica
+            # candidate telemetry names every ready replica
+            assert set(attrs["candidates"]) == {"r0", "r1"}
+        finally:
+            fleet.stop()
+
+    def test_caller_request_id_wins(self):
+        fleet, engines, _ = _fake_fleet()
+        try:
+            req, decision = fleet.submit(
+                _conv(4), 4, request_id="feedc0de", klass="batch")
+            assert req.id == "feedc0de"
+            assert (engines[decision.replica].submits[-1]["route_record"]
+                    ["trace_id"] == "feedc0de")
+        finally:
+            fleet.stop()
+
+    def test_legacy_engine_falls_back_without_trace_kwargs(self):
+        fleet, engines, _ = _fake_fleet(_LegacyFake)
+        try:
+            req, decision = fleet.submit(_conv(5), 4)
+            assert engines[decision.replica].submits[-1]["tokens"] == \
+                _conv(5)
+        finally:
+            fleet.stop()
+
+
+class TestPerReplicaSeries:
+    def test_preemption_and_ttft_series_separate_by_replica(self):
+        """Satellite: metrics recorded under fleet routing carry the
+        admitting replica's component — totals reconcile exactly
+        against what each engine actually served."""
+        fleet, engines, reg = _fake_fleet()
+        try:
+            for i in range(16):  # distinct conversations spread by hash
+                fleet.submit(_conv(i), 4, klass="best-effort")
+            totals = obs_metrics.serving_preemptions_total(
+                reg).total_by_component()
+            assert "" not in totals  # nothing leaked unscoped
+            by_engine = {rid: sum(1 for s in e.submits
+                                  if s.get("klass") == "best-effort")
+                         for rid, e in engines.items() if e.submits}
+            assert len(by_engine) == 2, "seed must exercise both replicas"
+            assert totals == {rid: float(n)
+                              for rid, n in by_engine.items()}
+            per = fleet.per_replica_telemetry()
+            assert set(per) == set(by_engine)
+            for rid, row in per.items():
+                assert row["preemptions"] == by_engine[rid]
+                assert row["ttft_p50_ms"] > 0
+            snap = fleet.fleet_snapshot()
+            assert snap["components"] == sorted(by_engine)
+            assert snap["ttft_skew"] is not None  # >= 2 components
+            # the router's own series landed under its component
+            assert "router" in obs_metrics.fleet_routed_total(
+                reg).components()
+        finally:
+            fleet.stop()
+
+    def test_fleet_snapshot_skew_undefined_below_two_replicas(self):
+        fleet, _, _ = _fake_fleet(replicas=1)
+        try:
+            fleet.submit(_conv(1), 4, klass="interactive")
+            assert fleet.fleet_snapshot()["ttft_skew"] is None
+        finally:
+            fleet.stop()
+
+    def test_scale_down_drops_released_replica_series(self):
+        """Release discipline: the victim's scoped series AND the
+        fleet-recorded queue-depth series about it both vanish."""
+        fleet, engines, reg = _fake_fleet(replicas=3)
+        try:
+            for i in range(16):
+                fleet.submit(_conv(i), 4, klass="interactive")
+            fleet.poll()
+            depth = obs_metrics.fleet_replica_queue_depth(reg)
+            assert "r2" in {obs_metrics.series_key_labels(
+                ("replica",), k)["replica"]
+                for k in depth.snapshot()["series"]}
+            ev = fleet.scale_down(timeout=5.0)
+            assert ev["replica"] == "r2"
+            assert fleet.wait_settled(timeout=10.0)
+            hist = obs_metrics.serving_ttft_hist(reg)
+            assert "r2" not in hist.components()
+            assert "r2" not in {obs_metrics.series_key_labels(
+                ("replica",), k)["replica"]
+                for k in depth.snapshot()["series"]}
+            # survivors keep their series
+            assert hist.components()
+            assert hist.components() <= {"r0", "r1"}
+        finally:
+            fleet.stop()
+
+    def test_stop_unsets_skew_rollup(self):
+        fleet, _, reg = _fake_fleet()
+        try:
+            for i in range(8):
+                fleet.submit(_conv(i), 4, klass="interactive")
+            fleet.poll()
+        finally:
+            fleet.stop()
+        assert obs_metrics.fleet_ttft_skew(
+            reg).snapshot()["series"] == {}
+
+    def test_telemetry_gaps_catch_a_muted_replica(self):
+        """The mute-replica gate: a replica built without its scoped
+        view serves traffic but is absent from the federated
+        per-component breakdown — exactly what flips CI."""
+        fleet, engines, _ = _fake_fleet(mute_first=True)
+        try:
+            for i in range(16):
+                fleet.submit(_conv(i), 4, klass="interactive")
+            assert all(e.submits for e in engines.values()), \
+                "both replicas must serve for the gap to be provable"
+            assert fleet_serve.telemetry_gaps(fleet) == ["r0"]
+        finally:
+            fleet.stop()
+
+    def test_no_gaps_when_every_replica_records_scoped(self):
+        fleet, engines, _ = _fake_fleet()
+        try:
+            for i in range(16):
+                fleet.submit(_conv(i), 4, klass="interactive")
+            assert fleet_serve.telemetry_gaps(fleet) == []
+        finally:
+            fleet.stop()
+
+
+# ================================================ fleet request lookup
+class _RingFake(_TraceFake):
+    def __init__(self, registry=None):
+        super().__init__(registry)
+        self.ring = reqtrace.TimelineRing()
+
+    def recent_requests(self):
+        return self.ring.summaries()
+
+    def request_timeline(self, request_id):
+        return self.ring.timeline(request_id)
+
+
+class TestFleetRequestLookup:
+    def _trace(self, rid, start, klass="interactive"):
+        t = reqtrace.RequestTrace(rid, klass=klass)
+        t.root.start = start
+        t.finish()
+        return t
+
+    def test_recent_requests_fans_out_and_stamps_replica(self):
+        fleet, engines, _ = _fake_fleet(_RingFake)
+        try:
+            engines["r0"].ring.add(self._trace("aa01", 100.0))
+            engines["r1"].ring.add(self._trace("bb02", 200.0))
+            rows = fleet.recent_requests()
+            assert [(r["request_id"], r["replica"]) for r in rows] == [
+                ("bb02", "r1"), ("aa01", "r0")]  # newest first
+        finally:
+            fleet.stop()
+
+    def test_request_timeline_searches_every_ring(self):
+        fleet, engines, _ = _fake_fleet(_RingFake)
+        try:
+            engines["r1"].ring.add(self._trace("cc03", 50.0))
+            tl = fleet.request_timeline("cc03")
+            assert tl is not None and tl["trace_id"] == "cc03"
+            assert fleet.request_timeline("dead") is None
+        finally:
+            fleet.stop()
+
+    def test_lookup_skips_engines_without_rings(self):
+        fleet, _, _ = _fake_fleet(_TraceFake)  # no recent_requests
+        try:
+            assert fleet.recent_requests() == []
+            assert fleet.request_timeline("anything") is None
+        finally:
+            fleet.stop()
+
+
+# ============================================= cross-component timeline
+class TestCrossComponentTimeline:
+    def _arc(self):
+        """A routed request that gets evicted and readmitted — the
+        span shapes the engine records, driven directly."""
+        rid = reqtrace.new_request_id()
+        route = Span(trace_id=rid, name="route", component="router",
+                     attributes={"decision": "affinity", "replica": "r1",
+                                 "candidates": {"r0": 0, "r1": 2}})
+        route.end = time.time()
+        tr = reqtrace.RequestTrace(
+            rid, klass="best-effort", component="r1",
+            parent_id=route.span_id, extra_records=[route.to_record()])
+        tr.start_phase("queue_wait")
+        tr.start_phase("prefill")
+        tr.event("preempted", reason="slots", slot=0)
+        tr.start_phase("queue_wait", requeued=True)
+        tr.start_phase("prefill")
+        tr.start_phase("decode")
+        tr.event("first_token")
+        tr.finish(tokens_out=4)
+        return rid, tr
+
+    def test_route_span_parents_the_request_tree(self):
+        rid, tr = self._arc()
+        tl = build_timeline(tr.records(), trace_id=rid)
+        assert tl["span_count"] == 7  # route + request + 5 phases
+        assert len(tl["spans"]) == 1, "ONE tree — no orphan roots"
+        root = tl["spans"][0]
+        assert (root["name"], root["component"]) == ("route", "router")
+        assert len(root["children"]) == 1
+        request = root["children"][0]
+        assert (request["name"], request["component"]) == ("request", "r1")
+        names = [c["name"] for c in request["children"]]
+        assert names.count("queue_wait") == 2
+        assert names.count("prefill") == 2
+        assert names.count("decode") == 1
+        requeued = [c for c in request["children"]
+                    if c["name"] == "queue_wait"
+                    and (c.get("attributes") or {}).get("requeued")]
+        assert len(requeued) == 1
+        # every engine-side hop names the replica, not generic serving
+        assert all(c["component"] == "r1"
+                   for c in request["children"])
+
+    def test_request_phases_reports_route_and_replica(self):
+        rid, tr = self._arc()
+        summary = request_phases(build_timeline(tr.records(),
+                                                trace_id=rid))
+        assert summary["request_id"] == rid
+        assert summary["route"] == {"decision": "affinity",
+                                    "replica": "r1"}
+        assert summary["replica"] == "r1"
+        # route is an upstream decision, never an engine phase
+        assert set(summary["phases_ms"]) == {"queue_wait", "prefill",
+                                             "decode"}
+        assert summary["events"]["preempted"] == 1
+        assert summary["ttft_ms"] is not None
+
+
+# =============================================== real engine, end to end
+class TestRealEngineFleetArc:
+    def test_one_trace_id_one_fleet_timeline_through_eviction(self):
+        """Acceptance: a request routed by the fleet, evicted by a
+        higher class, and readmitted yields ONE timeline whose route
+        span parents the engine's request tree through the
+        eviction→readmit arc — with the replica's identity on the
+        engine spans and on the preemption/TTFT series."""
+        from polyaxon_tpu.serving.fleet import engine_factory
+
+        reg = _reg()
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        fleet = ServingFleet(
+            engine_factory("llama_tiny", slots=1, kv="paged",
+                           page_size=4, max_len=64),
+            replicas=1, standby=0, max_replicas=1, prewarm=True,
+            warmup_rows=[prompt], router=FleetRouter(seed=0,
+                                                     registry=reg),
+            registry=reg, cooldown=1.0, idle_hold=1.0)
+        fleet.start()
+        try:
+            be, d_be = fleet.submit(prompt, 24, klass="best-effort")
+            while not be.out:  # live and decoding before the rival
+                time.sleep(0.005)
+            ia, d_ia = fleet.submit([7, 7, 7], 2, klass="interactive")
+            ia.wait(timeout=300)
+            be.wait(timeout=300)
+            assert be.preemptions >= 1
+            assert d_be.replica == d_ia.replica == "r0"
+
+            tl = fleet.request_timeline(be.id)
+            assert tl is not None and tl["trace_id"] == be.id
+            assert len(tl["spans"]) == 1
+            root = tl["spans"][0]
+            assert (root["name"], root["component"]) == ("route",
+                                                         "router")
+            assert root["attributes"]["replica"] == "r0"
+            request = next(c for c in root["children"]
+                           if c["name"] == "request")
+            assert request["component"] == "r0"
+            summary = request_phases(tl)
+            assert summary["route"]["replica"] == "r0"
+            assert summary["replica"] == "r0"
+            assert summary["events"].get("preempted", 0) >= 1
+            assert summary["phases_ms"].get("queue_wait", 0) >= 0
+            requeued = [s for s in request["children"]
+                        if s["name"] == "queue_wait"
+                        and (s.get("attributes") or {}).get("requeued")]
+            assert requeued, "readmit must reopen queue_wait in-tree"
+
+            # the series side of the same story: everything the engine
+            # recorded carries its component
+            assert obs_metrics.serving_preemptions_total(
+                reg).total_by_component().get("r0", 0) >= 1
+            assert obs_metrics.serving_ttft_hist(
+                reg).components() == {"r0"}
+            snap = fleet.fleet_snapshot()
+            assert snap["per_replica"]["r0"]["preemptions"] >= 1
+            assert snap["ttft_skew"] is None  # one replica: undefined
+            assert fleet_serve.telemetry_gaps(fleet) == []
+        finally:
+            fleet.stop()
